@@ -1,0 +1,17 @@
+"""Test bootstrap: force a virtual 8-device CPU mesh BEFORE jax imports.
+
+Multi-chip hardware is unavailable here; sharding paths are validated on a
+virtual CPU mesh exactly as the driver's dryrun does (task brief).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

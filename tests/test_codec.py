@@ -1,0 +1,120 @@
+import pytest
+
+from tpukube.core import codec
+from tpukube.core.mesh import MeshSpec
+from tpukube.core.types import (
+    AllocResult,
+    ChipInfo,
+    Health,
+    NodeInfo,
+    PodGroup,
+    PodInfo,
+    TopologyCoord,
+)
+
+
+def _node() -> tuple[NodeInfo, MeshSpec]:
+    mesh = MeshSpec(dims=(4, 4, 1), host_block=(2, 2, 1))
+    chips = [
+        ChipInfo("chip-0", 0, TopologyCoord(0, 0, 0), hbm_bytes=16 << 30),
+        ChipInfo(
+            "chip-1", 1, TopologyCoord(1, 0, 0), hbm_bytes=16 << 30,
+            health=Health.UNHEALTHY,
+        ),
+    ]
+    return NodeInfo(name="host-0-0-0", chips=chips, shares_per_chip=2), mesh
+
+
+def test_node_topology_roundtrip():
+    node, mesh = _node()
+    payload = codec.encode_node_topology(node, mesh)
+    node2, mesh2 = codec.decode_node_topology(payload)
+    assert mesh2 == mesh
+    assert node2.name == node.name
+    assert node2.shares_per_chip == 2
+    assert len(node2.chips) == 2
+    assert node2.chips[1].health is Health.UNHEALTHY
+    assert node2.chips[0].coord == TopologyCoord(0, 0, 0)
+    assert node2.chips[0].hbm_bytes == 16 << 30
+
+
+def test_node_from_annotations_checks_name():
+    node, mesh = _node()
+    annos = codec.annotate_node(node, mesh)
+    got = codec.node_from_annotations("host-0-0-0", annos)
+    assert got is not None and got[0].name == "host-0-0-0"
+    with pytest.raises(codec.CodecError):
+        codec.node_from_annotations("other-node", annos)
+    assert codec.node_from_annotations("n", {}) is None
+
+
+def test_node_topology_rejects_bad_payloads():
+    with pytest.raises(codec.CodecError):
+        codec.decode_node_topology("not json")
+    with pytest.raises(codec.CodecError):
+        codec.decode_node_topology('{"v":99,"node":"n","mesh":{},"chips":[]}')
+
+
+def test_alloc_roundtrip():
+    a = AllocResult(
+        pod_key="default/train-3",
+        node_name="host-1-0-0",
+        device_ids=["tpu-0", "tpu-1"],
+        coords=[TopologyCoord(2, 0, 0), TopologyCoord(3, 0, 0)],
+        env={"TPU_VISIBLE_CHIPS": "0,1"},
+    )
+    b = codec.decode_alloc(codec.encode_alloc(a))
+    assert b == a
+
+
+def test_pod_group_annotations_roundtrip():
+    g = PodGroup(name="llama-train", min_member=16, shape=(4, 4, 1))
+    annos = codec.pod_group_annotations(g)
+    g2 = codec.pod_group_from_annotations(annos)
+    assert g2 == g
+
+
+def test_pod_group_shape_optional_and_padded():
+    g = codec.pod_group_from_annotations(
+        {codec.ANNO_POD_GROUP: "g", codec.ANNO_POD_GROUP_MIN_MEMBER: "4"}
+    )
+    assert g == PodGroup("g", 4, None)
+    g = codec.pod_group_from_annotations(
+        {
+            codec.ANNO_POD_GROUP: "g",
+            codec.ANNO_POD_GROUP_MIN_MEMBER: "4",
+            codec.ANNO_POD_GROUP_SHAPE: "4x2",
+        }
+    )
+    assert g.shape == (4, 2, 1)
+
+
+def test_pod_group_absent():
+    assert codec.pod_group_from_annotations({}) is None
+
+
+def test_pod_group_bad_values():
+    with pytest.raises(codec.CodecError):
+        codec.pod_group_from_annotations(
+            {codec.ANNO_POD_GROUP: "g", codec.ANNO_POD_GROUP_MIN_MEMBER: "lots"}
+        )
+    with pytest.raises(codec.CodecError):
+        codec.pod_group_from_annotations(
+            {
+                codec.ANNO_POD_GROUP: "g",
+                codec.ANNO_POD_GROUP_MIN_MEMBER: "2",
+                codec.ANNO_POD_GROUP_SHAPE: "4xtwo",
+            }
+        )
+
+
+def test_attach_group_idempotent():
+    pod = PodInfo(
+        name="p",
+        annotations=codec.pod_group_annotations(PodGroup("g", 2)),
+    )
+    codec.attach_group(pod)
+    assert pod.group == PodGroup("g", 2)
+    pod.group = PodGroup("explicit", 9)
+    codec.attach_group(pod)  # must not clobber an explicit group
+    assert pod.group.name == "explicit"

@@ -1,0 +1,48 @@
+import pytest
+
+from tpukube.core.config import TpuKubeConfig, load_config
+
+
+def test_defaults():
+    cfg = load_config(env={})
+    assert cfg.resource_tpu == "qiniu.com/tpu"
+    assert cfg.shares_per_chip == 1
+    assert cfg.sim_mesh().num_chips == 64
+    assert cfg.plugin_socket_path().endswith("device-plugins/tpukube.sock")
+
+
+def test_yaml_then_env_precedence(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text("shares_per_chip: 2\nextender_port: 9999\nsim_mesh_dims: [8, 8, 1]\n")
+    cfg = load_config(str(p), env={"TPUKUBE_EXTENDER_PORT": "7777"})
+    assert cfg.shares_per_chip == 2
+    assert cfg.extender_port == 7777  # env wins over yaml
+    assert cfg.sim_mesh_dims == (8, 8, 1)
+
+
+def test_env_tuple_parsing():
+    cfg = load_config(env={"TPUKUBE_SIM_MESH_DIMS": "4x4x2", "TPUKUBE_SIM_TORUS": "true,false,false"})
+    assert cfg.sim_mesh_dims == (4, 4, 2)
+    assert cfg.sim_torus == (True, False, False)
+
+
+def test_rejects_unknown_yaml_key(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text("not_a_key: 1\n")
+    with pytest.raises(ValueError):
+        load_config(str(p), env={})
+
+
+def test_rejects_bad_values():
+    with pytest.raises(ValueError):
+        load_config(env={"TPUKUBE_SHARES_PER_CHIP": "0"})
+    with pytest.raises(ValueError):
+        load_config(env={"TPUKUBE_SCORE_MODE": "chaos"})
+    with pytest.raises(ValueError):
+        load_config(env={"TPUKUBE_BACKEND": "cuda"})
+
+
+def test_config_is_frozen():
+    cfg = TpuKubeConfig()
+    with pytest.raises(Exception):
+        cfg.shares_per_chip = 5  # type: ignore[misc]

@@ -1,0 +1,105 @@
+import pytest
+
+from tpukube.core.mesh import Box, MeshSpec, factor_shapes
+from tpukube.core.types import TopologyCoord
+
+
+def test_mesh_counts():
+    m = MeshSpec(dims=(4, 4, 4), host_block=(2, 2, 1))
+    assert m.num_chips == 64
+    assert m.chips_per_host == 4
+    assert m.num_hosts == 16
+    assert m.host_grid == (2, 2, 4)
+
+
+def test_mesh_rejects_nondividing_host_block():
+    with pytest.raises(ValueError):
+        MeshSpec(dims=(4, 4, 3), host_block=(2, 2, 2))
+
+
+def test_linearize_roundtrip():
+    m = MeshSpec(dims=(4, 2, 3), host_block=(1, 1, 1))
+    seen = set()
+    for c in m.all_coords():
+        i = m.linearize(c)
+        assert m.delinearize(i) == c
+        seen.add(i)
+    assert seen == set(range(m.num_chips))
+
+
+def test_host_partition_covers_mesh_exactly():
+    m = MeshSpec(dims=(4, 4, 2), host_block=(2, 2, 1))
+    all_from_hosts = []
+    for h in m.all_hosts():
+        coords = m.coords_of_host(h)
+        assert len(coords) == m.chips_per_host
+        for c in coords:
+            assert m.host_of(c) == h
+        all_from_hosts.extend(coords)
+    assert len(all_from_hosts) == m.num_chips
+    assert set(all_from_hosts) == set(m.all_coords())
+
+
+def test_host_origin_rejects_bad_names():
+    m = MeshSpec(dims=(4, 4, 1), host_block=(2, 2, 1))
+    with pytest.raises(ValueError):
+        m.host_origin("host-9-0-0")
+    with pytest.raises(ValueError):
+        m.host_origin("gpu-0-0-0")
+
+
+def test_neighbors_interior_and_edge():
+    m = MeshSpec(dims=(4, 4, 4), host_block=(2, 2, 1))
+    assert len(m.neighbors(TopologyCoord(1, 1, 1))) == 6
+    corner = m.neighbors(TopologyCoord(0, 0, 0))
+    assert len(corner) == 3
+    assert set(corner) == {
+        TopologyCoord(1, 0, 0),
+        TopologyCoord(0, 1, 0),
+        TopologyCoord(0, 0, 1),
+    }
+
+
+def test_neighbors_torus_wraps():
+    m = MeshSpec(dims=(4, 4, 1), host_block=(1, 1, 1), torus=(True, True, False))
+    nb = m.neighbors(TopologyCoord(0, 0, 0))
+    assert TopologyCoord(3, 0, 0) in nb and TopologyCoord(0, 3, 0) in nb
+    assert len(nb) == 4
+
+
+def test_neighbors_dim1_axis_skipped():
+    m = MeshSpec(dims=(2, 1, 1), host_block=(1, 1, 1), torus=(True, True, True))
+    # wraparound on a length-2 axis must not duplicate the single neighbor
+    assert m.neighbors(TopologyCoord(0, 0, 0)) == [TopologyCoord(1, 0, 0)]
+
+
+def test_box_coords_and_containment():
+    b = Box(TopologyCoord(1, 1, 0), (2, 2, 1))
+    cs = list(b.coords())
+    assert len(cs) == b.size == 4
+    assert b.contains(TopologyCoord(2, 2, 0))
+    assert not b.contains(TopologyCoord(3, 1, 0))
+    m = MeshSpec(dims=(4, 4, 1), host_block=(2, 2, 1))
+    assert b.fits_in(m)
+    assert not Box(TopologyCoord(3, 3, 0), (2, 1, 1)).fits_in(m)
+
+
+def test_factor_shapes_prefers_compact():
+    shapes = factor_shapes(16, (4, 4, 4))
+    assert shapes[0] in [(4, 4, 1), (4, 2, 2), (2, 4, 2), (2, 2, 4), (1, 4, 4), (4, 1, 4)]
+    # compactness: (4,2,2)-family surface 40 beats (4,4,1) surface 48
+    assert shapes[0] == (2, 2, 4) or shapes[0][0] * shapes[0][1] * shapes[0][2] == 16
+    assert all(a * b * c == 16 for a, b, c in shapes)
+    # nothing exceeds the mesh dims
+    assert all(a <= 4 and b <= 4 and c <= 4 for a, b, c in shapes)
+
+
+def test_factor_shapes_respects_mesh_limits():
+    shapes = factor_shapes(8, (8, 1, 1))
+    assert shapes == [(8, 1, 1)]
+    assert factor_shapes(16, (2, 2, 2)) == []
+
+
+def test_mesh_json_roundtrip():
+    m = MeshSpec(dims=(8, 8, 2), host_block=(2, 2, 1), torus=(True, False, False))
+    assert MeshSpec.from_json(m.to_json()) == m

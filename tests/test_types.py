@@ -1,0 +1,68 @@
+from tpukube.core.types import (
+    RESOURCE_TPU,
+    RESOURCE_VTPU,
+    AllocResult,
+    ContainerInfo,
+    PodInfo,
+    ResourceList,
+    TopologyCoord,
+    iter_pod_device_requests,
+    make_device_id,
+    parse_device_id,
+)
+
+import pytest
+
+
+def test_device_id_roundtrip_whole():
+    d = make_device_id(3)
+    assert d == "tpu-3"
+    assert parse_device_id(d) == (3, None)
+
+
+def test_device_id_roundtrip_frac():
+    d = make_device_id(7, (1, 4))
+    assert d == "tpu-7-frac1of4"
+    assert parse_device_id(d) == (7, (1, 4))
+
+
+@pytest.mark.parametrize("bad", ["gpu-0", "tpu-", "tpu-1-frac", "tpu-1-frac1", "x"])
+def test_device_id_rejects_junk(bad):
+    with pytest.raises(ValueError):
+        parse_device_id(bad)
+
+
+def test_resource_list_arithmetic():
+    cap = ResourceList({RESOURCE_TPU: 4})
+    req = ResourceList({RESOURCE_TPU: 2})
+    assert req.fits(cap)
+    left = cap.minus(req)
+    assert left[RESOURCE_TPU] == 2
+    assert left.nonneg()
+    assert not ResourceList({RESOURCE_TPU: 5}).fits(cap)
+    assert ResourceList().fits(cap)  # empty request always fits
+    assert cap.minus({RESOURCE_TPU: 5})[RESOURCE_TPU] == -1
+
+
+def test_pod_requests_sum_containers():
+    pod = PodInfo(
+        name="p",
+        containers=[
+            ContainerInfo("a", ResourceList({RESOURCE_TPU: 1})),
+            ContainerInfo("b", ResourceList({RESOURCE_TPU: 1, RESOURCE_VTPU: 2})),
+        ],
+    )
+    req = pod.requests()
+    assert req[RESOURCE_TPU] == 2 and req[RESOURCE_VTPU] == 2
+    assert dict(iter_pod_device_requests(pod)) == {RESOURCE_TPU: 2, RESOURCE_VTPU: 2}
+    assert pod.uid == "default/p"
+
+
+def test_alloc_result_chip_indices():
+    a = AllocResult(
+        pod_key="default/p",
+        node_name="host-0-0-0",
+        device_ids=["tpu-0", "tpu-2-frac1of2"],
+        coords=[TopologyCoord(0, 0, 0), TopologyCoord(1, 1, 0)],
+    )
+    assert a.chip_indices() == [0, 2]

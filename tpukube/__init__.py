@@ -1,0 +1,23 @@
+"""tpukube — TPU-native cluster device-plugin + scheduler framework.
+
+A ground-up rebuild of the capability set of qiniu-ava/KubeGPU (a Kubernetes
+GPU device-plugin / scheduler-extender framework, Go + cgo/NVML) for Cloud
+TPUs: libtpu-backed chip enumeration, a deviceplugin/v1beta1 gRPC node agent
+advertising ``qiniu.com/tpu``, fractional vTPU sharing with HBM quotas, a
+scheduler extender scoring ICI-mesh locality, gang scheduling onto contiguous
+sub-slices, and multi-tenant bin-packing + preemption.
+
+The reference tree at /root/reference was empty at survey time (SURVEY.md §0);
+capability parity is defined by BASELINE.json's north_star + five configs and
+SURVEY.md §8's acceptance checklist.
+
+Layer map (SURVEY.md §2):
+  L0 core/     — types, mesh geometry, annotation codec, config
+  L1 native/   — C++ libtpuinfo enumeration shim (sim + real backends)
+  L2 device/   — TpuDevice abstraction, vTPU minting, health
+  L3 plugin/   — deviceplugin/v1beta1 gRPC server + fake kubelet for sim
+  L4 core/codec.py — annotations are the cluster<->node channel
+  L5 sched/    — slicefit, extender, gang, policy
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,17 @@
+"""Core vocabulary: types, mesh geometry, annotation codec, configuration."""
+
+from tpukube.core.types import (  # noqa: F401
+    RESOURCE_TPU,
+    RESOURCE_VTPU,
+    AllocResult,
+    ChipInfo,
+    ContainerInfo,
+    Health,
+    NodeInfo,
+    PodGroup,
+    PodInfo,
+    ResourceList,
+    TopologyCoord,
+    VtpuShare,
+)
+from tpukube.core.mesh import MeshSpec  # noqa: F401

@@ -1,0 +1,210 @@
+"""Annotation codec (L4) — the cluster<->node information channel.
+
+TPU-native analog of the reference's ``kubeinterface/`` (SURVEY.md §2 C8):
+kube-scheduler extender webhooks only see core API object fields, so rich
+node topology and allocation results must ride Kubernetes annotations. The
+node agent writes ``node-topology`` onto its Node; the extender writes
+``alloc`` onto bound Pods; jobs declare gangs with ``pod-group`` annotations.
+
+Schema is versioned JSON. Every encode has a decode round-trip test.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from tpukube.core.mesh import MeshSpec
+from tpukube.core.types import (
+    AllocResult,
+    ChipInfo,
+    Health,
+    NodeInfo,
+    PodGroup,
+    PodInfo,
+    TopologyCoord,
+)
+
+SCHEMA_VERSION = 1
+
+ANNO_PREFIX = "tpu.qiniu.com/"
+ANNO_NODE_TOPOLOGY = ANNO_PREFIX + "node-topology"
+ANNO_ALLOC = ANNO_PREFIX + "alloc"
+ANNO_POD_GROUP = ANNO_PREFIX + "pod-group"
+ANNO_POD_GROUP_MIN_MEMBER = ANNO_PREFIX + "pod-group-min-member"
+ANNO_POD_GROUP_SHAPE = ANNO_PREFIX + "pod-group-shape"
+
+
+class CodecError(ValueError):
+    pass
+
+
+def _check_version(obj, what: str) -> None:
+    if not isinstance(obj, dict):
+        raise CodecError(f"{what}: payload must be a JSON object")
+    v = obj.get("v")
+    if v != SCHEMA_VERSION:
+        raise CodecError(f"{what}: unsupported schema version {v!r}")
+
+
+def _field(obj: dict, key: str, what: str):
+    try:
+        return obj[key]
+    except (KeyError, TypeError) as e:
+        raise CodecError(f"{what}: missing field {key!r}") from e
+
+
+# -- node topology ---------------------------------------------------------
+
+def encode_node_topology(node: NodeInfo, mesh: MeshSpec) -> str:
+    """Serialize a node's chip inventory + the global mesh it sits in."""
+    return json.dumps(
+        {
+            "v": SCHEMA_VERSION,
+            "node": node.name,
+            "mesh": mesh.to_json(),
+            "sharesPerChip": node.shares_per_chip,
+            "chips": [
+                {
+                    "id": c.chip_id,
+                    "index": c.index,
+                    "coord": c.coord.as_list(),
+                    "hbm": c.hbm_bytes,
+                    "cores": c.num_cores,
+                    "health": c.health.value,
+                }
+                for c in node.chips
+            ],
+        },
+        separators=(",", ":"),
+    )
+
+
+def decode_node_topology(payload: str) -> tuple[NodeInfo, MeshSpec]:
+    try:
+        obj = json.loads(payload)
+    except json.JSONDecodeError as e:
+        raise CodecError(f"node-topology: bad JSON: {e}") from e
+    _check_version(obj, "node-topology")
+    try:
+        mesh = MeshSpec.from_json(_field(obj, "mesh", "node-topology"))
+    except (KeyError, TypeError, ValueError) as e:
+        if isinstance(e, CodecError):
+            raise
+        raise CodecError(f"node-topology: malformed mesh: {e}") from e
+    raw_chips = _field(obj, "chips", "node-topology")
+    if not isinstance(raw_chips, list):
+        raise CodecError("node-topology: 'chips' must be a list")
+    try:
+        chips = [
+            ChipInfo(
+                chip_id=c["id"],
+                index=int(c["index"]),
+                coord=TopologyCoord.of(c["coord"]),
+                hbm_bytes=int(c["hbm"]),
+                num_cores=int(c.get("cores", 2)),
+                health=Health(c.get("health", "Healthy")),
+            )
+            for c in raw_chips
+        ]
+    except (KeyError, TypeError, ValueError) as e:
+        raise CodecError(f"node-topology: malformed chip entry: {e}") from e
+    node = NodeInfo(
+        name=_field(obj, "node", "node-topology"),
+        chips=chips,
+        shares_per_chip=int(obj.get("sharesPerChip", 1)),
+    )
+    return node, mesh
+
+
+def annotate_node(node: NodeInfo, mesh: MeshSpec) -> dict[str, str]:
+    return {ANNO_NODE_TOPOLOGY: encode_node_topology(node, mesh)}
+
+
+def node_from_annotations(
+    name: str, annotations: dict[str, str]
+) -> Optional[tuple[NodeInfo, MeshSpec]]:
+    payload = annotations.get(ANNO_NODE_TOPOLOGY)
+    if payload is None:
+        return None
+    node, mesh = decode_node_topology(payload)
+    if node.name != name:
+        raise CodecError(
+            f"node-topology annotation names {node.name!r} but lives on {name!r}"
+        )
+    node.annotations = dict(annotations)
+    return node, mesh
+
+
+# -- allocation result -----------------------------------------------------
+
+def encode_alloc(alloc: AllocResult) -> str:
+    return json.dumps(
+        {
+            "v": SCHEMA_VERSION,
+            "pod": alloc.pod_key,
+            "node": alloc.node_name,
+            "devices": alloc.device_ids,
+            "coords": [c.as_list() for c in alloc.coords],
+            "env": alloc.env,
+        },
+        separators=(",", ":"),
+    )
+
+
+def decode_alloc(payload: str) -> AllocResult:
+    try:
+        obj = json.loads(payload)
+    except json.JSONDecodeError as e:
+        raise CodecError(f"alloc: bad JSON: {e}") from e
+    _check_version(obj, "alloc")
+    try:
+        return AllocResult(
+            pod_key=_field(obj, "pod", "alloc"),
+            node_name=_field(obj, "node", "alloc"),
+            device_ids=list(_field(obj, "devices", "alloc")),
+            coords=[TopologyCoord.of(c) for c in obj.get("coords", [])],
+            env=dict(obj.get("env", {})),
+        )
+    except CodecError:
+        raise
+    except (TypeError, ValueError) as e:
+        raise CodecError(f"alloc: malformed payload: {e}") from e
+
+
+# -- pod group -------------------------------------------------------------
+
+def pod_group_annotations(group: PodGroup) -> dict[str, str]:
+    out = {
+        ANNO_POD_GROUP: group.name,
+        ANNO_POD_GROUP_MIN_MEMBER: str(group.min_member),
+    }
+    if group.shape is not None:
+        out[ANNO_POD_GROUP_SHAPE] = "x".join(str(s) for s in group.shape)
+    return out
+
+
+def pod_group_from_annotations(annotations: dict[str, str]) -> Optional[PodGroup]:
+    name = annotations.get(ANNO_POD_GROUP)
+    if not name:
+        return None
+    try:
+        min_member = int(annotations.get(ANNO_POD_GROUP_MIN_MEMBER, "1"))
+    except ValueError as e:
+        raise CodecError(f"pod-group-min-member not an int") from e
+    shape_s = annotations.get(ANNO_POD_GROUP_SHAPE)
+    shape = None
+    if shape_s:
+        parts = shape_s.split("x")
+        if len(parts) not in (1, 2, 3) or not all(p.isdigit() for p in parts):
+            raise CodecError(f"bad pod-group-shape {shape_s!r}")
+        vals = [int(p) for p in parts] + [1, 1]
+        shape = (vals[0], vals[1], vals[2])
+    return PodGroup(name=name, min_member=min_member, shape=shape)
+
+
+def attach_group(pod: PodInfo) -> PodInfo:
+    """Populate pod.group from its annotations (idempotent)."""
+    if pod.group is None:
+        pod.group = pod_group_from_annotations(pod.annotations)
+    return pod

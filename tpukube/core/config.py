@@ -1,0 +1,123 @@
+"""Configuration (SURVEY.md §6 "Config / flag system").
+
+The reference configures its daemons with Go flag/pflag + the kube-scheduler
+policy/extender config file. Here one dataclass covers both daemons and the
+sim harness, loadable from defaults < YAML file < environment (later wins).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, fields, replace
+from typing import Optional
+
+import yaml
+
+from tpukube.core.mesh import MeshSpec
+
+# Default HBM per chip: TPU v5p has 95 GiB HBM2e per chip.
+DEFAULT_HBM_BYTES = 95 * 1024**3
+
+ENV_PREFIX = "TPUKUBE_"
+
+
+@dataclass(frozen=True)
+class TpuKubeConfig:
+    # resources
+    resource_tpu: str = "qiniu.com/tpu"
+    resource_vtpu: str = "qiniu.com/vtpu"
+    shares_per_chip: int = 1  # >1 enables vTPU minting (e.g. 2 or 4)
+
+    # node agent / device plugin
+    device_plugin_dir: str = "/var/lib/kubelet/device-plugins"
+    kubelet_socket: str = "kubelet.sock"  # within device_plugin_dir
+    plugin_socket: str = "tpukube.sock"  # within device_plugin_dir
+    health_poll_seconds: float = 5.0
+
+    # scheduler extender
+    extender_host: str = "0.0.0.0"
+    extender_port: int = 12345
+    score_mode: str = "topology"  # topology | binpack | spread
+    reservation_ttl_seconds: float = 30.0
+
+    # sim topology (used when backend == "sim")
+    backend: str = "sim"  # sim | real
+    sim_mesh_dims: tuple[int, int, int] = (4, 4, 4)
+    sim_host_block: tuple[int, int, int] = (2, 2, 1)
+    sim_torus: tuple[bool, bool, bool] = (False, False, False)
+    hbm_bytes_per_chip: int = DEFAULT_HBM_BYTES
+    cores_per_chip: int = 2
+
+    def sim_mesh(self) -> MeshSpec:
+        return MeshSpec(
+            dims=self.sim_mesh_dims,
+            host_block=self.sim_host_block,
+            torus=self.sim_torus,
+        )
+
+    def plugin_socket_path(self) -> str:
+        return os.path.join(self.device_plugin_dir, self.plugin_socket)
+
+    def kubelet_socket_path(self) -> str:
+        return os.path.join(self.device_plugin_dir, self.kubelet_socket)
+
+
+_TUPLE_FIELDS = {"sim_mesh_dims", "sim_host_block", "sim_torus"}
+
+
+def _coerce(name: str, raw, current):
+    if name in _TUPLE_FIELDS:
+        if isinstance(raw, str):
+            raw = [p for p in raw.replace("x", ",").split(",") if p != ""]
+        elem = bool if isinstance(current[0], bool) else int
+        if elem is bool:
+            vals = tuple(
+                v if isinstance(v, bool) else str(v).lower() in ("1", "true", "yes")
+                for v in raw
+            )
+        else:
+            vals = tuple(int(v) for v in raw)
+        if len(vals) != 3:
+            raise ValueError(f"config {name}: need 3 values, got {vals!r}")
+        return vals
+    t = type(current)
+    if t is bool:
+        return raw if isinstance(raw, bool) else str(raw).lower() in ("1", "true", "yes")
+    return t(raw)
+
+
+def load_config(
+    yaml_path: Optional[str] = None, env: Optional[dict[str, str]] = None
+) -> TpuKubeConfig:
+    """defaults < yaml < env (TPUKUBE_<UPPER_FIELD_NAME>)."""
+    cfg = TpuKubeConfig()
+    updates: dict = {}
+    if yaml_path:
+        with open(yaml_path) as f:
+            doc = yaml.safe_load(f) or {}
+        if not isinstance(doc, dict):
+            raise ValueError(f"{yaml_path}: top level must be a mapping")
+        known = {f_.name for f_ in fields(cfg)}
+        for k, v in doc.items():
+            if k not in known:
+                raise ValueError(f"{yaml_path}: unknown config key {k!r}")
+            updates[k] = v
+    env = os.environ if env is None else env
+    for f_ in fields(cfg):
+        env_key = ENV_PREFIX + f_.name.upper()
+        if env_key in env:
+            updates[f_.name] = env[env_key]
+    for k, v in list(updates.items()):
+        updates[k] = _coerce(k, v, getattr(cfg, k))
+    cfg = replace(cfg, **updates)
+    if cfg.shares_per_chip < 1:
+        raise ValueError("shares_per_chip must be >= 1")
+    if not 0 < cfg.extender_port < 65536:
+        raise ValueError(f"extender_port {cfg.extender_port} out of range")
+    if cfg.health_poll_seconds <= 0 or cfg.reservation_ttl_seconds <= 0:
+        raise ValueError("poll/ttl intervals must be positive")
+    if cfg.score_mode not in ("topology", "binpack", "spread"):
+        raise ValueError(f"unknown score_mode {cfg.score_mode!r}")
+    if cfg.backend not in ("sim", "real"):
+        raise ValueError(f"unknown backend {cfg.backend!r}")
+    return cfg

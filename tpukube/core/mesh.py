@@ -1,0 +1,241 @@
+"""ICI mesh geometry (L0).
+
+The reference models GPU topology as a tree (NVLink domain / PCIe switch /
+NUMA levels, encoded in hierarchical resource names — SURVEY.md §2 C1/C7).
+A TPU pod slice is not a tree: it is an axis-aligned 3D mesh/torus of chips
+(v4/v5p: 3D torus; v5e/v6e: 2D), with hosts owning fixed sub-blocks of
+coordinates (4 chips per host on v4/v5p). So the core geometric object here
+is :class:`MeshSpec`: global dims + per-host block, from which chip->host
+mapping, adjacency, and sub-slice containment all derive.
+
+Pure geometry, no I/O. The slicefit allocator (SURVEY.md §2 C7) and the
+extender scorer (C9) are functions over this.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+from tpukube.core.types import TopologyCoord
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Shape of the global chip mesh and its partition into hosts.
+
+    dims:       chips along (x, y, z). 2D topologies use z=1.
+    host_block: chips per host along each axis; must divide dims elementwise.
+                v5p default is (2, 2, 1): 4 chips per host.
+    torus:      per-axis wraparound. Real v5p slices >= full-dim are tori;
+                sub-slices are plain meshes. Affects neighbor enumeration and
+                (optionally) wrapped sub-box search.
+    """
+
+    dims: tuple[int, int, int]
+    host_block: tuple[int, int, int] = (2, 2, 1)
+    torus: tuple[bool, bool, bool] = (False, False, False)
+
+    def __post_init__(self) -> None:
+        if len(self.dims) != 3 or len(self.host_block) != 3:
+            raise ValueError("dims and host_block must be 3-tuples")
+        for d, h in zip(self.dims, self.host_block):
+            if d <= 0 or h <= 0:
+                raise ValueError(f"non-positive mesh dimension: {self}")
+            if d % h != 0:
+                raise ValueError(
+                    f"host_block {self.host_block} does not divide dims {self.dims}"
+                )
+
+    # -- basic counts ------------------------------------------------------
+    @property
+    def num_chips(self) -> int:
+        x, y, z = self.dims
+        return x * y * z
+
+    @property
+    def chips_per_host(self) -> int:
+        a, b, c = self.host_block
+        return a * b * c
+
+    @property
+    def host_grid(self) -> tuple[int, int, int]:
+        return tuple(d // h for d, h in zip(self.dims, self.host_block))  # type: ignore[return-value]
+
+    @property
+    def num_hosts(self) -> int:
+        a, b, c = self.host_grid
+        return a * b * c
+
+    # -- coordinate enumeration -------------------------------------------
+    def contains(self, c: TopologyCoord) -> bool:
+        return all(0 <= v < d for v, d in zip(c, self.dims))
+
+    def all_coords(self) -> Iterator[TopologyCoord]:
+        X, Y, Z = self.dims
+        for z in range(Z):
+            for y in range(Y):
+                for x in range(X):
+                    yield TopologyCoord(x, y, z)
+
+    def linearize(self, c: TopologyCoord) -> int:
+        """Row-major (x fastest) chip index within the global mesh."""
+        X, Y, _ = self.dims
+        return c.x + X * (c.y + Y * c.z)
+
+    def delinearize(self, i: int) -> TopologyCoord:
+        X, Y, Z = self.dims
+        if not 0 <= i < self.num_chips:
+            raise ValueError(f"chip index {i} out of range for {self.dims}")
+        return TopologyCoord(i % X, (i // X) % Y, i // (X * Y))
+
+    # -- host partition ----------------------------------------------------
+    def host_of(self, c: TopologyCoord) -> str:
+        """Stable host name owning coordinate ``c`` ("host-i-j-k")."""
+        if not self.contains(c):
+            raise ValueError(f"coord {c} outside mesh {self.dims}")
+        i, j, k = (v // h for v, h in zip(c, self.host_block))
+        return f"host-{i}-{j}-{k}"
+
+    def host_origin(self, host: str) -> TopologyCoord:
+        try:
+            prefix, i, j, k = host.split("-")
+            if prefix != "host":
+                raise ValueError(host)
+            grid = (int(i), int(j), int(k))
+        except ValueError as e:
+            raise ValueError(f"malformed host name {host!r}") from e
+        ga, gb, gc = self.host_grid
+        if not (0 <= grid[0] < ga and 0 <= grid[1] < gb and 0 <= grid[2] < gc):
+            raise ValueError(f"host {host!r} outside host grid {self.host_grid}")
+        return TopologyCoord(*(g * h for g, h in zip(grid, self.host_block)))
+
+    def coords_of_host(self, host: str) -> list[TopologyCoord]:
+        ox, oy, oz = self.host_origin(host)
+        hx, hy, hz = self.host_block
+        return [
+            TopologyCoord(ox + dx, oy + dy, oz + dz)
+            for dz in range(hz)
+            for dy in range(hy)
+            for dx in range(hx)
+        ]
+
+    def all_hosts(self) -> list[str]:
+        ga, gb, gc = self.host_grid
+        return [
+            f"host-{i}-{j}-{k}"
+            for k in range(gc)
+            for j in range(gb)
+            for i in range(ga)
+        ]
+
+    # -- adjacency ---------------------------------------------------------
+    def neighbors(self, c: TopologyCoord) -> list[TopologyCoord]:
+        """ICI neighbors of a chip (±1 per axis, honoring per-axis torus).
+
+        This replaces the reference's per-pair NVLink queries
+        (nvmlDeviceGetTopologyCommonAncestor, SURVEY.md §2 C2): on a TPU the
+        link table IS mesh adjacency.
+        """
+        out: list[TopologyCoord] = []
+        for axis in range(3):
+            d = self.dims[axis]
+            if d == 1:
+                continue
+            for step in (-1, 1):
+                v = list(c)
+                v[axis] += step
+                if not 0 <= v[axis] < d:
+                    if not self.torus[axis]:
+                        continue
+                    v[axis] %= d
+                nb = TopologyCoord(*v)
+                if nb != c and nb not in out:
+                    out.append(nb)
+        return out
+
+    # -- (de)serialization -------------------------------------------------
+    def to_json(self) -> dict:
+        return {
+            "dims": list(self.dims),
+            "host_block": list(self.host_block),
+            "torus": list(self.torus),
+        }
+
+    @staticmethod
+    def from_json(obj: dict) -> "MeshSpec":
+        return MeshSpec(
+            dims=tuple(obj["dims"]),
+            host_block=tuple(obj.get("host_block", (2, 2, 1))),
+            torus=tuple(obj.get("torus", (False, False, False))),
+        )
+
+
+@dataclass(frozen=True)
+class Box:
+    """Axis-aligned sub-box [origin, origin+shape) of a mesh — the unit of
+    gang placement (a contiguous sub-slice)."""
+
+    origin: TopologyCoord
+    shape: tuple[int, int, int]
+
+    def __post_init__(self) -> None:
+        if any(s <= 0 for s in self.shape):
+            raise ValueError(f"non-positive box shape {self.shape}")
+
+    @property
+    def size(self) -> int:
+        a, b, c = self.shape
+        return a * b * c
+
+    def coords(self) -> Iterator[TopologyCoord]:
+        ox, oy, oz = self.origin
+        sx, sy, sz = self.shape
+        for z in range(sz):
+            for y in range(sy):
+                for x in range(sx):
+                    yield TopologyCoord(ox + x, oy + y, oz + z)
+
+    def contains(self, c: TopologyCoord) -> bool:
+        return all(o <= v < o + s for v, o, s in zip(c, self.origin, self.shape))
+
+    def fits_in(self, mesh: MeshSpec) -> bool:
+        return all(
+            0 <= o and o + s <= d
+            for o, s, d in zip(self.origin, self.shape, mesh.dims)
+        )
+
+    def to_json(self) -> dict:
+        return {"origin": list(self.origin), "shape": list(self.shape)}
+
+    @staticmethod
+    def from_json(obj: dict) -> "Box":
+        return Box(TopologyCoord.of(obj["origin"]), tuple(obj["shape"]))
+
+
+def factor_shapes(n: int, mesh_dims: tuple[int, int, int]) -> list[tuple[int, int, int]]:
+    """All 3D box shapes of volume n that could fit in ``mesh_dims``.
+
+    Used by slicefit when a gang requests a count without pinning a shape:
+    candidate shapes are ranked elsewhere (prefer compact, well-factoring
+    boxes — SURVEY.md §9.3). Deterministic order: sorted by descending
+    "compactness" (minimize surface area), then lexicographically.
+    """
+    shapes: set[tuple[int, int, int]] = set()
+    X, Y, Z = mesh_dims
+    for a in range(1, min(n, X) + 1):
+        if n % a:
+            continue
+        rem = n // a
+        for b in range(1, min(rem, Y) + 1):
+            if rem % b:
+                continue
+            c = rem // b
+            if c <= Z:
+                shapes.add((a, b, c))
+
+    def surface(s: tuple[int, int, int]) -> int:
+        a, b, c = s
+        return 2 * (a * b + b * c + a * c)
+
+    return sorted(shapes, key=lambda s: (surface(s), s))

@@ -1,0 +1,242 @@
+"""Shared core types (L0).
+
+TPU-native analog of the reference's ``types/`` package (SURVEY.md §2 C1).
+The reference (qiniu-ava/KubeGPU; tree unreadable at survey time, SURVEY.md §0)
+carries ``ResourceList``, tree-structured resource names encoding the
+PCIe/NVLink topology, and ``NodeInfo``/``PodInfo``/``ContainerInfo`` structs
+shared by every layer. Here the topology is an ICI mesh, so tree paths become
+:class:`TopologyCoord` mesh coordinates, and a GPU UUID becomes a chip id.
+
+Everything in this module is pure data — no I/O, no gRPC, no JAX — so the
+whole scheduler stack above it is testable as functions over values
+(SURVEY.md §5: "a cluster is just data").
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Iterator, Mapping, NamedTuple, Optional
+
+# Resource names advertised to the cluster. BASELINE.json's north_star fixes
+# the whole-chip name: pods request ``qiniu.com/tpu: 1``. Fractional shares
+# are a distinct extended resource (one device-plugin endpoint per resource).
+RESOURCE_TPU = "qiniu.com/tpu"
+RESOURCE_VTPU = "qiniu.com/vtpu"
+
+# Device-id scheme minted by the node agent (L2/L3):
+#   whole chip:       tpu-<index>
+#   fractional share: tpu-<index>-frac<k>of<n>
+_DEVICE_ID_RE = re.compile(r"^tpu-(\d+)(?:-frac(\d+)of(\d+))?$")
+
+
+def make_device_id(chip_index: int, frac: Optional[tuple[int, int]] = None) -> str:
+    if frac is None:
+        return f"tpu-{chip_index}"
+    k, n = frac
+    return f"tpu-{chip_index}-frac{k}of{n}"
+
+
+def parse_device_id(device_id: str) -> tuple[int, Optional[tuple[int, int]]]:
+    """Return (chip_index, (k, n) | None). Raises ValueError on junk."""
+    m = _DEVICE_ID_RE.match(device_id)
+    if not m:
+        raise ValueError(f"malformed tpu device id: {device_id!r}")
+    chip = int(m.group(1))
+    if m.group(2) is None:
+        return chip, None
+    return chip, (int(m.group(2)), int(m.group(3)))
+
+
+class Health(str, Enum):
+    HEALTHY = "Healthy"
+    UNHEALTHY = "Unhealthy"
+
+
+class TopologyCoord(NamedTuple):
+    """Position of a chip in the global ICI mesh (x fastest-varying)."""
+
+    x: int
+    y: int
+    z: int
+
+    def as_list(self) -> list[int]:
+        return [self.x, self.y, self.z]
+
+    @staticmethod
+    def of(seq) -> "TopologyCoord":
+        x, y, z = seq
+        return TopologyCoord(int(x), int(y), int(z))
+
+
+class ResourceList(dict):
+    """name -> integer quantity, with the arithmetic schedulers need.
+
+    The reference's ResourceList maps hierarchical resource names to
+    quantities; ours maps flat extended-resource names (topology travels in
+    :mod:`tpukube.core.codec` annotations instead of in the name).
+    """
+
+    def __init__(self, items: Optional[Mapping[str, int]] = None, **kw: int):
+        super().__init__()
+        for src in (items or {}), kw:
+            for k, v in src.items():
+                self[k] = int(v)
+
+    def fits(self, capacity: "ResourceList") -> bool:
+        """True if every requested quantity is available in ``capacity``."""
+        return all(capacity.get(k, 0) >= v for k, v in self.items())
+
+    def plus(self, other: Mapping[str, int]) -> "ResourceList":
+        out = ResourceList(self)
+        for k, v in other.items():
+            out[k] = out.get(k, 0) + int(v)
+        return out
+
+    def minus(self, other: Mapping[str, int]) -> "ResourceList":
+        out = ResourceList(self)
+        for k, v in other.items():
+            out[k] = out.get(k, 0) - int(v)
+        return out
+
+    def nonneg(self) -> bool:
+        return all(v >= 0 for v in self.values())
+
+
+@dataclass
+class ChipInfo:
+    """One physical TPU chip as seen by the node agent.
+
+    The reference's per-GPU record carries UUID, memory, and PCIe/NVLink
+    neighbor info (via NVML, SURVEY.md §2 C2/C3); the TPU analog carries the
+    chip's global mesh coordinate and HBM size. ICI links are implied by mesh
+    adjacency (MeshSpec.neighbors) rather than enumerated per-pair.
+    """
+
+    chip_id: str  # stable id, e.g. "chip-0" or a real serial
+    index: int  # node-local index (device-id minting)
+    coord: TopologyCoord  # global mesh coordinate
+    hbm_bytes: int
+    num_cores: int = 2  # TensorCores per chip (2 on v4/v5p, 1 on v5e)
+    health: Health = Health.HEALTHY
+
+    def device_id(self) -> str:
+        return make_device_id(self.index)
+
+
+@dataclass
+class VtpuShare:
+    """A minted fractional share of a physical chip (SURVEY.md §2 C6).
+
+    Enforcement is cooperative on real TPUs: the HBM quota is exported as env
+    (TPU_HBM_LIMIT_BYTES / XLA client mem fraction) for the in-pod JAX
+    runtime; the sim-mode C++ audit shim gives hard enforcement in tests.
+    """
+
+    chip_index: int
+    k: int  # share index, 0-based
+    n: int  # shares per chip
+    hbm_quota_bytes: int
+
+    def device_id(self) -> str:
+        return make_device_id(self.chip_index, (self.k, self.n))
+
+
+@dataclass
+class NodeInfo:
+    """Everything the scheduler needs to know about one node's TPUs.
+
+    Travels cluster-ward as the ``tpu.qiniu.com/node-topology`` annotation
+    (SURVEY.md §2 C8) because extender webhooks only see core object fields.
+    """
+
+    name: str
+    chips: list[ChipInfo] = field(default_factory=list)
+    shares_per_chip: int = 1  # >1 => vTPU minting enabled on this node
+    capacity: ResourceList = field(default_factory=ResourceList)
+    annotations: dict[str, str] = field(default_factory=dict)
+
+    def healthy_chips(self) -> list[ChipInfo]:
+        return [c for c in self.chips if c.health is Health.HEALTHY]
+
+    def chip_by_index(self, index: int) -> ChipInfo:
+        for c in self.chips:
+            if c.index == index:
+                return c
+        raise KeyError(f"{self.name}: no chip with index {index}")
+
+
+@dataclass
+class PodGroup:
+    """Gang-scheduling group identity (SURVEY.md §2 C10).
+
+    ``shape`` optionally pins the requested sub-slice geometry (e.g. (4,4,1)
+    for a 16-chip 2D-friendly slice); None means "any contiguous box of the
+    right size" (SURVEY.md §6, long-context note: shaped slices are how
+    sequence-parallel jobs ask for meshes that factor well).
+    """
+
+    name: str
+    min_member: int
+    shape: Optional[tuple[int, int, int]] = None
+
+
+@dataclass
+class ContainerInfo:
+    name: str
+    requests: ResourceList = field(default_factory=ResourceList)
+
+
+@dataclass
+class PodInfo:
+    """The slice of a k8s Pod this framework reasons about."""
+
+    name: str
+    namespace: str = "default"
+    uid: str = ""
+    containers: list[ContainerInfo] = field(default_factory=list)
+    priority: int = 0
+    group: Optional[PodGroup] = None
+    node_name: str = ""
+    annotations: dict[str, str] = field(default_factory=dict)
+    labels: dict[str, str] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        if not self.uid:
+            self.uid = f"{self.namespace}/{self.name}"
+
+    def requests(self) -> ResourceList:
+        total = ResourceList()
+        for c in self.containers:
+            total = total.plus(c.requests)
+        return total
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass
+class AllocResult:
+    """Outcome of placing one pod: which devices on which node, plus the env
+    the container must receive so the in-pod JAX runtime forms the intended
+    mesh (SURVEY.md §4.3: the TPU analog of NVIDIA_VISIBLE_DEVICES +
+    /dev/nvidia* injection is env-plumbing for libtpu/XLA)."""
+
+    pod_key: str
+    node_name: str
+    device_ids: list[str] = field(default_factory=list)
+    coords: list[TopologyCoord] = field(default_factory=list)
+    env: dict[str, str] = field(default_factory=dict)
+
+    def chip_indices(self) -> list[int]:
+        return [parse_device_id(d)[0] for d in self.device_ids]
+
+
+def iter_pod_device_requests(pod: PodInfo) -> Iterator[tuple[str, int]]:
+    """Yield (resource_name, count) for the TPU-flavored requests of a pod."""
+    req = pod.requests()
+    for name in (RESOURCE_TPU, RESOURCE_VTPU):
+        n = req.get(name, 0)
+        if n:
+            yield name, n
