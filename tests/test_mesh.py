@@ -86,9 +86,16 @@ def test_box_coords_and_containment():
 
 def test_factor_shapes_prefers_compact():
     shapes = factor_shapes(16, (4, 4, 4))
-    assert shapes[0] in [(4, 4, 1), (4, 2, 2), (2, 4, 2), (2, 2, 4), (1, 4, 4), (4, 1, 4)]
-    # compactness: (4,2,2)-family surface 40 beats (4,4,1) surface 48
-    assert shapes[0] == (2, 2, 4) or shapes[0][0] * shapes[0][1] * shapes[0][2] == 16
+
+    def surface(s):
+        a, b, c = s
+        return 2 * (a * b + b * c + a * c)
+
+    # first shape must be one of the minimum-surface boxes: the (4,2,2)
+    # family (surface 40) beats the (4,4,1) family (surface 48)
+    assert surface(shapes[0]) == min(surface(s) for s in shapes) == 40
+    # and the ordering is monotone in surface area
+    assert [surface(s) for s in shapes] == sorted(surface(s) for s in shapes)
     assert all(a * b * c == 16 for a, b, c in shapes)
     # nothing exceeds the mesh dims
     assert all(a <= 4 and b <= 4 and c <= 4 for a, b, c in shapes)

@@ -1,0 +1,99 @@
+"""Tests for the C++ libtpuinfo layer through the ctypes wrapper.
+
+The native selftest binary (incl. the ASan/UBSan build) covers the C side;
+these tests cover the Python marshalling and the sim backend semantics the
+node agent depends on.
+"""
+
+import subprocess
+
+import pytest
+
+from tpukube.core.mesh import MeshSpec
+from tpukube.core.types import Health, TopologyCoord
+from tpukube.native import TpuInfo, TpuInfoError, sim_spec
+
+MESH = MeshSpec(dims=(4, 4, 4), host_block=(2, 2, 1))
+
+
+def _open(host="host-0-0-0", hbm=16 << 30, cores=1, mesh=MESH):
+    return TpuInfo("sim", sim_spec(mesh, host, hbm, cores))
+
+
+def test_sim_enumeration_matches_python_mesh():
+    with _open(host="host-1-0-2") as ti:
+        mesh = ti.mesh()
+        assert mesh == MESH
+        chips = ti.chips()
+        assert len(chips) == MESH.chips_per_host == 4
+        # C++ minting order must match MeshSpec.coords_of_host exactly:
+        # the plugin's device ids depend on this agreement.
+        assert [c.coord for c in chips] == MESH.coords_of_host("host-1-0-2")
+        assert all(c.hbm_bytes == 16 << 30 for c in chips)
+        assert all(c.health is Health.HEALTHY for c in chips)
+        assert chips[0].chip_id == "host-1-0-2-chip-0"
+
+
+def test_links_match_python_neighbors():
+    with _open(host="host-0-0-0") as ti:
+        for chip in ti.chips():
+            got = set(ti.links(chip.index))
+            want = set(MESH.neighbors(chip.coord))
+            assert got == want, f"chip {chip.index} at {chip.coord}"
+
+
+def test_links_torus_wrap():
+    mesh = MeshSpec(dims=(4, 4, 1), host_block=(2, 2, 1), torus=(True, True, False))
+    with _open(mesh=mesh) as ti:
+        got = set(ti.links(0))  # chip at (0, 0, 0)
+        assert TopologyCoord(3, 0, 0) in got and TopologyCoord(0, 3, 0) in got
+        assert got == set(mesh.neighbors(TopologyCoord(0, 0, 0)))
+
+
+def test_fault_injection_roundtrip():
+    with _open() as ti:
+        ti.inject_fault(2)
+        assert ti.chips()[2].health is Health.UNHEALTHY
+        assert ti.chips()[0].health is Health.HEALTHY
+        ti.inject_fault(2, healthy=True)
+        assert ti.chips()[2].health is Health.HEALTHY
+        with pytest.raises(TpuInfoError, match="out of range"):
+            ti.inject_fault(99)
+
+
+def test_bad_specs_raise():
+    with pytest.raises(TpuInfoError, match="host_block"):
+        TpuInfo("sim", "dims=4,4,4\nhost_block=3,3,3")
+    with pytest.raises(TpuInfoError, match="unknown backend"):
+        TpuInfo("cuda")
+    with pytest.raises(TpuInfoError, match="host outside"):
+        TpuInfo("sim", sim_spec(MESH, "host-9-0-0", 1 << 30))
+
+
+def test_double_init_and_close_semantics():
+    ti = _open()
+    with pytest.raises(TpuInfoError, match="already initialized"):
+        TpuInfo("sim", sim_spec(MESH, "host-0-0-0", 1 << 30))
+    ti.close()
+    ti.close()  # idempotent
+    with pytest.raises(TpuInfoError, match="closed"):
+        ti.chips()
+    # after close, a fresh session works
+    with _open() as ti2:
+        assert ti2.chip_count() == 4
+
+
+def test_real_backend_bogus_libtpu_fails_cleanly():
+    with pytest.raises(TpuInfoError, match="cannot load libtpu"):
+        TpuInfo("real", "libtpu=/nonexistent/libtpu.so")
+
+
+def test_native_selftest_binary_passes():
+    proc = subprocess.run(
+        ["make", "-C", "tpukube/native", "selftest"],
+        capture_output=True,
+        text=True,
+        cwd="/root/repo",
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "all checks passed" in proc.stdout

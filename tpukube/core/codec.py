@@ -109,10 +109,14 @@ def decode_node_topology(payload: str) -> tuple[NodeInfo, MeshSpec]:
         ]
     except (KeyError, TypeError, ValueError) as e:
         raise CodecError(f"node-topology: malformed chip entry: {e}") from e
+    try:
+        shares = int(obj.get("sharesPerChip", 1))
+    except (TypeError, ValueError) as e:
+        raise CodecError(f"node-topology: bad sharesPerChip: {e}") from e
     node = NodeInfo(
         name=_field(obj, "node", "node-topology"),
         chips=chips,
-        shares_per_chip=int(obj.get("sharesPerChip", 1)),
+        shares_per_chip=shares,
     )
     return node, mesh
 
@@ -191,7 +195,9 @@ def pod_group_from_annotations(annotations: dict[str, str]) -> Optional[PodGroup
     try:
         min_member = int(annotations.get(ANNO_POD_GROUP_MIN_MEMBER, "1"))
     except ValueError as e:
-        raise CodecError(f"pod-group-min-member not an int") from e
+        raise CodecError("pod-group-min-member not an int") from e
+    if min_member < 1:
+        raise CodecError(f"pod-group-min-member must be >= 1, got {min_member}")
     shape_s = annotations.get(ANNO_POD_GROUP_SHAPE)
     shape = None
     if shape_s:
@@ -200,6 +206,8 @@ def pod_group_from_annotations(annotations: dict[str, str]) -> Optional[PodGroup
             raise CodecError(f"bad pod-group-shape {shape_s!r}")
         vals = [int(p) for p in parts] + [1, 1]
         shape = (vals[0], vals[1], vals[2])
+        if any(v < 1 for v in shape):
+            raise CodecError(f"pod-group-shape dims must be >= 1: {shape_s!r}")
     return PodGroup(name=name, min_member=min_member, shape=shape)
 
 

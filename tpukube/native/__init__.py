@@ -1,0 +1,3 @@
+"""Native bindings (L1): ctypes wrapper over libtpuinfo.so."""
+
+from tpukube.native.tpuinfo import TpuInfo, TpuInfoError, sim_spec  # noqa: F401

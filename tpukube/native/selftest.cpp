@@ -1,0 +1,96 @@
+/* Native self-test for libtpuinfo, built with -fsanitize=address,undefined
+ * in the `asan` target (SURVEY.md §6: the C++ shims get sanitizer builds,
+ * standing in for the reference lineage's `go test -race`). Exercises the
+ * sim backend end-to-end plus the error paths. Exit 0 == pass. */
+#include "tpuinfo.h"
+
+#include <cstdio>
+#include <cstring>
+
+static int failures = 0;
+#define CHECK(cond)                                                         \
+  do {                                                                      \
+    if (!(cond)) {                                                          \
+      std::fprintf(stderr, "FAIL %s:%d: %s (last_error=%s)\n", __FILE__,    \
+                   __LINE__, #cond, tpuinfo_last_error());                  \
+      ++failures;                                                           \
+    }                                                                       \
+  } while (0)
+
+int main() {
+  CHECK(tpuinfo_abi_version() == TPUINFO_ABI_VERSION);
+
+  /* not initialized yet */
+  CHECK(tpuinfo_chip_count() == -1);
+  tpuinfo_chip chip;
+  CHECK(tpuinfo_chip_get(0, &chip) == -1);
+  CHECK(tpuinfo_shutdown() == -1);
+
+  /* bad specs rejected */
+  CHECK(tpuinfo_init("sim", "dims=zero,4,4") == -1);
+  CHECK(tpuinfo_init("sim", "dims=4,4,4\nhost_block=3,3,3") == -1);
+  CHECK(tpuinfo_init("sim", "host=rack-0") == -1);
+  CHECK(tpuinfo_init("sim", "host=host-9-0-0") == -1);
+  CHECK(tpuinfo_init("sim", "mystery=1") == -1);
+  CHECK(tpuinfo_init("cuda", nullptr) == -1);
+  CHECK(tpuinfo_init(nullptr, nullptr) == -1);
+
+  /* good sim init: host-1-0-2 of a 4x4x4 mesh, 2x2x1 host blocks */
+  const char* spec =
+      "dims=4,4,4\nhost_block=2,2,1\ntorus=0,0,0\n"
+      "host=host-1-0-2\nhbm=17179869184\ncores=1\n";
+  CHECK(tpuinfo_init("sim", spec) == 0);
+  CHECK(tpuinfo_init("sim", spec) == -1); /* double init rejected */
+
+  tpuinfo_mesh mesh;
+  CHECK(tpuinfo_mesh_get(&mesh) == 0);
+  CHECK(mesh.dims[0] == 4 && mesh.dims[1] == 4 && mesh.dims[2] == 4);
+  CHECK(tpuinfo_chip_count() == 4);
+
+  /* chip 0 of host-1-0-2 sits at (2, 0, 2) */
+  CHECK(tpuinfo_chip_get(0, &chip) == 0);
+  CHECK(chip.coord[0] == 2 && chip.coord[1] == 0 && chip.coord[2] == 2);
+  CHECK(chip.hbm_bytes == 17179869184LL);
+  CHECK(chip.num_cores == 1);
+  CHECK(chip.healthy == 1);
+  CHECK(std::strcmp(chip.chip_id, "host-1-0-2-chip-0") == 0);
+  /* chip 3 is (+1,+1,0) from chip 0 within the host block */
+  CHECK(tpuinfo_chip_get(3, &chip) == 0);
+  CHECK(chip.coord[0] == 3 && chip.coord[1] == 1 && chip.coord[2] == 2);
+  CHECK(tpuinfo_chip_get(4, &chip) == -1);
+  CHECK(tpuinfo_chip_get(-1, &chip) == -1);
+
+  /* link table: interior-ish chip (2,0,2) has neighbors along x,z fully,
+   * y only upward (y=0 edge, no torus): 2 + 1 + 2 = 5 */
+  int32_t links[6 * 3];
+  int n = tpuinfo_chip_links(0, links, 6);
+  CHECK(n == 5);
+  n = tpuinfo_chip_links(0, links, 2); /* buffer too small */
+  CHECK(n == -1);
+
+  /* fault injection (the sim XID event) */
+  CHECK(tpuinfo_inject_fault(1, 0) == 0);
+  CHECK(tpuinfo_chip_get(1, &chip) == 0);
+  CHECK(chip.healthy == 0);
+  CHECK(tpuinfo_inject_fault(1, 1) == 0);
+  CHECK(tpuinfo_chip_get(1, &chip) == 0);
+  CHECK(chip.healthy == 1);
+  CHECK(tpuinfo_inject_fault(99, 0) == -1);
+
+  CHECK(tpuinfo_shutdown() == 0);
+  CHECK(tpuinfo_chip_count() == -1);
+
+  /* re-init after shutdown with a length-2 torus: dedup'd single neighbor
+   * per wrapped axis */
+  CHECK(tpuinfo_init("sim", "dims=2,1,1\nhost_block=1,1,1\ntorus=1,1,1\nhost=host-0-0-0") == 0);
+  n = tpuinfo_chip_links(0, links, 6);
+  CHECK(n == 1);
+  CHECK(links[0] == 1 && links[1] == 0 && links[2] == 0);
+  CHECK(tpuinfo_shutdown() == 0);
+
+  /* real backend with a bogus libtpu path must fail cleanly */
+  CHECK(tpuinfo_init("real", "libtpu=/nonexistent/libtpu.so") == -1);
+
+  if (failures == 0) std::printf("tpuinfo selftest: all checks passed\n");
+  return failures == 0 ? 0 : 1;
+}

@@ -1,0 +1,308 @@
+/* libtpuinfo implementation. See tpuinfo.h for the contract.
+ *
+ * Replaces the reference's cgo->libnvidia-ml.so layer (SURVEY.md §2 C2)
+ * with a TPU-native shim: mesh geometry instead of NVLink pair queries,
+ * libtpu.so liveness instead of NVML init, spec-driven sim topology for
+ * the CPU-only control plane the tests run on.
+ */
+#include "tpuinfo.h"
+
+#include <dlfcn.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct State {
+  bool initialized = false;
+  bool is_sim = false;
+  tpuinfo_mesh mesh{};
+  std::vector<tpuinfo_chip> chips;
+};
+
+State g_state;
+std::string g_last_error = "";
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+bool parse_triple(const std::string& val, int32_t out[3]) {
+  return std::sscanf(val.c_str(), "%d,%d,%d", &out[0], &out[1], &out[2]) == 3;
+}
+
+/* Per-generation chip facts (real backend). HBM per chip / TensorCores per
+ * chip for recent Cloud TPU generations; the sim backend takes these from
+ * its spec instead. */
+struct GenInfo {
+  const char* name;
+  int64_t hbm_bytes;
+  int32_t cores;
+};
+const GenInfo kGenTable[] = {
+    {"v4", 32LL << 30, 2},
+    {"v5e", 16LL << 30, 1},
+    {"v5litepod", 16LL << 30, 1},
+    {"v5p", 95LL << 30, 2},
+    {"v6e", 32LL << 30, 1},
+};
+
+std::vector<std::pair<std::string, std::string>> parse_spec(const char* spec) {
+  std::vector<std::pair<std::string, std::string>> kv;
+  if (spec == nullptr) return kv;
+  std::string s(spec);
+  size_t pos = 0;
+  while (pos < s.size()) {
+    size_t nl = s.find('\n', pos);
+    if (nl == std::string::npos) nl = s.size();
+    std::string line = s.substr(pos, nl - pos);
+    pos = nl + 1;
+    while (!line.empty() && (line.back() == '\r' || line.back() == ' '))
+      line.pop_back();
+    if (line.empty() || line[0] == '#') continue;
+    size_t eq = line.find('=');
+    if (eq == std::string::npos) continue;
+    kv.emplace_back(line.substr(0, eq), line.substr(eq + 1));
+  }
+  return kv;
+}
+
+int init_sim(const char* spec) {
+  int32_t dims[3] = {4, 4, 4};
+  int32_t host_block[3] = {2, 2, 1};
+  int32_t torus[3] = {0, 0, 0};
+  std::string host = "host-0-0-0";
+  int64_t hbm = 95LL << 30;
+  int32_t cores = 2;
+
+  for (const auto& [key, val] : parse_spec(spec)) {
+    if (key == "dims") {
+      if (!parse_triple(val, dims)) { set_error("sim: bad dims: " + val); return -1; }
+    } else if (key == "host_block") {
+      if (!parse_triple(val, host_block)) { set_error("sim: bad host_block: " + val); return -1; }
+    } else if (key == "torus") {
+      if (!parse_triple(val, torus)) { set_error("sim: bad torus: " + val); return -1; }
+    } else if (key == "host") {
+      host = val;
+    } else if (key == "hbm") {
+      hbm = std::strtoll(val.c_str(), nullptr, 10);
+      if (hbm <= 0) { set_error("sim: bad hbm: " + val); return -1; }
+    } else if (key == "cores") {
+      cores = std::atoi(val.c_str());
+      if (cores <= 0) { set_error("sim: bad cores: " + val); return -1; }
+    } else {
+      set_error("sim: unknown spec key: " + key);
+      return -1;
+    }
+  }
+  for (int a = 0; a < 3; ++a) {
+    if (dims[a] <= 0 || host_block[a] <= 0 || dims[a] % host_block[a] != 0) {
+      set_error("sim: host_block must divide dims and both be positive");
+      return -1;
+    }
+  }
+  int hg[3];  /* host grid position parsed from the host name */
+  if (std::sscanf(host.c_str(), "host-%d-%d-%d", &hg[0], &hg[1], &hg[2]) != 3) {
+    set_error("sim: malformed host name (want host-i-j-k): " + host);
+    return -1;
+  }
+  for (int a = 0; a < 3; ++a) {
+    if (hg[a] < 0 || hg[a] >= dims[a] / host_block[a]) {
+      set_error("sim: host outside host grid: " + host);
+      return -1;
+    }
+  }
+
+  std::memcpy(g_state.mesh.dims, dims, sizeof dims);
+  std::memcpy(g_state.mesh.host_block, host_block, sizeof host_block);
+  std::memcpy(g_state.mesh.torus, torus, sizeof torus);
+  g_state.chips.clear();
+
+  /* Mint this host's chips: x fastest within the host block, matching
+   * MeshSpec.coords_of_host on the Python side. */
+  int32_t idx = 0;
+  for (int dz = 0; dz < host_block[2]; ++dz)
+    for (int dy = 0; dy < host_block[1]; ++dy)
+      for (int dx = 0; dx < host_block[0]; ++dx) {
+        tpuinfo_chip c{};
+        c.index = idx;
+        c.coord[0] = hg[0] * host_block[0] + dx;
+        c.coord[1] = hg[1] * host_block[1] + dy;
+        c.coord[2] = hg[2] * host_block[2] + dz;
+        std::snprintf(c.chip_id, TPUINFO_MAX_ID, "%s-chip-%d", host.c_str(), idx);
+        c.hbm_bytes = hbm;
+        c.num_cores = cores;
+        c.healthy = 1;
+        g_state.chips.push_back(c);
+        ++idx;
+      }
+  g_state.is_sim = true;
+  return 0;
+}
+
+int init_real(const char* spec) {
+  std::string libtpu_path = "libtpu.so";
+  std::string gen = "v5e";
+  int32_t nchips = 1;
+  if (const char* env_gen = std::getenv("PALLAS_AXON_TPU_GEN")) gen = env_gen;
+  for (const auto& [key, val] : parse_spec(spec)) {
+    if (key == "libtpu") libtpu_path = val;
+    else if (key == "gen") gen = val;
+    else if (key == "chips") {
+      nchips = std::atoi(val.c_str());
+      if (nchips <= 0) { set_error("real: bad chips: " + val); return -1; }
+    } else { set_error("real: unknown spec key: " + key); return -1; }
+  }
+
+  const GenInfo* gi = nullptr;
+  for (const auto& g : kGenTable)
+    if (gen == g.name) { gi = &g; break; }
+  if (gi == nullptr) {
+    set_error("real: unknown TPU generation: " + gen);
+    return -1;
+  }
+
+  /* Liveness: libtpu.so must load and expose a PJRT entry point. This is
+   * the TPU analog of nvmlInit succeeding. RTLD_NOLOAD-first so we never
+   * double-initialize a runtime the host process already owns. */
+  void* h = dlopen(libtpu_path.c_str(), RTLD_LAZY | RTLD_NOLOAD);
+  if (h == nullptr) h = dlopen(libtpu_path.c_str(), RTLD_LAZY | RTLD_LOCAL);
+  if (h == nullptr) {
+    set_error(std::string("real: cannot load libtpu: ") + dlerror());
+    return -1;
+  }
+  if (dlsym(h, "GetPjrtApi") == nullptr) {
+    set_error("real: libtpu loaded but GetPjrtApi missing — not a PJRT libtpu");
+    dlclose(h);
+    return -1;
+  }
+  /* handle intentionally retained for process lifetime (liveness probe) */
+
+  g_state.mesh = tpuinfo_mesh{{nchips, 1, 1}, {nchips, 1, 1}, {0, 0, 0}};
+  g_state.chips.clear();
+  for (int32_t i = 0; i < nchips; ++i) {
+    tpuinfo_chip c{};
+    c.index = i;
+    c.coord[0] = i;
+    std::snprintf(c.chip_id, TPUINFO_MAX_ID, "local-%s-chip-%d", gen.c_str(), i);
+    c.hbm_bytes = gi->hbm_bytes;
+    c.num_cores = gi->cores;
+    c.healthy = 1;
+    g_state.chips.push_back(c);
+  }
+  g_state.is_sim = false;
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int tpuinfo_abi_version(void) { return TPUINFO_ABI_VERSION; }
+
+int tpuinfo_init(const char* backend, const char* spec) {
+  if (g_state.initialized) {
+    set_error("already initialized (call tpuinfo_shutdown first)");
+    return -1;
+  }
+  if (backend == nullptr) {
+    set_error("backend is null");
+    return -1;
+  }
+  int rc;
+  if (std::strcmp(backend, "sim") == 0) rc = init_sim(spec);
+  else if (std::strcmp(backend, "real") == 0) rc = init_real(spec);
+  else {
+    set_error(std::string("unknown backend: ") + backend);
+    return -1;
+  }
+  if (rc == 0) g_state.initialized = true;
+  return rc;
+}
+
+int tpuinfo_shutdown(void) {
+  if (!g_state.initialized) {
+    set_error("not initialized");
+    return -1;
+  }
+  g_state = State{};
+  return 0;
+}
+
+int tpuinfo_mesh_get(tpuinfo_mesh* out) {
+  if (!g_state.initialized) { set_error("not initialized"); return -1; }
+  if (out == nullptr) { set_error("out is null"); return -1; }
+  *out = g_state.mesh;
+  return 0;
+}
+
+int tpuinfo_chip_count(void) {
+  if (!g_state.initialized) { set_error("not initialized"); return -1; }
+  return static_cast<int>(g_state.chips.size());
+}
+
+int tpuinfo_chip_get(int32_t index, tpuinfo_chip* out) {
+  if (!g_state.initialized) { set_error("not initialized"); return -1; }
+  if (out == nullptr) { set_error("out is null"); return -1; }
+  if (index < 0 || index >= static_cast<int32_t>(g_state.chips.size())) {
+    set_error("chip index out of range");
+    return -1;
+  }
+  *out = g_state.chips[index];
+  return 0;
+}
+
+int tpuinfo_chip_links(int32_t index, int32_t* out, int32_t max) {
+  if (!g_state.initialized) { set_error("not initialized"); return -1; }
+  if (out == nullptr && max > 0) { set_error("out is null"); return -1; }
+  if (index < 0 || index >= static_cast<int32_t>(g_state.chips.size())) {
+    set_error("chip index out of range");
+    return -1;
+  }
+  const tpuinfo_chip& c = g_state.chips[index];
+  int n = 0;
+  for (int axis = 0; axis < 3; ++axis) {
+    int d = g_state.mesh.dims[axis];
+    if (d <= 1) continue;
+    for (int step = -1; step <= 1; step += 2) {
+      int32_t nb[3] = {c.coord[0], c.coord[1], c.coord[2]};
+      nb[axis] += step;
+      if (nb[axis] < 0 || nb[axis] >= d) {
+        if (!g_state.mesh.torus[axis]) continue;
+        nb[axis] = (nb[axis] + d) % d;
+      }
+      /* length-2 torus axis: both steps reach the same chip; dedup */
+      bool dup = false;
+      for (int j = 0; j < n; ++j)
+        if (out[3 * j] == nb[0] && out[3 * j + 1] == nb[1] && out[3 * j + 2] == nb[2])
+          dup = true;
+      if (dup || (nb[0] == c.coord[0] && nb[1] == c.coord[1] && nb[2] == c.coord[2]))
+        continue;
+      if (n >= max) { set_error("links buffer too small"); return -1; }
+      out[3 * n] = nb[0];
+      out[3 * n + 1] = nb[1];
+      out[3 * n + 2] = nb[2];
+      ++n;
+    }
+  }
+  return n;
+}
+
+int tpuinfo_inject_fault(int32_t index, int32_t healthy) {
+  if (!g_state.initialized) { set_error("not initialized"); return -1; }
+  if (!g_state.is_sim) {
+    set_error("fault injection is sim-only");
+    return -1;
+  }
+  if (index < 0 || index >= static_cast<int32_t>(g_state.chips.size())) {
+    set_error("chip index out of range");
+    return -1;
+  }
+  g_state.chips[index].healthy = healthy ? 1 : 0;
+  return 0;
+}
+
+const char* tpuinfo_last_error(void) { return g_last_error.c_str(); }
+
+}  // extern "C"

@@ -1,0 +1,80 @@
+/* libtpuinfo — native TPU chip enumeration shim (C ABI).
+ *
+ * TPU-native analog of the reference's NVML cgo binding (SURVEY.md §2 C2):
+ * where KubeGPU wraps libnvidia-ml.so (device count/UUID/memory, NVLink
+ * topology, XID health events), this shim exposes chip enumeration for a
+ * node agent: chip id, mesh coordinate, HBM bytes, core count, health, and
+ * the ICI link table (mesh adjacency).
+ *
+ * Two backends, selected at init:
+ *   "sim"  — topology from a key=value spec (the load-bearing backend: no
+ *            cluster or multi-chip hardware exists in CI; BASELINE config 1
+ *            requires a fake-device path).
+ *   "real" — minimal local-chip enumeration: libtpu.so liveness via
+ *            dlopen/dlsym + per-generation HBM/core tables. Full topology
+ *            introspection on real fleets rides the in-pod PJRT runtime;
+ *            the node agent only needs enumerate + liveness (SURVEY.md §9.3).
+ *
+ * Consumed from Python via ctypes (tpukube/native/tpuinfo.py). All calls
+ * return 0 on success, -1 on error; tpuinfo_last_error() describes the
+ * failure. Not thread-safe by design: the node agent owns one instance
+ * behind a lock (mirrors NVML's init/shutdown discipline).
+ */
+#ifndef TPUKUBE_TPUINFO_H
+#define TPUKUBE_TPUINFO_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define TPUINFO_ABI_VERSION 1
+#define TPUINFO_MAX_ID 64
+
+typedef struct {
+  int32_t index;              /* node-local chip index */
+  char chip_id[TPUINFO_MAX_ID];
+  int32_t coord[3];           /* global mesh coordinate (x, y, z) */
+  int64_t hbm_bytes;
+  int32_t num_cores;          /* TensorCores per chip */
+  int32_t healthy;            /* 1 healthy, 0 unhealthy */
+} tpuinfo_chip;
+
+typedef struct {
+  int32_t dims[3];
+  int32_t host_block[3];
+  int32_t torus[3];
+} tpuinfo_mesh;
+
+int tpuinfo_abi_version(void);
+
+/* backend: "sim" or "real". spec: key=value lines (sim), or NULL (real).
+ * Sim spec keys: dims=X,Y,Z  host_block=X,Y,Z  torus=0|1,0|1,0|1
+ *                host=host-i-j-k  hbm=<bytes>  cores=<n>
+ * Real spec keys (all optional): libtpu=<path>  gen=v4|v5e|v5p|v6e  chips=<n>
+ * Real-backend generation default: env PALLAS_AXON_TPU_GEN if set (the env
+ * this machine's TPU tunnel exports), else "v5e"; an explicit gen= spec key
+ * always wins.
+ */
+int tpuinfo_init(const char* backend, const char* spec);
+int tpuinfo_shutdown(void);
+
+int tpuinfo_mesh_get(tpuinfo_mesh* out);
+int tpuinfo_chip_count(void);
+int tpuinfo_chip_get(int32_t index, tpuinfo_chip* out);
+
+/* ICI link table: write up to max neighbor coords (x,y,z triples) of chip
+ * `index` into out (length 3*max). Returns neighbor count, or -1. */
+int tpuinfo_chip_links(int32_t index, int32_t* out, int32_t max);
+
+/* Health manipulation — the sim analog of an NVML XID event (sim only). */
+int tpuinfo_inject_fault(int32_t index, int32_t healthy);
+
+const char* tpuinfo_last_error(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TPUKUBE_TPUINFO_H */

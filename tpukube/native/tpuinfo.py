@@ -1,0 +1,242 @@
+"""ctypes wrapper over libtpuinfo.so (SURVEY.md §2 C2).
+
+The reference consumes libnvidia-ml.so through cgo; here Python consumes the
+C++ enumeration shim through ctypes (no pybind11 in this environment — task
+brief). The wrapper owns build-on-demand (make), struct marshalling into the
+core types, and turning C error returns into :class:`TpuInfoError`.
+
+Thread-safety: libtpuinfo is single-instance; :class:`TpuInfo` serializes
+all calls behind a lock, mirroring the reference's NVML init/shutdown
+discipline.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+from tpukube.core.mesh import MeshSpec
+from tpukube.core.types import ChipInfo, Health, TopologyCoord
+
+_NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libtpuinfo.so")
+
+ABI_VERSION = 1
+_MAX_LINKS = 6
+
+
+class TpuInfoError(RuntimeError):
+    pass
+
+
+class _Chip(ctypes.Structure):
+    _fields_ = [
+        ("index", ctypes.c_int32),
+        ("chip_id", ctypes.c_char * 64),
+        ("coord", ctypes.c_int32 * 3),
+        ("hbm_bytes", ctypes.c_int64),
+        ("num_cores", ctypes.c_int32),
+        ("healthy", ctypes.c_int32),
+    ]
+
+
+class _Mesh(ctypes.Structure):
+    _fields_ = [
+        ("dims", ctypes.c_int32 * 3),
+        ("host_block", ctypes.c_int32 * 3),
+        ("torus", ctypes.c_int32 * 3),
+    ]
+
+
+def _ensure_built() -> str:
+    """Build libtpuinfo.so if missing or older than its sources."""
+    src = os.path.join(_NATIVE_DIR, "tpuinfo.cpp")
+    hdr = os.path.join(_NATIVE_DIR, "tpuinfo.h")
+    if os.path.exists(_LIB_PATH):
+        lib_mtime = os.path.getmtime(_LIB_PATH)
+        if all(os.path.getmtime(p) <= lib_mtime for p in (src, hdr)):
+            return _LIB_PATH
+    proc = subprocess.run(
+        ["make", "-C", _NATIVE_DIR, "libtpuinfo.so"],
+        capture_output=True,
+        text=True,
+    )
+    if proc.returncode != 0:
+        raise TpuInfoError(
+            f"failed to build libtpuinfo.so:\n{proc.stdout}\n{proc.stderr}"
+        )
+    return _LIB_PATH
+
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        lib = ctypes.CDLL(_ensure_built())
+        lib.tpuinfo_abi_version.restype = ctypes.c_int
+        lib.tpuinfo_init.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.tpuinfo_init.restype = ctypes.c_int
+        lib.tpuinfo_shutdown.restype = ctypes.c_int
+        lib.tpuinfo_mesh_get.argtypes = [ctypes.POINTER(_Mesh)]
+        lib.tpuinfo_mesh_get.restype = ctypes.c_int
+        lib.tpuinfo_chip_count.restype = ctypes.c_int
+        lib.tpuinfo_chip_get.argtypes = [ctypes.c_int32, ctypes.POINTER(_Chip)]
+        lib.tpuinfo_chip_get.restype = ctypes.c_int
+        lib.tpuinfo_chip_links.argtypes = [
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+        ]
+        lib.tpuinfo_chip_links.restype = ctypes.c_int
+        lib.tpuinfo_inject_fault.argtypes = [ctypes.c_int32, ctypes.c_int32]
+        lib.tpuinfo_inject_fault.restype = ctypes.c_int
+        lib.tpuinfo_last_error.restype = ctypes.c_char_p
+        abi = lib.tpuinfo_abi_version()
+        if abi != ABI_VERSION:
+            raise TpuInfoError(f"libtpuinfo ABI {abi} != expected {ABI_VERSION}")
+        _lib = lib
+        return lib
+
+
+def sim_spec(
+    mesh: MeshSpec,
+    host: str,
+    hbm_bytes: int,
+    cores: int = 2,
+) -> str:
+    """Render the key=value sim spec libtpuinfo parses."""
+
+    def triple(t) -> str:
+        return ",".join(str(int(v)) for v in t)
+
+    return (
+        f"dims={triple(mesh.dims)}\n"
+        f"host_block={triple(mesh.host_block)}\n"
+        f"torus={triple(mesh.torus)}\n"
+        f"host={host}\n"
+        f"hbm={hbm_bytes}\n"
+        f"cores={cores}\n"
+    )
+
+
+class TpuInfo:
+    """One initialized enumeration session (context manager).
+
+    >>> with TpuInfo("sim", sim_spec(mesh, "host-0-0-0", 16 << 30)) as ti:
+    ...     chips = ti.chips()
+    """
+
+    _instance_lock = threading.Lock()
+
+    def __init__(self, backend: str, spec: Optional[str] = None):
+        self._lib = _load()
+        self._lock = threading.Lock()
+        self._open = False
+        with TpuInfo._instance_lock:
+            rc = self._lib.tpuinfo_init(
+                backend.encode(), spec.encode() if spec is not None else None
+            )
+            if rc != 0:
+                raise TpuInfoError(self._last_error())
+            self._open = True
+
+    def _last_error(self) -> str:
+        return (self._lib.tpuinfo_last_error() or b"").decode()
+
+    def _check_open(self) -> None:
+        if not self._open:
+            raise TpuInfoError("TpuInfo session is closed")
+
+    def close(self) -> None:
+        # _instance_lock serializes shutdown against a concurrent __init__ of
+        # a new session: the C globals are not thread-safe.
+        with TpuInfo._instance_lock, self._lock:
+            if self._open:
+                self._open = False
+                if self._lib.tpuinfo_shutdown() != 0:
+                    raise TpuInfoError(self._last_error())
+
+    def __enter__(self) -> "TpuInfo":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self) -> None:
+        # A leaked session would wedge the process-wide singleton; release
+        # best-effort on GC (explicit close() remains the contract).
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def mesh(self) -> MeshSpec:
+        with self._lock:
+            self._check_open()
+            m = _Mesh()
+            if self._lib.tpuinfo_mesh_get(ctypes.byref(m)) != 0:
+                raise TpuInfoError(self._last_error())
+            return MeshSpec(
+                dims=tuple(m.dims),
+                host_block=tuple(m.host_block),
+                torus=tuple(bool(v) for v in m.torus),
+            )
+
+    def chip_count(self) -> int:
+        with self._lock:
+            self._check_open()
+            n = self._lib.tpuinfo_chip_count()
+            if n < 0:
+                raise TpuInfoError(self._last_error())
+            return n
+
+    def chips(self) -> list[ChipInfo]:
+        with self._lock:
+            self._check_open()
+            n = self._lib.tpuinfo_chip_count()
+            if n < 0:
+                raise TpuInfoError(self._last_error())
+            out: list[ChipInfo] = []
+            for i in range(n):
+                c = _Chip()
+                if self._lib.tpuinfo_chip_get(i, ctypes.byref(c)) != 0:
+                    raise TpuInfoError(self._last_error())
+                out.append(
+                    ChipInfo(
+                        chip_id=c.chip_id.decode(),
+                        index=int(c.index),
+                        coord=TopologyCoord(*c.coord),
+                        hbm_bytes=int(c.hbm_bytes),
+                        num_cores=int(c.num_cores),
+                        health=Health.HEALTHY if c.healthy else Health.UNHEALTHY,
+                    )
+                )
+            return out
+
+    def links(self, index: int) -> list[TopologyCoord]:
+        """ICI neighbor coords of a chip — the NVLink-table analog."""
+        with self._lock:
+            self._check_open()
+            buf = (ctypes.c_int32 * (3 * _MAX_LINKS))()
+            n = self._lib.tpuinfo_chip_links(index, buf, _MAX_LINKS)
+            if n < 0:
+                raise TpuInfoError(self._last_error())
+            return [
+                TopologyCoord(buf[3 * i], buf[3 * i + 1], buf[3 * i + 2])
+                for i in range(n)
+            ]
+
+    def inject_fault(self, index: int, healthy: bool = False) -> None:
+        """Flip a chip's health (sim backend only) — the XID-event analog."""
+        with self._lock:
+            self._check_open()
+            if self._lib.tpuinfo_inject_fault(index, 1 if healthy else 0) != 0:
+                raise TpuInfoError(self._last_error())
