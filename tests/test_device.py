@@ -1,0 +1,115 @@
+import pytest
+
+from tpukube.core.config import load_config
+from tpukube.core.types import Health
+from tpukube.device import DeviceError, TpuDeviceManager
+from tpukube.device.tpu import (
+    ENV_HBM_LIMIT,
+    ENV_KUBE_CHIP_COORDS,
+    ENV_KUBE_MESH_DIMS,
+    ENV_MEM_FRACTION,
+    ENV_VISIBLE_DEVICES,
+)
+
+HBM = 16 << 30
+
+
+def _mgr(shares=1, host="host-0-0-0"):
+    cfg = load_config(env={
+        "TPUKUBE_SHARES_PER_CHIP": str(shares),
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        "TPUKUBE_HBM_BYTES_PER_CHIP": str(HBM),
+    })
+    return TpuDeviceManager(cfg, host=host)
+
+
+def test_whole_chip_mode_advertises_chips():
+    with _mgr() as m:
+        assert m.resource_name == "qiniu.com/tpu"
+        devs = m.device_list()
+        assert [d for d, _ in devs] == ["tpu-0", "tpu-1", "tpu-2", "tpu-3"]
+        assert all(h is Health.HEALTHY for _, h in devs)
+
+
+def test_vtpu_mode_advertises_shares_only():
+    with _mgr(shares=2) as m:
+        assert m.resource_name == "qiniu.com/vtpu"
+        ids = [d for d, _ in m.device_list()]
+        assert len(ids) == 8
+        assert "tpu-0-frac0of2" in ids and "tpu-3-frac1of2" in ids
+        assert all("frac" in d for d in ids)
+
+
+def test_allocate_env_whole_chips():
+    with _mgr() as m:
+        env = m.allocate_env(["tpu-2", "tpu-0"])
+        assert env[ENV_VISIBLE_DEVICES] == "0,2"
+        assert env[ENV_KUBE_MESH_DIMS] == "4,4,1"
+        assert env[ENV_HBM_LIMIT] == str(2 * HBM)
+        assert env[ENV_KUBE_CHIP_COORDS] == "0,0,0;0,1,0"
+        assert ENV_MEM_FRACTION not in env  # no cap in whole-chip mode
+
+
+def test_allocate_env_fractional_sets_quota():
+    with _mgr(shares=2) as m:
+        env = m.allocate_env(["tpu-1-frac0of2"])
+        assert env[ENV_VISIBLE_DEVICES] == "1"
+        assert env[ENV_HBM_LIMIT] == str(HBM // 2)
+        assert env[ENV_MEM_FRACTION] == "0.5000"
+        # both shares of one chip -> full chip quota
+        env = m.allocate_env(["tpu-2-frac0of2", "tpu-2-frac1of2"])
+        assert env[ENV_HBM_LIMIT] == str(HBM)
+        assert env[ENV_MEM_FRACTION] == "1.0000"
+
+
+def test_allocate_rejects_mode_mismatch_and_junk():
+    with _mgr() as m:
+        with pytest.raises(DeviceError, match="vTPU id rejected"):
+            m.allocate_env(["tpu-0-frac0of2"])
+        with pytest.raises(DeviceError, match="malformed"):
+            m.allocate_env(["gpu-0"])
+        with pytest.raises(DeviceError, match="duplicate"):
+            m.allocate_env(["tpu-0", "tpu-0"])
+        with pytest.raises(DeviceError, match="empty"):
+            m.allocate_env([])
+        with pytest.raises(DeviceError, match="unknown chip"):
+            m.allocate_env(["tpu-9"])
+    with _mgr(shares=2) as m:
+        with pytest.raises(DeviceError, match="whole-chip id rejected"):
+            m.allocate_env(["tpu-0"])
+        with pytest.raises(DeviceError, match="does not match"):
+            m.allocate_env(["tpu-0-frac0of4"])
+
+
+def test_allocate_rejects_unhealthy():
+    with _mgr() as m:
+        m.inject_fault(1)
+        with pytest.raises(DeviceError, match="unhealthy"):
+            m.allocate_env(["tpu-1"])
+        m.allocate_env(["tpu-0"])  # healthy chips still allocatable
+
+
+def test_preferred_allocation_prefers_adjacency():
+    # host block is 2x2x1: chips 0,1,2,3 at (0,0),(1,0),(0,1),(1,1).
+    with _mgr() as m:
+        chosen = m.preferred_allocation(
+            ["tpu-0", "tpu-1", "tpu-2", "tpu-3"], [], 2
+        )
+        # any adjacent pair is acceptable; first pick is deterministic tpu-0
+        assert chosen[0] == "tpu-0"
+        assert chosen[1] in ("tpu-1", "tpu-2")  # neighbors of chip 0, not diagonal
+        chosen = m.preferred_allocation(
+            ["tpu-0", "tpu-1", "tpu-2", "tpu-3"], ["tpu-3"], 3
+        )
+        assert chosen[0] == "tpu-3" and len(set(chosen)) == 3
+
+
+def test_preferred_allocation_errors():
+    with _mgr() as m:
+        with pytest.raises(DeviceError, match="smaller"):
+            m.preferred_allocation(["tpu-0"], ["tpu-0", "tpu-1"], 1)
+        with pytest.raises(DeviceError, match="larger"):
+            m.preferred_allocation(["tpu-0"], [], 2)
+        with pytest.raises(DeviceError, match="not in available"):
+            m.preferred_allocation(["tpu-0"], ["tpu-3"], 1)

@@ -89,11 +89,17 @@ def test_real_backend_bogus_libtpu_fails_cleanly():
 
 
 def test_native_selftest_binary_passes():
+    import os
+
+    native_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tpukube",
+        "native",
+    )
     proc = subprocess.run(
-        ["make", "-C", "tpukube/native", "selftest"],
+        ["make", "-C", native_dir, "selftest"],
         capture_output=True,
         text=True,
-        cwd="/root/repo",
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "all checks passed" in proc.stdout

@@ -1,0 +1,123 @@
+"""End-to-end device-plugin path over real unix sockets.
+
+This is BASELINE config 1 ("Single-pod 1-device Allocate smoke test,
+fake-device sim, CPU-only control plane") plus the health-shrink flow of
+SURVEY.md §4.4: Register -> ListAndWatch -> Allocate -> fault -> capacity
+drop, all against a live gRPC server in-process.
+"""
+
+import pytest
+
+from tpukube.core.config import load_config
+from tpukube.device import TpuDeviceManager
+from tpukube.device.tpu import ENV_HBM_LIMIT, ENV_VISIBLE_DEVICES
+from tpukube.plugin import DevicePluginServer, FakeKubelet, HealthWatcher
+
+HBM = 16 << 30
+
+
+@pytest.fixture
+def stack(tmp_path):
+    """A running fake kubelet + plugin + health watcher on tmp sockets."""
+    cfg = load_config(env={
+        "TPUKUBE_DEVICE_PLUGIN_DIR": str(tmp_path),
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        "TPUKUBE_HBM_BYTES_PER_CHIP": str(HBM),
+    })
+    with FakeKubelet(str(tmp_path)) as kubelet, \
+         TpuDeviceManager(cfg, host="host-0-0-0") as device:
+        with DevicePluginServer(cfg, device) as server:
+            watcher = HealthWatcher(device, server, poll_seconds=60.0)
+            watcher.start()
+            try:
+                yield cfg, kubelet, device, server, watcher
+            finally:
+                watcher.stop()
+
+
+def test_config1_register_watch_allocate(stack):
+    cfg, kubelet, device, server, watcher = stack
+    server.register_with_kubelet()
+
+    # kubelet's ListAndWatch cache fills with this host's 4 chips
+    devs = kubelet.wait_for_devices("qiniu.com/tpu", 4)
+    assert set(devs) == {"tpu-0", "tpu-1", "tpu-2", "tpu-3"}
+    assert kubelet.allocatable("qiniu.com/tpu") == 4
+
+    # single-pod, 1-device Allocate (the config-1 smoke)
+    env = kubelet.allocate("qiniu.com/tpu", ["tpu-0"])
+    assert env[ENV_VISIBLE_DEVICES] == "0"
+    assert env[ENV_HBM_LIMIT] == str(HBM)
+    assert server.allocation_count == 1
+
+
+def test_health_fault_shrinks_allocatable(stack):
+    cfg, kubelet, device, server, watcher = stack
+    server.register_with_kubelet()
+    kubelet.wait_for_devices("qiniu.com/tpu", 4)
+
+    # inject an XID-analog fault; step the watcher deterministically
+    device.inject_fault(2)
+    assert watcher.check_once() is True
+    kubelet.wait_for_health("qiniu.com/tpu", "tpu-2", "Unhealthy")
+    assert kubelet.allocatable("qiniu.com/tpu") == 3
+
+    # recovery flows back too
+    device.inject_fault(2, healthy=True)
+    assert watcher.check_once() is True
+    kubelet.wait_for_health("qiniu.com/tpu", "tpu-2", "Healthy")
+    assert kubelet.allocatable("qiniu.com/tpu") == 4
+    assert watcher.transitions == 2
+    # no-op poll pushes nothing
+    assert watcher.check_once() is False
+
+
+def test_preferred_allocation_rpc(stack):
+    cfg, kubelet, device, server, watcher = stack
+    server.register_with_kubelet()
+    kubelet.wait_for_devices("qiniu.com/tpu", 4)
+    chosen = kubelet.preferred(
+        "qiniu.com/tpu", ["tpu-0", "tpu-1", "tpu-2", "tpu-3"], 2
+    )
+    assert len(chosen) == 2 and chosen[1] in ("tpu-1", "tpu-2")
+
+
+def test_allocate_error_becomes_invalid_argument(stack):
+    import grpc
+
+    cfg, kubelet, device, server, watcher = stack
+    server.register_with_kubelet()
+    kubelet.wait_for_devices("qiniu.com/tpu", 4)
+    with pytest.raises(grpc.RpcError) as exc:
+        kubelet.allocate("qiniu.com/tpu", ["tpu-99"])
+    assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_re_registration_replaces_stream(stack):
+    cfg, kubelet, device, server, watcher = stack
+    server.register_with_kubelet()
+    kubelet.wait_for_devices("qiniu.com/tpu", 4)
+    # plugin restarts re-register (SURVEY.md §6: stateless control plane)
+    server.register_with_kubelet()
+    kubelet.wait_for_devices("qiniu.com/tpu", 4)
+    env = kubelet.allocate("qiniu.com/tpu", ["tpu-1"])
+    assert env[ENV_VISIBLE_DEVICES] == "1"
+
+
+def test_vtpu_node_advertises_shares(tmp_path):
+    cfg = load_config(env={
+        "TPUKUBE_DEVICE_PLUGIN_DIR": str(tmp_path),
+        "TPUKUBE_SHARES_PER_CHIP": "2",
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        "TPUKUBE_HBM_BYTES_PER_CHIP": str(HBM),
+    })
+    with FakeKubelet(str(tmp_path)) as kubelet, \
+         TpuDeviceManager(cfg) as device, \
+         DevicePluginServer(cfg, device) as server:
+        server.register_with_kubelet()
+        devs = kubelet.wait_for_devices("qiniu.com/vtpu", 8)
+        assert all("frac" in d for d in devs)
+        env = kubelet.allocate("qiniu.com/vtpu", ["tpu-0-frac1of2"])
+        assert env[ENV_HBM_LIMIT] == str(HBM // 2)

@@ -113,6 +113,8 @@ def decode_node_topology(payload: str) -> tuple[NodeInfo, MeshSpec]:
         shares = int(obj.get("sharesPerChip", 1))
     except (TypeError, ValueError) as e:
         raise CodecError(f"node-topology: bad sharesPerChip: {e}") from e
+    if shares < 1:
+        raise CodecError(f"node-topology: sharesPerChip must be >= 1, got {shares}")
     node = NodeInfo(
         name=_field(obj, "node", "node-topology"),
         chips=chips,

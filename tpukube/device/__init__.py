@@ -1,0 +1,3 @@
+"""Device abstraction (L2): TPU discovery, device minting, allocation env."""
+
+from tpukube.device.tpu import DeviceError, TpuDeviceManager  # noqa: F401

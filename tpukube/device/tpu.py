@@ -1,0 +1,260 @@
+"""TPU device manager (L2) — the reference's ``device/nvidia/`` analog.
+
+SURVEY.md §2 C3: the reference's Device impl discovers GPUs via NVML, builds
+the topology tree, translates container requests into tree resources, and
+does node-local allocation bookkeeping. The TPU version discovers chips via
+libtpuinfo (C++/ctypes), models the ICI mesh, and mints device ids:
+
+  whole chips      -> ``qiniu.com/tpu``   ids ``tpu-<i>``
+  fractional vTPUs -> ``qiniu.com/vtpu``  ids ``tpu-<i>-frac<k>of<n>``
+
+Sharing policy: ``shares_per_chip`` is a node-level mode switch. A node
+either advertises whole chips (shares_per_chip == 1) or vTPU shares (> 1),
+never both — advertising both would let the kubelet double-book a chip,
+since extended-resource accounting is per-resource. This mirrors the
+GPU-world practice of dedicating node pools to fractional sharing.
+
+Allocation responses carry env only (no /dev device nodes: TPU runtimes in
+pods reach chips through the platform's own device plumbing; what they need
+from us is which chips are theirs and how much HBM they may map —
+SURVEY.md §4.3).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from tpukube.core.config import TpuKubeConfig
+from tpukube.core.mesh import MeshSpec
+from tpukube.core.types import (
+    ChipInfo,
+    Health,
+    NodeInfo,
+    VtpuShare,
+    make_device_id,
+    parse_device_id,
+)
+from tpukube.native import TpuInfo, sim_spec
+
+# Env exported to allocated containers. TPU_VISIBLE_DEVICES is the real
+# libtpu env gating chip visibility; the TPU_KUBE_* keys carry mesh context
+# so the in-pod JAX job can build its jax.sharding.Mesh; the HBM keys are
+# the cooperative quota channel (XLA client respects MEM_FRACTION).
+ENV_VISIBLE_DEVICES = "TPU_VISIBLE_DEVICES"
+ENV_KUBE_DEVICE_IDS = "TPU_KUBE_DEVICE_IDS"
+ENV_KUBE_CHIP_COORDS = "TPU_KUBE_CHIP_COORDS"
+ENV_KUBE_MESH_DIMS = "TPU_KUBE_MESH_DIMS"
+ENV_KUBE_HOST = "TPU_KUBE_HOST"
+ENV_HBM_LIMIT = "TPU_HBM_LIMIT_BYTES"
+ENV_MEM_FRACTION = "XLA_PYTHON_CLIENT_MEM_FRACTION"
+
+
+class DeviceError(RuntimeError):
+    pass
+
+
+class TpuDeviceManager:
+    """Owns the node's libtpuinfo session and all device-id minting."""
+
+    def __init__(
+        self,
+        config: TpuKubeConfig,
+        host: Optional[str] = None,
+        libtpu_path: Optional[str] = None,
+    ):
+        self._config = config
+        self._lock = threading.Lock()
+        self._host = host or "host-0-0-0"
+        if config.backend == "sim":
+            spec = sim_spec(
+                config.sim_mesh(),
+                self._host,
+                config.hbm_bytes_per_chip,
+                config.cores_per_chip,
+            )
+            self._ti = TpuInfo("sim", spec)
+        else:
+            spec = f"libtpu={libtpu_path}\n" if libtpu_path else None
+            self._ti = TpuInfo("real", spec)
+        self._mesh = self._ti.mesh()
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self) -> None:
+        self._ti.close()
+
+    def __enter__(self) -> "TpuDeviceManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- discovery ---------------------------------------------------------
+    @property
+    def mesh(self) -> MeshSpec:
+        return self._mesh
+
+    @property
+    def host(self) -> str:
+        return self._host
+
+    @property
+    def resource_name(self) -> str:
+        """The one extended resource this node advertises (see module doc)."""
+        if self._config.shares_per_chip > 1:
+            return self._config.resource_vtpu
+        return self._config.resource_tpu
+
+    def chips(self) -> list[ChipInfo]:
+        return self._ti.chips()
+
+    def node_info(self) -> NodeInfo:
+        return NodeInfo(
+            name=self._host,
+            chips=self.chips(),
+            shares_per_chip=self._config.shares_per_chip,
+        )
+
+    def shares_of(self, chip: ChipInfo) -> list[VtpuShare]:
+        n = self._config.shares_per_chip
+        quota = chip.hbm_bytes // n
+        return [VtpuShare(chip.index, k, n, quota) for k in range(n)]
+
+    def device_list(self) -> list[tuple[str, Health]]:
+        """(device_id, health) pairs advertised on ListAndWatch."""
+        out: list[tuple[str, Health]] = []
+        for chip in self.chips():
+            if self._config.shares_per_chip > 1:
+                out.extend((s.device_id(), chip.health) for s in self.shares_of(chip))
+            else:
+                out.append((chip.device_id(), chip.health))
+        return out
+
+    def health_snapshot(self) -> dict[str, Health]:
+        return dict(self.device_list())
+
+    # -- allocation --------------------------------------------------------
+    def allocate_env(self, device_ids: list[str]) -> dict[str, str]:
+        """Build the container env for an Allocate of ``device_ids``.
+
+        Whole-chip and fractional ids cannot mix (they are different
+        resources; the kubelet never mixes them in one request — rejecting
+        here guards against a confused caller).
+        """
+        with self._lock:
+            if not device_ids:
+                raise DeviceError("empty device list")
+            by_index = {c.index: c for c in self.chips()}
+
+            def chip_at(index: int) -> ChipInfo:
+                if index not in by_index:
+                    raise DeviceError(f"unknown chip index {index} on {self._host}")
+                return by_index[index]
+
+            shares_mode = self._config.shares_per_chip > 1
+            chip_indices: list[int] = []
+            hbm_limit = 0
+            seen: set[str] = set()
+            for did in device_ids:
+                if did in seen:
+                    raise DeviceError(f"duplicate device id {did}")
+                seen.add(did)
+                try:
+                    index, frac = parse_device_id(did)
+                except ValueError as e:
+                    raise DeviceError(str(e)) from e
+                chip = chip_at(index)
+                if chip.health is not Health.HEALTHY:
+                    raise DeviceError(f"device {did} is unhealthy")
+                if shares_mode:
+                    if frac is None:
+                        raise DeviceError(
+                            f"{did}: node is in vTPU mode; whole-chip id rejected"
+                        )
+                    k, n = frac
+                    if n != self._config.shares_per_chip or not 0 <= k < n:
+                        raise DeviceError(f"{did}: share does not match node config")
+                    hbm_limit += chip.hbm_bytes // n
+                else:
+                    if frac is not None:
+                        raise DeviceError(
+                            f"{did}: node is in whole-chip mode; vTPU id rejected"
+                        )
+                    hbm_limit += chip.hbm_bytes
+                if index not in chip_indices:
+                    chip_indices.append(index)
+
+            chip_indices.sort()
+            coords = [chip_at(i).coord for i in chip_indices]
+            env = {
+                ENV_VISIBLE_DEVICES: ",".join(str(i) for i in chip_indices),
+                ENV_KUBE_DEVICE_IDS: ",".join(sorted(seen)),
+                ENV_KUBE_CHIP_COORDS: ";".join(
+                    ",".join(str(v) for v in c) for c in coords
+                ),
+                ENV_KUBE_MESH_DIMS: ",".join(str(d) for d in self._mesh.dims),
+                ENV_KUBE_HOST: self._host,
+                ENV_HBM_LIMIT: str(hbm_limit),
+            }
+            if shares_mode:
+                # Cooperative enforcement for the in-pod XLA client: cap its
+                # HBM pool at the quota's fraction of the chips it can see.
+                total_hbm = sum(chip_at(i).hbm_bytes for i in chip_indices)
+                env[ENV_MEM_FRACTION] = f"{hbm_limit / total_hbm:.4f}"
+            return env
+
+    def preferred_allocation(
+        self,
+        available: list[str],
+        required: list[str],
+        size: int,
+    ) -> list[str]:
+        """Pick ``size`` devices maximizing ICI adjacency within this host.
+
+        The reference's GetPreferredAllocation picks NVLink-connected GPU
+        sets; here we greedily grow a connected set in mesh-neighbor space
+        starting from the required ids (SURVEY.md §2 C4).
+        """
+        if size < len(required):
+            raise DeviceError("allocation_size smaller than must-include set")
+        if size > len(available):
+            raise DeviceError("allocation_size larger than available set")
+        avail = list(dict.fromkeys(available))
+        for r in required:
+            if r not in avail:
+                raise DeviceError(f"must-include id {r} not in available set")
+
+        by_index = {c.index: c for c in self.chips()}
+        coords = {}
+        for did in avail:
+            try:
+                index, _ = parse_device_id(did)
+            except ValueError as e:
+                raise DeviceError(str(e)) from e
+            if index not in by_index:
+                raise DeviceError(f"unknown chip index {index} on {self._host}")
+            coords[did] = by_index[index].coord
+
+        chosen: list[str] = list(required)
+        while len(chosen) < size:
+            best, best_score = None, (-1, 0)
+            for cand in avail:
+                if cand in chosen:
+                    continue
+                adj = sum(
+                    1
+                    for other in chosen
+                    if coords[cand] in self._mesh.neighbors(coords[other])
+                )
+                # tie-break deterministically by id for reproducibility
+                score = (adj, -avail.index(cand))
+                if best is None or score > best_score:
+                    best, best_score = cand, score
+            assert best is not None
+            chosen.append(best)
+        return chosen
+
+    # -- health / faults ---------------------------------------------------
+    def inject_fault(self, chip_index: int, healthy: bool = False) -> None:
+        """Sim-only: flip chip health (the NVML XID event analog)."""
+        self._ti.inject_fault(chip_index, healthy)

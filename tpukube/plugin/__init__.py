@@ -1,0 +1,4 @@
+"""Node agent (L3): deviceplugin/v1beta1 gRPC server + health watch."""
+
+from tpukube.plugin.server import DevicePluginServer, HealthWatcher  # noqa: F401
+from tpukube.plugin.fake_kubelet import FakeKubelet  # noqa: F401

@@ -1,0 +1,234 @@
+"""In-process fake kubelet for the sim harness.
+
+SURVEY.md §5: the reference's test trick is that "a cluster is just data" —
+plugin tests run against a fake peer rather than a live kubelet. This fake
+implements the kubelet side of the device-plugin contract faithfully:
+
+  1. serves the Registration service on kubelet.sock,
+  2. on Register, dials back to the plugin's endpoint (like the kubelet),
+  3. opens the ListAndWatch stream and maintains a live device cache,
+  4. exposes allocate() so tests/harness can play the container-start path.
+
+BASELINE config 1 ("fake-device sim, CPU-only control plane") walks exactly
+this object against a real DevicePluginServer over real unix sockets.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent import futures
+from dataclasses import dataclass, field
+from typing import Optional
+
+import grpc
+
+from tpukube.plugin import stubs
+from tpukube.plugin.proto import deviceplugin_pb2 as pb
+
+
+@dataclass
+class PluginHandle:
+    """One registered plugin endpoint, as the kubelet tracks it."""
+
+    resource_name: str
+    endpoint: str
+    options: pb.DevicePluginOptions
+    channel: grpc.Channel
+    stub: stubs.DevicePluginStub
+    devices: dict[str, str] = field(default_factory=dict)  # id -> health
+    watch_thread: Optional[threading.Thread] = None
+    stream_cancel: Optional[grpc.Future] = None
+
+
+class FakeKubelet(stubs.RegistrationServicer):
+    def __init__(self, device_plugin_dir: str):
+        self._dir = device_plugin_dir
+        self._socket_path = os.path.join(device_plugin_dir, "kubelet.sock")
+        self._server: Optional[grpc.Server] = None
+        self._plugins: dict[str, PluginHandle] = {}
+        self._lock = threading.Lock()
+        self._device_event = threading.Condition(self._lock)
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def socket_path(self) -> str:
+        return self._socket_path
+
+    def start(self) -> None:
+        if self._server is not None:
+            raise RuntimeError("fake kubelet already started")
+        os.makedirs(self._dir, exist_ok=True)
+        if os.path.exists(self._socket_path):
+            os.unlink(self._socket_path)
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        stubs.add_registration_to_server(self, self._server)
+        self._server.add_insecure_port(f"unix://{self._socket_path}")
+        self._server.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            handles = list(self._plugins.values())
+            self._plugins.clear()
+        for h in handles:
+            if h.stream_cancel is not None:
+                h.stream_cancel.cancel()
+            h.channel.close()
+            if h.watch_thread is not None:
+                h.watch_thread.join(timeout=5.0)
+        if self._server is not None:
+            self._server.stop(0.5).wait()
+            self._server = None
+        if os.path.exists(self._socket_path):
+            os.unlink(self._socket_path)
+
+    def __enter__(self) -> "FakeKubelet":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- Registration service ----------------------------------------------
+    def Register(self, request: pb.RegisterRequest, context) -> pb.Empty:
+        if request.version != stubs.API_VERSION:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"unsupported device plugin version {request.version}",
+            )
+        endpoint_path = os.path.join(self._dir, request.endpoint)
+        channel = grpc.insecure_channel(f"unix://{endpoint_path}")
+        handle = PluginHandle(
+            resource_name=request.resource_name,
+            endpoint=endpoint_path,
+            options=request.options,
+            channel=channel,
+            stub=stubs.DevicePluginStub(channel),
+        )
+        with self._lock:
+            old = self._plugins.get(request.resource_name)
+            self._plugins[request.resource_name] = handle
+        if old is not None:
+            if old.stream_cancel is not None:
+                old.stream_cancel.cancel()
+            old.channel.close()
+        # Like the kubelet: immediately open the ListAndWatch stream.
+        handle.watch_thread = threading.Thread(
+            target=self._watch, args=(handle,), daemon=True,
+            name=f"fake-kubelet-watch-{request.resource_name}",
+        )
+        handle.watch_thread.start()
+        return pb.Empty()
+
+    def _watch(self, handle: PluginHandle) -> None:
+        try:
+            stream = handle.stub.ListAndWatch(pb.Empty())
+            handle.stream_cancel = stream
+            for resp in stream:
+                with self._lock:
+                    handle.devices = {d.ID: d.health for d in resp.devices}
+                    self._device_event.notify_all()
+        except grpc.RpcError:
+            # Stream torn down. If the plugin died (vs. us replacing or
+            # closing the handle), the kubelet marks its devices unhealthy
+            # so the node stops advertising capacity it can't deliver.
+            with self._lock:
+                if self._plugins.get(handle.resource_name) is handle:
+                    handle.devices = {d: "Unhealthy" for d in handle.devices}
+                    self._device_event.notify_all()
+
+    # -- kubelet-side queries the harness uses ------------------------------
+    def resources(self) -> list[str]:
+        with self._lock:
+            return sorted(self._plugins)
+
+    def devices(self, resource_name: str) -> dict[str, str]:
+        with self._lock:
+            h = self._plugins.get(resource_name)
+            return dict(h.devices) if h else {}
+
+    def allocatable(self, resource_name: str) -> int:
+        """Healthy device count — what the node would report allocatable."""
+        return sum(
+            1 for h in self.devices(resource_name).values() if h == "Healthy"
+        )
+
+    def wait_for_devices(
+        self, resource_name: str, count: int, timeout: float = 5.0
+    ) -> dict[str, str]:
+        """Block until the device cache for a resource reaches ``count``
+        entries (any health)."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while True:
+                h = self._plugins.get(resource_name)
+                if h is not None and len(h.devices) >= count:
+                    return dict(h.devices)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    have = dict(h.devices) if h else {}
+                    raise TimeoutError(
+                        f"{resource_name}: wanted {count} devices, have {have}"
+                    )
+                self._device_event.wait(remaining)
+
+    def wait_for_health(
+        self, resource_name: str, device_id: str, health: str, timeout: float = 5.0
+    ) -> None:
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while True:
+                h = self._plugins.get(resource_name)
+                if h is not None and h.devices.get(device_id) == health:
+                    return
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"{device_id} never became {health}: "
+                        f"{h.devices if h else {}}"
+                    )
+                self._device_event.wait(remaining)
+
+    # -- container-start path (SURVEY.md §4.3) ------------------------------
+    def allocate(
+        self, resource_name: str, device_ids: list[str], timeout: float = 5.0
+    ) -> dict[str, str]:
+        """Play the kubelet's Allocate for one container; returns the env."""
+        with self._lock:
+            h = self._plugins.get(resource_name)
+        if h is None:
+            raise KeyError(f"no plugin registered for {resource_name}")
+        resp = h.stub.Allocate(
+            pb.AllocateRequest(
+                container_requests=[pb.ContainerAllocateRequest(devicesIDs=device_ids)]
+            ),
+            timeout=timeout,
+        )
+        return dict(resp.container_responses[0].envs)
+
+    def preferred(
+        self,
+        resource_name: str,
+        available: list[str],
+        size: int,
+        required: Optional[list[str]] = None,
+        timeout: float = 5.0,
+    ) -> list[str]:
+        with self._lock:
+            h = self._plugins.get(resource_name)
+        if h is None:
+            raise KeyError(f"no plugin registered for {resource_name}")
+        resp = h.stub.GetPreferredAllocation(
+            pb.PreferredAllocationRequest(
+                container_requests=[
+                    pb.ContainerPreferredAllocationRequest(
+                        available_deviceIDs=available,
+                        must_include_deviceIDs=required or [],
+                        allocation_size=size,
+                    )
+                ]
+            ),
+            timeout=timeout,
+        )
+        return list(resp.container_responses[0].deviceIDs)

@@ -1,0 +1,6 @@
+"""Generated protobuf messages for the kubelet device-plugin API.
+
+Regenerate with: protoc --python_out=. deviceplugin.proto
+"""
+
+from tpukube.plugin.proto import deviceplugin_pb2  # noqa: F401

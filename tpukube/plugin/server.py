@@ -1,0 +1,237 @@
+"""Device-plugin node agent (L3).
+
+SURVEY.md §2 C4/C5 and §4.1/§4.3/§4.4: the reference's Go daemon registers
+with the kubelet over its unix socket, serves the five deviceplugin/v1beta1
+RPCs, and runs a health loop (NVML XID events) that pushes shrunken device
+lists on the ListAndWatch stream. This is the TPU rendering: libtpuinfo
+health polls replace the blocking NVML event wait (libtpu has no event fd;
+the poll interval is config), and Allocate returns TPU env instead of
+/dev/nvidia* device nodes.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import time
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from tpukube.core.config import TpuKubeConfig
+from tpukube.core.types import Health
+from tpukube.device import DeviceError, TpuDeviceManager
+from tpukube.plugin import stubs
+from tpukube.plugin.proto import deviceplugin_pb2 as pb
+
+log = logging.getLogger("tpukube.plugin")
+
+
+class DevicePluginServer(stubs.DevicePluginServicer):
+    """Serves one extended resource on one unix socket.
+
+    A node runs exactly one instance: the device manager's sharing mode
+    decides whether it advertises whole chips or vTPU shares (see
+    tpukube/device/tpu.py module doc).
+    """
+
+    def __init__(self, config: TpuKubeConfig, device: TpuDeviceManager,
+                 socket_path: Optional[str] = None):
+        self._config = config
+        self._device = device
+        self._socket_path = socket_path or config.plugin_socket_path()
+        self._server: Optional[grpc.Server] = None
+        # Each active ListAndWatch stream gets its own update queue; the
+        # health watcher broadcasts a refreshed device list to all of them.
+        self._watch_queues: list[queue.SimpleQueue] = []
+        self._watch_lock = threading.Lock()
+        self._allocations = 0  # served Allocate calls (metrics)
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def socket_path(self) -> str:
+        return self._socket_path
+
+    @property
+    def resource_name(self) -> str:
+        return self._device.resource_name
+
+    @property
+    def allocation_count(self) -> int:
+        return self._allocations
+
+    def start(self) -> None:
+        if self._server is not None:
+            raise RuntimeError("plugin server already started")
+        if os.path.exists(self._socket_path):
+            os.unlink(self._socket_path)  # stale socket from a crashed agent
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        stubs.add_device_plugin_to_server(self, self._server)
+        self._server.add_insecure_port(f"unix://{self._socket_path}")
+        self._server.start()
+        log.info("plugin serving %s on %s", self.resource_name, self._socket_path)
+
+    def stop(self, grace: float = 0.5) -> None:
+        if self._server is not None:
+            self._server.stop(grace).wait()
+            self._server = None
+        if os.path.exists(self._socket_path):
+            os.unlink(self._socket_path)
+
+    def __enter__(self) -> "DevicePluginServer":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def register_with_kubelet(self, kubelet_socket: Optional[str] = None,
+                              timeout: float = 5.0) -> None:
+        """Dial the kubelet's Registration service and announce ourselves
+        (SURVEY.md §4.1)."""
+        ks = kubelet_socket or self._config.kubelet_socket_path()
+        with grpc.insecure_channel(f"unix://{ks}") as channel:
+            grpc.channel_ready_future(channel).result(timeout=timeout)
+            stub = stubs.RegistrationStub(channel)
+            stub.Register(
+                pb.RegisterRequest(
+                    version=stubs.API_VERSION,
+                    endpoint=os.path.basename(self._socket_path),
+                    resource_name=self.resource_name,
+                    options=pb.DevicePluginOptions(
+                        pre_start_required=False,
+                        get_preferred_allocation_available=True,
+                    ),
+                ),
+                timeout=timeout,
+            )
+        log.info("registered %s with kubelet at %s", self.resource_name, ks)
+
+    # -- device list plumbing ---------------------------------------------
+    def _current_devices(self) -> pb.ListAndWatchResponse:
+        return pb.ListAndWatchResponse(
+            devices=[
+                pb.Device(ID=did, health=h.value)
+                for did, h in self._device.device_list()
+            ]
+        )
+
+    def push_update(self) -> None:
+        """Broadcast the current device list to all ListAndWatch streams
+        (called by the health watcher on any health transition)."""
+        resp = self._current_devices()
+        with self._watch_lock:
+            for q in self._watch_queues:
+                q.put(resp)
+
+    # -- deviceplugin/v1beta1 RPCs -----------------------------------------
+    def GetDevicePluginOptions(self, request, context) -> pb.DevicePluginOptions:
+        return pb.DevicePluginOptions(
+            pre_start_required=False,
+            get_preferred_allocation_available=True,
+        )
+
+    def ListAndWatch(self, request, context):
+        """Initial full list, then a push per health transition — the
+        long-lived stream the kubelet sizes node allocatable from."""
+        q: queue.SimpleQueue = queue.SimpleQueue()
+        with self._watch_lock:
+            self._watch_queues.append(q)
+        try:
+            yield self._current_devices()
+            while context.is_active():
+                try:
+                    yield q.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+        finally:
+            with self._watch_lock:
+                self._watch_queues.remove(q)
+
+    def GetPreferredAllocation(self, request, context) -> pb.PreferredAllocationResponse:
+        resp = pb.PreferredAllocationResponse()
+        for creq in request.container_requests:
+            try:
+                chosen = self._device.preferred_allocation(
+                    list(creq.available_deviceIDs),
+                    list(creq.must_include_deviceIDs),
+                    creq.allocation_size,
+                )
+            except DeviceError as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            resp.container_responses.append(
+                pb.ContainerPreferredAllocationResponse(deviceIDs=chosen)
+            )
+        return resp
+
+    def Allocate(self, request, context) -> pb.AllocateResponse:
+        resp = pb.AllocateResponse()
+        for creq in request.container_requests:
+            try:
+                env = self._device.allocate_env(list(creq.devicesIDs))
+            except DeviceError as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            resp.container_responses.append(pb.ContainerAllocateResponse(envs=env))
+        self._allocations += 1
+        log.info("allocated %s", [list(c.devicesIDs) for c in request.container_requests])
+        return resp
+
+    def PreStartContainer(self, request, context) -> pb.PreStartContainerResponse:
+        return pb.PreStartContainerResponse()
+
+
+class HealthWatcher:
+    """Polls device health and pushes ListAndWatch updates on transitions.
+
+    The reference blocks in nvmlEventSetWait for XID events (SURVEY.md
+    §4.4); libtpu exposes no event fd, so this polls libtpuinfo at a config
+    interval — same contract (kubelet sees Unhealthy within one interval),
+    different mechanism.
+    """
+
+    def __init__(self, device: TpuDeviceManager, server: DevicePluginServer,
+                 poll_seconds: Optional[float] = None):
+        self._device = device
+        self._server = server
+        self._poll = poll_seconds if poll_seconds is not None else 5.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last: dict[str, Health] = {}
+        self.transitions = 0  # observed health flips (tests/metrics)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            raise RuntimeError("health watcher already started")
+        self._last = self._device.health_snapshot()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="tpukube-health")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def check_once(self) -> bool:
+        """One poll; returns True if a transition was pushed. Exposed so
+        tests (and the sim harness) can step deterministically."""
+        snap = self._device.health_snapshot()
+        if snap != self._last:
+            changed = {k for k in snap if snap[k] != self._last.get(k)}
+            log.warning("health transition: %s", sorted(changed))
+            self._last = snap
+            self.transitions += 1
+            self._server.push_update()
+            return True
+        return False
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll):
+            try:
+                self.check_once()
+            except Exception:
+                log.exception("health poll failed")

@@ -1,0 +1,132 @@
+"""Hand-written gRPC service wiring for deviceplugin/v1beta1.
+
+grpc_tools (the protoc gRPC plugin) is not available in this environment, so
+the service scaffolding normally emitted into ``*_pb2_grpc.py`` is written by
+hand here against the protoc-generated messages. The method paths and
+serialization must match the upstream API exactly — the kubelet is the peer.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from tpukube.plugin.proto import deviceplugin_pb2 as pb
+
+API_VERSION = "v1beta1"
+
+_REGISTRATION = "v1beta1.Registration"
+_DEVICE_PLUGIN = "v1beta1.DevicePlugin"
+
+
+# -- Registration service (served by the kubelet; plugins are clients) -----
+
+class RegistrationServicer:
+    def Register(self, request: pb.RegisterRequest, context) -> pb.Empty:
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "Register not implemented")
+
+
+def add_registration_to_server(servicer: RegistrationServicer, server: grpc.Server) -> None:
+    handlers = {
+        "Register": grpc.unary_unary_rpc_method_handler(
+            servicer.Register,
+            request_deserializer=pb.RegisterRequest.FromString,
+            response_serializer=pb.Empty.SerializeToString,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(_REGISTRATION, handlers),)
+    )
+
+
+class RegistrationStub:
+    def __init__(self, channel: grpc.Channel):
+        self.Register = channel.unary_unary(
+            f"/{_REGISTRATION}/Register",
+            request_serializer=pb.RegisterRequest.SerializeToString,
+            response_deserializer=pb.Empty.FromString,
+        )
+
+
+# -- DevicePlugin service (served by the plugin; kubelet is the client) ----
+
+class DevicePluginServicer:
+    def GetDevicePluginOptions(self, request: pb.Empty, context) -> pb.DevicePluginOptions:
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "not implemented")
+
+    def ListAndWatch(self, request: pb.Empty, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "not implemented")
+
+    def GetPreferredAllocation(
+        self, request: pb.PreferredAllocationRequest, context
+    ) -> pb.PreferredAllocationResponse:
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "not implemented")
+
+    def Allocate(self, request: pb.AllocateRequest, context) -> pb.AllocateResponse:
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "not implemented")
+
+    def PreStartContainer(
+        self, request: pb.PreStartContainerRequest, context
+    ) -> pb.PreStartContainerResponse:
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "not implemented")
+
+
+def add_device_plugin_to_server(servicer: DevicePluginServicer, server: grpc.Server) -> None:
+    handlers = {
+        "GetDevicePluginOptions": grpc.unary_unary_rpc_method_handler(
+            servicer.GetDevicePluginOptions,
+            request_deserializer=pb.Empty.FromString,
+            response_serializer=pb.DevicePluginOptions.SerializeToString,
+        ),
+        "ListAndWatch": grpc.unary_stream_rpc_method_handler(
+            servicer.ListAndWatch,
+            request_deserializer=pb.Empty.FromString,
+            response_serializer=pb.ListAndWatchResponse.SerializeToString,
+        ),
+        "GetPreferredAllocation": grpc.unary_unary_rpc_method_handler(
+            servicer.GetPreferredAllocation,
+            request_deserializer=pb.PreferredAllocationRequest.FromString,
+            response_serializer=pb.PreferredAllocationResponse.SerializeToString,
+        ),
+        "Allocate": grpc.unary_unary_rpc_method_handler(
+            servicer.Allocate,
+            request_deserializer=pb.AllocateRequest.FromString,
+            response_serializer=pb.AllocateResponse.SerializeToString,
+        ),
+        "PreStartContainer": grpc.unary_unary_rpc_method_handler(
+            servicer.PreStartContainer,
+            request_deserializer=pb.PreStartContainerRequest.FromString,
+            response_serializer=pb.PreStartContainerResponse.SerializeToString,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(_DEVICE_PLUGIN, handlers),)
+    )
+
+
+class DevicePluginStub:
+    def __init__(self, channel: grpc.Channel):
+        self.GetDevicePluginOptions = channel.unary_unary(
+            f"/{_DEVICE_PLUGIN}/GetDevicePluginOptions",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.DevicePluginOptions.FromString,
+        )
+        self.ListAndWatch = channel.unary_stream(
+            f"/{_DEVICE_PLUGIN}/ListAndWatch",
+            request_serializer=pb.Empty.SerializeToString,
+            response_deserializer=pb.ListAndWatchResponse.FromString,
+        )
+        self.GetPreferredAllocation = channel.unary_unary(
+            f"/{_DEVICE_PLUGIN}/GetPreferredAllocation",
+            request_serializer=pb.PreferredAllocationRequest.SerializeToString,
+            response_deserializer=pb.PreferredAllocationResponse.FromString,
+        )
+        self.Allocate = channel.unary_unary(
+            f"/{_DEVICE_PLUGIN}/Allocate",
+            request_serializer=pb.AllocateRequest.SerializeToString,
+            response_deserializer=pb.AllocateResponse.FromString,
+        )
+        self.PreStartContainer = channel.unary_unary(
+            f"/{_DEVICE_PLUGIN}/PreStartContainer",
+            request_serializer=pb.PreStartContainerRequest.SerializeToString,
+            response_deserializer=pb.PreStartContainerResponse.FromString,
+        )
