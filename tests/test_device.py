@@ -61,6 +61,13 @@ def test_allocate_env_fractional_sets_quota():
         env = m.allocate_env(["tpu-2-frac0of2", "tpu-2-frac1of2"])
         assert env[ENV_HBM_LIMIT] == str(HBM)
         assert env[ENV_MEM_FRACTION] == "1.0000"
+        # uneven shares across chips: XLA applies the fraction per device,
+        # so the cap must protect the most-constrained chip (1 share = 0.5)
+        env = m.allocate_env(
+            ["tpu-2-frac0of2", "tpu-2-frac1of2", "tpu-1-frac0of2"]
+        )
+        assert env[ENV_HBM_LIMIT] == str(HBM + HBM // 2)
+        assert env[ENV_MEM_FRACTION] == "0.5000"
 
 
 def test_allocate_rejects_mode_mismatch_and_junk():
@@ -103,6 +110,31 @@ def test_preferred_allocation_prefers_adjacency():
             ["tpu-0", "tpu-1", "tpu-2", "tpu-3"], ["tpu-3"], 3
         )
         assert chosen[0] == "tpu-3" and len(set(chosen)) == 3
+
+
+def test_preferred_allocation_colocates_vtpu_shares():
+    with _mgr(shares=2) as m:
+        avail = [
+            "tpu-0-frac0of2", "tpu-0-frac1of2",
+            "tpu-1-frac0of2", "tpu-1-frac1of2",
+        ]
+        chosen = m.preferred_allocation(avail, [], 2)
+        # both shares of one chip beat a cross-chip neighbor pair
+        chips = {c.split("-frac")[0] for c in chosen}
+        assert len(chips) == 1, chosen
+
+
+def test_preferred_allocation_skips_unhealthy():
+    with _mgr() as m:
+        m.inject_fault(1)
+        chosen = m.preferred_allocation(
+            ["tpu-0", "tpu-1", "tpu-2", "tpu-3"], [], 3
+        )
+        assert "tpu-1" not in chosen and len(chosen) == 3
+        with pytest.raises(DeviceError, match="only 3 healthy"):
+            m.preferred_allocation(["tpu-0", "tpu-1", "tpu-2", "tpu-3"], [], 4)
+        with pytest.raises(DeviceError, match="must-include id tpu-1 is unhealthy"):
+            m.preferred_allocation(["tpu-0", "tpu-1"], ["tpu-1"], 1)
 
 
 def test_preferred_allocation_errors():

@@ -213,6 +213,14 @@ class Box:
         return Box(TopologyCoord.of(obj["origin"]), tuple(obj["shape"]))
 
 
+def surface(shape: tuple[int, int, int]) -> int:
+    """Surface area of a box shape — the compactness measure used both for
+    shape ranking here and box scoring in slicefit (lower = more compact =
+    better ICI bisection for the job)."""
+    a, b, c = shape
+    return 2 * (a * b + b * c + a * c)
+
+
 def factor_shapes(n: int, mesh_dims: tuple[int, int, int]) -> list[tuple[int, int, int]]:
     """All 3D box shapes of volume n that could fit in ``mesh_dims``.
 
@@ -233,9 +241,5 @@ def factor_shapes(n: int, mesh_dims: tuple[int, int, int]) -> list[tuple[int, in
             c = rem // b
             if c <= Z:
                 shapes.add((a, b, c))
-
-    def surface(s: tuple[int, int, int]) -> int:
-        a, b, c = s
-        return 2 * (a * b + b * c + a * c)
 
     return sorted(shapes, key=lambda s: (surface(s), s))

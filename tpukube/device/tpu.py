@@ -153,6 +153,7 @@ class TpuDeviceManager:
 
             shares_mode = self._config.shares_per_chip > 1
             chip_indices: list[int] = []
+            shares_per_chip_alloc: dict[int, int] = {}
             hbm_limit = 0
             seen: set[str] = set()
             for did in device_ids:
@@ -175,6 +176,7 @@ class TpuDeviceManager:
                     if n != self._config.shares_per_chip or not 0 <= k < n:
                         raise DeviceError(f"{did}: share does not match node config")
                     hbm_limit += chip.hbm_bytes // n
+                    shares_per_chip_alloc[index] = shares_per_chip_alloc.get(index, 0) + 1
                 else:
                     if frac is not None:
                         raise DeviceError(
@@ -197,10 +199,14 @@ class TpuDeviceManager:
                 ENV_HBM_LIMIT: str(hbm_limit),
             }
             if shares_mode:
-                # Cooperative enforcement for the in-pod XLA client: cap its
-                # HBM pool at the quota's fraction of the chips it can see.
-                total_hbm = sum(chip_at(i).hbm_bytes for i in chip_indices)
-                env[ENV_MEM_FRACTION] = f"{hbm_limit / total_hbm:.4f}"
+                # Cooperative enforcement for the in-pod XLA client. XLA
+                # applies MEM_FRACTION per visible device, so the safe cap
+                # is the MOST-constrained chip's share fraction — with
+                # uneven shares per chip a pooled average would over-grant
+                # the chip holding fewer shares.
+                n = self._config.shares_per_chip
+                min_shares = min(shares_per_chip_alloc.values())
+                env[ENV_MEM_FRACTION] = f"{min_shares / n:.4f}"
             return env
 
     def preferred_allocation(
@@ -226,6 +232,7 @@ class TpuDeviceManager:
 
         by_index = {c.index: c for c in self.chips()}
         coords = {}
+        chip_of = {}
         for did in avail:
             try:
                 index, _ = parse_device_id(did)
@@ -233,21 +240,34 @@ class TpuDeviceManager:
                 raise DeviceError(str(e)) from e
             if index not in by_index:
                 raise DeviceError(f"unknown chip index {index} on {self._host}")
-            coords[did] = by_index[index].coord
+            chip = by_index[index]
+            if chip.health is not Health.HEALTHY:
+                if did in required:
+                    raise DeviceError(f"must-include id {did} is unhealthy")
+                continue  # never recommend a chip Allocate would reject
+            coords[did] = chip.coord
+            chip_of[did] = index
+        healthy_avail = [d for d in avail if d in coords]
+        if size > len(healthy_avail):
+            raise DeviceError(
+                f"only {len(healthy_avail)} healthy devices for size {size}"
+            )
+
+        def affinity(a: str, b: str) -> int:
+            # Two shares of one chip beat mesh neighbors: zero-hop co-location.
+            if chip_of[a] == chip_of[b]:
+                return 2
+            return 1 if coords[a] in self._mesh.neighbors(coords[b]) else 0
 
         chosen: list[str] = list(required)
         while len(chosen) < size:
             best, best_score = None, (-1, 0)
-            for cand in avail:
+            for cand in healthy_avail:
                 if cand in chosen:
                     continue
-                adj = sum(
-                    1
-                    for other in chosen
-                    if coords[cand] in self._mesh.neighbors(coords[other])
-                )
-                # tie-break deterministically by id for reproducibility
-                score = (adj, -avail.index(cand))
+                adj = sum(affinity(cand, other) for other in chosen)
+                # tie-break deterministically by available-list position
+                score = (adj, -healthy_avail.index(cand))
                 if best is None or score > best_score:
                     best, best_score = cand, score
             assert best is not None

@@ -15,7 +15,6 @@ import logging
 import os
 import queue
 import threading
-import time
 from concurrent import futures
 from typing import Optional
 
@@ -54,6 +53,10 @@ class DevicePluginServer(stubs.DevicePluginServicer):
     @property
     def socket_path(self) -> str:
         return self._socket_path
+
+    @property
+    def config(self) -> TpuKubeConfig:
+        return self._config
 
     @property
     def resource_name(self) -> str:
@@ -196,7 +199,9 @@ class HealthWatcher:
                  poll_seconds: Optional[float] = None):
         self._device = device
         self._server = server
-        self._poll = poll_seconds if poll_seconds is not None else 5.0
+        if poll_seconds is None:
+            poll_seconds = server.config.health_poll_seconds
+        self._poll = poll_seconds
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last: dict[str, Health] = {}

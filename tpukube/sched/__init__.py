@@ -1,0 +1,1 @@
+"""Scheduler layer (L5): slicefit allocator, extender, gang, policy."""

@@ -1,0 +1,340 @@
+"""slicefit — contiguous sub-slice search in a partially occupied ICI mesh.
+
+The algorithmic core of the scheduler (SURVEY.md §2 C7, §9.3 "the hard
+parts"). The reference's ``grpalloc`` tree-matches grouped GPU requests
+against a node's NVLink/PCIe topology tree; the TPU analog is geometric:
+find an axis-aligned sub-box of the chip mesh whose chips are all free,
+sized (or shaped) for the gang, and score candidates so that
+
+  * the gang gets a compact box (low surface area => short ICI paths and
+    good bisection bandwidth for XLA collectives), and
+  * the cluster keeps its free space defensible (corner/wall packing =>
+    low fragmentation for future gangs).
+
+Implementation: numpy occupancy voxel grid + a 3D summed-area table, so
+testing "is this box fully free" is O(1) per origin and a full shape sweep
+is O(X*Y*Z). Exact search with deterministic tie-breaking — mesh sizes in
+scope (<= a few thousand chips) make exact affordable (SURVEY.md §9.3).
+
+Torus axes are honored: on a wraparound axis the free grid is tiled so box
+origins may wrap (a (3,1,1) slice at x in {3,0,1} of a 4-torus is
+contiguous over ICI), and boundary "wall contact" is only credited on
+non-torus axes (a torus has no walls).
+
+Irregular fallback: when no box of the requested volume exists (e.g. a
+5-pod gang on a 4x4 mesh), ``find_slice(..., allow_irregular=True)`` grows
+a connected free region instead — gangs still land ICI-connected, just not
+box-shaped. Disabled by default; the extender decides policy.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass
+from typing import Iterable, Optional
+
+import numpy as np
+
+from tpukube.core.mesh import Box, MeshSpec, factor_shapes, surface
+from tpukube.core.types import TopologyCoord
+
+Shape = tuple[int, int, int]
+
+
+def occupancy_grid(mesh: MeshSpec, occupied: Iterable[TopologyCoord]) -> np.ndarray:
+    """Boolean [X, Y, Z] grid, True = occupied/unavailable."""
+    grid = np.zeros(mesh.dims, dtype=bool)
+    for c in occupied:
+        if not mesh.contains(TopologyCoord.of(c)):
+            raise ValueError(f"occupied coord {c} outside mesh {mesh.dims}")
+        grid[tuple(c)] = True
+    return grid
+
+
+def box_coords(mesh: MeshSpec, box: Box) -> list[TopologyCoord]:
+    """Chips of a box, wrapping on torus axes (origin is always in-mesh)."""
+    return [
+        TopologyCoord(*(v % d for v, d in zip(c, mesh.dims)))
+        for c in box.coords()
+    ]
+
+
+class _Sweep:
+    """One occupancy snapshot prepared for repeated box queries: the free
+    grid tiled along torus axes (so wrapped origins become plain origins)
+    plus its zero-padded summed-area table."""
+
+    def __init__(self, mesh: MeshSpec, grid: np.ndarray):
+        if grid.shape != mesh.dims:
+            raise ValueError(f"grid shape {grid.shape} != mesh dims {mesh.dims}")
+        self.mesh = mesh
+        self.grid = grid
+        free = ~grid
+        ext = free
+        for axis in range(3):
+            d = mesh.dims[axis]
+            if mesh.torus[axis] and d > 1:
+                # tile by d-1 so any box of extent <= d can start anywhere
+                wrap = ext.take(range(0, d - 1), axis=axis)
+                ext = np.concatenate([ext, wrap], axis=axis)
+        self.ext_free = ext
+        sat = np.zeros(tuple(s + 1 for s in ext.shape), dtype=np.int64)
+        sat[1:, 1:, 1:] = ext.astype(np.int64).cumsum(0).cumsum(1).cumsum(2)
+        self.sat = sat
+
+    def origins(self, shape: Shape) -> np.ndarray:
+        """[N, 3] origins (in-mesh) where a `shape` box is entirely free,
+        wrapping over torus axes. Lexicographic order; full-extent boxes on
+        a torus axis are canonicalized to origin 0 (all origins would name
+        the same chip set)."""
+        s = self.sat
+        a, b, c = shape
+        dims = self.mesh.dims
+        for extent, d in zip(shape, dims):
+            if extent > d:
+                return np.empty((0, 3), dtype=int)
+        eX, eY, eZ = self.ext_free.shape
+        if a > eX or b > eY or c > eZ:
+            return np.empty((0, 3), dtype=int)
+        vol = (
+            s[a:, b:, c:]
+            - s[:-a, b:, c:]
+            - s[a:, :-b, c:]
+            - s[a:, b:, :-c]
+            + s[:-a, :-b, c:]
+            + s[:-a, b:, :-c]
+            + s[a:, :-b, :-c]
+            - s[:-a, :-b, :-c]
+        )
+        origins = np.argwhere(vol == a * b * c)
+        if origins.size == 0:
+            return origins
+        # keep origins that are in-mesh and legal for each axis
+        keep = np.ones(len(origins), dtype=bool)
+        for axis, extent in enumerate(shape):
+            d = dims[axis]
+            if self.mesh.torus[axis] and d > 1:
+                if extent == d:
+                    keep &= origins[:, axis] == 0
+                else:
+                    keep &= origins[:, axis] < d
+            else:
+                keep &= origins[:, axis] <= d - extent
+        return origins[keep]
+
+    def contact(self, box: Box) -> int:
+        """Faces of the box touching a mesh wall or occupied chips.
+
+        Higher contact = snugger placement = less fragmentation of the
+        remaining free space (3D best-fit/corner packing). Wall credit only
+        exists on non-torus axes; on torus axes the adjacent slab is taken
+        modulo the dimension.
+        """
+        g = self.grid
+        mesh = self.mesh
+        X, Y, Z = g.shape
+        (ox, oy, oz), (sx, sy, sz) = box.origin, box.shape
+
+        def ax_idx(vals, d):
+            return np.asarray(vals) % d
+
+        xs = ax_idx(range(ox, ox + sx), X)
+        ys = ax_idx(range(oy, oy + sy), Y)
+        zs = ax_idx(range(oz, oz + sz), Z)
+        total = 0
+        # (axis, face_lo, slab_index, face_area, plane_sel)
+        faces = [
+            (0, ox - 1, ox + sx, sy * sz, np.ix_(ys, zs)),
+            (1, oy - 1, oy + sy, sx * sz, np.ix_(xs, zs)),
+            (2, oz - 1, oz + sz, sx * sy, np.ix_(xs, ys)),
+        ]
+        for axis, lo, hi, area, sel in faces:
+            d = g.shape[axis]
+            extent = box.shape[axis]
+            for idx in (lo, hi):
+                if mesh.torus[axis] and d > 1:
+                    if extent == d:
+                        continue  # box spans the whole ring: no face
+                    slab = np.take(g, idx % d, axis=axis)
+                    total += int(slab[sel].sum())
+                else:
+                    if idx < 0 or idx >= d:
+                        total += area  # true mesh wall
+                    else:
+                        slab = np.take(g, idx, axis=axis)
+                        total += int(slab[sel].sum())
+        return total
+
+
+@dataclass(frozen=True)
+class ScoredBox:
+    box: Box
+    # Lower is better on each component, compared in order:
+    surface: int       # box surface area — gang-internal ICI compactness
+    contact: int       # NEGATED wall/occupied contact — cluster packing
+    origin_key: Shape  # deterministic final tie-break
+
+    @property
+    def sort_key(self) -> tuple:
+        return (self.surface, self.contact, self.origin_key)
+
+
+def _candidate_shapes(
+    mesh: MeshSpec, count: Optional[int], shape: Optional[Shape]
+) -> list[Shape]:
+    """Shapes to sweep, most-preferred first.
+
+    A pinned shape is honored up to axis permutation (a 4x4x1 request is
+    geometrically the same slice as 1x4x4; jobs index their mesh axes
+    logically, the physical orientation is the scheduler's choice).
+    """
+    if shape is not None:
+        perms = sorted(set(itertools.permutations(shape)))
+        return [p for p in perms if all(s <= d for s, d in zip(p, mesh.dims))]
+    assert count is not None
+    return factor_shapes(count, mesh.dims)  # already compactness-sorted
+
+
+def _validate_request(count: Optional[int], shape: Optional[Shape]) -> None:
+    if (count is None) == (shape is None):
+        raise ValueError("exactly one of count/shape must be given")
+    if count is not None and count < 1:
+        raise ValueError(f"count must be >= 1, got {count}")
+    if shape is not None and any(s < 1 for s in shape):
+        raise ValueError(f"shape dims must be >= 1, got {shape}")
+
+
+def iter_free_boxes(
+    mesh: MeshSpec,
+    grid: np.ndarray,
+    count: Optional[int] = None,
+    shape: Optional[Shape] = None,
+) -> Iterable[ScoredBox]:
+    """All fully-free boxes matching the request, scored, unsorted."""
+    _validate_request(count, shape)
+    sweep = _Sweep(mesh, grid)
+    for shp in _candidate_shapes(mesh, count, shape):
+        for origin in sweep.origins(shp):
+            box = Box(TopologyCoord(*(int(v) for v in origin)), shp)
+            yield ScoredBox(
+                box=box,
+                surface=surface(shp),
+                contact=-sweep.contact(box),
+                origin_key=tuple(int(v) for v in origin),
+            )
+
+
+def find_slice(
+    mesh: MeshSpec,
+    occupied: Iterable[TopologyCoord],
+    count: Optional[int] = None,
+    shape: Optional[Shape] = None,
+    allow_irregular: bool = False,
+) -> Optional[list[TopologyCoord]]:
+    """Best placement for a gang: the chips of the best free box, or (with
+    ``allow_irregular``) a connected free region when no box exists.
+
+    Returns None when the request cannot be satisfied at all.
+
+    Surface area strictly dominates the score, so the sweep stops after the
+    first surface tier that yields any candidate — worse-surface shapes can
+    never win and are not scored (the scheduler's hot path).
+    """
+    _validate_request(count, shape)
+    grid = occupancy_grid(mesh, occupied)
+    sweep = _Sweep(mesh, grid)
+    best: Optional[ScoredBox] = None
+    tier: Optional[int] = None
+    for shp in _candidate_shapes(mesh, count, shape):
+        s = surface(shp)
+        if tier is not None and s > tier:
+            break  # strictly worse tier; current best cannot be beaten
+        for origin in sweep.origins(shp):
+            box = Box(TopologyCoord(*(int(v) for v in origin)), shp)
+            sb = ScoredBox(
+                box=box,
+                surface=s,
+                contact=-sweep.contact(box),
+                origin_key=tuple(int(v) for v in origin),
+            )
+            if best is None or sb.sort_key < best.sort_key:
+                best = sb
+                tier = s
+    if best is not None:
+        return box_coords(mesh, best.box)
+    if allow_irregular and shape is None and count is not None:
+        return _find_connected(mesh, grid, count)
+    return None
+
+
+def _find_connected(
+    mesh: MeshSpec, grid: np.ndarray, count: int
+) -> Optional[list[TopologyCoord]]:
+    """Greedy connected-region growth over free chips (BFS from the most
+    wall-adjacent free chip, preferring frontier chips with max contact).
+    Deterministic. Used only when no box of volume ``count`` exists."""
+    free = {c for c in mesh.all_coords() if not grid[tuple(c)]}
+    if len(free) < count:
+        return None
+
+    def isolation(c: TopologyCoord) -> int:
+        return -sum(1 for nb in mesh.neighbors(c) if nb in free)
+
+    # try seeds in decreasing wall/occupied-contact order; first success wins
+    seeds = sorted(free, key=lambda c: (isolation(c), tuple(c)))
+    for seed in seeds:
+        region = [seed]
+        chosen = {seed}
+        while len(region) < count:
+            frontier = [
+                nb
+                for r in region
+                for nb in mesh.neighbors(r)
+                if nb in free and nb not in chosen
+            ]
+            if not frontier:
+                break
+            # prefer the frontier chip most connected to the region
+            nxt = max(
+                frontier,
+                key=lambda c: (
+                    sum(1 for nb in mesh.neighbors(c) if nb in chosen),
+                    tuple(-v for v in c),
+                ),
+            )
+            region.append(nxt)
+            chosen.add(nxt)
+        if len(region) == count:
+            return region
+    return None
+
+
+def largest_free_box(mesh: MeshSpec, grid: np.ndarray) -> int:
+    """Volume of the largest fully-free box (one SAT build, full shape scan)."""
+    sweep = _Sweep(mesh, grid)
+    best = 0
+    X, Y, Z = mesh.dims
+    for a in range(1, X + 1):
+        for b in range(1, Y + 1):
+            if a * b * Z <= best:
+                continue
+            for c in range(Z, 0, -1):
+                if a * b * c <= best:
+                    break
+                if len(sweep.origins((a, b, c))):
+                    best = a * b * c
+                    break
+    return best
+
+
+def fragmentation(mesh: MeshSpec, occupied: Iterable[TopologyCoord]) -> float:
+    """Free-space fragmentation in [0, 1]: 1 - (largest free box)/(free chips).
+
+    0 = all free chips form one perfect box; -> 1 as free space shatters.
+    Exported to metrics and used by tests to validate packing behavior.
+    """
+    grid = occupancy_grid(mesh, occupied)
+    free_count = int((~grid).sum())
+    if free_count == 0:
+        return 0.0
+    return 1.0 - largest_free_box(mesh, grid) / free_count
