@@ -1,0 +1,423 @@
+"""Scheduler extender (L5) — HTTP webhooks for kube-scheduler.
+
+SURVEY.md §2 C9 and §4.2: the reference runs an HTTP server implementing
+the kube-scheduler extender protocol — /filter (feasibility via the group
+allocator), /prioritize (NVLink/PCIe topology score), /bind (commit +
+annotate). This is the TPU rendering: feasibility is free-share accounting
+per node, the score is ICI-mesh locality (how snugly the pod's chips pack
+against existing allocations — BASELINE's "ICI-mesh locality" replacing
+NVLink scoring), and bind plans concrete chips with slicefit and records
+the commitment in the ClusterState ledger + a pod ``alloc`` annotation.
+
+The extender is a pure function of (pod, node annotations, ledger): no
+apiserver connection exists here. The sim harness plays kube-scheduler
+over real HTTP (aiohttp), which is exactly how the reference is tested
+(SURVEY.md §5: "the extender is a pure function of (pods, node
+annotations), so 'a cluster' is just data").
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+from aiohttp import web
+
+from tpukube.core import codec
+from tpukube.core.config import TpuKubeConfig
+from tpukube.core.types import (
+    RESOURCE_TPU,
+    RESOURCE_VTPU,
+    AllocResult,
+    PodInfo,
+    TopologyCoord,
+    make_device_id,
+)
+from tpukube.sched import kube, slicefit
+from tpukube.sched.state import ClusterState, NodeView, StateError
+
+log = logging.getLogger("tpukube.extender")
+
+MAX_SCORE = 10  # kube extender HostPriority scores are 0..10
+
+
+class ExtenderError(RuntimeError):
+    pass
+
+
+class Extender:
+    """Webhook logic, HTTP-free (the aiohttp app wraps this)."""
+
+    # in-flight pods older than this are pruned (abandoned/deleted while
+    # Pending); the scheduler re-filters before any bind anyway
+    PENDING_TTL_S = 600.0
+    LATENCY_WINDOW = 4096
+
+    def __init__(self, config: TpuKubeConfig, state: Optional[ClusterState] = None):
+        self._config = config
+        self.state = state or ClusterState()
+        # Pods seen at filter time, so /bind (which only carries names) can
+        # recover the request: key -> (pod, uid, seen_monotonic).
+        self._pending: dict[str, tuple[PodInfo, str, float]] = {}
+        self._pending_lock = threading.Lock()
+        # latency capture for the north-star p50 (SURVEY.md §6 tracing);
+        # bounded windows, not unbounded lists — this is a daemon
+        self.latencies: dict[str, deque[float]] = {
+            "filter": deque(maxlen=self.LATENCY_WINDOW),
+            "prioritize": deque(maxlen=self.LATENCY_WINDOW),
+            "bind": deque(maxlen=self.LATENCY_WINDOW),
+        }
+
+    def _remember(self, pod: PodInfo) -> None:
+        now = time.monotonic()
+        with self._pending_lock:
+            self._pending[pod.key()] = (pod, pod.uid, now)
+            stale = [
+                k for k, (_, _, t) in self._pending.items()
+                if now - t > self.PENDING_TTL_S
+            ]
+            for k in stale:
+                del self._pending[k]
+
+    # -- request decoding --------------------------------------------------
+    @staticmethod
+    def device_request(pod: PodInfo) -> Optional[tuple[str, int]]:
+        """(resource, count) for the pod's TPU ask, or None for non-TPU pods.
+        A pod asking for both resources is malformed (different node modes)."""
+        req = pod.requests()
+        tpu = req.get(RESOURCE_TPU, 0)
+        vtpu = req.get(RESOURCE_VTPU, 0)
+        if tpu and vtpu:
+            raise ExtenderError(
+                f"{pod.key()}: requests both {RESOURCE_TPU} and {RESOURCE_VTPU}"
+            )
+        if tpu:
+            return RESOURCE_TPU, tpu
+        if vtpu:
+            return RESOURCE_VTPU, vtpu
+        return None
+
+    def _ingest_nodes(self, raw_nodes: list[dict[str, Any]]) -> list[str]:
+        names = []
+        for obj in raw_nodes:
+            name, annotations = kube.node_name_and_annotations(obj)
+            self.state.upsert_node(name, annotations)
+            names.append(name)
+        return names
+
+    # -- /filter -----------------------------------------------------------
+    def filter(
+        self, pod: PodInfo, raw_nodes: list[dict[str, Any]]
+    ) -> tuple[list[dict[str, Any]], dict[str, str]]:
+        t0 = time.monotonic()
+        try:
+            self._ingest_nodes(raw_nodes)
+            ask = self.device_request(pod)
+            if ask is None:
+                # not a TPU pod: everything is feasible, nothing to track
+                return raw_nodes, {}
+            resource, count = ask
+            self._remember(pod)
+            feasible, failed = [], {}
+            for obj in raw_nodes:
+                name, _ = kube.node_name_and_annotations(obj)
+                reason = self._node_feasibility(name, resource, count)
+                if reason is None:
+                    feasible.append(obj)
+                else:
+                    failed[name] = reason
+            return feasible, failed
+        finally:
+            self.latencies["filter"].append(time.monotonic() - t0)
+
+    def _node_feasibility(
+        self, name: str, resource: str, count: int
+    ) -> Optional[str]:
+        """None if feasible, else a human-readable reason."""
+        view = self.state.node(name)
+        if view is None:
+            return "no tpukube node-topology annotation"
+        vtpu_node = view.shares_per_chip > 1
+        if resource == RESOURCE_VTPU:
+            if not vtpu_node:
+                return "node is whole-chip mode, pod wants vTPU shares"
+            free = view.total_free_shares()
+            if free < count:
+                return f"wants {count} vTPU shares, node has {free}"
+            return None
+        if vtpu_node:
+            return "node is vTPU mode, pod wants whole chips"
+        free = len(view.free_chips())
+        if free < count:
+            return f"wants {count} chips, node has {free} free"
+        return None
+
+    # -- /prioritize -------------------------------------------------------
+    def prioritize(
+        self, pod: PodInfo, raw_nodes: list[dict[str, Any]]
+    ) -> dict[str, int]:
+        t0 = time.monotonic()
+        try:
+            names = self._ingest_nodes(raw_nodes)
+            try:
+                ask = self.device_request(pod)
+            except ExtenderError:
+                return {n: 0 for n in names}
+            if ask is None:
+                return {n: 0 for n in names}
+            resource, count = ask
+            # the occupancy sweep depends only on cluster state — build it
+            # once per request, not per node (scheduler hot path)
+            sweep = None
+            if self._config.score_mode == "topology" and resource == RESOURCE_TPU:
+                mesh = self.state.mesh
+                if mesh is not None:
+                    grid = slicefit.occupancy_grid(
+                        mesh, self.state.occupied_coords()
+                    )
+                    sweep = slicefit._Sweep(mesh, grid)
+            scores: dict[str, int] = {}
+            for name in names:
+                scores[name] = self._score_node(name, resource, count, sweep)
+            return scores
+        finally:
+            self.latencies["prioritize"].append(time.monotonic() - t0)
+
+    def _score_node(
+        self,
+        name: str,
+        resource: str,
+        count: int,
+        sweep: Optional["slicefit._Sweep"] = None,
+    ) -> int:
+        view = self.state.node(name)
+        if view is None or self._node_feasibility(name, resource, count):
+            return 0
+        mode = self._config.score_mode
+        n_chips = len(view.info.chips)
+        if mode == "spread":
+            free_frac = view.total_free_shares() / (
+                n_chips * view.shares_per_chip or 1
+            )
+            return round(MAX_SCORE * free_frac)
+        if mode == "binpack":
+            used_frac = 1 - view.total_free_shares() / (
+                n_chips * view.shares_per_chip or 1
+            )
+            return round(MAX_SCORE * used_frac)
+        # "topology" (default): ICI-mesh locality.
+        plan = self._plan_chips(view, resource, count)
+        if plan is None:
+            return 0
+        if resource == RESOURCE_VTPU:
+            # prefer riding already-used chips (keeps whole chips free)
+            reused = sum(
+                1
+                for c in plan
+                if view.used_share_count(self._index_at(view, c))
+            )
+            return min(MAX_SCORE, round(MAX_SCORE * (reused + 1) / (len(plan) + 1)))
+        # whole chips: snugness — chips packed against walls/allocations
+        # leave the mesh least fragmented, keeping future gangs' boxes open
+        if sweep is None:
+            mesh = self.state.mesh
+            assert mesh is not None
+            grid = slicefit.occupancy_grid(mesh, self.state.occupied_coords())
+            sweep = slicefit._Sweep(mesh, grid)
+        contact = 0
+        max_contact = 0
+        for coord in plan:
+            box = slicefit.Box(coord, (1, 1, 1))
+            contact += sweep.contact(box)
+            max_contact += 6
+        return round(MAX_SCORE * contact / max_contact) if max_contact else 0
+
+    @staticmethod
+    def _index_at(view: NodeView, coord: TopologyCoord) -> int:
+        for c in view.info.chips:
+            if c.coord == coord:
+                return c.index
+        raise ExtenderError(f"no chip at {coord} on {view.info.name}")
+
+    # -- placement planning -------------------------------------------------
+    def _plan_chips(
+        self, view: NodeView, resource: str, count: int
+    ) -> Optional[list[TopologyCoord]]:
+        """Choose concrete chips on one node for a request.
+
+        Whole chips: slicefit over the global mesh, restricted to this
+        node's free chips (everything else masked occupied) — irregular
+        allowed, a host block is tightly connected anyway.
+        vTPU: chip-level choice only (shares are fungible); fill
+        partially-used chips first to keep whole chips free.
+        """
+        if resource == RESOURCE_VTPU:
+            chips = sorted(
+                (c for c in view.info.chips if view.free_shares(c) > 0),
+                key=lambda c: (-view.used_share_count(c.index), c.index),
+            )
+            out: list[TopologyCoord] = []
+            remaining = count
+            for chip in chips:
+                take = min(remaining, view.free_shares(chip))
+                out.extend([chip.coord] * take)
+                remaining -= take
+                if remaining == 0:
+                    return out
+            return None
+        mesh = self.state.mesh
+        assert mesh is not None
+        free_chips = view.free_chips()
+        node_free = {c.coord for c in free_chips}
+        if len(node_free) < count:
+            return None
+        mask = {c for c in mesh.all_coords() if c not in node_free}
+        placed = slicefit.find_slice(mesh, mask, count=count, allow_irregular=True)
+        if placed is not None:
+            return placed
+        # Free chips exist but form no box/connected region (e.g. diagonal
+        # survivors in a host block). Chips on ONE HOST are always mutually
+        # usable — adjacency is a preference, not a requirement, for
+        # non-gang pods — so fall back to any free chips, keeping the
+        # filter's count-based feasibility and bind in agreement.
+        chosen = sorted(node_free)[:count]
+        return [TopologyCoord.of(c) for c in chosen]
+
+    # -- /bind --------------------------------------------------------------
+    def bind(self, pod_name: str, namespace: str, uid: str, node_name: str) -> AllocResult:
+        t0 = time.monotonic()
+        try:
+            key = f"{namespace}/{pod_name}"
+            with self._pending_lock:
+                entry = self._pending.get(key)
+            if entry is None:
+                raise ExtenderError(
+                    f"bind for {key} without a preceding filter (restart? "
+                    "scheduler will re-run the cycle)"
+                )
+            pod, cached_uid, _ = entry
+            if uid and cached_uid and uid != cached_uid:
+                raise ExtenderError(
+                    f"bind for {key}: uid {uid} does not match the filtered "
+                    f"pod {cached_uid} (deleted and recreated?)"
+                )
+            ask = self.device_request(pod)
+            if ask is None:
+                raise ExtenderError(f"{key}: no TPU request to bind")
+            resource, count = ask
+            view = self.state.node(node_name)
+            if view is None:
+                raise ExtenderError(f"bind to unknown node {node_name}")
+            plan = self._plan_chips(view, resource, count)
+            if plan is None:
+                raise ExtenderError(
+                    f"{key}: node {node_name} can no longer fit {count} x {resource}"
+                )
+            device_ids = self._mint_device_ids(view, resource, plan)
+            alloc = AllocResult(
+                pod_key=key,
+                node_name=node_name,
+                device_ids=device_ids,
+                coords=sorted(set(plan)),
+            )
+            self.state.commit(alloc)  # raises StateError on lost race
+            with self._pending_lock:
+                self._pending.pop(key, None)
+            log.info("bound %s -> %s %s", key, node_name, device_ids)
+            return alloc
+        finally:
+            self.latencies["bind"].append(time.monotonic() - t0)
+
+    def _mint_device_ids(
+        self, view: NodeView, resource: str, plan: list[TopologyCoord]
+    ) -> list[str]:
+        if resource == RESOURCE_TPU:
+            return [
+                make_device_id(self._index_at(view, coord)) for coord in plan
+            ]
+        # vTPU: mint the lowest UNUSED share index per chip — a count would
+        # re-issue a released id while its sibling is still allocated
+        n = view.shares_per_chip
+        ids = []
+        taken: dict[int, set[int]] = {}
+        for coord in plan:
+            index = self._index_at(view, coord)
+            if index not in taken:
+                taken[index] = set(view.used_frac_ks(index))
+            k = next((i for i in range(n) if i not in taken[index]), None)
+            if k is None:
+                raise ExtenderError(f"chip {index}: shares exhausted mid-mint")
+            taken[index].add(k)
+            ids.append(make_device_id(index, (k, n)))
+        return ids
+
+    # -- pod lifecycle ------------------------------------------------------
+    def release(self, pod_key: str) -> None:
+        self.state.release(pod_key)
+        with self._pending_lock:
+            self._pending.pop(pod_key, None)
+
+
+# -- aiohttp application ----------------------------------------------------
+
+def make_app(extender: Extender) -> web.Application:
+    app = web.Application()
+
+    async def _json(request: web.Request) -> Any:
+        try:
+            return await request.json()
+        except json.JSONDecodeError as e:
+            raise web.HTTPBadRequest(text=f"bad JSON: {e}")
+
+    async def filter_handler(request: web.Request) -> web.Response:
+        body = await _json(request)
+        try:
+            pod, nodes = kube.parse_extender_args(body)
+        except kube.KubeSchemaError as e:
+            raise web.HTTPBadRequest(text=str(e))
+        try:
+            feasible, failed = extender.filter(pod, nodes)
+            return web.json_response(kube.filter_result(feasible, failed))
+        except (ExtenderError, StateError, codec.CodecError) as e:
+            return web.json_response(kube.filter_result([], {}, error=str(e)))
+
+    async def prioritize_handler(request: web.Request) -> web.Response:
+        body = await _json(request)
+        try:
+            pod, nodes = kube.parse_extender_args(body)
+        except kube.KubeSchemaError as e:
+            raise web.HTTPBadRequest(text=str(e))
+        try:
+            scores = extender.prioritize(pod, nodes)
+        except (ExtenderError, StateError, codec.CodecError) as e:
+            log.warning("prioritize failed: %s", e)
+            scores = {}
+        return web.json_response(kube.host_priority_list(scores))
+
+    async def bind_handler(request: web.Request) -> web.Response:
+        body = await _json(request)
+        try:
+            name, ns, uid, node = kube.parse_binding_args(body)
+        except kube.KubeSchemaError as e:
+            raise web.HTTPBadRequest(text=str(e))
+        try:
+            alloc = extender.bind(name, ns, uid, node)
+        except (ExtenderError, StateError, codec.CodecError) as e:
+            return web.json_response(kube.binding_result(str(e)))
+        # the alloc annotation rides back to the harness/apiserver-writer
+        result = kube.binding_result()
+        result["Annotations"] = {codec.ANNO_ALLOC: codec.encode_alloc(alloc)}
+        return web.json_response(result)
+
+    async def healthz(request: web.Request) -> web.Response:
+        return web.json_response({"ok": True, "nodes": extender.state.node_names()})
+
+    app.router.add_post("/filter", filter_handler)
+    app.router.add_post("/prioritize", prioritize_handler)
+    app.router.add_post("/bind", bind_handler)
+    app.router.add_get("/healthz", healthz)
+    return app

@@ -1,0 +1,123 @@
+"""Kubernetes API JSON <-> core types.
+
+The extender webhook bodies are fixed by kube-scheduler (SURVEY.md §2 L5:
+"the scheduler extender JSON schema ExtenderArgs/ExtenderFilterResult/
+HostPriorityList — fixed by Kubernetes"). This module converts between
+those wire dicts and the framework's PodInfo/NodeInfo, so the extender
+logic never touches raw JSON.
+
+Field names follow the upstream scheduler-extender v1 API (capitalized:
+"Pod", "Nodes", "FailedNodes", "Host", "Score"); pod/node objects follow
+core v1 (lowercase metadata/spec).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from tpukube.core import codec
+from tpukube.core.types import ContainerInfo, PodInfo, ResourceList
+
+
+class KubeSchemaError(ValueError):
+    pass
+
+
+def pod_from_k8s(obj: dict[str, Any]) -> PodInfo:
+    """v1.Pod dict -> PodInfo (only the fields this framework reasons on)."""
+    if not isinstance(obj, dict):
+        raise KubeSchemaError("Pod must be a JSON object")
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    name = meta.get("name")
+    if not name:
+        raise KubeSchemaError("Pod.metadata.name missing")
+    containers = []
+    for c in spec.get("containers") or []:
+        requests_raw = ((c.get("resources") or {}).get("requests")) or {}
+        requests = ResourceList()
+        for k, v in requests_raw.items():
+            try:
+                requests[k] = int(v)
+            except (TypeError, ValueError):
+                # non-integer quantities (cpu "500m", memory "1Gi") are not
+                # device resources; this framework only meters whole devices
+                continue
+        containers.append(ContainerInfo(name=c.get("name", ""), requests=requests))
+    pod = PodInfo(
+        name=name,
+        namespace=meta.get("namespace", "default"),
+        uid=meta.get("uid", ""),
+        containers=containers,
+        priority=int(spec.get("priority") or 0),
+        annotations=dict(meta.get("annotations") or {}),
+        labels=dict(meta.get("labels") or {}),
+        node_name=spec.get("nodeName", ""),
+    )
+    codec.attach_group(pod)
+    return pod
+
+
+def node_name_and_annotations(obj: dict[str, Any]) -> tuple[str, dict[str, str]]:
+    if not isinstance(obj, dict):
+        raise KubeSchemaError("Node must be a JSON object")
+    meta = obj.get("metadata") or {}
+    name = meta.get("name")
+    if not name:
+        raise KubeSchemaError("Node.metadata.name missing")
+    return name, dict(meta.get("annotations") or {})
+
+
+def parse_extender_args(body: dict[str, Any]) -> tuple[PodInfo, list[dict[str, Any]]]:
+    """ExtenderArgs -> (pod, raw node objects). Non-cache-capable mode:
+    full node objects (with annotations) ride in each request."""
+    if not isinstance(body, dict):
+        raise KubeSchemaError("ExtenderArgs must be a JSON object")
+    pod_obj = body.get("Pod")
+    if pod_obj is None:
+        raise KubeSchemaError("ExtenderArgs.Pod missing")
+    pod = pod_from_k8s(pod_obj)
+    nodes = (body.get("Nodes") or {}).get("Items")
+    if nodes is None:
+        raise KubeSchemaError(
+            "ExtenderArgs.Nodes.Items missing (node-cache mode unsupported)"
+        )
+    return pod, list(nodes)
+
+
+def filter_result(
+    feasible: list[dict[str, Any]],
+    failed: dict[str, str],
+    error: str = "",
+) -> dict[str, Any]:
+    return {
+        "Nodes": {"Items": feasible},
+        "NodeNames": [
+            (n.get("metadata") or {}).get("name") for n in feasible
+        ],
+        "FailedNodes": failed,
+        "Error": error,
+    }
+
+
+def host_priority_list(scores: dict[str, int]) -> list[dict[str, Any]]:
+    return [{"Host": h, "Score": s} for h, s in sorted(scores.items())]
+
+
+def parse_binding_args(body: dict[str, Any]) -> tuple[str, str, str, str]:
+    """ExtenderBindingArgs -> (name, namespace, uid, node)."""
+    if not isinstance(body, dict):
+        raise KubeSchemaError("ExtenderBindingArgs must be a JSON object")
+    try:
+        return (
+            body["PodName"],
+            body.get("PodNamespace", "default"),
+            body.get("PodUID", ""),
+            body["Node"],
+        )
+    except KeyError as e:
+        raise KubeSchemaError(f"ExtenderBindingArgs missing {e}") from e
+
+
+def binding_result(error: Optional[str] = None) -> dict[str, Any]:
+    return {"Error": error or ""}

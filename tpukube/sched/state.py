@@ -1,0 +1,227 @@
+"""Cluster state as the extender sees it (L5 support).
+
+SURVEY.md §6 (checkpoint/resume): the control plane is deliberately
+stateless — node truth arrives in ``node-topology`` annotations with each
+webhook call, and allocations live in pod annotations. The only in-memory
+structure is this ledger of commitments, and it is reconstructible from pod
+annotations after an extender restart (``rebuild_from_pods``), which the
+tests exercise.
+
+Occupancy accounting is share-granular: a whole-chip node is just the
+n=1 case of a vTPU node, so one ledger covers both resources.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpukube.core import codec
+from tpukube.core.mesh import MeshSpec
+from tpukube.core.types import (
+    AllocResult,
+    ChipInfo,
+    Health,
+    NodeInfo,
+    TopologyCoord,
+    parse_device_id,
+)
+
+
+class StateError(RuntimeError):
+    pass
+
+
+@dataclass
+class NodeView:
+    """One node's decoded annotation + live occupancy, tracked at device-id
+    granularity (a count would re-mint a released share's id while its twin
+    is still live — ids are the unit of truth, counts are derived)."""
+
+    info: NodeInfo
+    used_ids: set[str] = field(default_factory=set)
+
+    @property
+    def shares_per_chip(self) -> int:
+        return max(1, self.info.shares_per_chip)
+
+    def chip(self, index: int) -> ChipInfo:
+        return self.info.chip_by_index(index)
+
+    def used_share_count(self, index: int) -> int:
+        n = 0
+        for did in self.used_ids:
+            i, frac = parse_device_id(did)
+            if i != index:
+                continue
+            n += 1 if frac is not None else self.shares_per_chip
+        return n
+
+    def used_frac_ks(self, index: int) -> set[int]:
+        out = set()
+        for did in self.used_ids:
+            i, frac = parse_device_id(did)
+            if i == index and frac is not None:
+                out.add(frac[0])
+        return out
+
+    def free_shares(self, chip: ChipInfo) -> int:
+        if chip.health is not Health.HEALTHY:
+            return 0
+        return self.shares_per_chip - self.used_share_count(chip.index)
+
+    def total_free_shares(self) -> int:
+        return sum(self.free_shares(c) for c in self.info.chips)
+
+    def free_chips(self) -> list[ChipInfo]:
+        """Chips with ALL shares free (placeable as whole units)."""
+        return [
+            c
+            for c in self.info.chips
+            if self.free_shares(c) == self.shares_per_chip
+        ]
+
+
+class ClusterState:
+    """Thread-safe ledger: node views + per-chip share occupancy.
+
+    The extender serves concurrent webhook calls; all mutation goes through
+    this object's lock (SURVEY.md §9.3: reservations must be linearizable
+    under concurrent filter calls — the gang layer in M7 builds on this).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._nodes: dict[str, NodeView] = {}
+        self._mesh: Optional[MeshSpec] = None
+        self._allocs: dict[str, AllocResult] = {}  # pod key -> commitment
+
+    # -- node ingestion ----------------------------------------------------
+    def upsert_node(self, name: str, annotations: dict[str, str]) -> bool:
+        """Decode and store a node's topology annotation. Returns False when
+        the node carries no tpukube annotation (not ours to manage)."""
+        decoded = codec.node_from_annotations(name, annotations)
+        if decoded is None:
+            return False
+        info, mesh = decoded
+        with self._lock:
+            if self._mesh is None:
+                self._mesh = mesh
+            elif self._mesh != mesh:
+                raise StateError(
+                    f"node {name} reports mesh {mesh.dims}, cluster has "
+                    f"{self._mesh.dims} — mixed-mesh clusters unsupported"
+                )
+            prev = self._nodes.get(name)
+            view = NodeView(info=info)
+            if prev is not None:
+                view.used_ids = prev.used_ids
+            self._nodes[name] = view
+        return True
+
+    # -- views -------------------------------------------------------------
+    @property
+    def mesh(self) -> Optional[MeshSpec]:
+        with self._lock:
+            return self._mesh
+
+    def node(self, name: str) -> Optional[NodeView]:
+        with self._lock:
+            return self._nodes.get(name)
+
+    def node_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._nodes)
+
+    def occupied_coords(self) -> set[TopologyCoord]:
+        """Coords unusable for a whole-chip/gang placement: any chip with
+        used shares, plus unhealthy chips."""
+        with self._lock:
+            out: set[TopologyCoord] = set()
+            for view in self._nodes.values():
+                for chip in view.info.chips:
+                    if (
+                        chip.health is not Health.HEALTHY
+                        or view.used_share_count(chip.index) > 0
+                    ):
+                        out.add(chip.coord)
+            return out
+
+    def allocation(self, pod_key: str) -> Optional[AllocResult]:
+        with self._lock:
+            return self._allocs.get(pod_key)
+
+    def allocations(self) -> list[AllocResult]:
+        with self._lock:
+            return list(self._allocs.values())
+
+    # -- utilization (north-star metric feed) ------------------------------
+    def utilization(self) -> float:
+        """Allocated share fraction over healthy capacity, 0..1."""
+        with self._lock:
+            total = 0
+            used = 0
+            for view in self._nodes.values():
+                n = view.shares_per_chip
+                for chip in view.info.chips:
+                    if chip.health is Health.HEALTHY:
+                        total += n
+                        used += min(n, view.used_share_count(chip.index))
+            return used / total if total else 0.0
+
+    # -- commit / release --------------------------------------------------
+    def commit(self, alloc: AllocResult) -> None:
+        """Record a bind: devices of one pod on one node."""
+        with self._lock:
+            if alloc.pod_key in self._allocs:
+                raise StateError(f"{alloc.pod_key} already has an allocation")
+            view = self._nodes.get(alloc.node_name)
+            if view is None:
+                raise StateError(f"bind to unknown node {alloc.node_name}")
+            n = view.shares_per_chip
+            # validate first, then apply (no partial commit)
+            adding: set[str] = set()
+            pending_shares: dict[int, int] = {}
+            for did in alloc.device_ids:
+                index, frac = parse_device_id(did)
+                chip = view.chip(index)
+                if chip.health is not Health.HEALTHY:
+                    raise StateError(f"{did}: chip unhealthy")
+                if did in view.used_ids or did in adding:
+                    raise StateError(f"{did}: device id already allocated")
+                if frac is not None and not 0 <= frac[0] < n:
+                    raise StateError(f"{did}: share index out of range")
+                want = n if frac is None else 1
+                have = view.free_shares(chip) - pending_shares.get(index, 0)
+                if have < want:
+                    raise StateError(f"{did}: insufficient free shares")
+                adding.add(did)
+                pending_shares[index] = pending_shares.get(index, 0) + want
+            view.used_ids |= adding
+            self._allocs[alloc.pod_key] = alloc
+
+    def release(self, pod_key: str) -> Optional[AllocResult]:
+        """Pod gone (deleted/preempted): free its shares."""
+        with self._lock:
+            alloc = self._allocs.pop(pod_key, None)
+            if alloc is None:
+                return None
+            view = self._nodes.get(alloc.node_name)
+            if view is not None:
+                view.used_ids -= set(alloc.device_ids)
+            return alloc
+
+    # -- restart story -----------------------------------------------------
+    def rebuild_from_pods(self, pods: list[dict[str, str]]) -> int:
+        """Reconstruct the ledger from pod alloc annotations (each item is
+        one pod's annotation dict). Returns commitments restored."""
+        restored = 0
+        for annotations in pods:
+            payload = annotations.get(codec.ANNO_ALLOC)
+            if not payload:
+                continue
+            alloc = codec.decode_alloc(payload)
+            self.commit(alloc)
+            restored += 1
+        return restored

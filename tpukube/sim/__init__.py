@@ -1,0 +1,9 @@
+"""Simulation harness: a data-driven cluster driving the real daemons.
+
+SURVEY.md §5: "multi-node behavior is exercised by feeding the extender
+synthetic multi-node ExtenderArgs — a cluster is just data." No Kubernetes
+exists in this environment; this harness IS the test cluster, and the
+BASELINE configs run against it.
+"""
+
+from tpukube.sim.harness import SimCluster  # noqa: F401

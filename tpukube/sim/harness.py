@@ -1,0 +1,296 @@
+"""SimCluster — plays apiserver + kube-scheduler against a real extender.
+
+The extender runs as a real aiohttp server on localhost; this harness POSTs
+the actual kube-scheduler webhook JSON (filter -> prioritize -> pick max ->
+bind), stores returned alloc annotations on its pod records (the apiserver's
+job), and can additionally execute an allocation through a real
+DevicePluginServer + FakeKubelet over unix sockets to prove the scheduler
+and node-agent halves compose (SURVEY.md §4.2 + §4.3 end to end).
+
+Node data is minted directly from MeshSpec geometry — running one real
+libtpuinfo-backed agent per simulated node is impossible in one process
+(the native layer is single-instance by design, like NVML), and the
+annotation codec is the actual interface the extender consumes anyway.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import socket
+import threading
+import urllib.request
+from typing import Any, Optional
+
+from aiohttp import web
+
+from tpukube.core import codec
+from tpukube.core.config import TpuKubeConfig, load_config
+from tpukube.core.mesh import MeshSpec
+from tpukube.core.types import (
+    RESOURCE_TPU,
+    RESOURCE_VTPU,
+    AllocResult,
+    ChipInfo,
+    Health,
+    NodeInfo,
+    PodGroup,
+    TopologyCoord,
+)
+from tpukube.sched.extender import Extender, make_app
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _AppThread:
+    """Runs an aiohttp app in a background thread with its own loop."""
+
+    def __init__(self, app: web.Application, host: str, port: int):
+        self._app = app
+        self._host = host
+        self._port = port
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="tpukube-extender-http")
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError("extender HTTP server failed to start")
+
+    def _run(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        runner = web.AppRunner(self._app)
+        self._loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, self._host, self._port)
+        self._loop.run_until_complete(site.start())
+        self._started.set()
+        try:
+            self._loop.run_forever()
+        finally:
+            self._loop.run_until_complete(runner.cleanup())
+            self._loop.close()
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+
+class SimCluster:
+    """A simulated multi-node TPU cluster around one live Extender."""
+
+    def __init__(
+        self,
+        config: Optional[TpuKubeConfig] = None,
+        mesh: Optional[MeshSpec] = None,
+        vtpu_nodes: Optional[set[str]] = None,
+        vtpu_shares: int = 2,
+    ):
+        self.config = config or load_config(env={})
+        self.mesh = mesh or self.config.sim_mesh()
+        self._vtpu_nodes = vtpu_nodes or set()
+        self._vtpu_shares = vtpu_shares
+        self.nodes: dict[str, NodeInfo] = {}
+        for host in self.mesh.all_hosts():
+            chips = [
+                ChipInfo(
+                    chip_id=f"{host}-chip-{i}",
+                    index=i,
+                    coord=coord,
+                    hbm_bytes=self.config.hbm_bytes_per_chip,
+                    num_cores=self.config.cores_per_chip,
+                )
+                for i, coord in enumerate(self.mesh.coords_of_host(host))
+            ]
+            shares = self._vtpu_shares if host in self._vtpu_nodes else 1
+            self.nodes[host] = NodeInfo(name=host, chips=chips, shares_per_chip=shares)
+        self.extender = Extender(self.config)
+        self.pods: dict[str, dict[str, Any]] = {}  # key -> pod object
+        self._port = _free_port()
+        self._http: Optional[_AppThread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def base_url(self) -> str:
+        return f"http://127.0.0.1:{self._port}"
+
+    def start(self) -> None:
+        self._http = _AppThread(make_app(self.extender), "127.0.0.1", self._port)
+        self._http.start()
+
+    def stop(self) -> None:
+        if self._http is not None:
+            self._http.stop()
+            self._http = None
+
+    def __enter__(self) -> "SimCluster":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- kube-object minting -----------------------------------------------
+    def node_objects(self) -> list[dict[str, Any]]:
+        return [
+            {
+                "metadata": {
+                    "name": name,
+                    "annotations": codec.annotate_node(info, self.mesh),
+                }
+            }
+            for name, info in sorted(self.nodes.items())
+        ]
+
+    def make_pod(
+        self,
+        name: str,
+        tpu: int = 0,
+        vtpu: int = 0,
+        namespace: str = "default",
+        priority: int = 0,
+        group: Optional[PodGroup] = None,
+    ) -> dict[str, Any]:
+        requests: dict[str, str] = {}
+        if tpu:
+            requests[RESOURCE_TPU] = str(tpu)
+        if vtpu:
+            requests[RESOURCE_VTPU] = str(vtpu)
+        annotations: dict[str, str] = {}
+        if group is not None:
+            annotations.update(codec.pod_group_annotations(group))
+        pod = {
+            "metadata": {
+                "name": name,
+                "namespace": namespace,
+                "uid": f"uid-{namespace}-{name}",
+                "annotations": annotations,
+                "labels": {},
+            },
+            "spec": {
+                "priority": priority,
+                "containers": [
+                    {"name": "main", "resources": {"requests": requests}}
+                ],
+            },
+        }
+        self.pods[f"{namespace}/{name}"] = pod
+        return pod
+
+    # -- the scheduler loop (what kube-scheduler would do) -------------------
+    def _post(self, path: str, body: dict[str, Any]) -> Any:
+        req = urllib.request.Request(
+            f"{self.base_url}{path}",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read())
+
+    def schedule(
+        self, pod: dict[str, Any], retries: int = 8
+    ) -> tuple[str, AllocResult]:
+        """One scheduling cycle for one pod, with kube-scheduler's requeue
+        semantics: a lost bind race (another pod took the chips between
+        filter and bind) re-runs the whole cycle. Raises on failure."""
+        last_err = ""
+        for _ in range(retries):
+            args = {"Pod": pod, "Nodes": {"Items": self.node_objects()}}
+            fres = self._post("/filter", args)
+            if fres.get("Error"):
+                raise RuntimeError(f"filter error: {fres['Error']}")
+            feasible = fres["Nodes"]["Items"]
+            if not feasible:
+                raise RuntimeError(f"unschedulable: {fres['FailedNodes']}")
+            pres = self._post(
+                "/prioritize", {"Pod": pod, "Nodes": {"Items": feasible}}
+            )
+            scores = {e["Host"]: e["Score"] for e in pres}
+            best = max(sorted(scores), key=lambda h: scores[h])
+            meta = pod["metadata"]
+            bres = self._post(
+                "/bind",
+                {
+                    "PodName": meta["name"],
+                    "PodNamespace": meta["namespace"],
+                    "PodUID": meta["uid"],
+                    "Node": best,
+                },
+            )
+            if bres.get("Error"):
+                last_err = bres["Error"]  # lost the race; requeue
+                continue
+            # apiserver role: persist alloc annotation + nodeName on the pod
+            meta.setdefault("annotations", {}).update(bres.get("Annotations", {}))
+            pod["spec"]["nodeName"] = best
+            alloc = codec.decode_alloc(meta["annotations"][codec.ANNO_ALLOC])
+            return best, alloc
+        raise RuntimeError(f"bind error after {retries} cycles: {last_err}")
+
+    def delete_pod(self, name: str, namespace: str = "default") -> None:
+        key = f"{namespace}/{name}"
+        self.pods.pop(key, None)
+        self.extender.release(key)
+
+    # -- fault injection (SURVEY.md §6) -------------------------------------
+    def inject_fault(self, node_name: str, chip_index: int,
+                     healthy: bool = False) -> None:
+        """Flip a chip's health in the node data — the node agent's health
+        watcher would do exactly this re-annotation on a real cluster."""
+        info = self.nodes[node_name]
+        for chip in info.chips:
+            if chip.index == chip_index:
+                chip.health = Health.HEALTHY if healthy else Health.UNHEALTHY
+                return
+        raise KeyError(f"{node_name} has no chip {chip_index}")
+
+    # -- node-agent composition check (config 2's fan-out leg) ---------------
+    def execute_allocation(self, alloc: AllocResult) -> dict[str, str]:
+        """Run the bound pod's Allocate through a REAL device-plugin stack
+        (gRPC over unix sockets) for the target node, returning the env the
+        container would receive. Sessions are sequential because libtpuinfo
+        is single-instance per process."""
+        import tempfile
+
+        from tpukube.core.config import load_config as _load
+        from tpukube.device import TpuDeviceManager
+        from tpukube.plugin import DevicePluginServer, FakeKubelet
+
+        info = self.nodes[alloc.node_name]
+        with tempfile.TemporaryDirectory() as td:
+            env_overrides = {
+                "TPUKUBE_DEVICE_PLUGIN_DIR": td,
+                "TPUKUBE_SIM_MESH_DIMS": ",".join(str(d) for d in self.mesh.dims),
+                "TPUKUBE_SIM_HOST_BLOCK": ",".join(
+                    str(d) for d in self.mesh.host_block
+                ),
+                "TPUKUBE_SIM_TORUS": ",".join(
+                    str(t).lower() for t in self.mesh.torus
+                ),
+                "TPUKUBE_HBM_BYTES_PER_CHIP": str(self.config.hbm_bytes_per_chip),
+                "TPUKUBE_SHARES_PER_CHIP": str(info.shares_per_chip),
+            }
+            cfg = _load(env=env_overrides)
+            with FakeKubelet(td) as kubelet, \
+                 TpuDeviceManager(cfg, host=alloc.node_name) as device, \
+                 DevicePluginServer(cfg, device) as server:
+                server.register_with_kubelet()
+                kubelet.wait_for_devices(
+                    server.resource_name, len(device.device_list())
+                )
+                return kubelet.allocate(server.resource_name, alloc.device_ids)
+
+    # -- metrics ------------------------------------------------------------
+    def utilization(self) -> float:
+        return self.extender.state.utilization()
