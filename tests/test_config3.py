@@ -1,0 +1,125 @@
+"""BASELINE config 3: fractional vTPU — 2 inference pods sharing 1 chip
+with HBM quota enforcement.
+
+Full stack: extender schedules both pods onto shares of the same chip over
+HTTP, each pod's Allocate runs through a real device-plugin gRPC stack to
+produce its container env, and a real subprocess launched with that env +
+the LD_PRELOADed libhbmguard.so proves the quota actually bites (the sim
+analog of the reference's CUDA-intercept enforcement, SURVEY.md §2 C6).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpukube.core.config import load_config
+from tpukube.device.tpu import ENV_HBM_LIMIT, ENV_MEM_FRACTION
+from tpukube.sim import SimCluster
+
+HBM = 256 << 20  # 256 MiB chips keep the enforcement subprocess fast
+GUARD = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tpukube", "native", "libhbmguard.so",
+)
+
+
+@pytest.fixture(scope="module")
+def guard_lib():
+    proc = subprocess.run(
+        ["make", "-C", os.path.dirname(GUARD), "libhbmguard.so"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+    return GUARD
+
+
+def _alloc_in_guarded_process(env: dict[str, str], mib: int) -> bool:
+    """Try a `mib`-MiB allocation in a subprocess running under the pod's
+    env + hbmguard preload. True iff the allocation succeeded."""
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         f"import numpy as np; np.zeros({mib} << 20, dtype=np.uint8); print('ok')"],
+        env={
+            **os.environ,
+            **env,
+            "LD_PRELOAD": GUARD,
+        },
+        capture_output=True, text=True, timeout=60,
+    )
+    if proc.returncode == 0 and "ok" in proc.stdout:
+        return True
+    assert "MemoryError" in proc.stderr, (
+        f"allocation failed for the wrong reason:\n{proc.stderr}"
+    )
+    return False
+
+
+def test_config3_two_pods_share_one_chip(guard_lib):
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "1,1,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "1,1,1",
+        "TPUKUBE_HBM_BYTES_PER_CHIP": str(HBM),
+    })
+    with SimCluster(cfg, vtpu_nodes={"host-0-0-0"}, vtpu_shares=2) as cluster:
+        envs = []
+        chips = set()
+        for i in range(2):
+            node, alloc = cluster.schedule(cluster.make_pod(f"infer-{i}", vtpu=1))
+            assert node == "host-0-0-0"
+            chips.add(alloc.device_ids[0].split("-frac")[0])
+            env = cluster.execute_allocation(alloc)
+            envs.append(env)
+
+        # both pods share the SAME physical chip, with half-HBM quotas
+        assert chips == {"tpu-0"}
+        for env in envs:
+            assert env[ENV_HBM_LIMIT] == str(HBM // 2)
+            assert env[ENV_MEM_FRACTION] == "0.5000"
+
+        # a third share does not exist
+        with pytest.raises(RuntimeError, match="unschedulable"):
+            cluster.schedule(cluster.make_pod("infer-2", vtpu=1))
+
+        # enforcement: within-quota (64 MiB < 128 MiB) succeeds,
+        # over-quota (200 MiB > 128 MiB) is refused in-process
+        assert _alloc_in_guarded_process(envs[0], 64) is True
+        assert _alloc_in_guarded_process(envs[0], 200) is False
+
+
+def test_config3_quota_accumulates_not_just_single_alloc(guard_lib):
+    # several small allocations crossing the quota in aggregate must fail;
+    # quota is 100 MiB (not exactly 3x32) because malloc_usable_size metes
+    # slightly more than the requested 32 MiB per buffer
+    env = {ENV_HBM_LIMIT: str(100 << 20)}
+    code = (
+        "import numpy as np\n"
+        "bufs = []\n"
+        "try:\n"
+        "    for i in range(10):\n"
+        "        bufs.append(np.zeros(32 << 20, dtype=np.uint8))\n"
+        "    print('allocated', len(bufs))\n"
+        "except MemoryError:\n"
+        "    print('refused at', len(bufs))\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env={**os.environ, **env, "LD_PRELOAD": GUARD},
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    # 100 MiB quota / ~32 MiB metered each -> exactly 3 fit
+    assert "refused at 3" in proc.stdout
+
+
+def test_guard_inert_without_limit(guard_lib):
+    # no TPU_HBM_LIMIT_BYTES -> the shim must not interfere at all
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import numpy as np; np.zeros(300 << 20, dtype=np.uint8); print('ok')"],
+        env={**{k: v for k, v in os.environ.items() if k != "TPU_HBM_LIMIT_BYTES"},
+             "LD_PRELOAD": GUARD},
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0 and "ok" in proc.stdout, proc.stderr

@@ -1,0 +1,301 @@
+/* libhbmguard — HBM-quota audit preload shim (sim-mode enforcement).
+ *
+ * The reference's vGPU layer enforces SM/memory quotas with a CUDA-API
+ * intercept .so preloaded into the container (SURVEY.md §2 C6). TPUs have
+ * no CUDA to intercept and real-fleet enforcement is cooperative (the
+ * Allocate env caps the XLA client's HBM pool); what the sim needs is HARD
+ * enforcement so config-3 tests can prove quotas bite. This shim is that
+ * enforcement: LD_PRELOADed into a simulated workload process, it
+ * interposes the allocator and fails any large allocation that would push
+ * the process past TPU_HBM_LIMIT_BYTES — large host buffers stand in for
+ * device HBM in the simulation.
+ *
+ * Mechanics:
+ *  - interposes malloc/calloc/realloc/free via dlsym(RTLD_NEXT, ...)
+ *  - only allocations with usable size >= HBMGUARD_THRESHOLD_BYTES
+ *    (default 1 MiB) are metered — interpreter small-object churn is
+ *    invisible; big tensor buffers are not
+ *  - metered blocks are remembered in a lock-free pointer table, so a
+ *    free() of memory the shim never metered (posix_memalign, pre-init
+ *    blocks) cannot corrupt the ledger
+ *  - over-quota requests return NULL with errno=ENOMEM (numpy raises
+ *    MemoryError, exactly how a real HBM OOM surfaces to the user)
+ *  - introspection for tests: hbmguard_used()/hbmguard_limit()
+ *
+ * Limits of the model (documented trust model, SURVEY.md §9.3): memory
+ * obtained through interfaces the shim does not interpose (posix_memalign,
+ * raw mmap) is not metered; if the pointer table fills, overflow blocks
+ * pass unmetered rather than corrupting accounting. An audit shim, not a
+ * security boundary (neither is the reference's).
+ */
+
+#include <dlfcn.h>
+#include <errno.h>
+#include <malloc.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <atomic>
+
+extern "C" {
+
+typedef void* (*malloc_t)(size_t);
+typedef void* (*calloc_t)(size_t, size_t);
+typedef void* (*realloc_t)(void*, size_t);
+typedef void (*free_t)(void*);
+
+static std::atomic<int64_t> g_used{0};
+static int64_t g_limit = -1;      /* -1 = unlimited (shim inert) */
+static int64_t g_threshold = 1 << 20;
+static std::atomic<int> g_init_state{0}; /* 0=uninit, 1=initializing, 2=ready */
+static pthread_t g_init_thread;
+
+static malloc_t real_malloc = nullptr;
+static calloc_t real_calloc = nullptr;
+static realloc_t real_realloc = nullptr;
+static free_t real_free = nullptr;
+
+/* -- boot arena ------------------------------------------------------------
+ * dlsym may itself allocate during init: serve those from a static arena
+ * (never freed; a few KiB at most). Each block carries a size header so a
+ * later realloc can copy exactly the old contents. */
+static char g_boot_arena[16384];
+static size_t g_boot_off = 0;
+
+static int in_boot_arena(const void* p) {
+  const char* c = static_cast<const char*>(p);
+  return c >= g_boot_arena && c < g_boot_arena + sizeof(g_boot_arena);
+}
+
+static void* boot_alloc(size_t n) {
+  size_t need = ((n + 15) & ~size_t{15}) + 16; /* 16-byte header */
+  if (g_boot_off + need > sizeof(g_boot_arena)) return nullptr;
+  char* base = g_boot_arena + g_boot_off;
+  g_boot_off += need;
+  *reinterpret_cast<size_t*>(base) = n;
+  return base + 16;
+}
+
+static size_t boot_size(const void* p) {
+  return *reinterpret_cast<const size_t*>(static_cast<const char*>(p) - 16);
+}
+
+/* -- metered-pointer table -------------------------------------------------
+ * Open-addressed, lock-free table of blocks the shim actually metered.
+ * Metered allocations are big (>= 1 MiB), so live count is small; 64Ki
+ * slots is generous. If the table ever fills, the block passes unmetered —
+ * losing one block's metering is strictly better than corrupting g_used. */
+#define TABLE_SLOTS 65536
+static std::atomic<uintptr_t> g_table[TABLE_SLOTS];
+
+static size_t slot_of(uintptr_t p) {
+  /* fibonacci hash on the address */
+  return (size_t)((p * 11400714819323198485ull) >> 48) & (TABLE_SLOTS - 1);
+}
+
+static int table_remove(void* p) {
+  uintptr_t v = reinterpret_cast<uintptr_t>(p);
+  size_t i = slot_of(v);
+  for (int probe = 0; probe < TABLE_SLOTS; ++probe) {
+    uintptr_t cur = g_table[i].load();
+    if (cur == v) {
+      /* tombstone-free removal is unsafe in open addressing; use a
+       * tombstone value so probe chains stay intact */
+      if (g_table[i].compare_exchange_strong(cur, UINTPTR_MAX)) return 1;
+    }
+    if (cur == 0) return 0; /* end of probe chain: never metered */
+    i = (i + 1) & (TABLE_SLOTS - 1);
+  }
+  return 0;
+}
+
+/* tombstones are reusable on insert */
+static int table_insert_reuse(void* p) {
+  uintptr_t v = reinterpret_cast<uintptr_t>(p);
+  size_t i = slot_of(v);
+  for (int probe = 0; probe < TABLE_SLOTS; ++probe) {
+    uintptr_t cur = g_table[i].load();
+    if (cur == 0 || cur == UINTPTR_MAX) {
+      if (g_table[i].compare_exchange_strong(cur, v)) return 1;
+    } else if (cur == v) {
+      return 1;
+    }
+    i = (i + 1) & (TABLE_SLOTS - 1);
+  }
+  return 0;
+}
+
+/* -- init ------------------------------------------------------------------ */
+static void hbmguard_init(void) {
+  int expected = 0;
+  if (!g_init_state.compare_exchange_strong(expected, 1)) {
+    if (expected == 1 && pthread_equal(g_init_thread, pthread_self())) {
+      return; /* re-entered by the initializing thread (dlsym alloc) */
+    }
+    while (g_init_state.load() != 2) {
+    }
+    return;
+  }
+  g_init_thread = pthread_self();
+  real_malloc = (malloc_t)dlsym(RTLD_NEXT, "malloc");
+  real_calloc = (calloc_t)dlsym(RTLD_NEXT, "calloc");
+  real_realloc = (realloc_t)dlsym(RTLD_NEXT, "realloc");
+  real_free = (free_t)dlsym(RTLD_NEXT, "free");
+  const char* lim = getenv("TPU_HBM_LIMIT_BYTES");
+  if (lim != nullptr && *lim != '\0') {
+    char* end = nullptr;
+    int64_t v = strtoll(lim, &end, 10);
+    /* unparseable garbage must leave the shim inert, not lock it to 0 */
+    if (end != lim && v >= 0) g_limit = v;
+  }
+  const char* thr = getenv("HBMGUARD_THRESHOLD_BYTES");
+  if (thr != nullptr && *thr != '\0') {
+    char* end = nullptr;
+    int64_t t = strtoll(thr, &end, 10);
+    if (end != thr && t > 0) g_threshold = t;
+  }
+  g_init_state.store(2);
+}
+
+/* Returns 1 when the caller must fall back to the boot arena (we are the
+ * thread running hbmguard_init and re-entered the allocator). */
+static inline int ensure_init(void) {
+  int s = g_init_state.load(std::memory_order_acquire);
+  if (s == 2) return 0;
+  if (s == 1 && pthread_equal(g_init_thread, pthread_self())) return 1;
+  hbmguard_init();
+  return g_init_state.load(std::memory_order_acquire) != 2;
+}
+
+/* -- metering -------------------------------------------------------------- */
+
+/* Meter a new block. Returns 0 if allowed (or not meterable). */
+static int meter_block(void* p, int64_t sz) {
+  if (g_limit < 0 || sz < g_threshold) return 0;
+  int64_t now = g_used.fetch_add(sz) + sz;
+  if (now > g_limit) {
+    g_used.fetch_sub(sz);
+    return -1;
+  }
+  if (!table_insert_reuse(p)) {
+    /* table full: pass unmetered rather than corrupt the ledger later */
+    g_used.fetch_sub(sz);
+  }
+  return 0;
+}
+
+static void unmeter_block(void* p, int64_t sz) {
+  if (g_limit < 0) return;
+  if (table_remove(p)) g_used.fetch_sub(sz);
+}
+
+/* -- interposed allocator -------------------------------------------------- */
+
+void* malloc(size_t size) {
+  if (ensure_init()) return boot_alloc(size);
+  void* p = real_malloc(size);
+  if (p == nullptr) return nullptr;
+  if (meter_block(p, (int64_t)malloc_usable_size(p)) != 0) {
+    real_free(p);
+    errno = ENOMEM;
+    return nullptr;
+  }
+  return p;
+}
+
+void* calloc(size_t nmemb, size_t size) {
+  if (ensure_init()) {
+    size_t total = nmemb * size;
+    void* p = boot_alloc(total);
+    if (p != nullptr) memset(p, 0, total);
+    return p;
+  }
+  void* p = real_calloc(nmemb, size);
+  if (p == nullptr) return nullptr;
+  if (meter_block(p, (int64_t)malloc_usable_size(p)) != 0) {
+    real_free(p);
+    errno = ENOMEM;
+    return nullptr;
+  }
+  return p;
+}
+
+void* realloc(void* ptr, size_t size) {
+  if (ensure_init()) {
+    void* p = boot_alloc(size);
+    if (p != nullptr && ptr != nullptr) {
+      size_t old = in_boot_arena(ptr) ? boot_size(ptr) : 0;
+      memcpy(p, ptr, old < size ? old : size);
+    }
+    return p;
+  }
+  if (ptr != nullptr && in_boot_arena(ptr)) {
+    /* migrate a boot block through the metered path */
+    void* p = malloc(size);
+    if (p != nullptr) {
+      size_t old = boot_size(ptr);
+      memcpy(p, ptr, old < size ? old : size);
+    }
+    return p;
+  }
+  /* The quota check must happen BEFORE real_realloc: once realloc moves
+   * the block, the old pointer is gone, and returning NULL then would
+   * break realloc's "old block intact on failure" contract (the caller
+   * would use-after-free). Pre-meter with the requested size; after a
+   * successful realloc, true up to the actual usable sizes. */
+  int64_t old_sz = ptr ? (int64_t)malloc_usable_size(ptr) : 0;
+  int old_metered = 0;
+  if (ptr != nullptr && g_limit >= 0) {
+    /* peek (remove+reinsert) to learn whether the old block was metered */
+    old_metered = table_remove(ptr);
+    if (old_metered) table_insert_reuse(ptr);
+  }
+  if (g_limit >= 0 && (int64_t)size >= g_threshold) {
+    int64_t projected =
+        g_used.load() - (old_metered ? old_sz : 0) + (int64_t)size;
+    if (projected > g_limit) {
+      errno = ENOMEM;
+      return nullptr; /* old block untouched */
+    }
+  }
+  void* p = real_realloc(ptr, size);
+  if (p == nullptr) return nullptr; /* old block intact, accounting holds */
+  if (old_metered) {
+    table_remove(ptr == p ? p : ptr);
+    g_used.fetch_sub(old_sz);
+  }
+  int64_t new_sz = (int64_t)malloc_usable_size(p);
+  if (g_limit >= 0 && new_sz >= g_threshold) {
+    /* account unconditionally — a post-hoc refusal would leak the move */
+    g_used.fetch_add(new_sz);
+    if (!table_insert_reuse(p)) g_used.fetch_sub(new_sz);
+  }
+  return p;
+}
+
+void free(void* ptr) {
+  if (ptr == nullptr || in_boot_arena(ptr)) return;
+  if (ensure_init()) return; /* init-window real pointer: leak one block */
+  unmeter_block(ptr, (int64_t)malloc_usable_size(ptr));
+  real_free(ptr);
+}
+
+/* -- test introspection --------------------------------------------------- */
+int64_t hbmguard_used(void) {
+  ensure_init();
+  return g_used.load();
+}
+
+int64_t hbmguard_limit(void) {
+  ensure_init();
+  return g_limit;
+}
+
+int64_t hbmguard_threshold(void) {
+  ensure_init();
+  return g_threshold;
+}
+
+} /* extern "C" */
