@@ -1,0 +1,70 @@
+"""BASELINE config 4: 16-pod gang-scheduled JAX Llama-3-8B job with
+ICI-contiguous slice binding, on a simulated multi-host v5p-style mesh."""
+
+import pytest
+
+from tpukube.core.config import load_config
+from tpukube.core.types import PodGroup
+from tpukube.sim import SimCluster
+
+
+def test_config4_sixteen_pod_gang_contiguous():
+    # 4x4x4 mesh = 64 chips over 16 hosts (2x2x1 blocks) with some
+    # pre-existing load; the 16-pod gang must land as one contiguous box
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,4",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        # background load: 8 chips of non-gang pods
+        for i in range(2):
+            c.schedule(c.make_pod(f"bg-{i}", tpu=4))
+
+        group = PodGroup("llama-8b", min_member=16)
+        allocs = []
+        for i in range(16):
+            node, alloc = c.schedule(
+                c.make_pod(f"llama-8b-{i}", tpu=1, group=group)
+            )
+            allocs.append(alloc)
+
+        res = c.extender.gang.reservation("default", "llama-8b")
+        assert res.committed
+        assert res.commit_latency is not None
+
+        coords = sorted(co for a in allocs for co in a.coords)
+        assert len(set(coords)) == 16
+        # ICI-contiguity: the 16 chips form an axis-aligned box
+        xs = sorted({c_[0] for c_ in coords})
+        ys = sorted({c_[1] for c_ in coords})
+        zs = sorted({c_[2] for c_ in coords})
+        assert len(xs) * len(ys) * len(zs) == 16
+        assert xs == list(range(xs[0], xs[0] + len(xs)))
+        assert ys == list(range(ys[0], ys[0] + len(ys)))
+        assert zs == list(range(zs[0], zs[0] + len(zs)))
+
+        # all-or-nothing held: utilization = background + gang
+        assert c.utilization() == pytest.approx((8 + 16) / 64)
+
+        # each member's Allocate works through the real plugin stack and
+        # exports its global coords for the in-pod JAX mesh
+        env = c.execute_allocation(allocs[0])
+        assert env["TPU_KUBE_MESH_DIMS"] == "4,4,4"
+
+
+def test_config4_partial_gang_never_occupies():
+    # only 10 of 16 members show up -> TTL rollback -> zero residue
+    import time
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,4",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        "TPUKUBE_RESERVATION_TTL_SECONDS": "0.3",
+    })
+    with SimCluster(cfg) as c:
+        group = PodGroup("half", min_member=16)
+        for i in range(10):
+            c.schedule(c.make_pod(f"h-{i}", tpu=1, group=group))
+        time.sleep(0.4)
+        c.extender.gang.sweep()
+        assert c.utilization() == 0.0
+        assert c.extender.gang.reservation("default", "half") is None

@@ -1,0 +1,218 @@
+"""Gang scheduling unit + lifecycle tests (SURVEY.md C10, §9.3)."""
+
+import threading
+
+import pytest
+
+from tpukube.core import codec
+from tpukube.core.config import load_config
+from tpukube.core.types import PodGroup
+from tpukube.sim import SimCluster
+
+
+def _cfg(ttl="30"):
+    return load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        "TPUKUBE_RESERVATION_TTL_SECONDS": ttl,
+    })
+
+
+def test_gang_all_members_land_contiguous():
+    with SimCluster(_cfg()) as c:
+        group = PodGroup("job", min_member=8)
+        allocs = [
+            c.schedule(c.make_pod(f"j-{i}", tpu=1, group=group))[1]
+            for i in range(8)
+        ]
+        coords = sorted(co for a in allocs for co in a.coords)
+        assert len(set(coords)) == 8
+        # contiguity: the 8 chips form an axis-aligned box (2x4 or 4x2)
+        xs = {x for x, y, z in coords}
+        ys = {y for x, y, z in coords}
+        assert len(xs) * len(ys) == 8
+        res = c.extender.gang.reservation("default", "job")
+        assert res.committed
+        assert res.commit_latency is not None and res.commit_latency < 5
+
+
+def test_gang_blocks_non_gang_poaching():
+    with SimCluster(_cfg()) as c:
+        group = PodGroup("big", min_member=12)
+        # first member reserves a 12-chip slice; 16-chip mesh leaves 4
+        c.schedule(c.make_pod("g-0", tpu=1, group=group))
+        # a non-gang pod must not take reserved chips: only 4 remain
+        taken = []
+        for i in range(4):
+            _, a = c.schedule(c.make_pod(f"solo-{i}", tpu=1))
+            taken.append(a.coords[0])
+        res = c.extender.gang.reservation("default", "big")
+        assert not (set(taken) & res.coords)
+        with pytest.raises(RuntimeError, match="unschedulable"):
+            c.schedule(c.make_pod("solo-4", tpu=1))
+        # and the gang can still finish assembling
+        for i in range(1, 12):
+            c.schedule(c.make_pod(f"g-{i}", tpu=1, group=group))
+        assert c.extender.gang.reservation("default", "big").committed
+
+
+def test_gang_ttl_rollback_releases_everything():
+    with SimCluster(_cfg(ttl="0.2")) as c:
+        group = PodGroup("doomed", min_member=8)
+        for i in range(3):  # only 3 of 8 members ever arrive
+            c.schedule(c.make_pod(f"d-{i}", tpu=1, group=group))
+        assert c.utilization() == pytest.approx(3 / 16)
+        import time
+        time.sleep(0.3)
+        rolled = c.extender.gang.sweep()
+        assert ("default", "doomed") in rolled
+        # all-or-nothing: the partial members' chips are free again
+        assert c.utilization() == 0.0
+        assert c.extender.gang.rollbacks == 1
+        # the whole mesh is schedulable again
+        _, a = c.schedule(c.make_pod("after", tpu=4))
+        assert len(a.device_ids) == 4
+
+
+def test_gang_fault_in_reserved_slice_rolls_back():
+    with SimCluster(_cfg()) as c:
+        group = PodGroup("fragile", min_member=8)
+        _, a0 = c.schedule(c.make_pod("f-0", tpu=1, group=group))
+        res = c.extender.gang.reservation("default", "fragile")
+        # kill an UNASSIGNED chip inside the reserved slice
+        victim = sorted(res.unassigned_coords())[0]
+        node = c.mesh.host_of(victim)
+        index = next(
+            ch.index for ch in c.nodes[node].chips if ch.coord == victim
+        )
+        c.inject_fault(node, index)
+        # next scheduling interaction sweeps and rolls the gang back;
+        # re-reservation then happens on healthy chips only
+        _, a1 = c.schedule(c.make_pod("f-1", tpu=1, group=group))
+        assert c.extender.gang.rollbacks == 1
+        res2 = c.extender.gang.reservation("default", "fragile")
+        assert victim not in res2.coords
+        # f-0 was rolled back (all-or-nothing) and must be rescheduled
+        assert c.extender.state.allocation("default/f-0") is None
+        c.schedule(c.make_pod("f-0b", tpu=1, group=group))
+        for i in range(2, 8):
+            c.schedule(c.make_pod(f"f-{i}", tpu=1, group=group))
+        assert res2.committed
+
+
+def test_gang_shape_hint_honored():
+    with SimCluster(_cfg()) as c:
+        group = PodGroup("shaped", min_member=4, shape=(4, 1, 1))
+        allocs = [
+            c.schedule(c.make_pod(f"s-{i}", tpu=1, group=group))[1]
+            for i in range(4)
+        ]
+        coords = sorted(co for a in allocs for co in a.coords)
+        # a 4x1 (or 1x4) line, not a 2x2 square
+        xs = {x for x, y, z in coords}
+        ys = {y for x, y, z in coords}
+        assert sorted([len(xs), len(ys)]) == [1, 4]
+
+
+def test_gang_unreservable_when_fragmented():
+    with SimCluster(_cfg()) as c:
+        # occupy one chip per host: no contiguous 8-slice left... each host
+        # block is 2x2; taking one chip per host leaves L-shapes
+        for i in range(4):
+            c.schedule(c.make_pod(f"frag-{i}", tpu=1))
+        # actually topology packing may co-locate; occupy explicitly instead
+        used = {tuple(a.coords[0]) for a in c.extender.state.allocations()}
+        group = PodGroup("wide", min_member=14)  # needs 14 contiguous chips
+        with pytest.raises(RuntimeError, match="no contiguous"):
+            c.schedule(c.make_pod("w-0", tpu=1, group=group))
+
+
+def test_gang_member_loss_before_commit_reopens_slot():
+    with SimCluster(_cfg()) as c:
+        group = PodGroup("churn", min_member=4)
+        c.schedule(c.make_pod("m-0", tpu=1, group=group))
+        c.schedule(c.make_pod("m-1", tpu=1, group=group))
+        c.delete_pod("m-1")  # member dies during assembly
+        res = c.extender.gang.reservation("default", "churn")
+        assert len(res.assigned) == 1 and not res.committed
+        # replacement + remaining members commit the gang
+        c.schedule(c.make_pod("m-1b", tpu=1, group=group))
+        c.schedule(c.make_pod("m-2", tpu=1, group=group))
+        c.schedule(c.make_pod("m-3", tpu=1, group=group))
+        assert res.committed
+
+
+def test_concurrent_gang_assembly():
+    with SimCluster(_cfg()) as c:
+        group = PodGroup("par", min_member=16)
+        errs, allocs = [], []
+        def run(i):
+            try:
+                allocs.append(c.schedule(c.make_pod(f"p-{i}", tpu=1, group=group)))
+            except Exception as e:
+                errs.append(str(e))
+        ts = [threading.Thread(target=run, args=(i,)) for i in range(16)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert not errs, errs
+        coords = [tuple(co) for _, a in allocs for co in a.coords]
+        assert len(coords) == len(set(coords)) == 16
+        assert c.extender.gang.reservation("default", "par").committed
+        assert c.utilization() == 1.0
+
+
+def test_overflow_replicas_schedule_as_normal_pods():
+    # replicas beyond min_member must not wedge Pending forever
+    with SimCluster(_cfg()) as c:
+        group = PodGroup("elastic", min_member=8)
+        for i in range(8):
+            c.schedule(c.make_pod(f"e-{i}", tpu=1, group=group))
+        assert c.extender.gang.reservation("default", "elastic").committed
+        # two extra replicas of the same group: plain placement on free chips
+        for i in range(8, 10):
+            node, alloc = c.schedule(c.make_pod(f"e-{i}", tpu=1, group=group))
+            assert len(alloc.device_ids) == 1
+        assert c.utilization() == pytest.approx(10 / 16)
+
+
+def test_committed_gang_teardown_frees_capacity():
+    # regression: a committed reservation must not mask chips forever
+    with SimCluster(_cfg()) as c:
+        group = PodGroup("done", min_member=16)
+        for i in range(16):
+            c.schedule(c.make_pod(f"t-{i}", tpu=1, group=group))
+        assert c.utilization() == 1.0
+        for i in range(16):
+            c.delete_pod(f"t-{i}")
+        assert c.utilization() == 0.0
+        assert c.extender.gang.reservation("default", "done") is None
+        # the whole mesh is schedulable again, including a fresh full gang
+        g2 = PodGroup("next", min_member=16)
+        for i in range(16):
+            c.schedule(c.make_pod(f"n-{i}", tpu=1, group=g2))
+        assert c.utilization() == 1.0
+
+
+def test_rollback_queues_member_evictions():
+    with SimCluster(_cfg(ttl="0.2")) as c:
+        import time
+        group = PodGroup("evict", min_member=8)
+        for i in range(2):
+            c.schedule(c.make_pod(f"v-{i}", tpu=1, group=group))
+        time.sleep(0.3)
+        c.extender.gang.sweep()
+        evicted = c.drain_evictions()
+        assert sorted(evicted) == ["default/v-0", "default/v-1"]
+        assert "default/v-0" not in c.pods  # pod object gone, not just ledger
+
+
+def test_two_gangs_dont_overlap():
+    with SimCluster(_cfg()) as c:
+        g1 = PodGroup("left", min_member=8)
+        g2 = PodGroup("right", min_member=8)
+        a1 = [c.schedule(c.make_pod(f"l-{i}", tpu=1, group=g1))[1] for i in range(8)]
+        a2 = [c.schedule(c.make_pod(f"r-{i}", tpu=1, group=g2))[1] for i in range(8)]
+        s1 = {tuple(co) for a in a1 for co in a.coords}
+        s2 = {tuple(co) for a in a2 for co in a.coords}
+        assert not (s1 & s2)
+        assert c.utilization() == 1.0

@@ -38,6 +38,7 @@ from tpukube.core.types import (
     make_device_id,
 )
 from tpukube.sched import kube, slicefit
+from tpukube.sched.gang import GangError, GangManager, GangReservation
 from tpukube.sched.state import ClusterState, NodeView, StateError
 
 log = logging.getLogger("tpukube.extender")
@@ -60,6 +61,7 @@ class Extender:
     def __init__(self, config: TpuKubeConfig, state: Optional[ClusterState] = None):
         self._config = config
         self.state = state or ClusterState()
+        self.gang = GangManager(self.state, ttl_seconds=config.reservation_ttl_seconds)
         # Pods seen at filter time, so /bind (which only carries names) can
         # recover the request: key -> (pod, uid, seen_monotonic).
         self._pending: dict[str, tuple[PodInfo, str, float]] = {}
@@ -122,10 +124,28 @@ class Extender:
                 return raw_nodes, {}
             resource, count = ask
             self._remember(pod)
+            res: Optional[GangReservation] = None
+            if pod.group is not None:
+                if resource != RESOURCE_TPU:
+                    raise ExtenderError(
+                        f"{pod.key()}: gang scheduling requires whole-chip "
+                        f"({RESOURCE_TPU}) requests"
+                    )
+                res = self.gang.ensure_reservation(pod, count)
+                if not self.gang.assignable(res, count):
+                    # replica beyond min_member of a full gang: schedule it
+                    # as a normal pod rather than wedging it Pending forever
+                    res = None
+            else:
+                self.gang.sweep()
+            reserved = self.gang.reserved_coords() if res is None else None
             feasible, failed = [], {}
             for obj in raw_nodes:
                 name, _ = kube.node_name_and_annotations(obj)
-                reason = self._node_feasibility(name, resource, count)
+                if res is not None:
+                    reason = self.gang.node_feasibility(res, name)
+                else:
+                    reason = self._node_feasibility(name, resource, count, reserved)
                 if reason is None:
                     feasible.append(obj)
                 else:
@@ -135,9 +155,14 @@ class Extender:
             self.latencies["filter"].append(time.monotonic() - t0)
 
     def _node_feasibility(
-        self, name: str, resource: str, count: int
+        self,
+        name: str,
+        resource: str,
+        count: int,
+        reserved: Optional[set[TopologyCoord]] = None,
     ) -> Optional[str]:
-        """None if feasible, else a human-readable reason."""
+        """None if feasible, else a human-readable reason. ``reserved`` is
+        the gang mask — pass it in when calling per-node in a loop."""
         view = self.state.node(name)
         if view is None:
             return "no tpukube node-topology annotation"
@@ -151,9 +176,11 @@ class Extender:
             return None
         if vtpu_node:
             return "node is vTPU mode, pod wants whole chips"
-        free = len(view.free_chips())
+        if reserved is None:
+            reserved = self.gang.reserved_coords()
+        free = sum(1 for c in view.free_chips() if c.coord not in reserved)
         if free < count:
-            return f"wants {count} chips, node has {free} free"
+            return f"wants {count} chips, node has {free} free (gang reservations excluded)"
         return None
 
     # -- /prioritize -------------------------------------------------------
@@ -170,19 +197,27 @@ class Extender:
             if ask is None:
                 return {n: 0 for n in names}
             resource, count = ask
-            # the occupancy sweep depends only on cluster state — build it
-            # once per request, not per node (scheduler hot path)
+            if pod.group is not None and resource == RESOURCE_TPU:
+                res = self.gang.reservation(pod.namespace, pod.group.name)
+                if res is not None and self.gang.assignable(res, count):
+                    return {n: self.gang.node_score(res, n) for n in names}
+                if res is None:
+                    return {n: 0 for n in names}
+                # overflow replica of a full gang: fall through to normal
+            # the occupancy sweep and gang mask depend only on cluster
+            # state — build once per request, not per node (hot path)
+            reserved = self.gang.reserved_coords()
             sweep = None
             if self._config.score_mode == "topology" and resource == RESOURCE_TPU:
                 mesh = self.state.mesh
                 if mesh is not None:
                     grid = slicefit.occupancy_grid(
-                        mesh, self.state.occupied_coords()
+                        mesh, self.state.occupied_coords() | reserved
                     )
                     sweep = slicefit._Sweep(mesh, grid)
             scores: dict[str, int] = {}
             for name in names:
-                scores[name] = self._score_node(name, resource, count, sweep)
+                scores[name] = self._score_node(name, resource, count, sweep, reserved)
             return scores
         finally:
             self.latencies["prioritize"].append(time.monotonic() - t0)
@@ -193,9 +228,10 @@ class Extender:
         resource: str,
         count: int,
         sweep: Optional["slicefit._Sweep"] = None,
+        reserved: Optional[set[TopologyCoord]] = None,
     ) -> int:
         view = self.state.node(name)
-        if view is None or self._node_feasibility(name, resource, count):
+        if view is None or self._node_feasibility(name, resource, count, reserved):
             return 0
         mode = self._config.score_mode
         n_chips = len(view.info.chips)
@@ -210,7 +246,7 @@ class Extender:
             )
             return round(MAX_SCORE * used_frac)
         # "topology" (default): ICI-mesh locality.
-        plan = self._plan_chips(view, resource, count)
+        plan = self._plan_chips(view, resource, count, reserved)
         if plan is None:
             return 0
         if resource == RESOURCE_VTPU:
@@ -245,7 +281,11 @@ class Extender:
 
     # -- placement planning -------------------------------------------------
     def _plan_chips(
-        self, view: NodeView, resource: str, count: int
+        self,
+        view: NodeView,
+        resource: str,
+        count: int,
+        reserved: Optional[set[TopologyCoord]] = None,
     ) -> Optional[list[TopologyCoord]]:
         """Choose concrete chips on one node for a request.
 
@@ -271,8 +311,11 @@ class Extender:
             return None
         mesh = self.state.mesh
         assert mesh is not None
-        free_chips = view.free_chips()
-        node_free = {c.coord for c in free_chips}
+        if reserved is None:
+            reserved = self.gang.reserved_coords()
+        node_free = {
+            c.coord for c in view.free_chips() if c.coord not in reserved
+        }
         if len(node_free) < count:
             return None
         mask = {c for c in mesh.all_coords() if c not in node_free}
@@ -312,7 +355,23 @@ class Extender:
             view = self.state.node(node_name)
             if view is None:
                 raise ExtenderError(f"bind to unknown node {node_name}")
-            plan = self._plan_chips(view, resource, count)
+            res: Optional[GangReservation] = None
+            if pod.group is not None and resource == RESOURCE_TPU:
+                res = self.gang.reservation(pod.namespace, pod.group.name)
+                if res is None:
+                    raise ExtenderError(
+                        f"{key}: gang reservation dissolved (TTL/fault); "
+                        "scheduler will re-run the cycle"
+                    )
+                if not self.gang.assignable(res, count):
+                    res = None  # overflow replica: normal placement
+            if res is not None:
+                try:
+                    plan = self.gang.plan_for_bind(res, pod, node_name)
+                except GangError as e:
+                    raise ExtenderError(str(e)) from e
+            else:
+                plan = self._plan_chips(view, resource, count)
             if plan is None:
                 raise ExtenderError(
                     f"{key}: node {node_name} can no longer fit {count} x {resource}"
@@ -325,6 +384,13 @@ class Extender:
                 coords=sorted(set(plan)),
             )
             self.state.commit(alloc)  # raises StateError on lost race
+            if res is not None:
+                try:
+                    self.gang.on_bound(res, key, plan)
+                except GangError as e:
+                    # reservation changed between plan and commit: undo
+                    self.state.release(key)
+                    raise ExtenderError(str(e)) from e
             with self._pending_lock:
                 self._pending.pop(key, None)
             log.info("bound %s -> %s %s", key, node_name, device_ids)
@@ -358,6 +424,7 @@ class Extender:
     # -- pod lifecycle ------------------------------------------------------
     def release(self, pod_key: str) -> None:
         self.state.release(pod_key)
+        self.gang.on_release(pod_key)
         with self._pending_lock:
             self._pending.pop(pod_key, None)
 
@@ -382,7 +449,7 @@ def make_app(extender: Extender) -> web.Application:
         try:
             feasible, failed = extender.filter(pod, nodes)
             return web.json_response(kube.filter_result(feasible, failed))
-        except (ExtenderError, StateError, codec.CodecError) as e:
+        except (ExtenderError, GangError, StateError, codec.CodecError) as e:
             return web.json_response(kube.filter_result([], {}, error=str(e)))
 
     async def prioritize_handler(request: web.Request) -> web.Response:
@@ -393,7 +460,7 @@ def make_app(extender: Extender) -> web.Application:
             raise web.HTTPBadRequest(text=str(e))
         try:
             scores = extender.prioritize(pod, nodes)
-        except (ExtenderError, StateError, codec.CodecError) as e:
+        except (ExtenderError, GangError, StateError, codec.CodecError) as e:
             log.warning("prioritize failed: %s", e)
             scores = {}
         return web.json_response(kube.host_priority_list(scores))
@@ -406,7 +473,7 @@ def make_app(extender: Extender) -> web.Application:
             raise web.HTTPBadRequest(text=str(e))
         try:
             alloc = extender.bind(name, ns, uid, node)
-        except (ExtenderError, StateError, codec.CodecError) as e:
+        except (ExtenderError, GangError, StateError, codec.CodecError) as e:
             return web.json_response(kube.binding_result(str(e)))
         # the alloc annotation rides back to the harness/apiserver-writer
         result = kube.binding_result()

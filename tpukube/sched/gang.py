@@ -1,0 +1,312 @@
+"""Gang scheduling (SURVEY.md §2 C10, §9.3 "gang atomicity").
+
+All-or-nothing placement of N-pod job groups onto one ICI-contiguous
+sub-slice. The reference accumulates per-group reservations across
+scheduling cycles; the last member's bind commits all, and a timeout rolls
+all back. The TPU rendering:
+
+  1. First member of a pod-group triggers a SLICE RESERVATION: slicefit
+     finds a contiguous sub-box for the whole gang (min_member x chips/pod,
+     honoring an optional shape hint) across the cluster mesh, spanning
+     hosts. Reserved chips are invisible to non-gang placements.
+  2. Members bind one by one; each takes chips from the reservation on its
+     bound node. The min_member-th bind COMMITS the gang (reservation
+     latency recorded — the north-star p50 gang-schedule metric).
+  3. TTL expiry before quorum rolls EVERYTHING back: assigned members'
+     allocations are released, the reservation dissolves — the "either
+     fully lands or not at all" contract (BASELINE).
+  4. A health fault on a reserved chip before commit also rolls the gang
+     back (SURVEY.md §6: re-reserve a fresh contiguous slice); the next
+     filter cycle re-reserves from scratch on healthy chips.
+
+Linearizability: one lock orders all reservation mutations; binds
+re-validate against the reservation under that lock (optimistic callers
+simply retry the cycle, same as ledger bind races).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tpukube.core.types import Health, PodGroup, PodInfo, TopologyCoord
+from tpukube.sched import slicefit
+from tpukube.sched.state import ClusterState
+
+log = logging.getLogger("tpukube.gang")
+
+
+class GangError(RuntimeError):
+    pass
+
+
+@dataclass
+class GangReservation:
+    group: PodGroup
+    namespace: str
+    coords: set[TopologyCoord]  # the whole reserved slice
+    chips_per_pod: int
+    created: float = field(default_factory=time.monotonic)
+    assigned: dict[str, list[TopologyCoord]] = field(default_factory=dict)
+    committed: bool = False
+    commit_latency: Optional[float] = None
+
+    @property
+    def key(self) -> tuple[str, str]:
+        return (self.namespace, self.group.name)
+
+    def assigned_coords(self) -> set[TopologyCoord]:
+        return {c for coords in self.assigned.values() for c in coords}
+
+    def unassigned_coords(self) -> set[TopologyCoord]:
+        return self.coords - self.assigned_coords()
+
+
+class GangManager:
+    """Owns all live reservations; consulted by the extender on every
+    filter/prioritize/bind involving a gang pod, and by non-gang placement
+    to mask reserved chips."""
+
+    LATENCY_WINDOW = 4096
+
+    def __init__(self, state: ClusterState, ttl_seconds: float = 30.0):
+        self._state = state
+        self._ttl = ttl_seconds
+        self._lock = threading.RLock()
+        self._reservations: dict[tuple[str, str], GangReservation] = {}
+        # reservation-created -> committed durations (north-star p50 feed)
+        self.commit_latencies: deque[float] = deque(maxlen=self.LATENCY_WINDOW)
+        self.rollbacks = 0  # TTL/fault rollbacks observed (metrics/tests)
+        # rolled-back members whose pods must be deleted by the pod-lifecycle
+        # owner (all-or-nothing: a half-gang must not keep running)
+        self.pending_evictions: deque[str] = deque()
+
+    # -- views -------------------------------------------------------------
+    def reservation(self, namespace: str, group_name: str) -> Optional[GangReservation]:
+        with self._lock:
+            return self._reservations.get((namespace, group_name))
+
+    def reserved_coords(self) -> set[TopologyCoord]:
+        """Chips held for gang members that have not bound yet — masked out
+        of every other placement. Assigned chips are NOT included: those
+        live in the ledger as per-pod allocations already (state.commit runs
+        before on_bound), and double-masking them would leak capacity after
+        a committed gang's pods finish."""
+        with self._lock:
+            out: set[TopologyCoord] = set()
+            for res in self._reservations.values():
+                out |= res.unassigned_coords()
+            return out
+
+    # -- expiry / fault sweep ----------------------------------------------
+    def sweep(self, now: Optional[float] = None) -> list[tuple[str, str]]:
+        """Lazy janitor, called at the top of every gang interaction:
+        rolls back (a) uncommitted reservations past TTL and (b) any
+        uncommitted reservation whose slice lost a chip to a health fault.
+        Returns the rolled-back group keys."""
+        now = time.monotonic() if now is None else now
+        rolled: list[tuple[str, str]] = []
+        unhealthy = self._state.unhealthy_coords()
+        with self._lock:
+            for key, res in list(self._reservations.items()):
+                if res.committed:
+                    continue
+                expired = now - res.created > self._ttl
+                sick = self._has_unhealthy_chip(res, unhealthy)
+                if expired or sick:
+                    why = "TTL expired" if expired else "chip fault in slice"
+                    log.warning("gang %s/%s rollback: %s", key[0], key[1], why)
+                    self._rollback_locked(res)
+                    rolled.append(key)
+        return rolled
+
+    def _has_unhealthy_chip(
+        self, res: GangReservation, unhealthy: set[TopologyCoord]
+    ) -> bool:
+        return bool(res.coords & unhealthy)
+
+    def _rollback_locked(self, res: GangReservation) -> None:
+        for pod_key in list(res.assigned):
+            self._state.release(pod_key)
+            # The pod may already be Running on its node; releasing the
+            # ledger alone would let another pod double-book those chips.
+            # Queue the eviction for whoever owns pod lifecycle (the sim
+            # harness, or an apiserver writer on a real cluster).
+            self.pending_evictions.append(pod_key)
+        self._reservations.pop(res.key, None)
+        self.rollbacks += 1
+
+    # -- reservation -------------------------------------------------------
+    def ensure_reservation(
+        self, pod: PodInfo, chips_per_pod: int
+    ) -> GangReservation:
+        """Get or create the slice reservation for a gang pod's group.
+        Raises GangError when no contiguous slice exists."""
+        assert pod.group is not None
+        self.sweep()
+        with self._lock:
+            key = (pod.namespace, pod.group.name)
+            res = self._reservations.get(key)
+            if res is not None:
+                if res.chips_per_pod != chips_per_pod:
+                    raise GangError(
+                        f"gang {key}: member {pod.key()} wants {chips_per_pod} "
+                        f"chips/pod but the reservation was made for "
+                        f"{res.chips_per_pod}"
+                    )
+                return res
+            mesh = self._state.mesh
+            if mesh is None:
+                raise GangError("no node topology known yet")
+            total = pod.group.min_member * chips_per_pod
+            occupied = self._state.occupied_coords() | self.reserved_coords()
+            if pod.group.shape is not None:
+                coords = slicefit.find_slice(mesh, occupied, shape=pod.group.shape)
+                if coords is not None and len(coords) != total:
+                    raise GangError(
+                        f"gang {key}: shape {pod.group.shape} holds "
+                        f"{len(coords)} chips but the gang needs {total}"
+                    )
+            else:
+                coords = slicefit.find_slice(mesh, occupied, count=total)
+            if coords is None:
+                raise GangError(
+                    f"gang {key}: no contiguous {total}-chip slice available "
+                    f"({mesh.num_chips - len(occupied)} chips free)"
+                )
+            res = GangReservation(
+                group=pod.group,
+                namespace=pod.namespace,
+                coords=set(coords),
+                chips_per_pod=chips_per_pod,
+            )
+            self._reservations[key] = res
+            log.info(
+                "gang %s/%s reserved %d chips", key[0], key[1], len(res.coords)
+            )
+            return res
+
+    # -- per-node queries for the extender ----------------------------------
+    def node_feasibility(
+        self, res: GangReservation, node_name: str
+    ) -> Optional[str]:
+        mesh = self._state.mesh
+        assert mesh is not None
+        with self._lock:
+            avail = [
+                c for c in res.unassigned_coords() if mesh.host_of(c) == node_name
+            ]
+            if len(avail) < res.chips_per_pod:
+                return (
+                    f"gang slice has {len(avail)} unassigned chips here, "
+                    f"pod needs {res.chips_per_pod}"
+                )
+            return None
+
+    def node_score(self, res: GangReservation, node_name: str) -> int:
+        """More unassigned reserved chips on the node = higher score: fill
+        the slice host by host so members land dense, not scattered."""
+        mesh = self._state.mesh
+        assert mesh is not None
+        with self._lock:
+            avail = sum(
+                1 for c in res.unassigned_coords() if mesh.host_of(c) == node_name
+            )
+            total = sum(1 for c in res.coords if mesh.host_of(c) == node_name)
+            return round(10 * avail / total) if total else 0
+
+    def plan_for_bind(
+        self, res: GangReservation, pod: PodInfo, node_name: str
+    ) -> list[TopologyCoord]:
+        """Pick this member's chips from the reservation on its node,
+        preferring chips adjacent to already-assigned ones (members that
+        talk most ride the shortest ICI paths)."""
+        mesh = self._state.mesh
+        assert mesh is not None
+        with self._lock:
+            if res.key not in self._reservations:
+                raise GangError(f"gang {res.key}: reservation dissolved; retry")
+            if pod.key() in res.assigned:
+                raise GangError(f"{pod.key()} already assigned in gang")
+            avail = sorted(
+                c for c in res.unassigned_coords() if mesh.host_of(c) == node_name
+            )
+            if len(avail) < res.chips_per_pod:
+                raise GangError(
+                    f"gang {res.key}: node {node_name} no longer has "
+                    f"{res.chips_per_pod} unassigned slice chips"
+                )
+            anchor = res.assigned_coords()
+            chosen: list[TopologyCoord] = []
+            pool = list(avail)
+            for _ in range(res.chips_per_pod):
+                best = max(
+                    pool,
+                    key=lambda c: (
+                        sum(1 for nb in mesh.neighbors(c) if nb in anchor or nb in chosen),
+                        tuple(-v for v in c),
+                    ),
+                )
+                chosen.append(best)
+                pool.remove(best)
+            return chosen
+
+    def on_bound(self, res: GangReservation, pod_key: str,
+                 coords: list[TopologyCoord]) -> None:
+        """Record a member's successful ledger commit; the quorum member
+        commits the whole gang."""
+        with self._lock:
+            live = self._reservations.get(res.key)
+            if live is not res:
+                raise GangError(f"gang {res.key}: reservation replaced mid-bind")
+            bad = [c for c in coords if c not in res.unassigned_coords()]
+            if bad:
+                raise GangError(f"gang {res.key}: coords {bad} not reservable")
+            res.assigned[pod_key] = list(coords)
+            if not res.committed and len(res.assigned) >= res.group.min_member:
+                res.committed = True
+                res.commit_latency = time.monotonic() - res.created
+                self.commit_latencies.append(res.commit_latency)
+                log.info(
+                    "gang %s/%s COMMITTED: %d members in %.3fs",
+                    res.namespace, res.group.name,
+                    len(res.assigned), res.commit_latency,
+                )
+
+    # -- pod lifecycle -------------------------------------------------------
+    def assignable(self, res: GangReservation, chips_per_pod: int) -> bool:
+        """True while the reservation still has room for another member.
+        Replicas beyond min_member of a committed gang get False — they
+        fall through to normal (non-gang) scheduling in the extender."""
+        with self._lock:
+            return len(res.unassigned_coords()) >= chips_per_pod
+
+    def on_release(self, pod_key: str) -> None:
+        """A gang member's pod went away. Uncommitted gang: the chips return
+        to the reservation pool (a replacement member can take them).
+        Committed gang: ditto while other members live; when the LAST member
+        of a committed gang is released the reservation dissolves — keeping
+        it would mask the freed chips forever (capacity leak)."""
+        with self._lock:
+            for res in self._reservations.values():
+                if pod_key in res.assigned:
+                    res.assigned.pop(pod_key)
+                    if res.committed and not res.assigned:
+                        self._reservations.pop(res.key, None)
+                        log.info(
+                            "gang %s/%s dissolved (all members released)",
+                            res.namespace, res.group.name,
+                        )
+                    return
+
+    def forget(self, namespace: str, group_name: str) -> None:
+        """Drop a committed gang's bookkeeping once its job is done (the
+        chips themselves free via per-pod release)."""
+        with self._lock:
+            res = self._reservations.get((namespace, group_name))
+            if res is not None and res.committed:
+                self._reservations.pop(res.key, None)

@@ -148,6 +148,15 @@ class ClusterState:
                         out.add(chip.coord)
             return out
 
+    def unhealthy_coords(self) -> set[TopologyCoord]:
+        with self._lock:
+            return {
+                chip.coord
+                for view in self._nodes.values()
+                for chip in view.info.chips
+                if chip.health is not Health.HEALTHY
+            }
+
     def allocation(self, pod_key: str) -> Optional[AllocResult]:
         with self._lock:
             return self._allocs.get(pod_key)

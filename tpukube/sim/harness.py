@@ -198,12 +198,28 @@ class SimCluster:
         with urllib.request.urlopen(req, timeout=10) as resp:
             return json.loads(resp.read())
 
+    def drain_evictions(self) -> list[str]:
+        """Delete pods the gang layer rolled back (all-or-nothing: a
+        half-assembled gang's running members must not keep their chips).
+        On a real cluster an apiserver writer does this."""
+        evicted = []
+        q = self.extender.gang.pending_evictions
+        while q:
+            pod_key = q.popleft()
+            pod = self.pods.pop(pod_key, None)
+            if pod is not None:
+                pod["metadata"].get("annotations", {}).pop(codec.ANNO_ALLOC, None)
+                pod["spec"].pop("nodeName", None)
+            evicted.append(pod_key)
+        return evicted
+
     def schedule(
         self, pod: dict[str, Any], retries: int = 8
     ) -> tuple[str, AllocResult]:
         """One scheduling cycle for one pod, with kube-scheduler's requeue
         semantics: a lost bind race (another pod took the chips between
         filter and bind) re-runs the whole cycle. Raises on failure."""
+        self.drain_evictions()
         last_err = ""
         for _ in range(retries):
             args = {"Pod": pod, "Nodes": {"Items": self.node_objects()}}
