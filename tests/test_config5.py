@@ -1,0 +1,179 @@
+"""BASELINE config 5: multi-tenant — 64-pod Llama-3-70B train gang + burst
+inference pods on a v5p-128-scale mesh (128 chips, 32 hosts): bin-packing,
+priority preemption, and the two north-star metrics.
+
+North star (BASELINE.md): >= 95% cluster chip utilization with the 64-pod
+gang placed ICI-contiguously; p50 gang-schedule latency measured.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from tpukube.core.config import load_config
+from tpukube.core.types import PodGroup
+from tpukube.sim import SimCluster
+
+
+@pytest.fixture(scope="module")
+def loaded_cluster():
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "8,8,2",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        yield c
+
+
+def test_config5_multi_tenant_preemption_and_utilization(loaded_cluster):
+    c = loaded_cluster
+
+    # phase 1: burst inference load — 80 single-chip pods at priority 0
+    for i in range(80):
+        c.schedule(c.make_pod(f"infer-{i}", tpu=1, priority=0))
+    assert c.utilization() == pytest.approx(80 / 128)
+
+    # phase 2: the 64-pod training gang arrives at high priority; no free
+    # contiguous 64-chip box exists, so it must preempt burst pods
+    group = PodGroup("llama-70b", min_member=64)
+    allocs = []
+    for i in range(64):
+        node, alloc = c.schedule(
+            c.make_pod(f"train-{i}", tpu=1, priority=100, group=group)
+        )
+        allocs.append(alloc)
+
+    res = c.extender.gang.reservation("default", "llama-70b")
+    assert res.committed
+    assert c.extender.preemptions > 0, "gang landed without preemption?"
+
+    # ICI-contiguity of the 64-chip slice
+    coords = sorted(co for a in allocs for co in a.coords)
+    assert len(set(coords)) == 64
+    xs = sorted({c_[0] for c_ in coords})
+    ys = sorted({c_[1] for c_ in coords})
+    zs = sorted({c_[2] for c_ in coords})
+    assert len(xs) * len(ys) * len(zs) == 64
+    for axis_vals in (xs, ys, zs):
+        assert axis_vals == list(range(axis_vals[0], axis_vals[0] + len(axis_vals)))
+
+    # phase 3: evicted burst pods (auto-drained from the pod store during
+    # the gang's scheduling cycles) get rescheduled onto remaining chips
+    evicted = [
+        f"infer-{i}" for i in range(80)
+        if c.extender.state.allocation(f"default/infer-{i}") is None
+    ]
+    assert evicted, "preemption evicted no burst pods"
+    assert all(f"default/{name}" not in c.pods for name in evicted), (
+        "evicted pods were not removed from the pod store"
+    )
+    rescheduled = 0
+    for name in evicted:
+        try:
+            c.schedule(c.make_pod(f"{name}-retry", tpu=1, priority=0))
+            rescheduled += 1
+        except RuntimeError:
+            break  # cluster full — remaining burst pods stay Pending
+    # fill any remaining capacity with fresh burst arrivals
+    while True:
+        try:
+            c.schedule(c.make_pod(f"fill-{rescheduled}", tpu=1, priority=0))
+            rescheduled += 1
+        except RuntimeError:
+            break
+
+    # ---- north star #1: utilization ------------------------------------
+    util = c.utilization()
+    assert util >= 0.95, f"north-star utilization {util:.2%} < 95%"
+
+    # ---- north star #2: gang latency from the live /metrics endpoint ---
+    with urllib.request.urlopen(f"{c.base_url}/metrics", timeout=5) as r:
+        text = r.read().decode()
+    assert "tpu_chip_utilization_percent" in text
+    lines = {
+        l.split(" ")[0]: float(l.split(" ")[1])
+        for l in text.splitlines()
+        if l and not l.startswith("#")
+    }
+    assert lines["tpu_chip_utilization_percent"] >= 95.0
+    p50 = lines['gang_schedule_latency_seconds{quantile="0.5"}']
+    assert 0 < p50 < 60, f"implausible gang p50 {p50}"
+    assert lines["tpukube_preemptions_total"] > 0
+    print(
+        f"\nNORTH STAR: utilization={lines['tpu_chip_utilization_percent']:.1f}% "
+        f"gang_p50={p50 * 1000:.1f}ms "
+        f"preemptions={int(lines['tpukube_preemptions_total'])}"
+    )
+
+
+def test_config5_low_priority_gang_cannot_preempt(loaded_cluster):
+    c = loaded_cluster  # cluster is ~full from the previous test
+    group = PodGroup("freeloader", min_member=32)
+    with pytest.raises(RuntimeError, match="cannot preempt|no victim set|no contiguous"):
+        c.schedule(c.make_pod("fl-0", tpu=1, priority=0, group=group))
+
+
+def test_config5_gang_victims_die_whole():
+    # preemption never evicts individual members of a gang: the victim is
+    # the entire gang (all-or-nothing in death as in birth)
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        low = PodGroup("low", min_member=8)
+        for i in range(8):
+            c.schedule(c.make_pod(f"lo-{i}", tpu=1, priority=10, group=low))
+        for i in range(8):
+            c.schedule(c.make_pod(f"solo-{i}", tpu=1, priority=10))
+        # a prio-50 4-chip gang: cheapest contiguous box costs 4 solo pods
+        # (cost 40) vs the whole low gang (cost 80) — solos must die first
+        vip = PodGroup("vip", min_member=4)
+        for i in range(4):
+            c.schedule(c.make_pod(f"vp-{i}", tpu=1, priority=50, group=vip))
+        assert all(
+            c.extender.state.allocation(f"default/lo-{i}") is not None
+            for i in range(8)
+        ), "gang was partially or wholly evicted though solos were cheaper"
+        # now a prio-60 8-chip gang arrives; only the low gang's box fits —
+        # it must be dissolved wholesale, never member-by-member
+        big = PodGroup("big", min_member=8)
+        for i in range(8):
+            c.schedule(c.make_pod(f"bg-{i}", tpu=1, priority=60, group=big))
+        low_alive = [
+            i for i in range(8)
+            if c.extender.state.allocation(f"default/lo-{i}") is not None
+        ]
+        assert low_alive == [], f"partial gang survival: {low_alive}"
+        assert c.extender.gang.reservation("default", "low") is None
+
+
+def test_config5_preemption_chooses_cheapest_victims():
+    # two victim populations: cheap (prio 1) on one half, expensive (prio
+    # 50) on the other; a prio-100 gang must evict from the cheap half
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        "TPUKUBE_SCORE_MODE": "binpack",
+    })
+    with SimCluster(cfg) as c:
+        # fill left half (x<2) with cheap, right half with expensive pods
+        for i in range(16):
+            c.schedule(c.make_pod(f"p-{i}", tpu=1,
+                                  priority=1 if i < 8 else 50))
+        # verify the halves actually split by checking a sample... binpack
+        # fills host by host deterministically: hosts 0,1 get p-0..7
+        group = PodGroup("vip", min_member=8, shape=(2, 4, 1))
+        for i in range(8):
+            c.schedule(c.make_pod(f"v-{i}", tpu=1, priority=100, group=group))
+        res = c.extender.gang.reservation("default", "vip")
+        assert res.committed
+        # every surviving allocation of the original 16 is expensive
+        survivors = {
+            k: c.extender.state.priority_of(k)
+            for k in (f"default/p-{i}" for i in range(16))
+            if c.extender.state.allocation(k) is not None
+        }
+        assert len(survivors) == 8, survivors
+        assert all(p == 50 for p in survivors.values()), survivors

@@ -1,0 +1,61 @@
+"""Metrics endpoints: extender /metrics (aiohttp) + node-agent MetricsServer."""
+
+import urllib.error
+import urllib.request
+
+from tpukube.core.config import load_config
+from tpukube.device import TpuDeviceManager
+from tpukube.metrics import MetricsServer, quantile, render_plugin_metrics
+from tpukube.plugin import DevicePluginServer
+from tpukube.sim import SimCluster
+
+
+def test_quantile_nearest_rank():
+    assert quantile([], 0.5) == 0.0
+    assert quantile([3.0], 0.99) == 3.0
+    assert quantile([1, 2, 3, 4, 5], 0.5) == 3
+    assert quantile([1, 2, 3, 4, 5], 0.0) == 1
+    assert quantile([1, 2, 3, 4, 5], 1.0) == 5
+
+
+def test_extender_metrics_endpoint():
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        c.schedule(c.make_pod("p", tpu=2))
+        with urllib.request.urlopen(f"{c.base_url}/metrics", timeout=5) as r:
+            text = r.read().decode()
+        assert "tpu_chip_utilization_percent 50" in text
+        assert "tpukube_binds_total 1" in text
+        assert 'tpukube_webhook_latency_seconds{handler="bind",quantile="0.5"}' in text
+
+
+def test_plugin_metrics_server(tmp_path):
+    cfg = load_config(env={
+        "TPUKUBE_DEVICE_PLUGIN_DIR": str(tmp_path),
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with TpuDeviceManager(cfg) as device, \
+         DevicePluginServer(cfg, device) as server:
+        ms = MetricsServer(lambda: render_plugin_metrics(server))
+        ms.start()
+        try:
+            device.inject_fault(1)
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{ms.port}/metrics", timeout=5
+            ) as r:
+                text = r.read().decode()
+            assert 'tpukube_plugin_devices{health="Healthy"} 3' in text
+            assert 'tpukube_plugin_devices{health="Unhealthy"} 1' in text
+            assert 'resource="qiniu.com/tpu"' in text
+            # non-metrics path 404s
+            try:
+                urllib.request.urlopen(f"http://127.0.0.1:{ms.port}/x", timeout=5)
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            ms.stop()

@@ -153,6 +153,7 @@ def encode_alloc(alloc: AllocResult) -> str:
             "devices": alloc.device_ids,
             "coords": [c.as_list() for c in alloc.coords],
             "env": alloc.env,
+            "priority": alloc.priority,
         },
         separators=(",", ":"),
     )
@@ -171,6 +172,7 @@ def decode_alloc(payload: str) -> AllocResult:
             device_ids=list(_field(obj, "devices", "alloc")),
             coords=[TopologyCoord.of(c) for c in obj.get("coords", [])],
             env=dict(obj.get("env", {})),
+            priority=int(obj.get("priority", 0)),
         )
     except CodecError:
         raise

@@ -228,6 +228,9 @@ class AllocResult:
     device_ids: list[str] = field(default_factory=list)
     coords: list[TopologyCoord] = field(default_factory=list)
     env: dict[str, str] = field(default_factory=dict)
+    # pod priority, persisted in the annotation so a restarted extender
+    # rebuilds preemption protection (not just occupancy)
+    priority: int = 0
 
     def chip_indices(self) -> list[int]:
         return [parse_device_id(d)[0] for d in self.device_ids]

@@ -1,0 +1,136 @@
+"""Metrics export (SURVEY.md §6 "Metrics / logging / observability").
+
+The reference lineage only has glog; BASELINE's north-star metrics demand
+more: cluster TPU-chip utilization % and the gang-schedule latency
+distribution. This module renders Prometheus text-format metrics without
+depending on prometheus_client (not in this environment), and provides a
+tiny threaded HTTP server for the node agent (the extender serves /metrics
+from its aiohttp app).
+
+Exported series (extender):
+  tpu_chip_utilization_percent            — north star #1
+  gang_schedule_latency_seconds{quantile} — north star #2 (+ _count/_sum)
+  tpukube_binds_total, tpukube_gang_rollbacks_total,
+  tpukube_preemptions_total, tpukube_webhook_latency_seconds{handler,quantile}
+
+Exported series (node agent):
+  tpukube_plugin_allocations_total, tpukube_plugin_devices{health}
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Iterable, Optional
+
+
+def quantile(values: Iterable[float], q: float) -> float:
+    """Nearest-rank quantile; 0.0 on empty input."""
+    vs = sorted(values)
+    if not vs:
+        return 0.0
+    idx = min(len(vs) - 1, max(0, round(q * (len(vs) - 1))))
+    return vs[idx]
+
+
+def _fmt(name: str, value: float, labels: Optional[dict[str, str]] = None) -> str:
+    if labels:
+        inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        return f"{name}{{{inner}}} {value:.6g}\n"
+    return f"{name} {value:.6g}\n"
+
+
+def render_extender_metrics(extender) -> str:
+    """Prometheus text for an Extender (tpukube.sched.extender)."""
+    out: list[str] = []
+    out.append("# TYPE tpu_chip_utilization_percent gauge\n")
+    out.append(_fmt("tpu_chip_utilization_percent",
+                    100.0 * extender.state.utilization()))
+
+    lats = list(extender.gang.commit_latencies)
+    out.append("# TYPE gang_schedule_latency_seconds summary\n")
+    for q in (0.5, 0.9, 0.99):
+        out.append(_fmt("gang_schedule_latency_seconds", quantile(lats, q),
+                        {"quantile": str(q)}))
+    out.append(_fmt("gang_schedule_latency_seconds_count", len(lats)))
+    out.append(_fmt("gang_schedule_latency_seconds_sum", sum(lats)))
+
+    out.append("# TYPE tpukube_binds_total counter\n")
+    out.append(_fmt("tpukube_binds_total", extender.binds_total))
+    out.append("# TYPE tpukube_gang_rollbacks_total counter\n")
+    out.append(_fmt("tpukube_gang_rollbacks_total", extender.gang.rollbacks))
+    out.append("# TYPE tpukube_preemptions_total counter\n")
+    out.append(_fmt("tpukube_preemptions_total", extender.preemptions))
+
+    out.append("# TYPE tpukube_webhook_latency_seconds summary\n")
+    for handler, window in extender.latencies.items():
+        vs = list(window)
+        for q in (0.5, 0.99):
+            out.append(_fmt("tpukube_webhook_latency_seconds",
+                            quantile(vs, q),
+                            {"handler": handler, "quantile": str(q)}))
+    return "".join(out)
+
+
+def render_plugin_metrics(server) -> str:
+    """Prometheus text for a DevicePluginServer (tpukube.plugin.server)."""
+    out: list[str] = []
+    out.append("# TYPE tpukube_plugin_allocations_total counter\n")
+    out.append(_fmt("tpukube_plugin_allocations_total", server.allocation_count))
+    out.append("# TYPE tpukube_plugin_devices gauge\n")
+    healthy = unhealthy = 0
+    for _, h in server._device.device_list():
+        if h.value == "Healthy":
+            healthy += 1
+        else:
+            unhealthy += 1
+    out.append(_fmt("tpukube_plugin_devices", healthy, {"health": "Healthy"}))
+    out.append(_fmt("tpukube_plugin_devices", unhealthy, {"health": "Unhealthy"}))
+    out.append(_fmt("tpukube_plugin_resource_info", 1,
+                    {"resource": server.resource_name}))
+    return "".join(out)
+
+
+class MetricsServer:
+    """Minimal threaded /metrics HTTP server for the node agent."""
+
+    def __init__(self, render: Callable[[], str], host: str = "127.0.0.1",
+                 port: int = 0):
+        render_fn = render
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802  (http.server API)
+                if self.path != "/metrics":
+                    self.send_error(404)
+                    return
+                body = render_fn().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-request stderr spam
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="tpukube-metrics",
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -37,8 +37,13 @@ from tpukube.core.types import (
     TopologyCoord,
     make_device_id,
 )
-from tpukube.sched import kube, slicefit
-from tpukube.sched.gang import GangError, GangManager, GangReservation
+from tpukube.sched import kube, policy, slicefit
+from tpukube.sched.gang import (
+    GangError,
+    GangManager,
+    GangReservation,
+    NoSliceError,
+)
 from tpukube.sched.state import ClusterState, NodeView, StateError
 
 log = logging.getLogger("tpukube.extender")
@@ -73,6 +78,8 @@ class Extender:
             "prioritize": deque(maxlen=self.LATENCY_WINDOW),
             "bind": deque(maxlen=self.LATENCY_WINDOW),
         }
+        self.preemptions = 0   # victims evicted for higher-priority gangs
+        self.binds_total = 0   # successful binds (metrics counter)
 
     def _remember(self, pod: PodInfo) -> None:
         now = time.monotonic()
@@ -131,7 +138,14 @@ class Extender:
                         f"{pod.key()}: gang scheduling requires whole-chip "
                         f"({RESOURCE_TPU}) requests"
                     )
-                res = self.gang.ensure_reservation(pod, count)
+                try:
+                    res = self.gang.ensure_reservation(pod, count)
+                except NoSliceError:
+                    # no contiguous slice — a high-priority gang may evict
+                    # cheaper pods to open one (SURVEY.md C11, config 5).
+                    # Other GangErrors are configuration mistakes and must
+                    # never cost innocent pods their chips.
+                    res = self._try_preemption(pod, count)
                 if not self.gang.assignable(res, count):
                     # replica beyond min_member of a full gang: schedule it
                     # as a normal pod rather than wedging it Pending forever
@@ -153,6 +167,98 @@ class Extender:
             return feasible, failed
         finally:
             self.latencies["filter"].append(time.monotonic() - t0)
+
+    def _try_preemption(self, pod: PodInfo, count: int) -> GangReservation:
+        """Open a contiguous slice for a gang by evicting lower-priority
+        pods. Raises GangError (propagates unschedulability) if no eligible
+        victim set exists or the pod has no priority to preempt with."""
+        assert pod.group is not None
+        mesh = self.state.mesh
+        if mesh is None or pod.priority <= 0:
+            raise GangError(
+                f"gang {pod.namespace}/{pod.group.name}: no contiguous slice "
+                f"and priority {pod.priority} cannot preempt"
+            )
+        total = pod.group.min_member * count
+        if pod.group.shape is not None:
+            sx, sy, sz = pod.group.shape
+            if sx * sy * sz != total:
+                raise GangError(
+                    f"gang {pod.namespace}/{pod.group.name}: shape "
+                    f"{pod.group.shape} holds {sx * sy * sz} chips but the "
+                    f"gang needs {total} — refusing to preempt for it"
+                )
+        plan = policy.find_preemption_plan(
+            self._preemption_workloads(),
+            mesh,
+            self.state.unhealthy_coords(),
+            total,
+            pod.group.shape,
+            pod.priority,
+        )
+        if plan is None:
+            raise GangError(
+                f"gang {pod.namespace}/{pod.group.name}: no victim set opens "
+                f"a contiguous {total}-chip slice at priority {pod.priority}"
+            )
+        evicted_pods = 0
+        for victim in plan.victims:
+            if victim.gang_key is not None:
+                evicted_pods += len(self.gang.dissolve(victim.gang_key))
+            else:
+                for pk in victim.pod_keys:
+                    self.state.release(pk)
+                    self.gang.pending_evictions.append(pk)
+                    evicted_pods += 1
+        self.preemptions += evicted_pods
+        log.warning(
+            "gang %s/%s preempts %d workloads / %d pods (priority sum %d) "
+            "for a %d-chip slice",
+            pod.namespace, pod.group.name,
+            plan.victim_count, evicted_pods, plan.cost_priority_sum, total,
+        )
+        return self.gang.reserve_exact(pod, count, plan.coords)
+
+    def _preemption_workloads(self) -> list[policy.Workload]:
+        """Current workloads at preemption granularity: whole gangs (with
+        their reserved-but-unassigned chips) and free-standing pods."""
+        out: list[policy.Workload] = []
+        gang_pods: set[str] = set()
+        for res in self.gang.snapshot():
+            members = sorted(res.assigned)
+            gang_pods.update(members)
+            prios = [self.state.priority_of(k) for k in members]
+            coords: set[TopologyCoord] = set(res.coords)
+            for k in members:
+                alloc = self.state.allocation(k)
+                if alloc is not None:
+                    coords.update(alloc.coords)
+            # Blocking priority covers members NOT yet bound: the
+            # reservation records its gang's priority, so a freshly
+            # reserving prio-100 gang is never the cheap victim of a
+            # prio-1 preemptor (priority inversion). Cost likewise counts
+            # unarrived members at the reservation's priority.
+            unarrived = max(0, res.group.min_member - len(members))
+            out.append(policy.Workload(
+                id=f"gang:{res.namespace}/{res.group.name}",
+                priority=max([res.priority, *prios]),
+                cost=sum(prios) + res.priority * unarrived,
+                coords=frozenset(coords),
+                pod_keys=tuple(members),
+                gang_key=res.key,
+            ))
+        for alloc in self.state.allocations():
+            if alloc.pod_key in gang_pods:
+                continue
+            prio = self.state.priority_of(alloc.pod_key)
+            out.append(policy.Workload(
+                id=alloc.pod_key,
+                priority=prio,
+                cost=prio,
+                coords=frozenset(TopologyCoord.of(c) for c in alloc.coords),
+                pod_keys=(alloc.pod_key,),
+            ))
+        return out
 
     def _node_feasibility(
         self,
@@ -382,8 +488,9 @@ class Extender:
                 node_name=node_name,
                 device_ids=device_ids,
                 coords=sorted(set(plan)),
+                priority=pod.priority,
             )
-            self.state.commit(alloc)  # raises StateError on lost race
+            self.state.commit(alloc, priority=pod.priority)  # StateError on lost race
             if res is not None:
                 try:
                     self.gang.on_bound(res, key, plan)
@@ -393,6 +500,7 @@ class Extender:
                     raise ExtenderError(str(e)) from e
             with self._pending_lock:
                 self._pending.pop(key, None)
+            self.binds_total += 1
             log.info("bound %s -> %s %s", key, node_name, device_ids)
             return alloc
         finally:
@@ -483,8 +591,17 @@ def make_app(extender: Extender) -> web.Application:
     async def healthz(request: web.Request) -> web.Response:
         return web.json_response({"ok": True, "nodes": extender.state.node_names()})
 
+    async def metrics(request: web.Request) -> web.Response:
+        from tpukube.metrics import render_extender_metrics
+
+        return web.Response(
+            text=render_extender_metrics(extender),
+            content_type="text/plain",
+        )
+
     app.router.add_post("/filter", filter_handler)
     app.router.add_post("/prioritize", prioritize_handler)
     app.router.add_post("/bind", bind_handler)
     app.router.add_get("/healthz", healthz)
+    app.router.add_get("/metrics", metrics)
     return app

@@ -44,12 +44,19 @@ class GangError(RuntimeError):
     pass
 
 
+class NoSliceError(GangError):
+    """No contiguous slice is free — the one GangError that may justify
+    preemption. Configuration errors (shape/volume/chips-per-pod mismatch)
+    must NOT trigger evictions."""
+
+
 @dataclass
 class GangReservation:
     group: PodGroup
     namespace: str
     coords: set[TopologyCoord]  # the whole reserved slice
     chips_per_pod: int
+    priority: int = 0  # the reserving pods' priority (preemption blocking)
     created: float = field(default_factory=time.monotonic)
     assigned: dict[str, list[TopologyCoord]] = field(default_factory=dict)
     committed: bool = False
@@ -174,7 +181,7 @@ class GangManager:
             else:
                 coords = slicefit.find_slice(mesh, occupied, count=total)
             if coords is None:
-                raise GangError(
+                raise NoSliceError(
                     f"gang {key}: no contiguous {total}-chip slice available "
                     f"({mesh.num_chips - len(occupied)} chips free)"
                 )
@@ -183,10 +190,73 @@ class GangManager:
                 namespace=pod.namespace,
                 coords=set(coords),
                 chips_per_pod=chips_per_pod,
+                priority=pod.priority,
             )
             self._reservations[key] = res
             log.info(
                 "gang %s/%s reserved %d chips", key[0], key[1], len(res.coords)
+            )
+            return res
+
+    def snapshot(self) -> list[GangReservation]:
+        """Stable copy of live reservations (the preemption planner's view)."""
+        with self._lock:
+            return list(self._reservations.values())
+
+    def dissolve(self, key: tuple[str, str]) -> list[str]:
+        """Evict a whole gang (preemption victim): release every member's
+        allocation, queue their evictions, drop the reservation. Gangs die
+        all-or-nothing exactly as they are born. Returns evicted pod keys."""
+        with self._lock:
+            res = self._reservations.pop(key, None)
+            if res is None:
+                return []
+            evicted = []
+            for pod_key in list(res.assigned):
+                self._state.release(pod_key)
+                self.pending_evictions.append(pod_key)
+                evicted.append(pod_key)
+            log.warning(
+                "gang %s/%s dissolved by preemption (%d members evicted)",
+                key[0], key[1], len(evicted),
+            )
+            return evicted
+
+    def reserve_exact(
+        self, pod: PodInfo, chips_per_pod: int, coords: list[TopologyCoord]
+    ) -> GangReservation:
+        """Reserve a specific chip set (the preemption path: policy already
+        chose the box and evicted its victims). Raises if any chip was
+        re-taken between eviction and this call — the scheduler retries."""
+        assert pod.group is not None
+        with self._lock:
+            key = (pod.namespace, pod.group.name)
+            existing = self._reservations.get(key)
+            if existing is not None:
+                return existing  # lost a benign race with a sibling member
+            expected = pod.group.min_member * chips_per_pod
+            if len(coords) != expected:
+                raise GangError(
+                    f"gang {key}: preemption opened {len(coords)} chips but "
+                    f"the gang needs {expected}"
+                )
+            occupied = self._state.occupied_coords() | self.reserved_coords()
+            clash = [c for c in coords if c in occupied]
+            if clash:
+                raise GangError(
+                    f"gang {key}: preempted box re-occupied at {clash[:3]}; retry"
+                )
+            res = GangReservation(
+                group=pod.group,
+                namespace=pod.namespace,
+                coords=set(coords),
+                chips_per_pod=chips_per_pod,
+                priority=pod.priority,
+            )
+            self._reservations[key] = res
+            log.info(
+                "gang %s/%s reserved %d chips via preemption",
+                key[0], key[1], len(res.coords),
             )
             return res
 

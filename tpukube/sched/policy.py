@@ -1,0 +1,111 @@
+"""Multi-tenant policy: bin-packing + priority preemption (SURVEY.md C11).
+
+BASELINE config 5's scenario: a cluster running low-priority burst
+inference pods must yield a CONTIGUOUS slice when a high-priority training
+gang arrives. Evicting the right victims to open a contiguous box is
+NP-flavored (SURVEY.md §9.3); this is the bounded exact-sweep heuristic:
+
+  1. Victim granularity is a WORKLOAD: a non-gang pod, or an entire gang
+     (members + reservation). Gangs are all-or-nothing in death as in
+     birth — evicting individual members would strand the rest on a
+     broken slice and hand their chips back to the gang's own reservation.
+  2. Build a "blocked" grid: unhealthy chips plus every chip of workloads
+     whose priority >= the preemptor's. These can never be taken.
+  3. Sweep every candidate box of the needed volume/shape over that grid
+     (the slicefit summed-area machinery, so the sweep is O(mesh)).
+  4. Cost of a box = (sum of victim workload priorities, victim count,
+     box surface, -contact): prefer cheap evictions, then few, then a
+     compact snug box. Deterministic tie-break on origin.
+
+The extender applies the winning plan: non-gang victims are released and
+queued for eviction; gang victims are dissolved wholesale.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Optional
+
+from tpukube.core.mesh import Box, MeshSpec, surface
+from tpukube.core.types import TopologyCoord
+from tpukube.sched import slicefit
+
+log = logging.getLogger("tpukube.policy")
+
+
+@dataclass(frozen=True)
+class Workload:
+    """Unit of preemption: one pod, or one whole gang."""
+
+    id: str                      # pod_key, or "gang:<ns>/<name>"
+    priority: int                # blocking priority (max member priority)
+    cost: int                    # eviction cost (sum of member priorities)
+    coords: frozenset[TopologyCoord]  # every chip it holds (gangs include
+                                      # their unassigned reserved chips)
+    pod_keys: tuple[str, ...] = ()
+    gang_key: Optional[tuple[str, str]] = None
+
+
+@dataclass(frozen=True)
+class PreemptionPlan:
+    coords: list[TopologyCoord]   # the box the gang will take
+    victims: list[Workload]       # workloads to evict, deterministic order
+    cost_priority_sum: int
+    victim_count: int
+
+
+def find_preemption_plan(
+    workloads: list[Workload],
+    mesh: MeshSpec,
+    unhealthy: set[TopologyCoord],
+    total: int,
+    shape: Optional[tuple[int, int, int]],
+    preemptor_priority: int,
+) -> Optional[PreemptionPlan]:
+    """Cheapest victim set whose eviction opens a contiguous `total`-chip
+    box (or the exact `shape`). None when no eligible box exists."""
+    # A chip may host several workloads (fractional vTPU co-tenants): all
+    # of them must be evicted to free it, so the owner map is coord->list.
+    owner: dict[TopologyCoord, list[Workload]] = {}
+    blocked = set(unhealthy)
+    for w in workloads:
+        for c in w.coords:
+            owner.setdefault(c, []).append(w)
+        if w.priority >= preemptor_priority:
+            blocked |= w.coords
+
+    grid = slicefit.occupancy_grid(mesh, blocked)
+    sweep = slicefit._Sweep(mesh, grid)
+
+    shapes = slicefit._candidate_shapes(
+        mesh, total if shape is None else None, shape
+    )
+
+    best: Optional[tuple] = None  # (key, coords, victims)
+    for shp in shapes:
+        for origin in sweep.origins(shp):
+            box = Box(TopologyCoord(*(int(v) for v in origin)), shp)
+            coords = slicefit.box_coords(mesh, box)
+            victims = {
+                w.id: w for c in coords for w in owner.get(c, ())
+            }
+            cost = sum(w.cost for w in victims.values())
+            key = (
+                cost,
+                len(victims),
+                surface(shp),
+                -sweep.contact(box),
+                tuple(int(v) for v in origin),
+            )
+            if best is None or key < best[0]:
+                best = (key, coords, [victims[i] for i in sorted(victims)])
+    if best is None:
+        return None
+    key, coords, victims = best
+    return PreemptionPlan(
+        coords=coords,
+        victims=victims,
+        cost_priority_sum=key[0],
+        victim_count=key[1],
+    )

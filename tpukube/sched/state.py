@@ -96,6 +96,7 @@ class ClusterState:
         self._nodes: dict[str, NodeView] = {}
         self._mesh: Optional[MeshSpec] = None
         self._allocs: dict[str, AllocResult] = {}  # pod key -> commitment
+        self._priorities: dict[str, int] = {}  # pod key -> pod priority
 
     # -- node ingestion ----------------------------------------------------
     def upsert_node(self, name: str, annotations: dict[str, str]) -> bool:
@@ -179,8 +180,15 @@ class ClusterState:
                         used += min(n, view.used_share_count(chip.index))
             return used / total if total else 0.0
 
+    def priority_of(self, pod_key: str) -> int:
+        """Priority recorded at commit time (0 for restart-rebuilt entries —
+        annotations don't carry priority; the preemption sweep then treats
+        them as cheapest, which is the conservative direction for victims)."""
+        with self._lock:
+            return self._priorities.get(pod_key, 0)
+
     # -- commit / release --------------------------------------------------
-    def commit(self, alloc: AllocResult) -> None:
+    def commit(self, alloc: AllocResult, priority: int = 0) -> None:
         """Record a bind: devices of one pod on one node."""
         with self._lock:
             if alloc.pod_key in self._allocs:
@@ -209,11 +217,13 @@ class ClusterState:
                 pending_shares[index] = pending_shares.get(index, 0) + want
             view.used_ids |= adding
             self._allocs[alloc.pod_key] = alloc
+            self._priorities[alloc.pod_key] = priority
 
     def release(self, pod_key: str) -> Optional[AllocResult]:
         """Pod gone (deleted/preempted): free its shares."""
         with self._lock:
             alloc = self._allocs.pop(pod_key, None)
+            self._priorities.pop(pod_key, None)
             if alloc is None:
                 return None
             view = self._nodes.get(alloc.node_name)
@@ -231,6 +241,6 @@ class ClusterState:
             if not payload:
                 continue
             alloc = codec.decode_alloc(payload)
-            self.commit(alloc)
+            self.commit(alloc, priority=alloc.priority)
             restored += 1
         return restored
