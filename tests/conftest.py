@@ -1,17 +1,26 @@
-"""Test bootstrap: force a virtual 8-device CPU mesh BEFORE jax imports.
+"""Test bootstrap: force a virtual 8-device CPU mesh for sharding tests.
 
 Multi-chip hardware is unavailable here; sharding paths are validated on a
 virtual CPU mesh exactly as the driver's dryrun does (task brief).
+
+This machine's interpreter imports jax at startup (an axon/TPU sitecustomize
+registers a PJRT plugin and JAX_PLATFORMS=axon is pre-set in the env), so
+setting os.environ here is too late for platform selection — use
+jax.config.update instead, plus XLA_FLAGS before any backend initializes.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # for subprocesses spawned by tests
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -187,7 +187,7 @@ def test_restart_rebuild_from_pod_annotations(cluster):
     restored = fresh.state.rebuild_from_pods(
         [p["metadata"]["annotations"] for p in cluster.pods.values()]
     )
-    assert restored == 2
+    assert len(restored) == 2
     assert fresh.state.utilization() == pytest.approx(util_before)
 
 
@@ -213,3 +213,157 @@ def test_healthz(cluster):
     with urllib.request.urlopen(f"{cluster.base_url}/healthz", timeout=5) as r:
         body = json.loads(r.read())
     assert body["ok"] is True
+
+
+def test_restart_rebuild_preserves_gang_granularity(cluster):
+    """After a restart, running gang members must NOT become individually
+    evictable free-standing pods: preemption stays all-or-nothing."""
+    from tpukube.core.types import (
+        RESOURCE_TPU, ContainerInfo, PodGroup, PodInfo, ResourceList,
+    )
+    from tpukube.sched.extender import Extender
+
+    low = PodGroup("low", min_member=8)
+    for i in range(8):
+        cluster.schedule(cluster.make_pod(f"lo-{i}", tpu=1, priority=10,
+                                          group=low))
+    for i in range(8):
+        cluster.schedule(cluster.make_pod(f"solo-{i}", tpu=1, priority=15))
+
+    # restart: new extender rebuilt purely from pod annotations
+    fresh = Extender(cluster.config)
+    for obj in cluster.node_objects():
+        fresh.state.upsert_node(
+            obj["metadata"]["name"], obj["metadata"]["annotations"]
+        )
+    restored = fresh.rebuild_from_pods(
+        [p["metadata"]["annotations"] for p in cluster.pods.values()]
+    )
+    assert restored == 16
+    res = fresh.gang.reservation("default", "low")
+    assert res is not None and res.committed
+    assert len(res.coords) == 8
+
+    # a prio-100 4-chip gang arrives; 4 gang members (cost 40) would be the
+    # cheapest individual victims, but the gang must be priced whole (80),
+    # so the 4 solos (cost 60) die instead
+    vip_pod = PodInfo(
+        name="vip-0", namespace="default", priority=100,
+        group=PodGroup("vip", min_member=4),
+        containers=[ContainerInfo("main", ResourceList({RESOURCE_TPU: 1}))],
+    )
+    feasible, _ = fresh.filter(vip_pod, cluster.node_objects())
+    assert feasible, "vip gang found no feasible nodes after preemption"
+    low_alive = [
+        i for i in range(8)
+        if fresh.state.allocation(f"default/lo-{i}") is not None
+    ]
+    assert low_alive == list(range(8)), (
+        f"restart broke gang all-or-nothing: survivors {low_alive}"
+    )
+    evicted_solos = [
+        i for i in range(8)
+        if fresh.state.allocation(f"default/solo-{i}") is None
+    ]
+    assert len(evicted_solos) == 4
+
+
+def test_restart_rebuild_mid_assembly_gang(cluster):
+    """Restart while a gang is half-assembled: either the reservation is
+    re-completed to a full contiguous slice (members keep their chips and
+    late members can still join) or the half-gang is rolled back whole —
+    never left as a broken committed=False shell that strands members."""
+    from tpukube.core.types import PodGroup
+    from tpukube.sched.extender import Extender
+
+    # assemble only 4 of an 8-member gang (schedule members one at a time,
+    # stopping early — the reservation exists, uncommitted)
+    group = PodGroup("half", min_member=8)
+    for i in range(4):
+        cluster.schedule(cluster.make_pod(f"h-{i}", tpu=1, priority=10,
+                                          group=group))
+    res = cluster.extender.gang.reservation("default", "half")
+    assert res is not None and not res.committed
+
+    fresh = Extender(cluster.config)
+    for obj in cluster.node_objects():
+        fresh.state.upsert_node(
+            obj["metadata"]["name"], obj["metadata"]["annotations"]
+        )
+    fresh.rebuild_from_pods(
+        [p["metadata"]["annotations"] for p in cluster.pods.values()]
+    )
+    res2 = fresh.gang.reservation("default", "half")
+    if res2 is not None:
+        # re-completed: full-size slice containing every member's chips
+        assert len(res2.coords) == 8
+        assert res2.assigned.keys() == {f"default/h-{i}" for i in range(4)}
+        assert len(res2.unassigned_coords()) == 4
+    else:
+        # rolled back whole: every member released and queued for eviction
+        assert all(
+            fresh.state.allocation(f"default/h-{i}") is None for i in range(4)
+        )
+        assert set(fresh.pending_evictions) == {
+            f"default/h-{i}" for i in range(4)
+        }
+
+
+def test_restart_rebuild_mid_assembly_gang_uncompletable():
+    """If the surviving members' chips cannot be extended to a full
+    contiguous slice, the restored half-gang must be rolled back whole.
+
+    Built from hand-made annotations: a live cluster can't produce this
+    state (the reservation masks its unassigned chips, which then remain
+    free and completable after restart) — but annotations on a real
+    apiserver outlive the reservation, so a restart CAN find members whose
+    slice was since stolen (e.g. the old extender rolled the gang back by
+    TTL and new pods took the chips, then it crashed before evictions ran).
+    """
+    from tpukube.core.config import load_config
+    from tpukube.core.types import AllocResult, PodGroup, TopologyCoord
+    from tpukube.sched.extender import Extender
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:  # only used to mint node annotations
+        group = PodGroup("doomed", min_member=8)
+        pods = []
+        # 4 gang members hold host-0-0-0's 2x2 block (chips 0..3)
+        host0 = c.mesh.coords_of_host("host-0-0-0")
+        for i in range(4):
+            anno = dict(codec.pod_group_annotations(group))
+            anno[codec.ANNO_ALLOC] = codec.encode_alloc(AllocResult(
+                pod_key=f"default/d-{i}", node_name="host-0-0-0",
+                device_ids=[f"tpu-{i}"], coords=[host0[i]], priority=10,
+            ))
+            pods.append(anno)
+        # every other chip is held by solo pods: no free chip anywhere else
+        for host in c.mesh.all_hosts():
+            if host == "host-0-0-0":
+                continue
+            for i, coord in enumerate(c.mesh.coords_of_host(host)):
+                pods.append({codec.ANNO_ALLOC: codec.encode_alloc(AllocResult(
+                    pod_key=f"default/solo-{host}-{i}", node_name=host,
+                    device_ids=[f"tpu-{i}"], coords=[coord], priority=0,
+                ))})
+        fresh = Extender(c.config)
+        for obj in c.node_objects():
+            fresh.state.upsert_node(
+                obj["metadata"]["name"], obj["metadata"]["annotations"]
+            )
+        fresh.rebuild_from_pods(pods)
+        # no 8-chip box can contain the 2x2 corner (only 4 chips are free
+        # in total): the half-gang must be rolled back whole
+        assert fresh.gang.reservation("default", "doomed") is None
+        assert all(
+            fresh.state.allocation(f"default/d-{i}") is None for i in range(4)
+        )
+        assert sorted(fresh.pending_evictions) == [
+            f"default/d-{i}" for i in range(4)
+        ]
+        assert fresh.gang.rollbacks == 1
+        # the 12 solos survive untouched
+        assert fresh.state.utilization() == pytest.approx(12 / 16)

@@ -66,7 +66,15 @@ class Extender:
     def __init__(self, config: TpuKubeConfig, state: Optional[ClusterState] = None):
         self._config = config
         self.state = state or ClusterState()
-        self.gang = GangManager(self.state, ttl_seconds=config.reservation_ttl_seconds)
+        # Cluster-wide eviction bus: pods whose chips were taken back
+        # (gang rollback/dissolve, preemption) and must be deleted by the
+        # pod-lifecycle owner (sim harness / apiserver writer).
+        self.pending_evictions: deque[str] = deque()
+        self.gang = GangManager(
+            self.state,
+            ttl_seconds=config.reservation_ttl_seconds,
+            eviction_sink=self.pending_evictions,
+        )
         # Pods seen at filter time, so /bind (which only carries names) can
         # recover the request: key -> (pod, uid, seen_monotonic).
         self._pending: dict[str, tuple[PodInfo, str, float]] = {}
@@ -208,7 +216,7 @@ class Extender:
             else:
                 for pk in victim.pod_keys:
                     self.state.release(pk)
-                    self.gang.pending_evictions.append(pk)
+                    self.pending_evictions.append(pk)
                     evicted_pods += 1
         self.preemptions += evicted_pods
         log.warning(
@@ -490,7 +498,7 @@ class Extender:
                 coords=sorted(set(plan)),
                 priority=pod.priority,
             )
-            self.state.commit(alloc, priority=pod.priority)  # StateError on lost race
+            self.state.commit(alloc)  # StateError on lost race
             if res is not None:
                 try:
                     self.gang.on_bound(res, key, plan)
@@ -535,6 +543,36 @@ class Extender:
         self.gang.on_release(pod_key)
         with self._pending_lock:
             self._pending.pop(pod_key, None)
+
+    # -- restart story (SURVEY.md §6 checkpoint/resume) ----------------------
+    def rebuild_from_pods(self, pods: list[dict[str, str]]) -> int:
+        """Reconstruct ledger AND gang reservations from pod annotations
+        (each item is one pod's annotation dict) after an extender restart.
+
+        Restoring only per-pod allocations would silently downgrade running
+        gangs to free-standing pods: a later preemption could then evict
+        individual members, violating all-or-nothing death. The pod-group
+        annotations persist gang identity, so rebuild it here.
+        """
+        restored = self.state.rebuild_from_pods(pods)
+        # restored is ordered 1:1 with the pods that carried an alloc
+        # annotation (rebuild_from_pods' contract) — single decode, no
+        # re-parse here.
+        it = iter(restored)
+        members: dict[tuple[str, str], list] = {}  # (ns, group) -> [(alloc, group)]
+        for annotations in pods:
+            if not annotations.get(codec.ANNO_ALLOC):
+                continue
+            alloc = next(it)
+            group = codec.pod_group_from_annotations(annotations)
+            if group is None:
+                continue
+            ns = alloc.pod_key.split("/", 1)[0]
+            members.setdefault((ns, group.name), []).append((alloc, group))
+        for (ns, _), entries in members.items():
+            allocs = [a for a, _ in entries]
+            self.gang.restore(ns, entries[0][1], allocs)
+        return len(restored)
 
 
 # -- aiohttp application ----------------------------------------------------

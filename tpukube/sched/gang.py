@@ -80,7 +80,8 @@ class GangManager:
 
     LATENCY_WINDOW = 4096
 
-    def __init__(self, state: ClusterState, ttl_seconds: float = 30.0):
+    def __init__(self, state: ClusterState, ttl_seconds: float = 30.0,
+                 eviction_sink: Optional[deque] = None):
         self._state = state
         self._ttl = ttl_seconds
         self._lock = threading.RLock()
@@ -88,9 +89,12 @@ class GangManager:
         # reservation-created -> committed durations (north-star p50 feed)
         self.commit_latencies: deque[float] = deque(maxlen=self.LATENCY_WINDOW)
         self.rollbacks = 0  # TTL/fault rollbacks observed (metrics/tests)
-        # rolled-back members whose pods must be deleted by the pod-lifecycle
-        # owner (all-or-nothing: a half-gang must not keep running)
-        self.pending_evictions: deque[str] = deque()
+        # Cluster-wide eviction bus, owned by the Extender (which also feeds
+        # it preemption victims); gang rollback/dissolve appends rolled-back
+        # members here (all-or-nothing: a half-gang must not keep running).
+        self._evictions: deque[str] = (
+            eviction_sink if eviction_sink is not None else deque()
+        )
 
     # -- views -------------------------------------------------------------
     def reservation(self, namespace: str, group_name: str) -> Optional[GangReservation]:
@@ -143,7 +147,7 @@ class GangManager:
             # ledger alone would let another pod double-book those chips.
             # Queue the eviction for whoever owns pod lifecycle (the sim
             # harness, or an apiserver writer on a real cluster).
-            self.pending_evictions.append(pod_key)
+            self._evictions.append(pod_key)
         self._reservations.pop(res.key, None)
         self.rollbacks += 1
 
@@ -214,13 +218,105 @@ class GangManager:
             evicted = []
             for pod_key in list(res.assigned):
                 self._state.release(pod_key)
-                self.pending_evictions.append(pod_key)
+                self._evictions.append(pod_key)
                 evicted.append(pod_key)
             log.warning(
                 "gang %s/%s dissolved by preemption (%d members evicted)",
                 key[0], key[1], len(evicted),
             )
             return evicted
+
+    def restore(
+        self, namespace: str, group: PodGroup, allocs: list
+    ) -> Optional[GangReservation]:
+        """Rebuild a gang's reservation from its members' restored
+        allocations after an extender restart (the extender's
+        rebuild_from_pods). Without this, running gang members look like
+        free-standing pods to the preemption planner and could be evicted
+        individually — partial gang death. ``allocs`` are the members'
+        AllocResults (already committed to the ledger).
+
+        A quorum of members means the gang had committed: restore it as
+        committed with exactly its members' chips. A partial set (restart
+        mid-assembly) lost its in-memory unassigned-chip pool, so re-derive
+        it: find a full-size free box CONTAINING the members' chips; if none
+        exists the gang can never complete — roll it back now (members
+        released + queued for eviction), all-or-nothing in death as in
+        birth, rather than letting late members bind as strays."""
+        with self._lock:
+            key = (namespace, group.name)
+            if key in self._reservations or not allocs:
+                return self._reservations.get(key)
+            chips_per_pod = max(1, len(allocs[0].coords))
+            assigned_coords = {c for a in allocs for c in a.coords}
+            committed = len(allocs) >= group.min_member
+            coords = set(assigned_coords)
+            if not committed:
+                coords_or_none = self._recomplete_slice(
+                    group, chips_per_pod, assigned_coords
+                )
+                if coords_or_none is None:
+                    log.warning(
+                        "gang %s/%s: restart found %d/%d members and no "
+                        "completable slice — rolling back", namespace,
+                        group.name, len(allocs), group.min_member,
+                    )
+                    for a in allocs:
+                        self._state.release(a.pod_key)
+                        self._evictions.append(a.pod_key)
+                    self.rollbacks += 1
+                    return None
+                coords = coords_or_none
+            res = GangReservation(
+                group=group,
+                namespace=namespace,
+                coords=coords,
+                chips_per_pod=chips_per_pod,
+                priority=max(a.priority for a in allocs),
+            )
+            for a in allocs:
+                res.assigned[a.pod_key] = list(a.coords)
+            res.committed = committed
+            self._reservations[key] = res
+            log.info(
+                "gang %s/%s restored from pod annotations: %d members, "
+                "committed=%s", namespace, group.name, len(res.assigned),
+                res.committed,
+            )
+            return res
+
+    def _recomplete_slice(
+        self,
+        group: PodGroup,
+        chips_per_pod: int,
+        assigned: set[TopologyCoord],
+    ) -> Optional[set[TopologyCoord]]:
+        """Full-size contiguous box containing ``assigned``, treating the
+        members' own chips as free (they are the gang's). None if the mesh
+        is unknown or no such box exists."""
+        mesh = self._state.mesh
+        if mesh is None:
+            return None
+        total = group.min_member * chips_per_pod
+        shape = group.shape
+        if shape is not None and shape[0] * shape[1] * shape[2] != total:
+            shape = None  # malformed hint: fall back to count search
+        occupied = (
+            self._state.occupied_coords() | self.reserved_coords()
+        ) - assigned
+        grid = slicefit.occupancy_grid(mesh, occupied)
+        best: Optional[tuple] = None
+        for sb in slicefit.iter_free_boxes(
+            mesh, grid,
+            count=total if shape is None else None,
+            shape=shape,
+        ):
+            box_set = set(slicefit.box_coords(mesh, sb.box))
+            if assigned <= box_set and (
+                best is None or sb.sort_key < best[0]
+            ):
+                best = (sb.sort_key, box_set)
+        return best[1] if best is not None else None
 
     def reserve_exact(
         self, pod: PodInfo, chips_per_pod: int, coords: list[TopologyCoord]

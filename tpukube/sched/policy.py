@@ -27,7 +27,7 @@ import logging
 from dataclasses import dataclass
 from typing import Optional
 
-from tpukube.core.mesh import Box, MeshSpec, surface
+from tpukube.core.mesh import MeshSpec
 from tpukube.core.types import TopologyCoord
 from tpukube.sched import slicefit
 
@@ -75,31 +75,31 @@ def find_preemption_plan(
         if w.priority >= preemptor_priority:
             blocked |= w.coords
 
+    # Sweep candidate boxes over a grid where only BLOCKED chips count as
+    # occupied — victims' chips look free because evicting them is the plan.
     grid = slicefit.occupancy_grid(mesh, blocked)
-    sweep = slicefit._Sweep(mesh, grid)
-
-    shapes = slicefit._candidate_shapes(
-        mesh, total if shape is None else None, shape
+    candidates = slicefit.iter_free_boxes(
+        mesh, grid,
+        count=total if shape is None else None,
+        shape=shape,
     )
 
     best: Optional[tuple] = None  # (key, coords, victims)
-    for shp in shapes:
-        for origin in sweep.origins(shp):
-            box = Box(TopologyCoord(*(int(v) for v in origin)), shp)
-            coords = slicefit.box_coords(mesh, box)
-            victims = {
-                w.id: w for c in coords for w in owner.get(c, ())
-            }
-            cost = sum(w.cost for w in victims.values())
-            key = (
-                cost,
-                len(victims),
-                surface(shp),
-                -sweep.contact(box),
-                tuple(int(v) for v in origin),
-            )
-            if best is None or key < best[0]:
-                best = (key, coords, [victims[i] for i in sorted(victims)])
+    for sb in candidates:
+        coords = slicefit.box_coords(mesh, sb.box)
+        victims = {
+            w.id: w for c in coords for w in owner.get(c, ())
+        }
+        cost = sum(w.cost for w in victims.values())
+        key = (
+            cost,
+            len(victims),
+            sb.surface,
+            sb.contact,  # already negated: lower = snugger
+            sb.origin_key,
+        )
+        if best is None or key < best[0]:
+            best = (key, coords, [victims[i] for i in sorted(victims)])
     if best is None:
         return None
     key, coords, victims = best

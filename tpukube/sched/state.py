@@ -96,7 +96,6 @@ class ClusterState:
         self._nodes: dict[str, NodeView] = {}
         self._mesh: Optional[MeshSpec] = None
         self._allocs: dict[str, AllocResult] = {}  # pod key -> commitment
-        self._priorities: dict[str, int] = {}  # pod key -> pod priority
 
     # -- node ingestion ----------------------------------------------------
     def upsert_node(self, name: str, annotations: dict[str, str]) -> bool:
@@ -181,15 +180,17 @@ class ClusterState:
             return used / total if total else 0.0
 
     def priority_of(self, pod_key: str) -> int:
-        """Priority recorded at commit time (0 for restart-rebuilt entries —
-        annotations don't carry priority; the preemption sweep then treats
-        them as cheapest, which is the conservative direction for victims)."""
+        """Pod priority as committed (AllocResult carries it, and it is
+        persisted in the alloc annotation, so preemption protection survives
+        an extender restart). 0 for unknown pods."""
         with self._lock:
-            return self._priorities.get(pod_key, 0)
+            alloc = self._allocs.get(pod_key)
+            return alloc.priority if alloc is not None else 0
 
     # -- commit / release --------------------------------------------------
-    def commit(self, alloc: AllocResult, priority: int = 0) -> None:
-        """Record a bind: devices of one pod on one node."""
+    def commit(self, alloc: AllocResult) -> None:
+        """Record a bind: devices of one pod on one node. ``alloc.priority``
+        is the single source of priority truth (no side table to diverge)."""
         with self._lock:
             if alloc.pod_key in self._allocs:
                 raise StateError(f"{alloc.pod_key} already has an allocation")
@@ -217,13 +218,11 @@ class ClusterState:
                 pending_shares[index] = pending_shares.get(index, 0) + want
             view.used_ids |= adding
             self._allocs[alloc.pod_key] = alloc
-            self._priorities[alloc.pod_key] = priority
 
     def release(self, pod_key: str) -> Optional[AllocResult]:
         """Pod gone (deleted/preempted): free its shares."""
         with self._lock:
             alloc = self._allocs.pop(pod_key, None)
-            self._priorities.pop(pod_key, None)
             if alloc is None:
                 return None
             view = self._nodes.get(alloc.node_name)
@@ -232,15 +231,19 @@ class ClusterState:
             return alloc
 
     # -- restart story -----------------------------------------------------
-    def rebuild_from_pods(self, pods: list[dict[str, str]]) -> int:
+    def rebuild_from_pods(
+        self, pods: list[dict[str, str]]
+    ) -> list[AllocResult]:
         """Reconstruct the ledger from pod alloc annotations (each item is
-        one pod's annotation dict). Returns commitments restored."""
-        restored = 0
+        one pod's annotation dict). Returns the restored commitments, so
+        callers building further state (gang restore) reuse the single
+        decode rather than re-parsing annotations."""
+        restored: list[AllocResult] = []
         for annotations in pods:
             payload = annotations.get(codec.ANNO_ALLOC)
             if not payload:
                 continue
             alloc = codec.decode_alloc(payload)
-            self.commit(alloc, priority=alloc.priority)
-            restored += 1
+            self.commit(alloc)
+            restored.append(alloc)
         return restored

@@ -203,7 +203,7 @@ class SimCluster:
         half-assembled gang's running members must not keep their chips).
         On a real cluster an apiserver writer does this."""
         evicted = []
-        q = self.extender.gang.pending_evictions
+        q = self.extender.pending_evictions
         while q:
             pod_key = q.popleft()
             pod = self.pods.pop(pod_key, None)

@@ -1,0 +1,26 @@
+"""Reference JAX workload (the scheduled side of the framework).
+
+The framework proper is control-plane (SURVEY.md §3 scope note): it places
+pods and injects `TPU_KUBE_*` env at Allocate. This package is the other half
+of that contract — a minimal Llama-style JAX training job that consumes the
+injected env to build its `jax.sharding.Mesh`, proving the placement →
+in-pod-parallelism handoff end to end (BASELINE north_star: "gang-scheduled
+JAX pods land on a contiguous slice" whose shape the job then uses).
+"""
+
+from tpukube.workload.llama import LlamaConfig, init_params, forward, loss_fn
+from tpukube.workload.meshenv import PodTpuEnv, mesh_axes_from_box, build_mesh
+from tpukube.workload.train import make_train_step, param_specs, init_sharded
+
+__all__ = [
+    "LlamaConfig",
+    "init_params",
+    "forward",
+    "loss_fn",
+    "PodTpuEnv",
+    "mesh_axes_from_box",
+    "build_mesh",
+    "make_train_step",
+    "param_specs",
+    "init_sharded",
+]

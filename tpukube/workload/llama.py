@@ -1,0 +1,157 @@
+"""Minimal Llama-style decoder in pure JAX (bfloat16, MXU-shaped).
+
+This is the workload the BASELINE scenarios schedule (configs 4-5 name
+"JAX Llama-3-8B/70B" jobs); the framework's job is placing it, and this
+module's job is being a real, shardable training step to place. Design
+choices are TPU-first:
+
+- all FLOPs are einsums over static shapes (MXU-friendly, no dynamic
+  control flow under jit);
+- compute dtype is bfloat16 with float32 params/accumulators;
+- GQA attention + RoPE + SwiGLU, the Llama-3 block structure;
+- no sharding in this file: parallelism is expressed entirely via
+  PartitionSpecs in :mod:`tpukube.workload.train`, so the same code runs
+  single-chip or SPMD over a mesh (GSPMD inserts the collectives).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab: int = 512
+    d_model: int = 128
+    n_layers: int = 2
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    d_ff: int = 256
+    max_seq: int = 128
+    rope_theta: float = 10_000.0
+    norm_eps: float = 1e-5
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        """The real flagship shape (for sizing; tests use tiny configs)."""
+        return LlamaConfig(
+            vocab=128_256, d_model=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, d_ff=14_336, max_seq=8192, rope_theta=500_000.0,
+        )
+
+
+def init_params(rng: jax.Array, cfg: LlamaConfig) -> dict:
+    """float32 param pytree; layers are stacked on a leading axis so the
+    decoder is one lax.scan (one compiled block, layer-count-independent
+    compile time)."""
+    k_embed, k_layers, k_out = jax.random.split(rng, 3)
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(jnp.float32)
+
+    L, D, H, KV, HD, F = (cfg.n_layers, cfg.d_model, cfg.n_heads,
+                          cfg.n_kv_heads, cfg.head_dim, cfg.d_ff)
+    ks = jax.random.split(k_layers, 7)
+
+    def stack(key, shape, fan_in):
+        return dense(key, (L, *shape), fan_in)
+
+    return {
+        "embed": dense(k_embed, (cfg.vocab, D), D),
+        "layers": {
+            "attn_norm": jnp.ones((L, D), jnp.float32),
+            "wq": stack(ks[0], (D, H * HD), D),
+            "wk": stack(ks[1], (D, KV * HD), D),
+            "wv": stack(ks[2], (D, KV * HD), D),
+            "wo": stack(ks[3], (H * HD, D), H * HD),
+            "mlp_norm": jnp.ones((L, D), jnp.float32),
+            "w_gate": stack(ks[4], (D, F), D),
+            "w_up": stack(ks[5], (D, F), D),
+            "w_down": stack(ks[6], (F, D), F),
+        },
+        "final_norm": jnp.ones((D,), jnp.float32),
+        "unembed": dense(k_out, (D, cfg.vocab), D),
+    }
+
+
+def _rmsnorm(x: jax.Array, g: jax.Array, eps: float) -> jax.Array:
+    # norm statistics in f32 regardless of compute dtype
+    h = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(h * h, axis=-1, keepdims=True) + eps)
+    return (h * scale).astype(x.dtype) * g.astype(x.dtype)
+
+
+def _rope(x: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding over (B, S, N, HD)."""
+    _, S, _, HD = x.shape
+    half = HD // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = jnp.arange(S, dtype=jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+def _block(h: jax.Array, layer: dict, cfg: LlamaConfig) -> jax.Array:
+    """One decoder block over activations (B, S, D) in bfloat16."""
+    B, S, D = h.shape
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    x = _rmsnorm(h, layer["attn_norm"], cfg.norm_eps)
+    q = jnp.einsum("bsd,dh->bsh", x, layer["wq"].astype(x.dtype))
+    k = jnp.einsum("bsd,dh->bsh", x, layer["wk"].astype(x.dtype))
+    v = jnp.einsum("bsd,dh->bsh", x, layer["wv"].astype(x.dtype))
+    q = _rope(q.reshape(B, S, H, HD), cfg.rope_theta)
+    k = _rope(k.reshape(B, S, KV, HD), cfg.rope_theta)
+    v = v.reshape(B, S, KV, HD)
+    # GQA: group query heads (g) over kv heads (k)
+    q = q.reshape(B, S, KV, H // KV, HD)
+    logits = jnp.einsum("bskgd,btkd->bkgst", q, k) * (HD ** -0.5)
+    causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
+    logits = jnp.where(causal[None, None, None], logits, -1e9)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(x.dtype)
+    ctx = jnp.einsum("bkgst,btkd->bskgd", probs, v)
+    ctx = ctx.reshape(B, S, H * HD)
+    h = h + jnp.einsum("bsh,hd->bsd", ctx, layer["wo"].astype(x.dtype))
+
+    x = _rmsnorm(h, layer["mlp_norm"], cfg.norm_eps)
+    gate = jnp.einsum("bsd,df->bsf", x, layer["w_gate"].astype(x.dtype))
+    up = jnp.einsum("bsd,df->bsf", x, layer["w_up"].astype(x.dtype))
+    h = h + jnp.einsum(
+        "bsf,fd->bsd", jax.nn.silu(gate) * up, layer["w_down"].astype(x.dtype)
+    )
+    return h
+
+
+def forward(params: dict, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """tokens (B, S) int32 -> logits (B, S, vocab) float32."""
+    h = params["embed"].astype(jnp.bfloat16)[tokens]
+
+    def body(h, layer):
+        return _block(h, layer, cfg), None
+
+    h, _ = jax.lax.scan(body, h, params["layers"])
+    h = _rmsnorm(h, params["final_norm"], cfg.norm_eps)
+    return jnp.einsum(
+        "bsd,dv->bsv", h, params["unembed"].astype(h.dtype)
+    ).astype(jnp.float32)
+
+
+def loss_fn(params: dict, tokens: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """Next-token cross-entropy (shifted), mean over all positions."""
+    logits = forward(params, tokens[:, :-1], cfg)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)

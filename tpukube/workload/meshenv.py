@@ -1,0 +1,133 @@
+"""Allocate-env → jax.sharding.Mesh bridge.
+
+The node agent injects ``TPU_KUBE_CHIP_COORDS`` / ``TPU_KUBE_MESH_DIMS`` /
+``TPU_HBM_LIMIT_BYTES`` at Allocate (tpukube.device.tpu, SURVEY.md §4.3) —
+the TPU analog of the reference's NVIDIA_VISIBLE_DEVICES + /dev/nvidia*
+injection. This module is the consumer side: parse that env and turn the
+gang's ICI-contiguous box into a well-aligned logical (dp, tp) device mesh,
+so the data-parallel axis and the tensor-parallel axis both ride ICI rings
+rather than arbitrary device orderings.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from tpukube.device.tpu import (
+    ENV_HBM_LIMIT,
+    ENV_KUBE_CHIP_COORDS,
+    ENV_KUBE_DEVICE_IDS,
+    ENV_KUBE_HOST,
+    ENV_KUBE_MESH_DIMS,
+    ENV_VISIBLE_DEVICES,
+)
+
+
+@dataclass(frozen=True)
+class PodTpuEnv:
+    """The Allocate contract as seen from inside the container."""
+
+    visible_chips: tuple[int, ...]
+    device_ids: tuple[str, ...]
+    coords: tuple[tuple[int, int, int], ...]
+    mesh_dims: tuple[int, int, int]
+    host: str
+    hbm_limit_bytes: int
+
+    @staticmethod
+    def from_env(env: Optional[dict] = None) -> "PodTpuEnv":
+        e = os.environ if env is None else env
+        try:
+            coords = tuple(
+                tuple(int(v) for v in part.split(","))
+                for part in e[ENV_KUBE_CHIP_COORDS].split(";")
+            )
+            return PodTpuEnv(
+                visible_chips=tuple(
+                    int(v) for v in e[ENV_VISIBLE_DEVICES].split(",")
+                ),
+                device_ids=tuple(e[ENV_KUBE_DEVICE_IDS].split(",")),
+                coords=coords,  # type: ignore[arg-type]
+                mesh_dims=tuple(int(v) for v in e[ENV_KUBE_MESH_DIMS].split(",")),  # type: ignore[arg-type]
+                host=e.get(ENV_KUBE_HOST, ""),
+                hbm_limit_bytes=int(e.get(ENV_HBM_LIMIT, "0")),
+            )
+        except KeyError as k:
+            raise RuntimeError(
+                f"not running under a tpukube allocation: missing env {k}"
+            ) from k
+
+
+def box_shape(coords: Sequence[tuple[int, int, int]]) -> tuple[int, int, int]:
+    """Bounding-box shape of a coord set; raises if the set is not exactly a
+    full axis-aligned box (the gang layer guarantees contiguity — this is the
+    in-pod assertion of that guarantee)."""
+    xs, ys, zs = ({c[a] for c in coords} for a in range(3))
+    shape = (len(xs), len(ys), len(zs))
+    n = shape[0] * shape[1] * shape[2]
+    if n != len(set(coords)):
+        raise ValueError(f"coords are not a full box: {sorted(coords)}")
+    for vals in (xs, ys, zs):
+        lo, hi = min(vals), max(vals)
+        if hi - lo + 1 != len(vals):
+            raise ValueError(f"coords are not contiguous: {sorted(coords)}")
+    return shape
+
+
+def mesh_axes_from_box(
+    shape: tuple[int, int, int], tp: Optional[int] = None
+) -> tuple[int, int]:
+    """Map a physical box shape to logical (dp, tp) sizes.
+
+    Policy: tp should be an ICI-ring-aligned physical axis so tensor-parallel
+    collectives (the latency-critical ones) stay single-hop — pick the
+    largest box axis as tp unless pinned; dp takes the rest. This is the
+    "prefer sub-slices whose shape factors well" note of SURVEY.md §3 made
+    executable.
+    """
+    n = shape[0] * shape[1] * shape[2]
+    if tp is None:
+        tp = max(shape)
+    if tp <= 0 or n % tp:
+        raise ValueError(f"tp={tp} does not divide {n} chips")
+    return n // tp, tp
+
+
+def build_mesh(devices, dp: int, tp: int):
+    """Arrange ``devices`` (e.g. jax.devices()) into a Mesh('dp','tp').
+
+    Import of jax is deferred so the control plane never pays for it.
+    """
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = np.asarray(devices[: dp * tp]).reshape(dp, tp)
+    return Mesh(devs, ("dp", "tp"))
+
+
+def mesh_from_alloc_env(env: Optional[dict] = None, devices=None,
+                        tp: Optional[int] = None):
+    """One-call consumer: env → (Mesh, PodTpuEnv).
+
+    In a real gang each pod contributes its local chips and the sizes come
+    from the gang's box; under the sim/dryrun there is one process, so
+    ``devices`` defaults to all of jax.devices().
+    """
+    import jax
+
+    pe = PodTpuEnv.from_env(env)
+    devs = list(jax.devices()) if devices is None else list(devices)
+    shape = box_shape(pe.coords)
+    n = shape[0] * shape[1] * shape[2]
+    if len(devs) < n:
+        # dryrun case: fewer local devices than gang chips — fold onto what
+        # exists. A caller-pinned tp is still honored (mesh_axes_from_box
+        # raises if it cannot divide the device count — never silently swap
+        # the requested layout for a different one).
+        n = len(devs)
+        dp, tp_ = mesh_axes_from_box((n, 1, 1), tp)
+    else:
+        dp, tp_ = mesh_axes_from_box(shape, tp)
+    return build_mesh(devs, dp, tp_), pe
