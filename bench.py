@@ -1,67 +1,24 @@
 """North-star benchmark: BASELINE config 5 on the sim control plane.
 
-Runs the multi-tenant scenario (128-chip 8x8x2 mesh / 32 hosts: 80 burst
-inference pods, then a 64-pod priority-100 training gang that must preempt
-and land ICI-contiguously, then burst backfill) through the REAL extender
-HTTP stack, and prints one JSON line with the headline metric.
-
-vs_baseline is measured utilization over the BASELINE.json target (>= 95%).
+Delegates to tpukube.sim.scenarios.multi_tenant_northstar — the SAME code
+path the acceptance test (tests/test_config5.py shape) and `tpukube-sim 5`
+run — and prints one JSON line with the headline metric. vs_baseline is
+measured utilization over the BASELINE.json target (>= 95%).
 """
 
 from __future__ import annotations
 
 import json
 import time
-import urllib.request
 
 
 def run() -> dict:
-    from tpukube.core.config import load_config
-    from tpukube.core.types import PodGroup
-    from tpukube.sim import SimCluster
+    from tpukube.sim import scenarios
 
-    cfg = load_config(env={
-        "TPUKUBE_SIM_MESH_DIMS": "8,8,2",
-        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
-    })
     t0 = time.perf_counter()
-    with SimCluster(cfg) as c:
-        for i in range(80):
-            c.schedule(c.make_pod(f"infer-{i}", tpu=1, priority=0))
-        group = PodGroup("llama-70b", min_member=64)
-        for i in range(64):
-            c.schedule(c.make_pod(f"train-{i}", tpu=1, priority=100,
-                                  group=group))
-        # backfill evicted burst load until the cluster refuses
-        fill = 0
-        while True:
-            try:
-                c.schedule(c.make_pod(f"fill-{fill}", tpu=1, priority=0))
-                fill += 1
-            except RuntimeError:
-                break
-        wall = time.perf_counter() - t0
-
-        with urllib.request.urlopen(f"{c.base_url}/metrics", timeout=5) as r:
-            text = r.read().decode()
-        series = {
-            line.split(" ")[0]: float(line.split(" ")[1])
-            for line in text.splitlines()
-            if line and not line.startswith("#")
-        }
-        util = series["tpu_chip_utilization_percent"]
-        return {
-            "metric": "cluster_tpu_utilization_percent",
-            "value": round(util, 2),
-            "unit": "%",
-            "vs_baseline": round(util / 95.0, 4),
-            "gang_p50_s": round(
-                series['gang_schedule_latency_seconds{quantile="0.5"}'], 4
-            ),
-            "preemptions": int(series["tpukube_preemptions_total"]),
-            "sched_wall_s": round(wall, 2),
-            "pods_placed": int(series["tpukube_binds_total"]),
-        }
+    result = scenarios.multi_tenant_northstar(None)
+    result["sched_wall_s"] = round(time.perf_counter() - t0, 2)
+    return result
 
 
 if __name__ == "__main__":

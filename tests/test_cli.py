@@ -1,0 +1,155 @@
+"""C13 CLI: scenario runner, tpukubectl inspection, extender daemon main."""
+
+import io
+import json
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tpukube import cli
+from tpukube.core.config import load_config
+from tpukube.core.types import PodGroup
+from tpukube.sim import SimCluster, scenarios
+
+
+def test_scenarios_one_through_four():
+    r1 = scenarios.run(1)
+    assert r1["scenario"] == 1
+    assert r1["devices"] == ["tpu-0"] or len(r1["devices"]) == 1
+    assert "TPU_VISIBLE_DEVICES" in r1["env_keys"]
+
+    r2 = scenarios.run(2)
+    assert len(r2["placements"]) == 4
+    assert r2["utilization_percent"] == 50.0  # 4 of 8 chips
+
+    r3 = scenarios.run(3)
+    assert r3["shared_one_chip"] is True
+    assert all(p["hbm_limit"] is not None for p in r3["pods"])
+
+    r4 = scenarios.run(4)
+    assert r4["contiguous"] is True
+    assert r4["utilization_percent"] == pytest.approx(100 * 24 / 64)
+
+
+def test_main_sim_prints_one_json_line(capsys):
+    rc = cli.main_sim(["1"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1
+    doc = json.loads(out[0])
+    assert doc["scenario"] == 1
+
+
+@pytest.fixture(scope="module")
+def live_cluster():
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        group = PodGroup("g", min_member=4)
+        for i in range(4):
+            c.schedule(c.make_pod(f"m-{i}", tpu=1, priority=5, group=group))
+        c.schedule(c.make_pod("solo", tpu=1))
+        yield c
+
+
+def _ctl(live_cluster, *argv) -> tuple[int, str]:
+    buf = io.StringIO()
+    real_stdout = sys.stdout
+    sys.stdout = buf
+    try:
+        rc = cli.main_ctl(["--server", live_cluster.base_url, *argv])
+    finally:
+        sys.stdout = real_stdout
+    return rc, buf.getvalue()
+
+
+def test_ctl_topo(live_cluster):
+    rc, out = _ctl(live_cluster, "topo")
+    assert rc == 0
+    assert "util 31.25%" in out  # 5 of 16 chips
+    assert "z=0" in out
+    # 5 allocated chips drawn as '#' in the grid rows (legend excluded)
+    grid_rows = [l for l in out.splitlines() if l.startswith("  ")]
+    assert sum(line.count("#") for line in grid_rows) == 5
+
+
+def test_ctl_alloc_and_gangs(live_cluster):
+    rc, out = _ctl(live_cluster, "alloc")
+    assert rc == 0
+    assert out.count("\n") == 5
+    assert "default/solo" in out
+
+    rc, out = _ctl(live_cluster, "gangs")
+    assert rc == 0
+    assert "default/g" in out
+    assert "committed" in out
+    assert "4/4 bound" in out
+
+    rc, out = _ctl(live_cluster, "--json", "gangs")
+    assert json.loads(out)[0]["group"] == "g"
+
+
+def test_ctl_metrics(live_cluster):
+    rc, out = _ctl(live_cluster, "metrics")
+    assert rc == 0
+    assert "tpu_chip_utilization_percent" in out
+
+
+def test_ctl_replay_roundtrip(live_cluster, tmp_path):
+    events = live_cluster.extender.trace.events()
+    path = tmp_path / "t.jsonl"
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    rc, out = _ctl(live_cluster, "replay", str(path))
+    assert rc == 0
+    assert "0 divergences" in out
+
+    # corrupt one response -> nonzero exit + divergence report
+    events = [dict(e) for e in events]
+    bind = next(e for e in events if e["kind"] == "bind")
+    bind["response"] = {"Error": "tampered"}
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    rc, out = _ctl(live_cluster, "replay", str(path))
+    assert rc == 1
+    assert "divergence at seq" in out
+
+
+def test_extender_daemon_subprocess():
+    """tpukube-extender really serves the webhook API as a daemon."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpukube.cli", "extender",
+         "--host", "127.0.0.1", "--port", str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    try:
+        deadline = time.monotonic() + 15
+        last = None
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=2
+                ) as r:
+                    doc = json.loads(r.read())
+                assert doc["ok"] is True
+                break
+            except Exception as e:  # noqa: BLE001 — retry until deadline
+                last = e
+                time.sleep(0.2)
+        else:
+            pytest.fail(f"extender daemon never came up: {last}")
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)

@@ -1,0 +1,113 @@
+"""C14 deploy manifests: schema sanity + consistency with config defaults.
+
+No cluster exists here (SURVEY.md §9.1); what CAN be verified is that the
+YAML is well-formed Kubernetes shape and that every value that must agree
+with the code (resource names, ports, socket dir, webhook verbs) does.
+"""
+
+import glob
+import os
+
+import yaml
+
+from tpukube.core.config import TpuKubeConfig
+
+DEPLOY = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                      "deploy")
+CFG = TpuKubeConfig()
+
+
+def _docs(name: str) -> list[dict]:
+    with open(os.path.join(DEPLOY, name)) as f:
+        return [d for d in yaml.safe_load_all(f) if d]
+
+
+def _all_docs() -> list[dict]:
+    out = []
+    for path in glob.glob(os.path.join(DEPLOY, "*.yaml")):
+        with open(path) as f:
+            out.extend(d for d in yaml.safe_load_all(f) if d)
+    return out
+
+
+def test_all_manifests_parse_with_kind_and_metadata():
+    docs = _all_docs()
+    assert len(docs) >= 9
+    for doc in docs:
+        assert "kind" in doc and "apiVersion" in doc, doc
+        if doc["kind"] != "KubeSchedulerConfiguration":
+            assert doc["metadata"].get("name"), doc["kind"]
+
+
+def test_daemonset_mounts_kubelet_socket_dir():
+    ds = next(d for d in _docs("device-plugin-daemonset.yaml")
+              if d["kind"] == "DaemonSet")
+    spec = ds["spec"]["template"]["spec"]
+    host_paths = {
+        v["hostPath"]["path"]
+        for v in spec["volumes"] if "hostPath" in v
+    }
+    assert CFG.device_plugin_dir in host_paths
+    c = spec["containers"][0]
+    assert c["command"] == ["tpukube-plugin"]
+    mounts = {m["mountPath"] for m in c["volumeMounts"]}
+    assert CFG.device_plugin_dir in mounts
+    # real backend on TPU nodes
+    env = {e["name"]: e.get("value") for e in c["env"]}
+    assert env.get("TPUKUBE_BACKEND") == "real"
+
+
+def test_extender_service_port_matches_config():
+    docs = _docs("extender-deployment.yaml")
+    svc = next(d for d in docs if d["kind"] == "Service")
+    assert svc["spec"]["ports"][0]["port"] == CFG.extender_port
+    dep = next(d for d in docs if d["kind"] == "Deployment")
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    assert c["command"] == ["tpukube-extender"]
+    assert c["ports"][0]["containerPort"] == CFG.extender_port
+    # single replica: in-memory reservation table (deploy/README.md)
+    assert dep["spec"]["replicas"] == 1
+
+    cm = next(d for d in docs if d["kind"] == "ConfigMap")
+    cfg_doc = yaml.safe_load(cm["data"]["config.yaml"])
+    assert cfg_doc["resource_tpu"] == CFG.resource_tpu
+    assert cfg_doc["resource_vtpu"] == CFG.resource_vtpu
+    # every ConfigMap key must be a real TpuKubeConfig field
+    from dataclasses import fields
+    known = {f.name for f in fields(CFG)}
+    assert set(cfg_doc) <= known
+
+
+def test_scheduler_config_manages_only_tpu_resources():
+    (sched,) = _docs("scheduler-config.yaml")
+    assert sched["kind"] == "KubeSchedulerConfiguration"
+    (ext,) = sched["extenders"]
+    assert ext["filterVerb"] == "filter"
+    assert ext["prioritizeVerb"] == "prioritize"
+    assert ext["bindVerb"] == "bind"
+    assert str(CFG.extender_port) in ext["urlPrefix"]
+    managed = {m["name"] for m in ext["managedResources"]}
+    assert managed == {CFG.resource_tpu, CFG.resource_vtpu}
+    # no nvidia.com/gpu anywhere in the cluster (BASELINE north star)
+    assert "nvidia.com/gpu" not in str(_all_docs())
+
+
+def test_rbac_covers_bindings_and_evictions():
+    docs = _docs("rbac.yaml")
+    roles = {d["metadata"]["name"]: d for d in docs if d["kind"] == "ClusterRole"}
+    ext_rules = roles["tpukube-extender"]["rules"]
+    flat = [(r0, v) for r in ext_rules
+            for r0 in r["resources"] for v in r["verbs"]]
+    assert ("pods/binding", "create") in flat
+    assert ("pods", "delete") in flat      # preemption evictions
+    assert ("nodes", "watch") in flat
+    agent_rules = roles["tpukube-node-agent"]["rules"]
+    flat_a = [(r0, v) for r in agent_rules
+              for r0 in r["resources"] for v in r["verbs"]]
+    assert ("nodes", "patch") in flat_a    # node-topology annotation
+    # every ServiceAccount referenced by a binding exists
+    sas = {d["metadata"]["name"] for d in docs if d["kind"] == "ServiceAccount"}
+    for d in docs:
+        if d["kind"] == "ClusterRoleBinding":
+            for s in d["subjects"]:
+                assert s["name"] in sas

@@ -103,3 +103,25 @@ def test_native_selftest_binary_passes():
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "all checks passed" in proc.stdout
+
+
+def test_native_selftest_under_asan_ubsan():
+    """SURVEY.md §6 race/sanitizer story: the C++ enumeration layer must be
+    clean under AddressSanitizer + UBSan (hbmguard interposes malloc and is
+    exercised sanitizer-free by `make selftest` instead — the two allocator
+    layers cannot coexist in one process)."""
+    import os
+
+    native_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tpukube",
+        "native",
+    )
+    proc = subprocess.run(
+        ["make", "-C", native_dir, "asan"],
+        capture_output=True,
+        text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "all checks passed" in proc.stdout
+    assert "runtime error" not in proc.stderr  # UBSan reports go to stderr

@@ -1,0 +1,126 @@
+"""Decision trace + replay (SURVEY.md §6 tracing) and /state endpoints."""
+
+import json
+import urllib.request
+
+import pytest
+
+from tpukube import trace as trace_mod
+from tpukube.core.config import load_config
+from tpukube.core.types import PodGroup
+from tpukube.sim import SimCluster
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture(scope="module")
+def traced_cluster(tmp_path_factory):
+    """One scheduling session — mixed plain pods, a gang, a delete —
+    recorded to both the ring and a JSONL sink."""
+    path = str(tmp_path_factory.mktemp("trace") / "decisions.jsonl")
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        "TPUKUBE_TRACE_PATH": path,
+    })
+    with SimCluster(cfg) as c:
+        for i in range(3):
+            c.schedule(c.make_pod(f"plain-{i}", tpu=1))
+        c.delete_pod("plain-1")
+        group = PodGroup("g1", min_member=4)
+        for i in range(4):
+            c.schedule(c.make_pod(f"gang-{i}", tpu=1, priority=10, group=group))
+        yield c, cfg, path
+
+
+def test_trace_records_protocol_stream(traced_cluster):
+    c, _, _ = traced_cluster
+    events = c.extender.trace.events()
+    kinds = [e["kind"] for e in events]
+    # 7 scheduled pods -> at least 7 of each webhook; 1 release
+    assert kinds.count("filter") >= 7
+    assert kinds.count("prioritize") >= 7
+    assert kinds.count("bind") >= 7
+    assert kinds.count("release") == 1
+    assert [e["seq"] for e in events] == list(range(1, len(events) + 1))
+    # requests/responses are the verbatim webhook JSON
+    first_bind = next(e for e in events if e["kind"] == "bind")
+    assert "PodName" in first_bind["request"]
+    assert first_bind["response"]["Error"] == ""
+
+
+def test_replay_reproduces_decisions(traced_cluster):
+    c, cfg, _ = traced_cluster
+    divergences = trace_mod.replay(c.extender.trace.events(), config=cfg)
+    assert divergences == []
+
+
+def test_replay_detects_divergence(traced_cluster):
+    c, cfg, _ = traced_cluster
+    events = [dict(e) for e in c.extender.trace.events()]
+    victim = next(e for e in events if e["kind"] == "bind")
+    victim["response"] = dict(victim["response"],
+                              Annotations={"tpu.qiniu.com/alloc": "{}"})
+    divergences = trace_mod.replay(events, config=cfg)
+    assert len(divergences) == 1
+    assert divergences[0].kind == "bind"
+    assert divergences[0].seq == victim["seq"]
+    assert "divergence at seq" in str(divergences[0])
+
+
+def test_jsonl_sink_round_trips(traced_cluster):
+    c, cfg, path = traced_cluster
+    loaded = trace_mod.load(path)
+    live = c.extender.trace.events()
+    assert [e["seq"] for e in loaded] == [e["seq"] for e in live]
+    assert trace_mod.replay(loaded, config=cfg) == []
+
+
+def test_state_endpoints(traced_cluster):
+    c, _, _ = traced_cluster
+    topo = _get(f"{c.base_url}/state/topology")
+    assert topo["mesh_dims"] == [4, 4, 1]
+    assert topo["chips_total"] == 16
+    # 2 plain survivors + 4 gang members
+    assert topo["chips_allocated"] == 6
+    statuses = {
+        ch["status"] for n in topo["nodes"] for ch in n["chips"]
+    }
+    assert statuses == {"allocated", "free"}
+
+    allocs = _get(f"{c.base_url}/state/allocs")
+    assert len(allocs) == 6
+    assert all(a["devices"] for a in allocs)
+    assert not any(a["pod"].endswith("plain-1") for a in allocs)
+
+    gangs = _get(f"{c.base_url}/state/gangs")
+    assert len(gangs) == 1
+    assert gangs[0]["group"] == "g1"
+    assert gangs[0]["committed"] is True
+    assert gangs[0]["members_bound"] == 4
+    assert len(gangs[0]["coords"]) == 4
+
+
+def test_trace_endpoint_incremental(traced_cluster):
+    c, _, _ = traced_cluster
+    all_events = _get(f"{c.base_url}/trace")
+    assert [e["seq"] for e in all_events] == [
+        e["seq"] for e in c.extender.trace.events()
+    ]
+    mid = all_events[len(all_events) // 2]["seq"]
+    later = _get(f"{c.base_url}/trace?since={mid}")
+    assert [e["seq"] for e in later] == [
+        e["seq"] for e in all_events if e["seq"] > mid
+    ]
+
+
+def test_trace_ring_bounded():
+    t = trace_mod.DecisionTrace(capacity=4)
+    for i in range(10):
+        t.record("release", {"pod_key": f"ns/p{i}"}, None)
+    evs = t.events()
+    assert len(evs) == 4
+    assert evs[-1]["seq"] == 10

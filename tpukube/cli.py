@@ -1,0 +1,274 @@
+"""Console entry points (SURVEY.md §3 C13 — the reference's ``cmd/``).
+
+The reference ships daemon mains (device-plugin, extender) plus flag
+parsing; forks add inspection tooling. Here:
+
+  tpukube-plugin    node agent: device discovery, kubelet registration,
+                    ListAndWatch/Allocate gRPC service, health watch,
+                    /metrics, node-topology annotation emission
+  tpukube-extender  scheduler extender HTTP daemon (filter/prioritize/bind
+                    + /metrics + /state/* + /trace)
+  tpukube-sim       run a BASELINE config scenario against the real stack
+                    and print its metrics as one JSON line
+  tpukubectl        inspect a live extender: topo / alloc / gangs /
+                    metrics, and offline trace replay
+
+All commands take ``--config <yaml>`` (same schema as TpuKubeConfig) and
+honor TPUKUBE_* env overrides, mirroring the reference's flag+config-file
+pattern (SURVEY.md §6 config system).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import sys
+import threading
+import urllib.request
+from typing import Any, Optional
+
+from tpukube.core.config import TpuKubeConfig, load_config
+
+log = logging.getLogger("tpukube.cli")
+
+
+def _base_parser(prog: str, description: str) -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog=prog, description=description)
+    p.add_argument("--config", metavar="YAML", default=None,
+                   help="config file (TpuKubeConfig schema); TPUKUBE_* env wins")
+    p.add_argument("-v", "--verbose", action="count", default=0,
+                   help="-v info, -vv debug (glog-style leveled logging)")
+    return p
+
+
+def _setup(args: argparse.Namespace) -> TpuKubeConfig:
+    level = (logging.WARNING, logging.INFO, logging.DEBUG)[min(args.verbose, 2)]
+    logging.basicConfig(
+        level=level,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+    )
+    return load_config(yaml_path=args.config)
+
+
+def _wait_forever() -> None:
+    """Block the main thread until SIGINT/SIGTERM."""
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+
+
+# -- tpukube-plugin ----------------------------------------------------------
+
+def main_plugin(argv: Optional[list[str]] = None) -> int:
+    p = _base_parser("tpukube-plugin", "TPU node agent / device plugin daemon")
+    p.add_argument("--socket", default=None,
+                   help="override plugin unix socket path")
+    p.add_argument("--no-register", action="store_true",
+                   help="serve without dialing the kubelet (sim/debug)")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="serve /metrics on this port (0 = ephemeral)")
+    p.add_argument("--annotation-out", metavar="FILE", default="-",
+                   help="write the node-topology annotation JSON here "
+                        "('-' = stdout); an apiserver syncer applies it")
+    args = p.parse_args(argv)
+    cfg = _setup(args)
+
+    from tpukube.core import codec
+    from tpukube.device.tpu import TpuDeviceManager
+    from tpukube.metrics import MetricsServer, render_plugin_metrics
+    from tpukube.plugin.server import DevicePluginServer, HealthWatcher
+
+    with TpuDeviceManager(cfg) as device:
+        server = DevicePluginServer(cfg, device, socket_path=args.socket)
+        server.start()
+        watcher = HealthWatcher(device, server)
+        watcher.start()
+        metrics = MetricsServer(lambda: render_plugin_metrics(server),
+                                port=args.metrics_port)
+        metrics.start()
+
+        # the reference's "write NodeInfo annotation to apiserver" step
+        # (SURVEY.md §4.1): no apiserver in this environment, so emit the
+        # annotation for an external writer / the sim harness
+        anno = codec.annotate_node(device.node_info(), device.mesh)
+        payload = json.dumps(anno)
+        if args.annotation_out == "-":
+            print(payload, flush=True)
+        else:
+            with open(args.annotation_out, "w") as f:
+                f.write(payload + "\n")
+
+        if not args.no_register:
+            server.register_with_kubelet()
+        log.warning(
+            "plugin serving %s on %s (metrics :%d)",
+            server.resource_name, server.socket_path, metrics.port,
+        )
+        try:
+            _wait_forever()
+        finally:
+            watcher.stop()
+            metrics.stop()
+            server.stop()
+    return 0
+
+
+# -- tpukube-extender --------------------------------------------------------
+
+def main_extender(argv: Optional[list[str]] = None) -> int:
+    p = _base_parser("tpukube-extender", "scheduler extender HTTP daemon")
+    p.add_argument("--host", default=None, help="override extender_host")
+    p.add_argument("--port", type=int, default=None, help="override extender_port")
+    args = p.parse_args(argv)
+    cfg = _setup(args)
+
+    from aiohttp import web
+
+    from tpukube.sched.extender import Extender, make_app
+
+    host = args.host or cfg.extender_host
+    port = args.port if args.port is not None else cfg.extender_port
+    extender = Extender(cfg)
+    log.warning("extender serving on %s:%d (score_mode=%s)",
+                host, port, cfg.score_mode)
+    web.run_app(make_app(extender), host=host, port=port,
+                print=None, handle_signals=True)
+    return 0
+
+
+# -- tpukube-sim -------------------------------------------------------------
+
+def main_sim(argv: Optional[list[str]] = None) -> int:
+    p = _base_parser(
+        "tpukube-sim",
+        "run a BASELINE config scenario against the real control-plane stack",
+    )
+    p.add_argument("scenario", type=int, choices=range(1, 6),
+                   help="BASELINE config number (1..5)")
+    args = p.parse_args(argv)
+    _setup(args)
+
+    from tpukube.sim import scenarios
+
+    result = scenarios.run(args.scenario)
+    print(json.dumps(result))
+    return 0
+
+
+# -- tpukubectl --------------------------------------------------------------
+
+def _fetch(server: str, path: str) -> Any:
+    with urllib.request.urlopen(f"{server}{path}", timeout=10) as r:
+        body = r.read()
+    if path == "/metrics":
+        return body.decode()
+    return json.loads(body)
+
+
+def _render_topo(topo: dict[str, Any], out) -> None:
+    """ASCII mesh occupancy map: one grid per z-plane, one cell per chip."""
+    glyph = {"free": ".", "allocated": "#", "reserved": "+", "unhealthy": "X"}
+    print(
+        f"mesh {topo['mesh_dims']}  util {topo['utilization_percent']}%  "
+        f"alloc {topo['chips_allocated']}/{topo['chips_total']}  "
+        f"reserved {topo['chips_reserved_unbound']}  "
+        f"unhealthy {topo['chips_unhealthy']}",
+        file=out,
+    )
+    if not topo["mesh_dims"]:
+        return
+    dx, dy, dz = topo["mesh_dims"]
+    grid = {}
+    for node in topo["nodes"]:
+        for chip in node["chips"]:
+            x, y, z = chip["coord"]
+            grid[(x, y, z)] = glyph.get(chip["status"], "?")
+    for z in range(dz):
+        print(f"z={z}  ({glyph['free']} free {glyph['allocated']} alloc "
+              f"{glyph['reserved']} reserved {glyph['unhealthy']} unhealthy)",
+              file=out)
+        for y in range(dy):
+            print("  " + " ".join(grid.get((x, y, z), " ")
+                                  for x in range(dx)), file=out)
+
+
+def main_ctl(argv: Optional[list[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tpukubectl",
+        description="inspect a live tpukube extender / replay decision traces",
+    )
+    p.add_argument("--server", default="http://127.0.0.1:12345",
+                   help="extender base URL")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="raw JSON output")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("topo", help="cluster topology + occupancy map")
+    sub.add_parser("alloc", help="committed allocations")
+    sub.add_parser("gangs", help="live gang reservations")
+    sub.add_parser("metrics", help="prometheus metrics dump")
+    rp = sub.add_parser("replay", help="replay a JSONL decision trace and "
+                                       "report determinism divergences")
+    rp.add_argument("trace_file")
+    rp.add_argument("--config", default=None,
+                    help="config YAML for the scratch scheduler")
+    args = p.parse_args(argv)
+
+    if args.cmd == "replay":
+        from tpukube import trace as trace_mod
+
+        cfg = load_config(yaml_path=args.config)
+        events = trace_mod.load(args.trace_file)
+        divergences = trace_mod.replay(events, config=cfg)
+        if not divergences:
+            print(f"replay ok: {len(events)} events, 0 divergences")
+            return 0
+        for d in divergences:
+            print(d)
+        return 1
+
+    data = _fetch(args.server, {
+        "topo": "/state/topology",
+        "alloc": "/state/allocs",
+        "gangs": "/state/gangs",
+        "metrics": "/metrics",
+    }[args.cmd])
+    if args.cmd == "metrics":
+        sys.stdout.write(data)
+        return 0
+    if args.as_json:
+        print(json.dumps(data, indent=2))
+        return 0
+    if args.cmd == "topo":
+        _render_topo(data, sys.stdout)
+    elif args.cmd == "alloc":
+        if not data:
+            print("no allocations")
+        for a in data:
+            print(f"{a['pod']:40s} {a['node']:16s} prio={a['priority']:<4d} "
+                  f"{','.join(a['devices'])}")
+    elif args.cmd == "gangs":
+        if not data:
+            print("no gang reservations")
+        for g in data:
+            state = "committed" if g["committed"] else "assembling"
+            print(f"{g['namespace']}/{g['group']:24s} {state:10s} "
+                  f"{g['members_bound']}/{g['min_member']} bound "
+                  f"prio={g['priority']} chips={len(g['coords'])}")
+    return 0
+
+
+if __name__ == "__main__":  # python -m tpukube.cli <tool> ...
+    tools = {
+        "plugin": main_plugin,
+        "extender": main_extender,
+        "sim": main_sim,
+        "ctl": main_ctl,
+    }
+    if len(sys.argv) < 2 or sys.argv[1] not in tools:
+        print(f"usage: python -m tpukube.cli {{{'|'.join(tools)}}} ...",
+              file=sys.stderr)
+        raise SystemExit(2)
+    raise SystemExit(tools[sys.argv[1]](sys.argv[2:]))

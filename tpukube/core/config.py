@@ -39,6 +39,13 @@ class TpuKubeConfig:
     extender_port: int = 12345
     score_mode: str = "topology"  # topology | binpack | spread
     reservation_ttl_seconds: float = 30.0
+    # decision trace (SURVEY.md §6): in-memory ring size (0 disables) and
+    # optional JSONL sink for post-mortem replay (tpukubectl replay).
+    # Events retain verbatim webhook bodies (the full node list), so the
+    # default ring is kept small; raise it (or set trace_path) on clusters
+    # where post-mortem replay depth matters more than extender RSS.
+    trace_capacity: int = 4096
+    trace_path: str = ""
 
     # sim topology (used when backend == "sim")
     backend: str = "sim"  # sim | real

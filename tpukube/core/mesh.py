@@ -92,10 +92,20 @@ class MeshSpec:
     # -- host partition ----------------------------------------------------
     def host_of(self, c: TopologyCoord) -> str:
         """Stable host name owning coordinate ``c`` ("host-i-j-k")."""
-        if not self.contains(c):
-            raise ValueError(f"coord {c} outside mesh {self.dims}")
-        i, j, k = (v // h for v, h in zip(c, self.host_block))
-        return f"host-{i}-{j}-{k}"
+        # memoized: the scheduler asks this for every reservation coord on
+        # every node of every webhook (hot; the cache lives in __dict__ and
+        # is invisible to the frozen dataclass' eq/hash)
+        cache = self.__dict__.get("_host_of_cache")
+        if cache is None:
+            cache = {}
+            object.__setattr__(self, "_host_of_cache", cache)
+        h = cache.get(c)
+        if h is None:
+            if not self.contains(c):
+                raise ValueError(f"coord {c} outside mesh {self.dims}")
+            i, j, k = (v // b for v, b in zip(c, self.host_block))
+            h = cache[c] = f"host-{i}-{j}-{k}"
+        return h
 
     def host_origin(self, host: str) -> TopologyCoord:
         try:

@@ -25,6 +25,7 @@ import time
 from collections import deque
 from typing import Any, Optional
 
+import numpy as np
 from aiohttp import web
 
 from tpukube.core import codec
@@ -45,6 +46,7 @@ from tpukube.sched.gang import (
     NoSliceError,
 )
 from tpukube.sched.state import ClusterState, NodeView, StateError
+from tpukube.trace import DecisionTrace
 
 log = logging.getLogger("tpukube.extender")
 
@@ -63,9 +65,22 @@ class Extender:
     PENDING_TTL_S = 600.0
     LATENCY_WINDOW = 4096
 
-    def __init__(self, config: TpuKubeConfig, state: Optional[ClusterState] = None):
+    def __init__(
+        self,
+        config: TpuKubeConfig,
+        state: Optional[ClusterState] = None,
+        trace: Optional["DecisionTrace"] = None,
+    ):
         self._config = config
         self.state = state or ClusterState()
+        # decision trace (SURVEY.md §6 tracing): make_app records at the
+        # HTTP boundary, release() records inline; trace_capacity=0 disables
+        if trace is None and config.trace_capacity > 0:
+            trace = DecisionTrace(
+                capacity=config.trace_capacity,
+                path=config.trace_path or None,
+            )
+        self.trace = trace
         # Cluster-wide eviction bus: pods whose chips were taken back
         # (gang rollback/dissolve, preemption) and must be deleted by the
         # pod-lifecycle owner (sim harness / apiserver writer).
@@ -381,8 +396,7 @@ class Extender:
         contact = 0
         max_contact = 0
         for coord in plan:
-            box = slicefit.Box(coord, (1, 1, 1))
-            contact += sweep.contact(box)
+            contact += sweep.contact_point(coord)
             max_contact += 6
         return round(MAX_SCORE * contact / max_contact) if max_contact else 0
 
@@ -432,7 +446,12 @@ class Extender:
         }
         if len(node_free) < count:
             return None
-        mask = {c for c in mesh.all_coords() if c not in node_free}
+        # everything outside this node's free set is masked occupied; built
+        # directly as a grid — a whole-mesh Python set here was the hottest
+        # line of /prioritize (this runs per node per webhook)
+        mask = np.ones(mesh.dims, dtype=bool)
+        for c in node_free:
+            mask[tuple(c)] = False
         placed = slicefit.find_slice(mesh, mask, count=count, allow_irregular=True)
         if placed is not None:
             return placed
@@ -539,10 +558,78 @@ class Extender:
 
     # -- pod lifecycle ------------------------------------------------------
     def release(self, pod_key: str) -> None:
+        if self.trace is not None:
+            self.trace.record("release", {"pod_key": pod_key}, None)
         self.state.release(pod_key)
         self.gang.on_release(pod_key)
         with self._pending_lock:
             self._pending.pop(pod_key, None)
+
+    # -- inspection (tpukubectl + /state endpoints) --------------------------
+    def topology_snapshot(self) -> dict[str, Any]:
+        """Cluster topology + occupancy as plain JSON (for tpukubectl topo)."""
+        mesh = self.state.mesh
+        occupied = self.state.occupied_coords()
+        reserved = self.gang.reserved_coords()
+        unhealthy = self.state.unhealthy_coords()
+        nodes = []
+        for name in self.state.node_names():
+            view = self.state.node(name)
+            if view is None:
+                continue
+            chips = []
+            for chip in view.info.chips:
+                status = (
+                    "unhealthy" if chip.coord in unhealthy
+                    else "allocated" if chip.coord in occupied
+                    else "reserved" if chip.coord in reserved
+                    else "free"
+                )
+                chips.append({
+                    "index": chip.index,
+                    "coord": list(chip.coord),
+                    "status": status,
+                    "used_shares": view.used_share_count(chip.index),
+                    "shares": view.shares_per_chip,
+                })
+            nodes.append({"name": name, "chips": chips})
+        return {
+            "mesh_dims": list(mesh.dims) if mesh else None,
+            "utilization_percent": round(100.0 * self.state.utilization(), 2),
+            "chips_total": sum(len(n["chips"]) for n in nodes),
+            "chips_allocated": len(occupied),
+            "chips_reserved_unbound": len(reserved - occupied),
+            "chips_unhealthy": len(unhealthy),
+            "nodes": nodes,
+        }
+
+    def alloc_snapshot(self) -> list[dict[str, Any]]:
+        """Committed allocations as plain JSON (for tpukubectl alloc)."""
+        return [
+            {
+                "pod": a.pod_key,
+                "node": a.node_name,
+                "devices": list(a.device_ids),
+                "coords": [list(c) for c in a.coords],
+                "priority": a.priority,
+            }
+            for a in sorted(self.state.allocations(), key=lambda a: a.pod_key)
+        ]
+
+    def gang_snapshot(self) -> list[dict[str, Any]]:
+        """Live gang reservations as plain JSON (for tpukubectl gangs)."""
+        out = []
+        for res in self.gang.snapshot():
+            out.append({
+                "namespace": res.namespace,
+                "group": res.group.name,
+                "min_member": res.group.min_member,
+                "members_bound": len(res.assigned),
+                "committed": res.committed,
+                "priority": res.priority,
+                "coords": [list(c) for c in sorted(res.coords)],
+            })
+        return sorted(out, key=lambda g: (g["namespace"], g["group"]))
 
     # -- restart story (SURVEY.md §6 checkpoint/resume) ----------------------
     def rebuild_from_pods(self, pods: list[dict[str, str]]) -> int:
@@ -555,15 +642,8 @@ class Extender:
         annotations persist gang identity, so rebuild it here.
         """
         restored = self.state.rebuild_from_pods(pods)
-        # restored is ordered 1:1 with the pods that carried an alloc
-        # annotation (rebuild_from_pods' contract) — single decode, no
-        # re-parse here.
-        it = iter(restored)
         members: dict[tuple[str, str], list] = {}  # (ns, group) -> [(alloc, group)]
-        for annotations in pods:
-            if not annotations.get(codec.ANNO_ALLOC):
-                continue
-            alloc = next(it)
+        for annotations, alloc in restored:
             group = codec.pod_group_from_annotations(annotations)
             if group is None:
                 continue
@@ -586,6 +666,11 @@ def make_app(extender: Extender) -> web.Application:
         except json.JSONDecodeError as e:
             raise web.HTTPBadRequest(text=f"bad JSON: {e}")
 
+    def _traced(kind: str, body: Any, response: Any) -> web.Response:
+        if extender.trace is not None:
+            extender.trace.record(kind, body, response)
+        return web.json_response(response)
+
     async def filter_handler(request: web.Request) -> web.Response:
         body = await _json(request)
         try:
@@ -594,9 +679,10 @@ def make_app(extender: Extender) -> web.Application:
             raise web.HTTPBadRequest(text=str(e))
         try:
             feasible, failed = extender.filter(pod, nodes)
-            return web.json_response(kube.filter_result(feasible, failed))
+            result = kube.filter_result(feasible, failed)
         except (ExtenderError, GangError, StateError, codec.CodecError) as e:
-            return web.json_response(kube.filter_result([], {}, error=str(e)))
+            result = kube.filter_result([], {}, error=str(e))
+        return _traced("filter", body, result)
 
     async def prioritize_handler(request: web.Request) -> web.Response:
         body = await _json(request)
@@ -609,7 +695,7 @@ def make_app(extender: Extender) -> web.Application:
         except (ExtenderError, GangError, StateError, codec.CodecError) as e:
             log.warning("prioritize failed: %s", e)
             scores = {}
-        return web.json_response(kube.host_priority_list(scores))
+        return _traced("prioritize", body, kube.host_priority_list(scores))
 
     async def bind_handler(request: web.Request) -> web.Response:
         body = await _json(request)
@@ -619,12 +705,12 @@ def make_app(extender: Extender) -> web.Application:
             raise web.HTTPBadRequest(text=str(e))
         try:
             alloc = extender.bind(name, ns, uid, node)
+            # the alloc annotation rides back to the harness/apiserver-writer
+            result = kube.binding_result()
+            result["Annotations"] = {codec.ANNO_ALLOC: codec.encode_alloc(alloc)}
         except (ExtenderError, GangError, StateError, codec.CodecError) as e:
-            return web.json_response(kube.binding_result(str(e)))
-        # the alloc annotation rides back to the harness/apiserver-writer
-        result = kube.binding_result()
-        result["Annotations"] = {codec.ANNO_ALLOC: codec.encode_alloc(alloc)}
-        return web.json_response(result)
+            result = kube.binding_result(str(e))
+        return _traced("bind", body, result)
 
     async def healthz(request: web.Request) -> web.Response:
         return web.json_response({"ok": True, "nodes": extender.state.node_names()})
@@ -637,9 +723,28 @@ def make_app(extender: Extender) -> web.Application:
             content_type="text/plain",
         )
 
+    async def state_topology(request: web.Request) -> web.Response:
+        return web.json_response(extender.topology_snapshot())
+
+    async def state_allocs(request: web.Request) -> web.Response:
+        return web.json_response(extender.alloc_snapshot())
+
+    async def state_gangs(request: web.Request) -> web.Response:
+        return web.json_response(extender.gang_snapshot())
+
+    async def trace_handler(request: web.Request) -> web.Response:
+        if extender.trace is None:
+            raise web.HTTPNotFound(text="tracing disabled (set trace_capacity)")
+        since = int(request.query.get("since", 0))
+        return web.json_response(extender.trace.events(since_seq=since))
+
     app.router.add_post("/filter", filter_handler)
     app.router.add_post("/prioritize", prioritize_handler)
     app.router.add_post("/bind", bind_handler)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)
+    app.router.add_get("/state/topology", state_topology)
+    app.router.add_get("/state/allocs", state_allocs)
+    app.router.add_get("/state/gangs", state_gangs)
+    app.router.add_get("/trace", trace_handler)
     return app

@@ -41,6 +41,10 @@ class NodeView:
 
     info: NodeInfo
     used_ids: set[str] = field(default_factory=set)
+    # verbatim annotation payload this view was decoded from; upsert_node
+    # skips re-decoding when a webhook carries the identical string (hot:
+    # every /filter and /prioritize re-sends every node's annotations)
+    raw_payload: str = ""
 
     @property
     def shares_per_chip(self) -> int:
@@ -101,6 +105,13 @@ class ClusterState:
     def upsert_node(self, name: str, annotations: dict[str, str]) -> bool:
         """Decode and store a node's topology annotation. Returns False when
         the node carries no tpukube annotation (not ours to manage)."""
+        payload = annotations.get(codec.ANNO_NODE_TOPOLOGY)
+        if payload is None:
+            return False
+        with self._lock:
+            prev = self._nodes.get(name)
+            if prev is not None and prev.raw_payload == payload:
+                return True  # unchanged annotation: keep the decoded view
         decoded = codec.node_from_annotations(name, annotations)
         if decoded is None:
             return False
@@ -114,7 +125,7 @@ class ClusterState:
                     f"{self._mesh.dims} — mixed-mesh clusters unsupported"
                 )
             prev = self._nodes.get(name)
-            view = NodeView(info=info)
+            view = NodeView(info=info, raw_payload=payload)
             if prev is not None:
                 view.used_ids = prev.used_ids
             self._nodes[name] = view
@@ -233,17 +244,18 @@ class ClusterState:
     # -- restart story -----------------------------------------------------
     def rebuild_from_pods(
         self, pods: list[dict[str, str]]
-    ) -> list[AllocResult]:
+    ) -> list[tuple[dict[str, str], AllocResult]]:
         """Reconstruct the ledger from pod alloc annotations (each item is
-        one pod's annotation dict). Returns the restored commitments, so
-        callers building further state (gang restore) reuse the single
-        decode rather than re-parsing annotations."""
-        restored: list[AllocResult] = []
+        one pod's annotation dict). Returns (annotations, alloc) pairs so
+        callers building further state (gang restore) keep the association
+        structurally — positional re-pairing against the input would break
+        silently the day this method skips one more pod."""
+        restored: list[tuple[dict[str, str], AllocResult]] = []
         for annotations in pods:
             payload = annotations.get(codec.ANNO_ALLOC)
             if not payload:
                 continue
             alloc = codec.decode_alloc(payload)
             self.commit(alloc)
-            restored.append(alloc)
+            restored.append((annotations, alloc))
         return restored

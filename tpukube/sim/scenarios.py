@@ -1,0 +1,172 @@
+"""Runnable BASELINE scenarios for the tpukube-sim CLI.
+
+Each scenario replays one BASELINE.json config against the real stack
+(extender over HTTP; configs 1-2 additionally walk the device-plugin gRPC
+path) and returns a JSON-able result. The pytest configs
+(tests/test_config*.py) are the asserting versions; these are the
+operator-facing ones — same shapes, metrics out instead of asserts.
+"""
+
+from __future__ import annotations
+
+import time
+import urllib.request
+from typing import Any
+
+from tpukube.core.config import TpuKubeConfig, load_config
+from tpukube.core.types import PodGroup
+from tpukube.sim.harness import SimCluster
+
+
+def run(scenario: int, config: TpuKubeConfig | None = None) -> dict[str, Any]:
+    fn = {
+        1: smoke_single_pod,
+        2: dp_fanout,
+        3: fractional_vtpu,
+        4: gang_16,
+        5: multi_tenant_northstar,
+    }[scenario]
+    t0 = time.perf_counter()
+    result = fn(config)
+    result.setdefault("wall_s", round(time.perf_counter() - t0, 3))
+    result["scenario"] = scenario
+    return result
+
+
+def _metrics(c: SimCluster) -> dict[str, float]:
+    with urllib.request.urlopen(f"{c.base_url}/metrics", timeout=5) as r:
+        text = r.read().decode()
+    return {
+        line.split(" ")[0]: float(line.split(" ")[1])
+        for line in text.splitlines()
+        if line and not line.startswith("#")
+    }
+
+
+def smoke_single_pod(config: TpuKubeConfig | None) -> dict[str, Any]:
+    """Config 1: one pod, one chip, full schedule + Allocate walk."""
+    cfg = config or load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        node, alloc = c.schedule(c.make_pod("smoke", tpu=1))
+        env = c.execute_allocation(alloc)
+        return {
+            "metric": "allocate_smoke",
+            "node": node,
+            "devices": alloc.device_ids,
+            "env_keys": sorted(env),
+            "utilization_percent": round(100 * c.utilization(), 2),
+        }
+
+
+def dp_fanout(config: TpuKubeConfig | None) -> dict[str, Any]:
+    """Config 2: 4-pod data-parallel job, 1 chip per pod, no topology hint."""
+    cfg = config or load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        placements = {}
+        for i in range(4):
+            node, alloc = c.schedule(c.make_pod(f"resnet-{i}", tpu=1))
+            c.execute_allocation(alloc)
+            placements[f"resnet-{i}"] = node
+        return {
+            "metric": "dp_fanout",
+            "placements": placements,
+            "utilization_percent": round(100 * c.utilization(), 2),
+        }
+
+
+def fractional_vtpu(config: TpuKubeConfig | None) -> dict[str, Any]:
+    """Config 3: two inference pods share one chip via vTPU shares."""
+    cfg = config or load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,1,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,1,1",
+        "TPUKUBE_SHARES_PER_CHIP": "2",
+    })
+    with SimCluster(cfg, vtpu_nodes={"host-0-0-0"},
+                    vtpu_shares=cfg.shares_per_chip) as c:
+        results = []
+        for i in range(2):
+            node, alloc = c.schedule(c.make_pod(f"infer-{i}", vtpu=1))
+            env = c.execute_allocation(alloc)
+            results.append({
+                "pod": f"infer-{i}",
+                "devices": alloc.device_ids,
+                "hbm_limit": env.get("TPU_HBM_LIMIT_BYTES"),
+            })
+        chips = {r["devices"][0].split("-frac")[0] for r in results}
+        return {
+            "metric": "fractional_vtpu",
+            "pods": results,
+            "shared_one_chip": len(chips) == 1,
+        }
+
+
+def gang_16(config: TpuKubeConfig | None) -> dict[str, Any]:
+    """Config 4: 16-pod gang onto a contiguous box of a 64-chip mesh."""
+    cfg = config or load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,4",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        for i in range(2):
+            c.schedule(c.make_pod(f"bg-{i}", tpu=4))
+        group = PodGroup("llama-8b", min_member=16)
+        coords = []
+        for i in range(16):
+            _, alloc = c.schedule(
+                c.make_pod(f"llama-8b-{i}", tpu=1, priority=10, group=group)
+            )
+            coords.extend(alloc.coords)
+        xs = sorted({co[0] for co in coords})
+        ys = sorted({co[1] for co in coords})
+        zs = sorted({co[2] for co in coords})
+        m = _metrics(c)
+        return {
+            "metric": "gang_16_contiguous",
+            "gang_box": [len(xs), len(ys), len(zs)],
+            "contiguous": len(xs) * len(ys) * len(zs) == len(set(coords)) == 16,
+            "gang_p50_s": round(
+                m['gang_schedule_latency_seconds{quantile="0.5"}'], 4),
+            "utilization_percent": round(100 * c.utilization(), 2),
+        }
+
+
+def multi_tenant_northstar(config: TpuKubeConfig | None) -> dict[str, Any]:
+    """Config 5: the north-star scenario (also bench.py): 80 burst infer
+    pods, a 64-pod priority training gang that preempts its way to a
+    contiguous slice, then burst backfill to measure utilization."""
+    cfg = config or load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "8,8,2",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        for i in range(80):
+            c.schedule(c.make_pod(f"infer-{i}", tpu=1, priority=0))
+        group = PodGroup("llama-70b", min_member=64)
+        for i in range(64):
+            c.schedule(c.make_pod(f"train-{i}", tpu=1, priority=100,
+                                  group=group))
+        fill = 0
+        while True:
+            try:
+                c.schedule(c.make_pod(f"fill-{fill}", tpu=1, priority=0))
+                fill += 1
+            except RuntimeError:
+                break
+        m = _metrics(c)
+        util = m["tpu_chip_utilization_percent"]
+        return {
+            "metric": "cluster_tpu_utilization_percent",
+            "value": round(util, 2),
+            "unit": "%",
+            "vs_baseline": round(util / 95.0, 4),
+            "gang_p50_s": round(
+                m['gang_schedule_latency_seconds{quantile="0.5"}'], 4),
+            "preemptions": int(m["tpukube_preemptions_total"]),
+            "pods_placed": int(m["tpukube_binds_total"]),
+        }

@@ -1,0 +1,185 @@
+"""Scheduling decision trace + deterministic replay.
+
+SURVEY.md §6 ("Tracing / profiling"): the reference lineage has only glog
+leveled logging; the blueprint adds an "optional JSON trace dump of
+scheduling decisions for replay". This module is that subsystem.
+
+Every webhook decision (filter / prioritize / bind) and every pod release
+is recorded at the protocol boundary — the exact request JSON in, the
+exact response JSON out — as one event. The stream is therefore a complete
+transcript of the control plane: replaying it against a FRESH Extender
+must reproduce byte-identical responses, because the extender is a pure
+function of (pod, node annotations, ledger) and the ledger is itself built
+only from these events. ``replay()`` performs that check, which doubles as
+a determinism/regression harness: capture a trace from a live incident,
+re-run it against a patched scheduler, diff the divergence point.
+
+Events live in a bounded in-memory ring (this is a daemon) and optionally
+stream to a JSONL file sink for post-mortem replay across restarts.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Optional
+
+# Event kinds. filter/prioritize/bind carry the webhook request/response
+# verbatim; release carries the pod key (the apiserver-side pod deletion
+# the extender observed); fault carries a node re-annotation event.
+KINDS = ("filter", "prioritize", "bind", "release")
+
+
+@dataclass
+class DecisionTrace:
+    """Bounded ring of decision events, with an optional JSONL file sink."""
+
+    capacity: int = 65536
+    path: Optional[str] = None
+    _events: deque = field(init=False)
+    _lock: threading.Lock = field(init=False, default_factory=threading.Lock)
+    _seq: int = field(init=False, default=0)
+    _sink: Optional[io.TextIOBase] = field(init=False, default=None)
+
+    def __post_init__(self) -> None:
+        self._events = deque(maxlen=self.capacity)
+        if self.path:
+            self._sink = open(self.path, "a", buffering=1)  # line-buffered
+
+    def record(self, kind: str, request: Any, response: Any) -> dict:
+        assert kind in KINDS, kind
+        with self._lock:
+            self._seq += 1
+            ev = {
+                "seq": self._seq,
+                "ts": time.time(),
+                "kind": kind,
+                "request": request,
+                "response": response,
+            }
+            self._events.append(ev)
+            if self._sink is not None:
+                self._sink.write(json.dumps(ev, sort_keys=True) + "\n")
+        return ev
+
+    def events(self, since_seq: int = 0) -> list[dict]:
+        with self._lock:
+            return [e for e in self._events if e["seq"] > since_seq]
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sink is not None:
+                self._sink.close()
+                self._sink = None
+
+
+def load(path: str) -> list[dict]:
+    """Read a JSONL trace file back into an event list."""
+    out: list[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+@dataclass
+class Divergence:
+    seq: int
+    kind: str
+    recorded: Any
+    replayed: Any
+
+    def __str__(self) -> str:  # human-readable diff summary for the CLI
+        return (
+            f"divergence at seq {self.seq} ({self.kind}):\n"
+            f"  recorded: {json.dumps(self.recorded, sort_keys=True)[:400]}\n"
+            f"  replayed: {json.dumps(self.replayed, sort_keys=True)[:400]}"
+        )
+
+
+def replay(
+    events: Iterable[dict],
+    extender: Optional[Any] = None,
+    config: Optional[Any] = None,
+    stop_on_divergence: bool = True,
+) -> list[Divergence]:
+    """Re-run a recorded decision stream against a fresh Extender and
+    return every point where the replayed response differs.
+
+    An empty result proves the scheduler is a deterministic function of
+    its request stream (time-dependent behavior — gang TTL sweeps — only
+    fires on inactivity gaps longer than the TTL, which a replay never
+    reproduces, so a clean capture replays clean).
+    """
+    # local import: trace must stay importable from the extender module
+    from tpukube.core.config import load_config
+    from tpukube.sched import kube
+    from tpukube.sched.extender import Extender, ExtenderError
+    from tpukube.sched.gang import GangError
+    from tpukube.sched.state import StateError
+    from tpukube.core import codec
+
+    if extender is None:
+        from dataclasses import replace as _dc_replace
+
+        cfg = config or load_config(env={})
+        # replay must not record (or append to the live sink!) — the
+        # replayed extender is a scratch instance, not a daemon
+        extender = Extender(_dc_replace(cfg, trace_capacity=0, trace_path=""))
+    divergences: list[Divergence] = []
+
+    def _check(ev: dict, replayed: Any) -> bool:
+        if _canon(replayed) != _canon(ev["response"]):
+            divergences.append(
+                Divergence(ev["seq"], ev["kind"], ev["response"], replayed)
+            )
+            return stop_on_divergence
+        return False
+
+    for ev in events:
+        kind, req = ev["kind"], ev["request"]
+        if kind == "filter":
+            pod, nodes = kube.parse_extender_args(req)
+            try:
+                feasible, failed = extender.filter(pod, nodes)
+                got = kube.filter_result(feasible, failed)
+            except (ExtenderError, GangError, StateError, codec.CodecError) as e:
+                got = kube.filter_result([], {}, error=str(e))
+            if _check(ev, got):
+                break
+        elif kind == "prioritize":
+            pod, nodes = kube.parse_extender_args(req)
+            try:
+                scores = extender.prioritize(pod, nodes)
+            except (ExtenderError, GangError, StateError, codec.CodecError):
+                scores = {}
+            if _check(ev, kube.host_priority_list(scores)):
+                break
+        elif kind == "bind":
+            name, ns, uid, node = kube.parse_binding_args(req)
+            try:
+                alloc = extender.bind(name, ns, uid, node)
+                got = kube.binding_result()
+                got["Annotations"] = {codec.ANNO_ALLOC: codec.encode_alloc(alloc)}
+            except (ExtenderError, GangError, StateError, codec.CodecError) as e:
+                got = kube.binding_result(str(e))
+            if _check(ev, got):
+                break
+        elif kind == "release":
+            extender.release(req["pod_key"])
+            # releases have no response to compare
+        else:  # unknown kind in a newer trace format: report, don't crash
+            divergences.append(Divergence(ev.get("seq", -1), kind, ev, None))
+            if stop_on_divergence:
+                break
+    return divergences
+
+
+def _canon(obj: Any) -> str:
+    return json.dumps(obj, sort_keys=True)
