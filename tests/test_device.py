@@ -6,6 +6,7 @@ from tpukube.device import DeviceError, TpuDeviceManager
 from tpukube.device.tpu import (
     ENV_HBM_LIMIT,
     ENV_KUBE_CHIP_COORDS,
+    ENV_KUBE_CORE_IDS,
     ENV_KUBE_MESH_DIMS,
     ENV_MEM_FRACTION,
     ENV_VISIBLE_DEVICES,
@@ -145,3 +146,35 @@ def test_preferred_allocation_errors():
             m.preferred_allocation(["tpu-0"], [], 2)
         with pytest.raises(DeviceError, match="not in available"):
             m.preferred_allocation(["tpu-0"], ["tpu-3"], 1)
+
+
+def test_vtpu_share_gets_dedicated_tensorcore():
+    """BASELINE: the vTPU layer "partitions TPU HBM and TensorCores" — with
+    2 shares on a 2-core chip, each share owns exactly one core."""
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,1,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,1,1",
+        "TPUKUBE_SHARES_PER_CHIP": "2",
+        "TPUKUBE_CORES_PER_CHIP": "2",
+    })
+    with TpuDeviceManager(cfg) as dev:
+        env0 = dev.allocate_env(["tpu-0-frac0of2"])
+        env1 = dev.allocate_env(["tpu-0-frac1of2"])
+        assert env0[ENV_KUBE_CORE_IDS] == "0:0"
+        assert env1[ENV_KUBE_CORE_IDS] == "0:1"
+        # both shares of one chip in a single pod -> both cores
+        env_both = dev.allocate_env(["tpu-1-frac0of2", "tpu-1-frac1of2"])
+        assert env_both[ENV_KUBE_CORE_IDS] == "1:0+1"
+
+
+def test_vtpu_core_env_absent_when_shares_exceed_cores():
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "1,1,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "1,1,1",
+        "TPUKUBE_SHARES_PER_CHIP": "4",
+        "TPUKUBE_CORES_PER_CHIP": "2",
+    })
+    with TpuDeviceManager(cfg) as dev:
+        env = dev.allocate_env(["tpu-0-frac2of4"])
+        assert ENV_KUBE_CORE_IDS not in env
+        assert env[ENV_MEM_FRACTION] == "0.2500"

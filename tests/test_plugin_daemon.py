@@ -1,0 +1,67 @@
+"""tpukube-plugin as a real daemon process: the full SURVEY.md §4.1 startup
+sequence (discover → annotate → register with kubelet → serve) driven from
+outside, exactly as a kubelet on a TPU node would see it."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tpukube.core import codec
+from tpukube.plugin.fake_kubelet import FakeKubelet
+
+
+@pytest.fixture
+def plugin_dir(tmp_path):
+    d = tmp_path / "device-plugins"
+    d.mkdir()
+    return str(d)
+
+
+def test_plugin_daemon_full_lifecycle(plugin_dir, tmp_path):
+    anno_path = str(tmp_path / "node-annotation.json")
+    with FakeKubelet(plugin_dir) as kubelet:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpukube.cli", "plugin",
+             "--metrics-port", "0", "--annotation-out", anno_path],
+            env={
+                **os.environ,
+                "JAX_PLATFORMS": "cpu",
+                "TPUKUBE_BACKEND": "sim",
+                "TPUKUBE_DEVICE_PLUGIN_DIR": plugin_dir,
+                "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+                "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+            },
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        try:
+            # daemon registers itself and streams its device list
+            kubelet.wait_for_devices("qiniu.com/tpu", 4, timeout=30)
+            assert kubelet.allocatable("qiniu.com/tpu") == 4
+
+            # node-topology annotation emitted for the apiserver syncer
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not os.path.exists(anno_path):
+                time.sleep(0.1)
+            with open(anno_path) as f:
+                anno = json.load(f)
+            node, mesh = codec.node_from_annotations("host-0-0-0", anno)
+            assert mesh.dims == (2, 2, 1)
+            assert len(node.chips) == 4
+
+            # Allocate through the daemon's socket returns the JAX env
+            env = kubelet.allocate("qiniu.com/tpu", ["tpu-1"])
+            assert env["TPU_VISIBLE_DEVICES"] == "1"
+            assert "TPU_KUBE_CHIP_COORDS" in env
+
+            # clean shutdown on SIGTERM
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(timeout=15) == 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)

@@ -52,12 +52,14 @@ def _setup(args: argparse.Namespace) -> TpuKubeConfig:
     return load_config(yaml_path=args.config)
 
 
-def _wait_forever() -> None:
-    """Block the main thread until SIGINT/SIGTERM."""
+def _install_stop_handlers() -> threading.Event:
+    """Install SIGINT/SIGTERM handlers NOW (before any serving starts, so a
+    supervisor's early TERM still shuts down cleanly); returns the event the
+    main thread should wait on."""
     stop = threading.Event()
     for sig in (signal.SIGINT, signal.SIGTERM):
         signal.signal(sig, lambda *_: stop.set())
-    stop.wait()
+    return stop
 
 
 # -- tpukube-plugin ----------------------------------------------------------
@@ -75,6 +77,7 @@ def main_plugin(argv: Optional[list[str]] = None) -> int:
                         "('-' = stdout); an apiserver syncer applies it")
     args = p.parse_args(argv)
     cfg = _setup(args)
+    stop = _install_stop_handlers()
 
     from tpukube.core import codec
     from tpukube.device.tpu import TpuDeviceManager
@@ -108,7 +111,7 @@ def main_plugin(argv: Optional[list[str]] = None) -> int:
             server.resource_name, server.socket_path, metrics.port,
         )
         try:
-            _wait_forever()
+            stop.wait()
         finally:
             watcher.stop()
             metrics.stop()
@@ -149,11 +152,13 @@ def main_sim(argv: Optional[list[str]] = None) -> int:
     p.add_argument("scenario", type=int, choices=range(1, 6),
                    help="BASELINE config number (1..5)")
     args = p.parse_args(argv)
-    _setup(args)
+    cfg = _setup(args)
 
     from tpukube.sim import scenarios
 
-    result = scenarios.run(args.scenario)
+    # without --config each scenario uses its canonical BASELINE topology;
+    # with it, the user's topology/config drives the scenario
+    result = scenarios.run(args.scenario, cfg if args.config else None)
     print(json.dumps(result))
     return 0
 

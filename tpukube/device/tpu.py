@@ -48,6 +48,11 @@ ENV_KUBE_MESH_DIMS = "TPU_KUBE_MESH_DIMS"
 ENV_KUBE_HOST = "TPU_KUBE_HOST"
 ENV_HBM_LIMIT = "TPU_HBM_LIMIT_BYTES"
 ENV_MEM_FRACTION = "XLA_PYTHON_CLIENT_MEM_FRACTION"
+# vTPU TensorCore partition (BASELINE: "partitions TPU HBM and TensorCores"):
+# when shares divide a chip's cores evenly, each share owns dedicated
+# core(s) — "chip:coreA+coreB;chip:core" per allocated chip. Cooperative,
+# like the HBM limit (see README trust model).
+ENV_KUBE_CORE_IDS = "TPU_KUBE_CORE_IDS"
 
 
 class DeviceError(RuntimeError):
@@ -154,6 +159,7 @@ class TpuDeviceManager:
             shares_mode = self._config.shares_per_chip > 1
             chip_indices: list[int] = []
             shares_per_chip_alloc: dict[int, int] = {}
+            share_ks: dict[int, list[int]] = {}  # chip index -> share ks
             hbm_limit = 0
             seen: set[str] = set()
             for did in device_ids:
@@ -177,6 +183,7 @@ class TpuDeviceManager:
                         raise DeviceError(f"{did}: share does not match node config")
                     hbm_limit += chip.hbm_bytes // n
                     shares_per_chip_alloc[index] = shares_per_chip_alloc.get(index, 0) + 1
+                    share_ks.setdefault(index, []).append(k)
                 else:
                     if frac is not None:
                         raise DeviceError(
@@ -207,6 +214,25 @@ class TpuDeviceManager:
                 n = self._config.shares_per_chip
                 min_shares = min(shares_per_chip_alloc.values())
                 env[ENV_MEM_FRACTION] = f"{min_shares / n:.4f}"
+                # TensorCore partition: when shares divide a chip's cores
+                # evenly, share k owns cores [k*cps, (k+1)*cps). With more
+                # shares than cores the cores are time-shared and no core
+                # assignment is emitted (HBM-only partitioning).
+                parts = []
+                for index in chip_indices:
+                    cores = chip_at(index).num_cores
+                    if cores % n != 0:
+                        parts = []
+                        break
+                    cps = cores // n
+                    owned = sorted(
+                        c
+                        for k in share_ks[index]
+                        for c in range(k * cps, (k + 1) * cps)
+                    )
+                    parts.append(f"{index}:{'+'.join(map(str, owned))}")
+                if parts:
+                    env[ENV_KUBE_CORE_IDS] = ";".join(parts)
             return env
 
     def preferred_allocation(

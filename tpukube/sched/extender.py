@@ -558,12 +558,19 @@ class Extender:
 
     # -- pod lifecycle ------------------------------------------------------
     def release(self, pod_key: str) -> None:
-        if self.trace is not None:
-            self.trace.record("release", {"pod_key": pod_key}, None)
         self.state.release(pod_key)
         self.gang.on_release(pod_key)
         with self._pending_lock:
             self._pending.pop(pod_key, None)
+        # recorded AFTER the mutation, matching the webhook handlers
+        # (which record their response post-processing) so trace order
+        # tracks application order. Caveat: with releases arriving from a
+        # different thread than the webhook loop, mutation and recording
+        # are not one atomic step — a trace captured under concurrent
+        # multi-writer load can interleave and replay divergent; replay's
+        # determinism guarantee is for the serialized request stream.
+        if self.trace is not None:
+            self.trace.record("release", {"pod_key": pod_key}, None)
 
     # -- inspection (tpukubectl + /state endpoints) --------------------------
     def topology_snapshot(self) -> dict[str, Any]:
@@ -735,7 +742,10 @@ def make_app(extender: Extender) -> web.Application:
     async def trace_handler(request: web.Request) -> web.Response:
         if extender.trace is None:
             raise web.HTTPNotFound(text="tracing disabled (set trace_capacity)")
-        since = int(request.query.get("since", 0))
+        try:
+            since = int(request.query.get("since", 0))
+        except ValueError:
+            raise web.HTTPBadRequest(text="since must be an integer")
         return web.json_response(extender.trace.events(since_seq=since))
 
     app.router.add_post("/filter", filter_handler)

@@ -16,6 +16,7 @@ annotation codec is the actual interface the extender consumes anyway.
 from __future__ import annotations
 
 import asyncio
+import http.client
 import json
 import socket
 import threading
@@ -118,6 +119,12 @@ class SimCluster:
         self.pods: dict[str, dict[str, Any]] = {}  # key -> pod object
         self._port = _free_port()
         self._http: Optional[_AppThread] = None
+        # keep-alive connection per client thread (kube-scheduler likewise
+        # reuses connections to its extenders; per-request TCP setup was
+        # the dominant term in the measured gang-commit latency).
+        # http.client connections are not thread-safe, and tests drive
+        # schedule() from many threads at once — hence thread-local.
+        self._tls = threading.local()
 
     # -- lifecycle ---------------------------------------------------------
     @property
@@ -129,6 +136,10 @@ class SimCluster:
         self._http.start()
 
     def stop(self) -> None:
+        conn = getattr(self._tls, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._tls.conn = None
         if self._http is not None:
             self._http.stop()
             self._http = None
@@ -189,14 +200,41 @@ class SimCluster:
 
     # -- the scheduler loop (what kube-scheduler would do) -------------------
     def _post(self, path: str, body: dict[str, Any]) -> Any:
-        req = urllib.request.Request(
-            f"{self.base_url}{path}",
-            data=json.dumps(body).encode(),
-            headers={"Content-Type": "application/json"},
-            method="POST",
-        )
-        with urllib.request.urlopen(req, timeout=10) as resp:
-            return json.loads(resp.read())
+        payload = json.dumps(body).encode()
+        for attempt in (0, 1):  # one reconnect if the kept-alive conn died
+            conn = getattr(self._tls, "conn", None)
+            if conn is None:
+                conn = self._tls.conn = http.client.HTTPConnection(
+                    "127.0.0.1", self._port, timeout=10
+                )
+            try:
+                # send and receive are separated: a failure to SEND means
+                # the server never saw the request (stale keep-alive conn,
+                # safe to retry); a failure AFTER send must not be retried
+                # — the server may have executed the (non-idempotent) bind
+                conn.request(
+                    "POST", path, body=payload,
+                    headers={"Content-Type": "application/json"},
+                )
+            except (http.client.HTTPException, OSError):
+                conn.close()
+                self._tls.conn = None
+                if attempt:
+                    raise
+                continue
+            try:
+                resp = conn.getresponse()
+                raw = resp.read()
+            except (http.client.HTTPException, OSError):
+                conn.close()
+                self._tls.conn = None
+                raise
+            if resp.status >= 400:
+                raise RuntimeError(
+                    f"HTTP {resp.status} from {path}: "
+                    f"{raw.decode(errors='replace')[:300]}"
+                )
+            return json.loads(raw)
 
     def drain_evictions(self) -> list[str]:
         """Delete pods the gang layer rolled back (all-or-nothing: a

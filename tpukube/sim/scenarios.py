@@ -122,14 +122,18 @@ def gang_16(config: TpuKubeConfig | None) -> dict[str, Any]:
                 c.make_pod(f"llama-8b-{i}", tpu=1, priority=10, group=group)
             )
             coords.extend(alloc.coords)
-        xs = sorted({co[0] for co in coords})
-        ys = sorted({co[1] for co in coords})
-        zs = sorted({co[2] for co in coords})
+        extents = [
+            max(co[a] for co in coords) - min(co[a] for co in coords) + 1
+            for a in range(3)
+        ]
         m = _metrics(c)
+        ex, ey, ez = extents
         return {
             "metric": "gang_16_contiguous",
-            "gang_box": [len(xs), len(ys), len(zs)],
-            "contiguous": len(xs) * len(ys) * len(zs) == len(set(coords)) == 16,
+            "gang_box": extents,
+            # a true axis-aligned box: axis extents (not distinct-value
+            # counts, which would miss gaps) multiply out to the chip count
+            "contiguous": ex * ey * ez == len(set(coords)) == 16,
             "gang_p50_s": round(
                 m['gang_schedule_latency_seconds{quantile="0.5"}'], 4),
             "utilization_percent": round(100 * c.utilization(), 2),
