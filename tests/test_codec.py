@@ -118,3 +118,29 @@ def test_attach_group_idempotent():
     pod.group = PodGroup("explicit", 9)
     codec.attach_group(pod)  # must not clobber an explicit group
     assert pod.group.name == "explicit"
+
+
+def test_node_topology_bad_links_roundtrip():
+    node, mesh = _node()
+    node.bad_links = [(TopologyCoord(1, 0, 0), TopologyCoord(0, 0, 0))]
+    payload = codec.encode_node_topology(node, mesh)
+    node2, _ = codec.decode_node_topology(payload)
+    # decode canonicalizes the pair (smaller endpoint first)
+    assert node2.bad_links == [(TopologyCoord(0, 0, 0), TopologyCoord(1, 0, 0))]
+    # absent field (older annotations) decodes to no bad links
+    node.bad_links = []
+    node3, _ = codec.decode_node_topology(codec.encode_node_topology(node, mesh))
+    assert node3.bad_links == []
+
+
+def test_node_topology_rejects_malformed_bad_links():
+    node, mesh = _node()
+    payload = codec.encode_node_topology(node, mesh)
+    import json
+    obj = json.loads(payload)
+    obj["badLinks"] = [[[0, 0], [1, 0, 0]]]  # 2-element coord
+    with pytest.raises(codec.CodecError, match="badLinks"):
+        codec.decode_node_topology(json.dumps(obj))
+    obj["badLinks"] = "nope"
+    with pytest.raises(codec.CodecError, match="badLinks"):
+        codec.decode_node_topology(json.dumps(obj))

@@ -6,7 +6,7 @@ import pytest
 
 from tpukube.core import codec
 from tpukube.core.config import load_config
-from tpukube.core.types import PodGroup
+from tpukube.core.types import PodGroup, TopologyCoord
 from tpukube.sim import SimCluster
 
 
@@ -216,3 +216,56 @@ def test_two_gangs_dont_overlap():
         s2 = {tuple(co) for a in a2 for co in a.coords}
         assert not (s1 & s2)
         assert c.utilization() == 1.0
+
+
+def test_gang_link_fault_in_reserved_slice_rolls_back():
+    """SURVEY.md §6: a dropped ICI link inside an uncommitted gang's slice
+    rolls the gang back; re-reservation lands clear of the dead link."""
+    with SimCluster(_cfg()) as c:
+        group = PodGroup("linky", min_member=4)
+        c.schedule(c.make_pod("l-0", tpu=1, group=group))
+        res = c.extender.gang.reservation("default", "linky")
+        # drop a link between two chips INSIDE the reserved slice
+        coords = sorted(res.coords)
+        a = coords[0]
+        b = next(nb for nb in c.mesh.neighbors(a) if nb in res.coords)
+        c.inject_link_fault(a, b)
+        c.schedule(c.make_pod("l-1", tpu=1, group=group))
+        assert c.extender.gang.rollbacks == 1
+        res2 = c.extender.gang.reservation("default", "linky")
+        cs = res2.coords
+        assert not (a in cs and b in cs)
+        # rolled-back member rescheduled; gang completes on the new slice
+        assert c.extender.state.allocation("default/l-0") is None
+        c.schedule(c.make_pod("l-0b", tpu=1, group=group))
+        for i in range(2, 4):
+            c.schedule(c.make_pod(f"l-{i}", tpu=1, group=group))
+        assert res2.committed
+
+
+def test_gang_reservation_avoids_preexisting_link_fault():
+    with SimCluster(_cfg()) as c:
+        # partition awareness: link down in the middle of the mesh
+        c.inject_link_fault((1, 1, 0), (2, 1, 0))
+        group = PodGroup("careful", min_member=8)
+        for i in range(8):
+            c.schedule(c.make_pod(f"c-{i}", tpu=1, group=group))
+        res = c.extender.gang.reservation("default", "careful")
+        cs = res.coords
+        assert not (TopologyCoord(1, 1, 0) in cs and TopologyCoord(2, 1, 0) in cs)
+        assert res.committed
+
+
+def test_link_fault_restore_reopens_placement():
+    with SimCluster(_cfg()) as c:
+        # every x-link at the x=1|x=2 boundary down: no 16-chip slice
+        for y in range(4):
+            c.inject_link_fault((1, y, 0), (2, y, 0))
+        group = PodGroup("whole", min_member=16)
+        with pytest.raises(RuntimeError, match="no contiguous slice"):
+            c.schedule(c.make_pod("w-0", tpu=1, group=group))
+        for y in range(4):
+            c.inject_link_fault((1, y, 0), (2, y, 0), up=True)
+        for i in range(16):
+            c.schedule(c.make_pod(f"w-{i}", tpu=1, group=group))
+        assert c.extender.gang.reservation("default", "whole").committed

@@ -125,3 +125,36 @@ def test_native_selftest_under_asan_ubsan():
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "all checks passed" in proc.stdout
     assert "runtime error" not in proc.stderr  # UBSan reports go to stderr
+
+
+def test_link_fault_injection_roundtrip():
+    with _open() as ti:
+        assert ti.link_faults() == []
+        a, b = TopologyCoord(0, 0, 0), TopologyCoord(1, 0, 0)
+        ti.inject_link_fault(b, a)  # reversed order canonicalizes
+        assert ti.link_faults() == [(a, b)]
+        ti.inject_link_fault(a, b)  # idempotent re-drop
+        assert ti.link_faults() == [(a, b)]
+        ti.inject_link_fault(a, b, up=True)
+        assert ti.link_faults() == []
+
+
+def test_link_fault_rejects_non_adjacent():
+    with _open() as ti:
+        with pytest.raises(TpuInfoError, match="adjacent"):
+            ti.inject_link_fault(TopologyCoord(0, 0, 0), TopologyCoord(2, 0, 0))
+        with pytest.raises(TpuInfoError, match="adjacent"):
+            ti.inject_link_fault(TopologyCoord(0, 0, 0), TopologyCoord(1, 1, 0))
+        # no torus on this mesh: the wrap pair is not adjacent
+        with pytest.raises(TpuInfoError, match="adjacent"):
+            ti.inject_link_fault(TopologyCoord(0, 0, 0), TopologyCoord(3, 0, 0))
+
+
+def test_link_fault_torus_wrap_adjacency():
+    mesh = MeshSpec(dims=(4, 1, 1), host_block=(1, 1, 1),
+                    torus=(True, False, False))
+    with _open(mesh=mesh) as ti:
+        ti.inject_link_fault(TopologyCoord(0, 0, 0), TopologyCoord(3, 0, 0))
+        assert ti.link_faults() == [
+            (TopologyCoord(0, 0, 0), TopologyCoord(3, 0, 0))
+        ]

@@ -211,3 +211,83 @@ def test_large_mesh_performance():
     assert coords is not None and len(coords) == 64
     assert not (set(coords) & occupied)
     assert dt < 2.0, f"slicefit took {dt:.2f}s on 1024-chip mesh"
+
+
+# -- ICI link faults (SURVEY.md §6: drop ICI link) ---------------------------
+
+def test_broken_link_steers_box_choice():
+    mesh = MeshSpec(dims=(4, 4, 1), host_block=(2, 2, 1))
+    # a downed link in the left half: the 2x2 box must land clear of it
+    broken = {(TopologyCoord(0, 0, 0), TopologyCoord(0, 1, 0))}
+    coords = find_slice(mesh, [], count=4, broken=broken)
+    assert coords is not None and len(coords) == 4
+    cs = set(coords)
+    assert not (TopologyCoord(0, 0, 0) in cs and TopologyCoord(0, 1, 0) in cs)
+
+
+def test_broken_link_makes_request_unsatisfiable():
+    mesh = MeshSpec(dims=(2, 1, 1), host_block=(1, 1, 1))
+    broken = {(TopologyCoord(0, 0, 0), TopologyCoord(1, 0, 0))}
+    # the only 2-chip box spans the dead link
+    assert find_slice(mesh, [], count=2, broken=broken) is None
+    # single chips are unaffected
+    assert find_slice(mesh, [], count=1, broken=broken) is not None
+
+
+def test_broken_link_only_blocks_boxes_containing_both_ends():
+    mesh = MeshSpec(dims=(4, 1, 1), host_block=(1, 1, 1))
+    broken = {(TopologyCoord(1, 0, 0), TopologyCoord(2, 0, 0))}
+    coords = find_slice(mesh, [], count=2, broken=broken)
+    assert coords is not None
+    cs = set(coords)
+    assert cs in ({TopologyCoord(0, 0, 0), TopologyCoord(1, 0, 0)},
+                  {TopologyCoord(2, 0, 0), TopologyCoord(3, 0, 0)})
+
+
+def test_irregular_growth_never_crosses_broken_link():
+    mesh = MeshSpec(dims=(3, 1, 1), host_block=(1, 1, 1))
+    broken = {(TopologyCoord(0, 0, 0), TopologyCoord(1, 0, 0))}
+    # no 3-box exists without the dead link; irregular growth must not
+    # pretend chips 0..2 are connected through it either
+    got = find_slice(mesh, [], count=3, allow_irregular=True, broken=broken)
+    assert got is None
+    # 2 chips connected through the live link still work
+    got2 = find_slice(mesh, [], count=2, allow_irregular=True, broken=broken)
+    assert got2 is not None
+    assert set(got2) == {TopologyCoord(1, 0, 0), TopologyCoord(2, 0, 0)}
+
+
+def test_broken_link_respected_on_torus_wrap():
+    mesh = MeshSpec(dims=(4, 1, 1), host_block=(1, 1, 1),
+                    torus=(True, False, False))
+    # wrap link 3-0 is down; a wrapped 2-box {3,0} must be rejected,
+    # the interior 2-boxes must not be
+    broken = {(TopologyCoord(0, 0, 0), TopologyCoord(3, 0, 0))}
+    occupied = [TopologyCoord(1, 0, 0), TopologyCoord(2, 0, 0)]
+    assert find_slice(mesh, occupied, count=2, broken=broken) is None
+    assert find_slice(mesh, [TopologyCoord(2, 0, 0)], count=2,
+                      broken=broken) is not None
+
+
+def test_iter_free_boxes_excludes_broken():
+    mesh = MeshSpec(dims=(2, 2, 1), host_block=(1, 1, 1))
+    broken = {(TopologyCoord(0, 0, 0), TopologyCoord(1, 0, 0))}
+    grid = occupancy_grid(mesh, [])
+    boxes = list(iter_free_boxes(mesh, grid, count=2, broken=broken))
+    for sb in boxes:
+        cs = set(sb.box.coords())
+        assert not (TopologyCoord(0, 0, 0) in cs and TopologyCoord(1, 0, 0) in cs)
+    assert boxes  # vertical pairs remain
+
+
+def test_irregular_region_never_contains_both_ends_of_dead_link():
+    # Both endpoints reachable through LIVE paths (around the square) —
+    # the region must still not contain both ends of the dead link
+    mesh = MeshSpec(dims=(2, 2, 1), host_block=(1, 1, 1))
+    broken = {(TopologyCoord(0, 0, 0), TopologyCoord(0, 1, 0))}
+    got = find_slice(mesh, [], count=4, allow_irregular=True, broken=broken)
+    assert got is None
+    got3 = find_slice(mesh, [], count=3, allow_irregular=True, broken=broken)
+    assert got3 is not None
+    cs = set(got3)
+    assert not (TopologyCoord(0, 0, 0) in cs and TopologyCoord(0, 1, 0) in cs)

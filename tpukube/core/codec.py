@@ -23,6 +23,7 @@ from tpukube.core.types import (
     PodGroup,
     PodInfo,
     TopologyCoord,
+    canonical_link,
 )
 
 SCHEMA_VERSION = 1
@@ -75,6 +76,9 @@ def encode_node_topology(node: NodeInfo, mesh: MeshSpec) -> str:
                 }
                 for c in node.chips
             ],
+            "badLinks": [
+                [a.as_list(), b.as_list()] for a, b in node.bad_links
+            ],
         },
         separators=(",", ":"),
     )
@@ -115,10 +119,18 @@ def decode_node_topology(payload: str) -> tuple[NodeInfo, MeshSpec]:
         raise CodecError(f"node-topology: bad sharesPerChip: {e}") from e
     if shares < 1:
         raise CodecError(f"node-topology: sharesPerChip must be >= 1, got {shares}")
+    raw_links = obj.get("badLinks", [])
+    if not isinstance(raw_links, list):
+        raise CodecError("node-topology: 'badLinks' must be a list")
+    try:
+        bad_links = [canonical_link(a, b) for a, b in raw_links]
+    except (TypeError, ValueError) as e:
+        raise CodecError(f"node-topology: malformed badLinks entry: {e}") from e
     node = NodeInfo(
         name=_field(obj, "node", "node-topology"),
         chips=chips,
         shares_per_chip=shares,
+        bad_links=bad_links,
     )
     return node, mesh
 

@@ -70,6 +70,17 @@ class TopologyCoord(NamedTuple):
         return TopologyCoord(int(x), int(y), int(z))
 
 
+# An ICI link is an unordered pair of adjacent chip coords; the canonical
+# form (lexicographically smaller endpoint first) makes pairs reported by
+# either endpoint's node agent compare equal.
+Link = tuple[TopologyCoord, TopologyCoord]
+
+
+def canonical_link(a, b) -> Link:
+    a, b = TopologyCoord.of(a), TopologyCoord.of(b)
+    return (a, b) if a <= b else (b, a)
+
+
 class ResourceList(dict):
     """name -> integer quantity, with the arithmetic schedulers need.
 
@@ -156,6 +167,10 @@ class NodeInfo:
     shares_per_chip: int = 1  # >1 => vTPU minting enabled on this node
     capacity: ResourceList = field(default_factory=ResourceList)
     annotations: dict[str, str] = field(default_factory=dict)
+    # Downed ICI links with at least one endpoint on this node (canonical
+    # pairs). The health watch reports them like chip faults; the scheduler
+    # keeps gang slices off degraded links (SURVEY.md §6 fault injection).
+    bad_links: list[Link] = field(default_factory=list)
 
     def healthy_chips(self) -> list[ChipInfo]:
         return [c for c in self.chips if c.health is Health.HEALTHY]

@@ -114,10 +114,20 @@ class TpuDeviceManager:
         return self._ti.chips()
 
     def node_info(self) -> NodeInfo:
+        chips = self.chips()
+        mine = {c.coord for c in chips}
+        # a node agent reports only the downed links it can see: those with
+        # at least one endpoint on this host (the far host reports its side;
+        # the scheduler dedupes on the canonical pair)
+        bad_links = [
+            (a, b) for a, b in self._ti.link_faults()
+            if a in mine or b in mine
+        ]
         return NodeInfo(
             name=self._host,
-            chips=self.chips(),
+            chips=chips,
             shares_per_chip=self._config.shares_per_chip,
+            bad_links=bad_links,
         )
 
     def shares_of(self, chip: ChipInfo) -> list[VtpuShare]:
@@ -304,3 +314,12 @@ class TpuDeviceManager:
     def inject_fault(self, chip_index: int, healthy: bool = False) -> None:
         """Sim-only: flip chip health (the NVML XID event analog)."""
         self._ti.inject_fault(chip_index, healthy)
+
+    def inject_link_fault(self, a, b, up: bool = False) -> None:
+        """Sim-only: drop (or restore) the ICI link between adjacent coords
+        ``a``/``b`` — the NVLink lane-error analog (SURVEY.md §6)."""
+        self._ti.inject_link_fault(a, b, up)
+
+    def link_faults(self) -> list:
+        """Downed ICI links visible to this session (canonical pairs)."""
+        return self._ti.link_faults()

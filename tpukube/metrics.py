@@ -55,6 +55,9 @@ def render_extender_metrics(extender) -> str:
     out.append(_fmt("gang_schedule_latency_seconds_count", len(lats)))
     out.append(_fmt("gang_schedule_latency_seconds_sum", sum(lats)))
 
+    out.append("# TYPE tpukube_ici_links_down gauge\n")
+    out.append(_fmt("tpukube_ici_links_down", len(extender.state.broken_links())))
+
     out.append("# TYPE tpukube_binds_total counter\n")
     out.append(_fmt("tpukube_binds_total", extender.binds_total))
     out.append("# TYPE tpukube_gang_rollbacks_total counter\n")

@@ -68,6 +68,20 @@ int main() {
   n = tpuinfo_chip_links(0, links, 2); /* buffer too small */
   CHECK(n == -1);
 
+  /* ICI link faults (ABI v2): inject, list, restore, reject non-adjacent */
+  int32_t lf[6 * 4];
+  CHECK(tpuinfo_link_faults(lf, 4) == 0);
+  CHECK(tpuinfo_inject_link_fault(2, 0, 2, 3, 0, 2, 0) == 0);
+  CHECK(tpuinfo_inject_link_fault(3, 0, 2, 2, 0, 2, 0) == 0); /* dup, reversed */
+  CHECK(tpuinfo_link_faults(lf, 4) == 1);
+  CHECK(lf[0] == 2 && lf[1] == 0 && lf[2] == 2);  /* canonical a<=b */
+  CHECK(lf[3] == 3 && lf[4] == 0 && lf[5] == 2);
+  CHECK(tpuinfo_inject_link_fault(0, 0, 0, 2, 0, 0, 0) == -1); /* 2 hops */
+  CHECK(tpuinfo_inject_link_fault(0, 0, 0, 1, 1, 0, 0) == -1); /* diagonal */
+  CHECK(tpuinfo_inject_link_fault(0, 0, 0, 3, 0, 0, 0) == -1); /* no torus wrap */
+  CHECK(tpuinfo_inject_link_fault(2, 0, 2, 3, 0, 2, 1) == 0);  /* restore */
+  CHECK(tpuinfo_link_faults(lf, 4) == 0);
+
   /* fault injection (the sim XID event) */
   CHECK(tpuinfo_inject_fault(1, 0) == 0);
   CHECK(tpuinfo_chip_get(1, &chip) == 0);

@@ -9,6 +9,8 @@
 
 #include <dlfcn.h>
 
+#include <algorithm>
+#include <array>
 #include <cstdio>
 #include <cstring>
 #include <string>
@@ -16,11 +18,14 @@
 
 namespace {
 
+using LinkPair = std::array<int32_t, 6>;  /* ax,ay,az,bx,by,bz, a<=b lex */
+
 struct State {
   bool initialized = false;
   bool is_sim = false;
   tpuinfo_mesh mesh{};
   std::vector<tpuinfo_chip> chips;
+  std::vector<LinkPair> bad_links;
 };
 
 State g_state;
@@ -286,6 +291,62 @@ int tpuinfo_chip_links(int32_t index, int32_t* out, int32_t max) {
       ++n;
     }
   }
+  return n;
+}
+
+static int mesh_adjacent(const int32_t a[3], const int32_t b[3]) {
+  /* Exactly one axis differs, by 1 (or wraps on a torus axis). */
+  int diff_axis = -1;
+  for (int axis = 0; axis < 3; ++axis) {
+    int32_t d = g_state.mesh.dims[axis];
+    if (a[axis] < 0 || a[axis] >= d || b[axis] < 0 || b[axis] >= d) return 0;
+    if (a[axis] == b[axis]) continue;
+    if (diff_axis != -1) return 0;
+    int32_t delta = a[axis] > b[axis] ? a[axis] - b[axis] : b[axis] - a[axis];
+    if (delta != 1 && !(g_state.mesh.torus[axis] && delta == d - 1 && d > 1))
+      return 0;
+    diff_axis = axis;
+  }
+  return diff_axis != -1;
+}
+
+int tpuinfo_inject_link_fault(int32_t ax, int32_t ay, int32_t az,
+                              int32_t bx, int32_t by, int32_t bz,
+                              int32_t up) {
+  if (!g_state.initialized) { set_error("not initialized"); return -1; }
+  if (!g_state.is_sim) {
+    set_error("link fault injection is sim-only");
+    return -1;
+  }
+  int32_t a[3] = {ax, ay, az};
+  int32_t b[3] = {bx, by, bz};
+  if (!mesh_adjacent(a, b)) {
+    set_error("link endpoints are not mesh-adjacent chips");
+    return -1;
+  }
+  LinkPair p;
+  bool a_first = std::lexicographical_compare(a, a + 3, b, b + 3);
+  const int32_t* lo = a_first ? a : b;
+  const int32_t* hi = a_first ? b : a;
+  for (int i = 0; i < 3; ++i) { p[i] = lo[i]; p[3 + i] = hi[i]; }
+  auto& v = g_state.bad_links;
+  for (auto it = v.begin(); it != v.end(); ++it) {
+    if (*it == p) {
+      if (up) v.erase(it);
+      return 0;  /* already down, or just restored */
+    }
+  }
+  if (!up) v.push_back(p);
+  return 0;
+}
+
+int tpuinfo_link_faults(int32_t* out, int32_t max) {
+  if (!g_state.initialized) { set_error("not initialized"); return -1; }
+  if (out == nullptr && max > 0) { set_error("out is null"); return -1; }
+  int32_t n = static_cast<int32_t>(g_state.bad_links.size());
+  int32_t write = n < max ? n : max;
+  for (int32_t i = 0; i < write; ++i)
+    std::memcpy(out + 6 * i, g_state.bad_links[i].data(), 6 * sizeof(int32_t));
   return n;
 }
 

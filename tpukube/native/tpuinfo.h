@@ -29,7 +29,7 @@
 extern "C" {
 #endif
 
-#define TPUINFO_ABI_VERSION 1
+#define TPUINFO_ABI_VERSION 2
 #define TPUINFO_MAX_ID 64
 
 typedef struct {
@@ -70,6 +70,18 @@ int tpuinfo_chip_links(int32_t index, int32_t* out, int32_t max);
 
 /* Health manipulation — the sim analog of an NVML XID event (sim only). */
 int tpuinfo_inject_fault(int32_t index, int32_t healthy);
+
+/* ICI link faults (ABI v2). A fault is an unordered pair of mesh-adjacent
+ * chip coords whose link is down — the TPU analog of an NVLink lane error.
+ * inject (sim only): up=0 marks the link down, up=1 restores it; the pair
+ * must be mesh-adjacent (torus wrap honored) or -1 is returned.
+ * faults: write up to `max` downed links into out (6 ints per entry: ax,
+ * ay, az, bx, by, bz, pair canonicalized a<=b lexicographically). Returns
+ * the total downed-link count (may exceed max; callers re-ask), or -1. */
+int tpuinfo_inject_link_fault(int32_t ax, int32_t ay, int32_t az,
+                              int32_t bx, int32_t by, int32_t bz,
+                              int32_t up);
+int tpuinfo_link_faults(int32_t* out, int32_t max);
 
 const char* tpuinfo_last_error(void);
 

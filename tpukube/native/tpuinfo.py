@@ -24,7 +24,7 @@ from tpukube.core.types import ChipInfo, Health, TopologyCoord
 _NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libtpuinfo.so")
 
-ABI_VERSION = 1
+ABI_VERSION = 2
 _MAX_LINKS = 6
 
 
@@ -82,6 +82,11 @@ def _load() -> ctypes.CDLL:
             return _lib
         lib = ctypes.CDLL(_ensure_built())
         lib.tpuinfo_abi_version.restype = ctypes.c_int
+        # check ABI FIRST: binding v2 symbols against a stale v1 .so would
+        # die with an opaque AttributeError before the guard below ran
+        abi = lib.tpuinfo_abi_version()
+        if abi != ABI_VERSION:
+            raise TpuInfoError(f"libtpuinfo ABI {abi} != expected {ABI_VERSION}")
         lib.tpuinfo_init.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
         lib.tpuinfo_init.restype = ctypes.c_int
         lib.tpuinfo_shutdown.restype = ctypes.c_int
@@ -98,10 +103,14 @@ def _load() -> ctypes.CDLL:
         lib.tpuinfo_chip_links.restype = ctypes.c_int
         lib.tpuinfo_inject_fault.argtypes = [ctypes.c_int32, ctypes.c_int32]
         lib.tpuinfo_inject_fault.restype = ctypes.c_int
+        lib.tpuinfo_inject_link_fault.argtypes = [ctypes.c_int32] * 7
+        lib.tpuinfo_inject_link_fault.restype = ctypes.c_int
+        lib.tpuinfo_link_faults.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+        ]
+        lib.tpuinfo_link_faults.restype = ctypes.c_int
         lib.tpuinfo_last_error.restype = ctypes.c_char_p
-        abi = lib.tpuinfo_abi_version()
-        if abi != ABI_VERSION:
-            raise TpuInfoError(f"libtpuinfo ABI {abi} != expected {ABI_VERSION}")
         _lib = lib
         return lib
 
@@ -240,3 +249,37 @@ class TpuInfo:
             self._check_open()
             if self._lib.tpuinfo_inject_fault(index, 1 if healthy else 0) != 0:
                 raise TpuInfoError(self._last_error())
+
+    def inject_link_fault(
+        self, a: TopologyCoord, b: TopologyCoord, up: bool = False
+    ) -> None:
+        """Mark the ICI link between adjacent chips ``a``/``b`` down (or back
+        up) — sim backend only; the NVLink lane-error analog."""
+        with self._lock:
+            self._check_open()
+            a, b = TopologyCoord.of(a), TopologyCoord.of(b)
+            rc = self._lib.tpuinfo_inject_link_fault(
+                a.x, a.y, a.z, b.x, b.y, b.z, 1 if up else 0
+            )
+            if rc != 0:
+                raise TpuInfoError(self._last_error())
+
+    def link_faults(self) -> list[tuple[TopologyCoord, TopologyCoord]]:
+        """All downed ICI links, canonical (a <= b) coord pairs."""
+        with self._lock:
+            self._check_open()
+            max_n = 16
+            while True:
+                buf = (ctypes.c_int32 * (6 * max_n))()
+                n = self._lib.tpuinfo_link_faults(buf, max_n)
+                if n < 0:
+                    raise TpuInfoError(self._last_error())
+                if n <= max_n:
+                    return [
+                        (
+                            TopologyCoord(buf[6 * i], buf[6 * i + 1], buf[6 * i + 2]),
+                            TopologyCoord(buf[6 * i + 3], buf[6 * i + 4], buf[6 * i + 5]),
+                        )
+                        for i in range(n)
+                    ]
+                max_n = n

@@ -218,6 +218,7 @@ class Extender:
             total,
             pod.group.shape,
             pod.priority,
+            broken=self.state.broken_links(),
         )
         if plan is None:
             raise GangError(
@@ -452,7 +453,10 @@ class Extender:
         mask = np.ones(mesh.dims, dtype=bool)
         for c in node_free:
             mask[tuple(c)] = False
-        placed = slicefit.find_slice(mesh, mask, count=count, allow_irregular=True)
+        placed = slicefit.find_slice(
+            mesh, mask, count=count, allow_irregular=True,
+            broken=self.state.broken_links(),
+        )
         if placed is not None:
             return placed
         # Free chips exist but form no box/connected region (e.g. diagonal
@@ -600,6 +604,7 @@ class Extender:
                     "shares": view.shares_per_chip,
                 })
             nodes.append({"name": name, "chips": chips})
+        broken = sorted(self.state.broken_links())
         return {
             "mesh_dims": list(mesh.dims) if mesh else None,
             "utilization_percent": round(100.0 * self.state.utilization(), 2),
@@ -607,6 +612,7 @@ class Extender:
             "chips_allocated": len(occupied),
             "chips_reserved_unbound": len(reserved - occupied),
             "chips_unhealthy": len(unhealthy),
+            "links_down": [[list(a), list(b)] for a, b in broken],
             "nodes": nodes,
         }
 

@@ -15,9 +15,10 @@ all back. The TPU rendering:
   3. TTL expiry before quorum rolls EVERYTHING back: assigned members'
      allocations are released, the reservation dissolves — the "either
      fully lands or not at all" contract (BASELINE).
-  4. A health fault on a reserved chip before commit also rolls the gang
-     back (SURVEY.md §6: re-reserve a fresh contiguous slice); the next
-     filter cycle re-reserves from scratch on healthy chips.
+  4. A health fault on a reserved chip — or a downed ICI link between two
+     reserved chips — before commit rolls the gang back (SURVEY.md §6:
+     re-reserve a fresh contiguous slice); the next filter cycle
+     re-reserves from scratch on healthy, fully-linked chips.
 
 Linearizability: one lock orders all reservation mutations; binds
 re-validate against the reservation under that lock (optimistic callers
@@ -117,19 +118,26 @@ class GangManager:
     def sweep(self, now: Optional[float] = None) -> list[tuple[str, str]]:
         """Lazy janitor, called at the top of every gang interaction:
         rolls back (a) uncommitted reservations past TTL and (b) any
-        uncommitted reservation whose slice lost a chip to a health fault.
+        uncommitted reservation whose slice lost a chip to a health fault
+        or an internal ICI link to a link fault.
         Returns the rolled-back group keys."""
         now = time.monotonic() if now is None else now
         rolled: list[tuple[str, str]] = []
         unhealthy = self._state.unhealthy_coords()
+        broken = self._state.broken_links()
         with self._lock:
             for key, res in list(self._reservations.items()):
                 if res.committed:
                     continue
                 expired = now - res.created > self._ttl
                 sick = self._has_unhealthy_chip(res, unhealthy)
-                if expired or sick:
-                    why = "TTL expired" if expired else "chip fault in slice"
+                cut = self._has_broken_link(res, broken)
+                if expired or sick or cut:
+                    why = (
+                        "TTL expired" if expired
+                        else "chip fault in slice" if sick
+                        else "ICI link fault in slice"
+                    )
                     log.warning("gang %s/%s rollback: %s", key[0], key[1], why)
                     self._rollback_locked(res)
                     rolled.append(key)
@@ -139,6 +147,10 @@ class GangManager:
         self, res: GangReservation, unhealthy: set[TopologyCoord]
     ) -> bool:
         return bool(res.coords & unhealthy)
+
+    @staticmethod
+    def _has_broken_link(res: GangReservation, broken: set) -> bool:
+        return slicefit.coords_break_link(res.coords, broken)
 
     def _rollback_locked(self, res: GangReservation) -> None:
         for pod_key in list(res.assigned):
@@ -175,15 +187,20 @@ class GangManager:
                 raise GangError("no node topology known yet")
             total = pod.group.min_member * chips_per_pod
             occupied = self._state.occupied_coords() | self.reserved_coords()
+            broken = self._state.broken_links()
             if pod.group.shape is not None:
-                coords = slicefit.find_slice(mesh, occupied, shape=pod.group.shape)
+                coords = slicefit.find_slice(
+                    mesh, occupied, shape=pod.group.shape, broken=broken
+                )
                 if coords is not None and len(coords) != total:
                     raise GangError(
                         f"gang {key}: shape {pod.group.shape} holds "
                         f"{len(coords)} chips but the gang needs {total}"
                     )
             else:
-                coords = slicefit.find_slice(mesh, occupied, count=total)
+                coords = slicefit.find_slice(
+                    mesh, occupied, count=total, broken=broken
+                )
             if coords is None:
                 raise NoSliceError(
                     f"gang {key}: no contiguous {total}-chip slice available "
@@ -310,6 +327,7 @@ class GangManager:
             mesh, grid,
             count=total if shape is None else None,
             shape=shape,
+            broken=self._state.broken_links(),
         ):
             box_set = set(slicefit.box_coords(mesh, sb.box))
             if assigned <= box_set and (
@@ -341,6 +359,10 @@ class GangManager:
             if clash:
                 raise GangError(
                     f"gang {key}: preempted box re-occupied at {clash[:3]}; retry"
+                )
+            if slicefit.coords_break_link(set(coords), self._state.broken_links()):
+                raise GangError(
+                    f"gang {key}: preempted box spans a downed ICI link; retry"
                 )
             res = GangReservation(
                 group=pod.group,

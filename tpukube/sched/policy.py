@@ -62,9 +62,12 @@ def find_preemption_plan(
     total: int,
     shape: Optional[tuple[int, int, int]],
     preemptor_priority: int,
+    broken: Optional[set] = None,
 ) -> Optional[PreemptionPlan]:
     """Cheapest victim set whose eviction opens a contiguous `total`-chip
-    box (or the exact `shape`). None when no eligible box exists."""
+    box (or the exact `shape`). None when no eligible box exists. Boxes
+    spanning a downed ICI link are never candidates — evicting pods cannot
+    repair a link, so such a box would be a degraded slice."""
     # A chip may host several workloads (fractional vTPU co-tenants): all
     # of them must be evicted to free it, so the owner map is coord->list.
     owner: dict[TopologyCoord, list[Workload]] = {}
@@ -82,6 +85,7 @@ def find_preemption_plan(
         mesh, grid,
         count=total if shape is None else None,
         shape=shape,
+        broken=broken,
     )
 
     best: Optional[tuple] = None  # (key, coords, victims)

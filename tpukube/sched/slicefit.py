@@ -36,9 +36,39 @@ from typing import Iterable, Optional
 import numpy as np
 
 from tpukube.core.mesh import Box, MeshSpec, factor_shapes, surface
-from tpukube.core.types import TopologyCoord
+from tpukube.core.types import Link, TopologyCoord, canonical_link
 
 Shape = tuple[int, int, int]
+
+
+def coords_break_link(chips: set[TopologyCoord], broken: set[Link]) -> bool:
+    """True if both endpoints of any downed ICI link are in ``chips``.
+
+    A slice containing both ends of a dead link is degraded no matter its
+    geometry — XLA collectives route over mesh adjacency, so the link WILL
+    carry traffic. Containment (not just internal adjacency) is the test.
+    The single source of this predicate; gang sweep and placement share it.
+    """
+    return any(a in chips and b in chips for a, b in broken)
+
+
+def box_breaks_link(
+    mesh: MeshSpec, box: Box, broken: set[Link]
+) -> bool:
+    """``coords_break_link`` specialized to an (optionally torus-wrapped)
+    box, O(|broken|) interval checks — this runs per candidate origin in the
+    sweep hot loop, so no coord-set materialization."""
+    if not broken:
+        return False
+    o, s, dims = box.origin, box.shape, mesh.dims
+
+    def inside(p: TopologyCoord) -> bool:
+        # (p - origin) mod dim < extent is exact for wrapped boxes on torus
+        # axes and, because in-mesh non-torus boxes never wrap, for plain
+        # axes too (the mod only bites when the box wraps).
+        return all((p[i] - o[i]) % dims[i] < s[i] for i in range(3))
+
+    return any(inside(a) and inside(b) for a, b in broken)
 
 
 def occupancy_grid(mesh: MeshSpec, occupied: Iterable[TopologyCoord]) -> np.ndarray:
@@ -241,13 +271,17 @@ def iter_free_boxes(
     grid: np.ndarray,
     count: Optional[int] = None,
     shape: Optional[Shape] = None,
+    broken: Optional[set[Link]] = None,
 ) -> Iterable[ScoredBox]:
-    """All fully-free boxes matching the request, scored, unsorted."""
+    """All fully-free boxes matching the request, scored, unsorted.
+    Boxes spanning a downed ICI link (``broken``) are excluded."""
     _validate_request(count, shape)
     sweep = _Sweep(mesh, grid)
     for shp in _candidate_shapes(mesh, count, shape):
         for origin in sweep.origins(shp):
             box = Box(TopologyCoord(*(int(v) for v in origin)), shp)
+            if broken and box_breaks_link(mesh, box, broken):
+                continue
             yield ScoredBox(
                 box=box,
                 surface=surface(shp),
@@ -262,11 +296,13 @@ def find_slice(
     count: Optional[int] = None,
     shape: Optional[Shape] = None,
     allow_irregular: bool = False,
+    broken: Optional[set[Link]] = None,
 ) -> Optional[list[TopologyCoord]]:
     """Best placement for a gang: the chips of the best free box, or (with
     ``allow_irregular``) a connected free region when no box exists.
 
-    Returns None when the request cannot be satisfied at all.
+    Returns None when the request cannot be satisfied at all. Candidates
+    spanning a downed ICI link (``broken``, canonical pairs) are rejected.
 
     Surface area strictly dominates the score, so the sweep stops after the
     first surface tier that yields any candidate — worse-surface shapes can
@@ -283,6 +319,8 @@ def find_slice(
             break  # strictly worse tier; current best cannot be beaten
         for origin in sweep.origins(shp):
             box = Box(TopologyCoord(*(int(v) for v in origin)), shp)
+            if broken and box_breaks_link(mesh, box, broken):
+                continue
             sb = ScoredBox(
                 box=box,
                 surface=s,
@@ -295,22 +333,37 @@ def find_slice(
     if best is not None:
         return box_coords(mesh, best.box)
     if allow_irregular and shape is None and count is not None:
-        return _find_connected(mesh, grid, count)
+        return _find_connected(mesh, grid, count, broken)
     return None
 
 
 def _find_connected(
-    mesh: MeshSpec, grid: np.ndarray, count: int
+    mesh: MeshSpec, grid: np.ndarray, count: int,
+    broken: Optional[set[Link]] = None,
 ) -> Optional[list[TopologyCoord]]:
     """Greedy connected-region growth over free chips (BFS from the most
     wall-adjacent free chip, preferring frontier chips with max contact).
-    Deterministic. Used only when no box of volume ``count`` exists."""
+    Deterministic. Used only when no box of volume ``count`` exists.
+    Growth never crosses a downed link, and never ADDS a chip that would
+    put both endpoints of a downed link inside the region (a region
+    containing both ends of a dead link is degraded even when they joined
+    through live paths — same containment rule as ``box_breaks_link``)."""
     free = {TopologyCoord(*map(int, idx)) for idx in np.argwhere(~grid)}
     if len(free) < count:
         return None
+    broken = broken or set()
+
+    def live(a: TopologyCoord, b: TopologyCoord) -> bool:
+        return not broken or canonical_link(a, b) not in broken
+
+    def degrades(c: TopologyCoord, chosen: set[TopologyCoord]) -> bool:
+        return any(
+            (c == a and b in chosen) or (c == b and a in chosen)
+            for a, b in broken
+        )
 
     def isolation(c: TopologyCoord) -> int:
-        return -sum(1 for nb in mesh.neighbors(c) if nb in free)
+        return -sum(1 for nb in mesh.neighbors(c) if nb in free and live(c, nb))
 
     # try seeds in decreasing wall/occupied-contact order; first success wins
     seeds = sorted(free, key=lambda c: (isolation(c), tuple(c)))
@@ -322,7 +375,8 @@ def _find_connected(
                 nb
                 for r in region
                 for nb in mesh.neighbors(r)
-                if nb in free and nb not in chosen
+                if nb in free and nb not in chosen and live(r, nb)
+                and not degrades(nb, chosen)
             ]
             if not frontier:
                 break
@@ -330,7 +384,10 @@ def _find_connected(
             nxt = max(
                 frontier,
                 key=lambda c: (
-                    sum(1 for nb in mesh.neighbors(c) if nb in chosen),
+                    sum(
+                        1 for nb in mesh.neighbors(c)
+                        if nb in chosen and live(c, nb)
+                    ),
                     tuple(-v for v in c),
                 ),
             )

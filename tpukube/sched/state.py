@@ -23,6 +23,7 @@ from tpukube.core.types import (
     AllocResult,
     ChipInfo,
     Health,
+    Link,
     NodeInfo,
     TopologyCoord,
     parse_device_id,
@@ -166,6 +167,16 @@ class ClusterState:
                 for view in self._nodes.values()
                 for chip in view.info.chips
                 if chip.health is not Health.HEALTHY
+            }
+
+    def broken_links(self) -> set[Link]:
+        """Downed ICI links, unioned over node reports. Both endpoint hosts
+        may report the same link; canonical pairs dedupe them."""
+        with self._lock:
+            return {
+                link
+                for view in self._nodes.values()
+                for link in view.info.bad_links
             }
 
     def allocation(self, pod_key: str) -> Optional[AllocResult]:

@@ -37,6 +37,7 @@ from tpukube.core.types import (
     NodeInfo,
     PodGroup,
     TopologyCoord,
+    canonical_link,
 )
 from tpukube.sched.extender import Extender, make_app
 
@@ -308,6 +309,22 @@ class SimCluster:
                 chip.health = Health.HEALTHY if healthy else Health.UNHEALTHY
                 return
         raise KeyError(f"{node_name} has no chip {chip_index}")
+
+    def inject_link_fault(self, a, b, up: bool = False) -> None:
+        """Drop (or restore) the ICI link between adjacent coords ``a``/``b``
+        — each endpoint's owning node agent reports its side, exactly as the
+        real health watch would re-annotate (SURVEY.md §6)."""
+        link = canonical_link(a, b)
+        ca, cb = link
+        if cb not in self.mesh.neighbors(ca):
+            raise ValueError(f"{ca} and {cb} are not ICI-adjacent")
+        for coord in link:
+            info = self.nodes[self.mesh.host_of(coord)]
+            if up:
+                if link in info.bad_links:
+                    info.bad_links.remove(link)
+            elif link not in info.bad_links:
+                info.bad_links.append(link)
 
     # -- node-agent composition check (config 2's fan-out leg) ---------------
     def execute_allocation(self, alloc: AllocResult) -> dict[str, str]:
