@@ -1,0 +1,171 @@
+"""Multi-slice (ICI + DCN) cluster tests.
+
+SURVEY.md §3 "distributed communication backend": ICI is intra-slice, DCN is
+inter-slice. A multi-slice cluster therefore has slice-local coordinate
+spaces; gangs (ICI-contiguous by definition) never span slices, and the
+extender's slice choice bin-packs so empty slices stay whole for big gangs.
+"""
+
+import pytest
+
+from tpukube.core.config import load_config
+from tpukube.core.mesh import MeshSpec
+from tpukube.core.types import PodGroup, TopologyCoord
+from tpukube.sim import SimCluster
+
+M22 = MeshSpec(dims=(2, 2, 1), host_block=(2, 2, 1))
+M44 = MeshSpec(dims=(4, 4, 1), host_block=(2, 2, 1))
+
+
+def _cfg(**extra):
+    env = {"TPUKUBE_RESERVATION_TTL_SECONDS": "30"}
+    env.update(extra)
+    return load_config(env=env)
+
+
+def two_slices():
+    return SimCluster(_cfg(), slices={"slice-a": M44, "slice-b": M44})
+
+
+def test_same_coords_in_different_slices_dont_conflict():
+    with SimCluster(_cfg(), slices={"slice-a": M22, "slice-b": M22}) as c:
+        # 8 chips total across two 4-chip slices; all 8 must be placeable
+        # even though every coord exists twice (once per slice)
+        nodes = [c.schedule(c.make_pod(f"p-{i}", tpu=1))[0] for i in range(8)]
+        assert len({n for n in nodes}) == 2  # one node per slice here
+        assert c.utilization() == 1.0
+        with pytest.raises(RuntimeError, match="unschedulable"):
+            c.schedule(c.make_pod("p-8", tpu=1))
+
+
+def test_gang_never_spans_slices():
+    with SimCluster(_cfg(), slices={"slice-a": M22, "slice-b": M22}) as c:
+        # 4 free chips per slice; an 8-pod gang would need both => must fail
+        group = PodGroup("big", min_member=8)
+        with pytest.raises(RuntimeError, match="no contiguous"):
+            c.schedule(c.make_pod("g-0", tpu=1, group=group))
+        # a 4-pod gang fits inside one slice and commits
+        small = PodGroup("small", min_member=4)
+        nodes = {
+            c.schedule(c.make_pod(f"s-{i}", tpu=1, group=small))[0]
+            for i in range(4)
+        }
+        res = c.extender.gang.reservation("default", "small")
+        assert res.committed
+        assert len(nodes) == 1  # one host block == one slice here
+
+
+def test_gang_slice_choice_binpacks():
+    with two_slices() as c:
+        # preload slice-b with 4 pods so it is fuller
+        for i in range(4):
+            node, _ = c.schedule(c.make_pod(f"pre-{i}", tpu=1))
+        # all preloads land on ONE slice (binpack/topology scoring is
+        # deterministic); find which
+        preload_slice = {c.extender.state.slice_of_node(
+            c.pods[f"default/pre-{i}"]["spec"]["nodeName"])
+            for i in range(4)}
+        assert len(preload_slice) == 1
+        loaded = preload_slice.pop()
+        # a 8-pod gang fits in both slices; bin-pack must choose the fuller
+        group = PodGroup("packed", min_member=8)
+        c.schedule(c.make_pod("g-0", tpu=1, group=group))
+        res = c.extender.gang.reservation("default", "packed")
+        assert res.slice_id == loaded
+
+
+def test_link_fault_is_slice_local():
+    with two_slices() as c:
+        # the same link coords are downed in slice-a only
+        c.inject_link_fault((1, 1, 0), (2, 1, 0), slice_id="slice-a")
+        group = PodGroup("whole", min_member=16)  # needs a full 4x4 slice
+        c.schedule(c.make_pod("w-0", tpu=1, group=group))
+        res = c.extender.gang.reservation("default", "whole")
+        assert res.slice_id == "slice-b"
+
+
+def test_preemption_plans_per_slice():
+    with two_slices() as c:
+        # fill BOTH slices with burst pods (priority 1)
+        pods = [c.schedule(c.make_pod(f"b-{i}", tpu=1, priority=1))
+                for i in range(32)]
+        assert c.utilization() == 1.0
+        # a priority-100 16-pod gang must evict exactly one slice's worth
+        group = PodGroup("train", min_member=16)
+        c.schedule(c.make_pod("t-0", tpu=1, group=group, priority=100))
+        res = c.extender.gang.reservation("default", "train")
+        assert res.slice_id in ("slice-a", "slice-b")
+        evicted = c.drain_evictions()
+        # 16 single-chip victims, all in the reservation's slice
+        assert c.extender.preemptions == 16
+        for i in range(1, 16):
+            c.schedule(c.make_pod(f"t-{i}", tpu=1, group=group, priority=100))
+        assert res.committed
+
+
+def test_snapshot_reports_slices():
+    with two_slices() as c:
+        c.schedule(c.make_pod("p-0", tpu=1))
+        c.inject_link_fault((0, 0, 0), (1, 0, 0), slice_id="slice-b")
+        c.schedule(c.make_pod("p-1", tpu=1))  # re-ingest annotations
+        topo = c.extender.topology_snapshot()
+        assert topo["mesh_dims"] is None  # multi-slice: no single dims
+        assert [s["id"] for s in topo["slices"]] == ["slice-a", "slice-b"]
+        by_id = {s["id"]: s for s in topo["slices"]}
+        assert by_id["slice-b"]["links_down"] == [[[0, 0, 0], [1, 0, 0]]]
+        assert by_id["slice-a"]["links_down"] == []
+        assert topo["chips_total"] == 32
+        slices_of_nodes = {n["slice"] for n in topo["nodes"]}
+        assert slices_of_nodes == {"slice-a", "slice-b"}
+
+
+def test_restart_rebuild_restores_gang_slice():
+    from tpukube.core import codec
+    from tpukube.sched.extender import Extender
+
+    with two_slices() as c:
+        group = PodGroup("job", min_member=8)
+        for i in range(8):
+            c.schedule(c.make_pod(f"j-{i}", tpu=1, group=group))
+        old = c.extender.gang.reservation("default", "job")
+        assert old.committed
+        # fresh extender, rebuilt from node + pod annotations only
+        ext = Extender(c.config)
+        for obj in c.node_objects():
+            ext.state.upsert_node(
+                obj["metadata"]["name"], obj["metadata"]["annotations"]
+            )
+        ext.rebuild_from_pods(
+            [p["metadata"]["annotations"] for p in c.pods.values()]
+        )
+        res = ext.gang.reservation("default", "job")
+        assert res is not None and res.committed
+        assert res.slice_id == old.slice_id
+
+
+def test_allocation_executes_on_prefixed_node():
+    """The real device-plugin stack runs for a slice-prefixed node name
+    (free-form host label + explicit origin in the native sim spec)."""
+    with SimCluster(_cfg(), slices={"slice-a": M22, "slice-b": M22}) as c:
+        node, alloc = c.schedule(c.make_pod("p-0", tpu=1))
+        env = c.execute_allocation(alloc)
+        assert env["TPU_KUBE_HOST"] == node
+        assert env["TPU_KUBE_SLICE_ID"] == c.extender.state.slice_of_node(node)
+        got = env["TPU_KUBE_CHIP_COORDS"].split(";")
+        assert len(got) == 1
+
+
+def test_mixed_mesh_sizes_across_slices():
+    with SimCluster(_cfg(), slices={"small": M22, "large": M44}) as c:
+        # a 16-pod gang only fits in the large slice
+        group = PodGroup("big", min_member=16)
+        for i in range(16):
+            c.schedule(c.make_pod(f"g-{i}", tpu=1, group=group))
+        res = c.extender.gang.reservation("default", "big")
+        assert res.committed and res.slice_id == "large"
+        # the small slice still serves singles; 4 + 16 chips all allocated
+        for i in range(4):
+            c.schedule(c.make_pod(f"s-{i}", tpu=1))
+        assert c.utilization() == 1.0
+        with pytest.raises(RuntimeError, match="unschedulable"):
+            c.schedule(c.make_pod("overflow", tpu=1))

@@ -16,6 +16,7 @@ from typing import Optional
 
 from tpukube.core.mesh import MeshSpec
 from tpukube.core.types import (
+    DEFAULT_SLICE,
     AllocResult,
     ChipInfo,
     Health,
@@ -63,6 +64,7 @@ def encode_node_topology(node: NodeInfo, mesh: MeshSpec) -> str:
         {
             "v": SCHEMA_VERSION,
             "node": node.name,
+            "slice": node.slice_id,
             "mesh": mesh.to_json(),
             "sharesPerChip": node.shares_per_chip,
             "chips": [
@@ -126,11 +128,15 @@ def decode_node_topology(payload: str) -> tuple[NodeInfo, MeshSpec]:
         bad_links = [canonical_link(a, b) for a, b in raw_links]
     except (TypeError, ValueError) as e:
         raise CodecError(f"node-topology: malformed badLinks entry: {e}") from e
+    slice_id = obj.get("slice", DEFAULT_SLICE)
+    if not isinstance(slice_id, str) or not slice_id:
+        raise CodecError(f"node-topology: bad slice id {slice_id!r}")
     node = NodeInfo(
         name=_field(obj, "node", "node-topology"),
         chips=chips,
         shares_per_chip=shares,
         bad_links=bad_links,
+        slice_id=slice_id,
     )
     return node, mesh
 

@@ -47,11 +47,19 @@ class TpuKubeConfig:
     trace_capacity: int = 4096
     trace_path: str = ""
 
+    # Which ICI slice this node belongs to (multi-slice clusters name
+    # their pod slices; coords are slice-local — SURVEY.md §3 ICI/DCN note)
+    slice_id: str = "slice-0"
+
     # sim topology (used when backend == "sim")
     backend: str = "sim"  # sim | real
     sim_mesh_dims: tuple[int, int, int] = (4, 4, 4)
     sim_host_block: tuple[int, int, int] = (2, 2, 1)
     sim_torus: tuple[bool, bool, bool] = (False, False, False)
+    # chip-coord origin of this host's block ("x,y,z"); empty = derive from
+    # the host name's host-i-j-k convention. Set it when node names do not
+    # follow that convention (e.g. multi-slice sims prefix the slice id).
+    sim_host_origin: str = ""
     hbm_bytes_per_chip: int = DEFAULT_HBM_BYTES
     cores_per_chip: int = 2
 
@@ -127,4 +135,12 @@ def load_config(
         raise ValueError(f"unknown score_mode {cfg.score_mode!r}")
     if cfg.backend not in ("sim", "real"):
         raise ValueError(f"unknown backend {cfg.backend!r}")
+    if cfg.sim_host_origin:
+        parts = cfg.sim_host_origin.split(",")
+        if len(parts) != 3 or not all(p.strip().lstrip("-").isdigit() for p in parts):
+            raise ValueError(
+                f"sim_host_origin must be 'x,y,z', got {cfg.sim_host_origin!r}"
+            )
+    if not cfg.slice_id:
+        raise ValueError("slice_id must be non-empty")
     return cfg

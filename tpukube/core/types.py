@@ -25,6 +25,10 @@ from typing import Iterator, Mapping, NamedTuple, Optional
 RESOURCE_TPU = "qiniu.com/tpu"
 RESOURCE_VTPU = "qiniu.com/vtpu"
 
+# Slice id a node reports when the cluster has a single ICI domain (the
+# common case; multi-slice clusters name theirs, e.g. "slice-a").
+DEFAULT_SLICE = "slice-0"
+
 # Device-id scheme minted by the node agent (L2/L3):
 #   whole chip:       tpu-<index>
 #   fractional share: tpu-<index>-frac<k>of<n>
@@ -171,6 +175,11 @@ class NodeInfo:
     # pairs). The health watch reports them like chip faults; the scheduler
     # keeps gang slices off degraded links (SURVEY.md §6 fault injection).
     bad_links: list[Link] = field(default_factory=list)
+    # Which ICI domain (pod slice) this node belongs to. A cluster may hold
+    # several slices connected only over DCN; chip coords are meaningful
+    # within one slice, so every coord the scheduler touches is implicitly
+    # (slice_id, coord). Gangs are ICI-contiguous and thus slice-confined.
+    slice_id: str = DEFAULT_SLICE
 
     def healthy_chips(self) -> list[ChipInfo]:
         return [c for c in self.chips if c.health is Health.HEALTHY]

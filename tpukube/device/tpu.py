@@ -46,6 +46,7 @@ ENV_KUBE_DEVICE_IDS = "TPU_KUBE_DEVICE_IDS"
 ENV_KUBE_CHIP_COORDS = "TPU_KUBE_CHIP_COORDS"
 ENV_KUBE_MESH_DIMS = "TPU_KUBE_MESH_DIMS"
 ENV_KUBE_HOST = "TPU_KUBE_HOST"
+ENV_KUBE_SLICE = "TPU_KUBE_SLICE_ID"  # ICI domain (multi-slice clusters)
 ENV_HBM_LIMIT = "TPU_HBM_LIMIT_BYTES"
 ENV_MEM_FRACTION = "XLA_PYTHON_CLIENT_MEM_FRACTION"
 # vTPU TensorCore partition (BASELINE: "partitions TPU HBM and TensorCores"):
@@ -72,11 +73,16 @@ class TpuDeviceManager:
         self._lock = threading.Lock()
         self._host = host or "host-0-0-0"
         if config.backend == "sim":
+            origin = None
+            if config.sim_host_origin:
+                x, y, z = config.sim_host_origin.split(",")
+                origin = (int(x), int(y), int(z))
             spec = sim_spec(
                 config.sim_mesh(),
                 self._host,
                 config.hbm_bytes_per_chip,
                 config.cores_per_chip,
+                origin=origin,
             )
             self._ti = TpuInfo("sim", spec)
         else:
@@ -128,6 +134,7 @@ class TpuDeviceManager:
             chips=chips,
             shares_per_chip=self._config.shares_per_chip,
             bad_links=bad_links,
+            slice_id=self._config.slice_id,
         )
 
     def shares_of(self, chip: ChipInfo) -> list[VtpuShare]:
@@ -213,6 +220,7 @@ class TpuDeviceManager:
                 ),
                 ENV_KUBE_MESH_DIMS: ",".join(str(d) for d in self._mesh.dims),
                 ENV_KUBE_HOST: self._host,
+                ENV_KUBE_SLICE: self._config.slice_id,
                 ENV_HBM_LIMIT: str(hbm_limit),
             }
             if shares_mode:

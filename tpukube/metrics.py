@@ -56,7 +56,10 @@ def render_extender_metrics(extender) -> str:
     out.append(_fmt("gang_schedule_latency_seconds_sum", sum(lats)))
 
     out.append("# TYPE tpukube_ici_links_down gauge\n")
-    out.append(_fmt("tpukube_ici_links_down", len(extender.state.broken_links())))
+    out.append(_fmt("tpukube_ici_links_down", sum(
+        len(extender.state.broken_links(sid))
+        for sid in extender.state.slice_ids()
+    )))
 
     out.append("# TYPE tpukube_binds_total counter\n")
     out.append(_fmt("tpukube_binds_total", extender.binds_total))

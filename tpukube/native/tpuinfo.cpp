@@ -80,6 +80,8 @@ int init_sim(const char* spec) {
   std::string host = "host-0-0-0";
   int64_t hbm = 95LL << 30;
   int32_t cores = 2;
+  int32_t origin[3] = {0, 0, 0};
+  bool have_origin = false;
 
   for (const auto& [key, val] : parse_spec(spec)) {
     if (key == "dims") {
@@ -90,6 +92,9 @@ int init_sim(const char* spec) {
       if (!parse_triple(val, torus)) { set_error("sim: bad torus: " + val); return -1; }
     } else if (key == "host") {
       host = val;
+    } else if (key == "origin") {
+      if (!parse_triple(val, origin)) { set_error("sim: bad origin: " + val); return -1; }
+      have_origin = true;
     } else if (key == "hbm") {
       hbm = std::strtoll(val.c_str(), nullptr, 10);
       if (hbm <= 0) { set_error("sim: bad hbm: " + val); return -1; }
@@ -107,15 +112,28 @@ int init_sim(const char* spec) {
       return -1;
     }
   }
-  int hg[3];  /* host grid position parsed from the host name */
-  if (std::sscanf(host.c_str(), "host-%d-%d-%d", &hg[0], &hg[1], &hg[2]) != 3) {
-    set_error("sim: malformed host name (want host-i-j-k): " + host);
-    return -1;
-  }
-  for (int a = 0; a < 3; ++a) {
-    if (hg[a] < 0 || hg[a] >= dims[a] / host_block[a]) {
-      set_error("sim: host outside host grid: " + host);
+  if (have_origin) {
+    /* explicit chip-coord origin of the host block: the host name is then
+     * a free-form label (multi-slice sims prefix slice ids) */
+    for (int a = 0; a < 3; ++a) {
+      if (origin[a] < 0 || origin[a] + host_block[a] > dims[a] ||
+          origin[a] % host_block[a] != 0) {
+        set_error("sim: origin not host_block-aligned inside dims");
+        return -1;
+      }
+    }
+  } else {
+    int hg[3];  /* host grid position parsed from the host name */
+    if (std::sscanf(host.c_str(), "host-%d-%d-%d", &hg[0], &hg[1], &hg[2]) != 3) {
+      set_error("sim: malformed host name (want host-i-j-k, or pass origin=): " + host);
       return -1;
+    }
+    for (int a = 0; a < 3; ++a) {
+      if (hg[a] < 0 || hg[a] >= dims[a] / host_block[a]) {
+        set_error("sim: host outside host grid: " + host);
+        return -1;
+      }
+      origin[a] = hg[a] * host_block[a];
     }
   }
 
@@ -132,9 +150,9 @@ int init_sim(const char* spec) {
       for (int dx = 0; dx < host_block[0]; ++dx) {
         tpuinfo_chip c{};
         c.index = idx;
-        c.coord[0] = hg[0] * host_block[0] + dx;
-        c.coord[1] = hg[1] * host_block[1] + dy;
-        c.coord[2] = hg[2] * host_block[2] + dz;
+        c.coord[0] = origin[0] + dx;
+        c.coord[1] = origin[1] + dy;
+        c.coord[2] = origin[2] + dz;
         std::snprintf(c.chip_id, TPUINFO_MAX_ID, "%s-chip-%d", host.c_str(), idx);
         c.hbm_bytes = hbm;
         c.num_cores = cores;

@@ -120,13 +120,18 @@ def sim_spec(
     host: str,
     hbm_bytes: int,
     cores: int = 2,
+    origin: Optional[tuple[int, int, int]] = None,
 ) -> str:
-    """Render the key=value sim spec libtpuinfo parses."""
+    """Render the key=value sim spec libtpuinfo parses.
+
+    ``origin`` pins the host block's chip-coord origin explicitly; without
+    it the C side derives the origin from the host-i-j-k name convention
+    (so free-form node names — e.g. slice-prefixed — need origin)."""
 
     def triple(t) -> str:
         return ",".join(str(int(v)) for v in t)
 
-    return (
+    out = (
         f"dims={triple(mesh.dims)}\n"
         f"host_block={triple(mesh.host_block)}\n"
         f"torus={triple(mesh.torus)}\n"
@@ -134,6 +139,9 @@ def sim_spec(
         f"hbm={hbm_bytes}\n"
         f"cores={cores}\n"
     )
+    if origin is not None:
+        out += f"origin={triple(origin)}\n"
+    return out
 
 
 class TpuInfo:

@@ -31,6 +31,7 @@ from aiohttp import web
 from tpukube.core import codec
 from tpukube.core.config import TpuKubeConfig
 from tpukube.core.types import (
+    DEFAULT_SLICE,
     RESOURCE_TPU,
     RESOURCE_VTPU,
     AllocResult,
@@ -175,7 +176,7 @@ class Extender:
                     res = None
             else:
                 self.gang.sweep()
-            reserved = self.gang.reserved_coords() if res is None else None
+            reserved = self._reserved_by_slice() if res is None else None
             feasible, failed = [], {}
             for obj in raw_nodes:
                 name, _ = kube.node_name_and_annotations(obj)
@@ -191,13 +192,21 @@ class Extender:
         finally:
             self.latencies["filter"].append(time.monotonic() - t0)
 
+    def _reserved_by_slice(self) -> dict[str, set[TopologyCoord]]:
+        return {
+            sid: self.gang.reserved_coords(sid)
+            for sid in self.state.slice_ids()
+        }
+
     def _try_preemption(self, pod: PodInfo, count: int) -> GangReservation:
         """Open a contiguous slice for a gang by evicting lower-priority
-        pods. Raises GangError (propagates unschedulability) if no eligible
-        victim set exists or the pod has no priority to preempt with."""
+        pods. Plans per ICI slice (victim chips only help inside their own
+        slice) and applies the cheapest plan across slices. Raises GangError
+        (propagates unschedulability) if no eligible victim set exists or
+        the pod has no priority to preempt with."""
         assert pod.group is not None
-        mesh = self.state.mesh
-        if mesh is None or pod.priority <= 0:
+        slice_ids = self.state.slice_ids()
+        if not slice_ids or pod.priority <= 0:
             raise GangError(
                 f"gang {pod.namespace}/{pod.group.name}: no contiguous slice "
                 f"and priority {pod.priority} cannot preempt"
@@ -211,19 +220,30 @@ class Extender:
                     f"{pod.group.shape} holds {sx * sy * sz} chips but the "
                     f"gang needs {total} — refusing to preempt for it"
                 )
-        plan = policy.find_preemption_plan(
-            self._preemption_workloads(),
-            mesh,
-            self.state.unhealthy_coords(),
-            total,
-            pod.group.shape,
-            pod.priority,
-            broken=self.state.broken_links(),
-        )
+        workloads = self._preemption_workloads()
+        plan = None
+        plan_slice = None
+        best_rank = None
+        for sid in slice_ids:
+            cand = policy.find_preemption_plan(
+                [w for w in workloads if w.slice_id == sid],
+                self.state.slice_mesh(sid),
+                self.state.unhealthy_coords(sid),
+                total,
+                pod.group.shape,
+                pod.priority,
+                broken=self.state.broken_links(sid),
+            )
+            if cand is None:
+                continue
+            rank = (cand.cost_priority_sum, cand.victim_count, sid)
+            if best_rank is None or rank < best_rank:
+                best_rank, plan, plan_slice = rank, cand, sid
         if plan is None:
             raise GangError(
                 f"gang {pod.namespace}/{pod.group.name}: no victim set opens "
-                f"a contiguous {total}-chip slice at priority {pod.priority}"
+                f"a contiguous {total}-chip slice at priority {pod.priority} "
+                f"in any of {len(slice_ids)} ICI slices"
             )
         evicted_pods = 0
         for victim in plan.victims:
@@ -237,11 +257,14 @@ class Extender:
         self.preemptions += evicted_pods
         log.warning(
             "gang %s/%s preempts %d workloads / %d pods (priority sum %d) "
-            "for a %d-chip slice",
+            "for a %d-chip slice in %s",
             pod.namespace, pod.group.name,
             plan.victim_count, evicted_pods, plan.cost_priority_sum, total,
+            plan_slice,
         )
-        return self.gang.reserve_exact(pod, count, plan.coords)
+        return self.gang.reserve_exact(
+            pod, count, plan.coords, slice_id=plan_slice
+        )
 
     def _preemption_workloads(self) -> list[policy.Workload]:
         """Current workloads at preemption granularity: whole gangs (with
@@ -270,9 +293,17 @@ class Extender:
                 coords=frozenset(coords),
                 pod_keys=tuple(members),
                 gang_key=res.key,
+                slice_id=res.slice_id,
             ))
         for alloc in self.state.allocations():
             if alloc.pod_key in gang_pods:
+                continue
+            sid = self.state.slice_of_node(alloc.node_name)
+            if sid is None:
+                # node view gone (deleted mid-teardown): its chips are not
+                # in any slice's occupied set either, so skipping keeps the
+                # planner's view consistent — guessing a slice would plant
+                # these coords in the wrong coordinate space
                 continue
             prio = self.state.priority_of(alloc.pod_key)
             out.append(policy.Workload(
@@ -281,6 +312,7 @@ class Extender:
                 cost=prio,
                 coords=frozenset(TopologyCoord.of(c) for c in alloc.coords),
                 pod_keys=(alloc.pod_key,),
+                slice_id=sid,
             ))
         return out
 
@@ -289,10 +321,11 @@ class Extender:
         name: str,
         resource: str,
         count: int,
-        reserved: Optional[set[TopologyCoord]] = None,
+        reserved: Optional[dict[str, set[TopologyCoord]]] = None,
     ) -> Optional[str]:
         """None if feasible, else a human-readable reason. ``reserved`` is
-        the gang mask — pass it in when calling per-node in a loop."""
+        the per-slice gang mask — pass it in when calling per-node in a
+        loop (coords are slice-local, so the mask is keyed by slice)."""
         view = self.state.node(name)
         if view is None:
             return "no tpukube node-topology annotation"
@@ -306,9 +339,12 @@ class Extender:
             return None
         if vtpu_node:
             return "node is vTPU mode, pod wants whole chips"
-        if reserved is None:
-            reserved = self.gang.reserved_coords()
-        free = sum(1 for c in view.free_chips() if c.coord not in reserved)
+        sid = view.info.slice_id
+        mask = (
+            reserved.get(sid, set()) if reserved is not None
+            else self.gang.reserved_coords(sid)
+        )
+        free = sum(1 for c in view.free_chips() if c.coord not in mask)
         if free < count:
             return f"wants {count} chips, node has {free} free (gang reservations excluded)"
         return None
@@ -334,20 +370,23 @@ class Extender:
                 if res is None:
                     return {n: 0 for n in names}
                 # overflow replica of a full gang: fall through to normal
-            # the occupancy sweep and gang mask depend only on cluster
-            # state — build once per request, not per node (hot path)
-            reserved = self.gang.reserved_coords()
-            sweep = None
+            # the occupancy sweeps and gang masks depend only on cluster
+            # state — build once per request, not per node (hot path);
+            # both are slice-keyed (coords are slice-local)
+            reserved = self._reserved_by_slice()
+            sweeps: Optional[dict[str, "slicefit._Sweep"]] = None
             if self._config.score_mode == "topology" and resource == RESOURCE_TPU:
-                mesh = self.state.mesh
-                if mesh is not None:
+                sweeps = {}
+                for sid in self.state.slice_ids():
+                    mesh = self.state.slice_mesh(sid)
                     grid = slicefit.occupancy_grid(
-                        mesh, self.state.occupied_coords() | reserved
+                        mesh,
+                        self.state.occupied_coords(sid) | reserved.get(sid, set()),
                     )
-                    sweep = slicefit._Sweep(mesh, grid)
+                    sweeps[sid] = slicefit._Sweep(mesh, grid)
             scores: dict[str, int] = {}
             for name in names:
-                scores[name] = self._score_node(name, resource, count, sweep, reserved)
+                scores[name] = self._score_node(name, resource, count, sweeps, reserved)
             return scores
         finally:
             self.latencies["prioritize"].append(time.monotonic() - t0)
@@ -357,8 +396,8 @@ class Extender:
         name: str,
         resource: str,
         count: int,
-        sweep: Optional["slicefit._Sweep"] = None,
-        reserved: Optional[set[TopologyCoord]] = None,
+        sweeps: Optional[dict[str, "slicefit._Sweep"]] = None,
+        reserved: Optional[dict[str, set[TopologyCoord]]] = None,
     ) -> int:
         view = self.state.node(name)
         if view is None or self._node_feasibility(name, resource, count, reserved):
@@ -389,10 +428,13 @@ class Extender:
             return min(MAX_SCORE, round(MAX_SCORE * (reused + 1) / (len(plan) + 1)))
         # whole chips: snugness — chips packed against walls/allocations
         # leave the mesh least fragmented, keeping future gangs' boxes open
+        sid = view.info.slice_id
+        sweep = sweeps.get(sid) if sweeps is not None else None
         if sweep is None:
-            mesh = self.state.mesh
-            assert mesh is not None
-            grid = slicefit.occupancy_grid(mesh, self.state.occupied_coords())
+            mesh = self.state.slice_mesh(sid)
+            grid = slicefit.occupancy_grid(
+                mesh, self.state.occupied_coords(sid)
+            )
             sweep = slicefit._Sweep(mesh, grid)
         contact = 0
         max_contact = 0
@@ -414,7 +456,7 @@ class Extender:
         view: NodeView,
         resource: str,
         count: int,
-        reserved: Optional[set[TopologyCoord]] = None,
+        reserved: Optional[dict[str, set[TopologyCoord]]] = None,
     ) -> Optional[list[TopologyCoord]]:
         """Choose concrete chips on one node for a request.
 
@@ -438,12 +480,14 @@ class Extender:
                 if remaining == 0:
                     return out
             return None
-        mesh = self.state.mesh
-        assert mesh is not None
-        if reserved is None:
-            reserved = self.gang.reserved_coords()
+        sid = view.info.slice_id
+        mesh = self.state.slice_mesh(sid)
+        mask_set = (
+            reserved.get(sid, set()) if reserved is not None
+            else self.gang.reserved_coords(sid)
+        )
         node_free = {
-            c.coord for c in view.free_chips() if c.coord not in reserved
+            c.coord for c in view.free_chips() if c.coord not in mask_set
         }
         if len(node_free) < count:
             return None
@@ -455,7 +499,7 @@ class Extender:
             mask[tuple(c)] = False
         placed = slicefit.find_slice(
             mesh, mask, count=count, allow_irregular=True,
-            broken=self.state.broken_links(),
+            broken=self.state.broken_links(sid),
         )
         if placed is not None:
             return placed
@@ -578,22 +622,31 @@ class Extender:
 
     # -- inspection (tpukubectl + /state endpoints) --------------------------
     def topology_snapshot(self) -> dict[str, Any]:
-        """Cluster topology + occupancy as plain JSON (for tpukubectl topo)."""
-        mesh = self.state.mesh
-        occupied = self.state.occupied_coords()
-        reserved = self.gang.reserved_coords()
-        unhealthy = self.state.unhealthy_coords()
+        """Cluster topology + occupancy as plain JSON (for tpukubectl topo).
+        Per-slice sections carry the slice-local coord sets; the top-level
+        fields aggregate across slices (mesh_dims is the sole slice's dims
+        on a single-slice cluster, null otherwise)."""
+        slice_ids = self.state.slice_ids()
+        per_slice: dict[str, dict[str, Any]] = {}
+        for sid in slice_ids:
+            per_slice[sid] = {
+                "occupied": self.state.occupied_coords(sid),
+                "reserved": self.gang.reserved_coords(sid),
+                "unhealthy": self.state.unhealthy_coords(sid),
+                "broken": sorted(self.state.broken_links(sid)),
+            }
         nodes = []
         for name in self.state.node_names():
             view = self.state.node(name)
             if view is None:
                 continue
+            s = per_slice[view.info.slice_id]
             chips = []
             for chip in view.info.chips:
                 status = (
-                    "unhealthy" if chip.coord in unhealthy
-                    else "allocated" if chip.coord in occupied
-                    else "reserved" if chip.coord in reserved
+                    "unhealthy" if chip.coord in s["unhealthy"]
+                    else "allocated" if chip.coord in s["occupied"]
+                    else "reserved" if chip.coord in s["reserved"]
                     else "free"
                 )
                 chips.append({
@@ -603,16 +656,40 @@ class Extender:
                     "used_shares": view.used_share_count(chip.index),
                     "shares": view.shares_per_chip,
                 })
-            nodes.append({"name": name, "chips": chips})
-        broken = sorted(self.state.broken_links())
+            nodes.append(
+                {"name": name, "slice": view.info.slice_id, "chips": chips}
+            )
         return {
-            "mesh_dims": list(mesh.dims) if mesh else None,
+            "mesh_dims": (
+                list(self.state.slice_mesh(slice_ids[0]).dims)
+                if len(slice_ids) == 1 else None
+            ),
             "utilization_percent": round(100.0 * self.state.utilization(), 2),
             "chips_total": sum(len(n["chips"]) for n in nodes),
-            "chips_allocated": len(occupied),
-            "chips_reserved_unbound": len(reserved - occupied),
-            "chips_unhealthy": len(unhealthy),
-            "links_down": [[list(a), list(b)] for a, b in broken],
+            "chips_allocated": sum(len(s["occupied"]) for s in per_slice.values()),
+            "chips_reserved_unbound": sum(
+                len(s["reserved"] - s["occupied"]) for s in per_slice.values()
+            ),
+            "chips_unhealthy": sum(
+                len(s["unhealthy"]) for s in per_slice.values()
+            ),
+            "links_down": [
+                [list(a), list(b)]
+                for s in per_slice.values() for a, b in s["broken"]
+            ],
+            "slices": [
+                {
+                    "id": sid,
+                    "mesh_dims": list(self.state.slice_mesh(sid).dims),
+                    "utilization_percent": round(
+                        100.0 * self.state.slice_utilization(sid), 2
+                    ),
+                    "links_down": [
+                        [list(a), list(b)] for a, b in per_slice[sid]["broken"]
+                    ],
+                }
+                for sid in slice_ids
+            ],
             "nodes": nodes,
         }
 
@@ -640,6 +717,7 @@ class Extender:
                 "members_bound": len(res.assigned),
                 "committed": res.committed,
                 "priority": res.priority,
+                "slice": res.slice_id,
                 "coords": [list(c) for c in sorted(res.coords)],
             })
         return sorted(out, key=lambda g: (g["namespace"], g["group"]))

@@ -34,9 +34,15 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
 
-from tpukube.core.types import Health, PodGroup, PodInfo, TopologyCoord
+from tpukube.core.types import (
+    DEFAULT_SLICE,
+    Health,
+    PodGroup,
+    PodInfo,
+    TopologyCoord,
+)
 from tpukube.sched import slicefit
-from tpukube.sched.state import ClusterState
+from tpukube.sched.state import ClusterState, StateError
 
 log = logging.getLogger("tpukube.gang")
 
@@ -55,8 +61,10 @@ class NoSliceError(GangError):
 class GangReservation:
     group: PodGroup
     namespace: str
-    coords: set[TopologyCoord]  # the whole reserved slice
+    coords: set[TopologyCoord]  # the whole reserved slice (slice-local)
     chips_per_pod: int
+    slice_id: str = DEFAULT_SLICE  # the ICI domain the box lives in; gangs
+    # are ICI-contiguous, so a gang never spans slices (DCN is not ICI)
     priority: int = 0  # the reserving pods' priority (preemption blocking)
     created: float = field(default_factory=time.monotonic)
     assigned: dict[str, list[TopologyCoord]] = field(default_factory=dict)
@@ -102,16 +110,21 @@ class GangManager:
         with self._lock:
             return self._reservations.get((namespace, group_name))
 
-    def reserved_coords(self) -> set[TopologyCoord]:
+    def reserved_coords(
+        self, slice_id: Optional[str] = None
+    ) -> set[TopologyCoord]:
         """Chips held for gang members that have not bound yet — masked out
-        of every other placement. Assigned chips are NOT included: those
-        live in the ledger as per-pod allocations already (state.commit runs
-        before on_bound), and double-masking them would leak capacity after
-        a committed gang's pods finish."""
+        of every other placement. Coords are slice-local, so callers name
+        the slice (None = all reservations, for single-slice callers).
+        Assigned chips are NOT included: those live in the ledger as
+        per-pod allocations already (state.commit runs before on_bound),
+        and double-masking them would leak capacity after a committed
+        gang's pods finish."""
         with self._lock:
             out: set[TopologyCoord] = set()
             for res in self._reservations.values():
-                out |= res.unassigned_coords()
+                if slice_id is None or res.slice_id == slice_id:
+                    out |= res.unassigned_coords()
             return out
 
     # -- expiry / fault sweep ----------------------------------------------
@@ -123,15 +136,20 @@ class GangManager:
         Returns the rolled-back group keys."""
         now = time.monotonic() if now is None else now
         rolled: list[tuple[str, str]] = []
-        unhealthy = self._state.unhealthy_coords()
-        broken = self._state.broken_links()
+        unhealthy: dict[str, set[TopologyCoord]] = {}
+        broken: dict[str, set] = {}
+        for sid in self._state.slice_ids():
+            unhealthy[sid] = self._state.unhealthy_coords(sid)
+            broken[sid] = self._state.broken_links(sid)
         with self._lock:
             for key, res in list(self._reservations.items()):
                 if res.committed:
                     continue
                 expired = now - res.created > self._ttl
-                sick = self._has_unhealthy_chip(res, unhealthy)
-                cut = self._has_broken_link(res, broken)
+                sick = self._has_unhealthy_chip(
+                    res, unhealthy.get(res.slice_id, set())
+                )
+                cut = self._has_broken_link(res, broken.get(res.slice_id, set()))
                 if expired or sick or cut:
                     why = (
                         "TTL expired" if expired
@@ -182,35 +200,52 @@ class GangManager:
                         f"{res.chips_per_pod}"
                     )
                 return res
-            mesh = self._state.mesh
-            if mesh is None:
+            slice_ids = self._state.slice_ids()
+            if not slice_ids:
                 raise GangError("no node topology known yet")
             total = pod.group.min_member * chips_per_pod
-            occupied = self._state.occupied_coords() | self.reserved_coords()
-            broken = self._state.broken_links()
             if pod.group.shape is not None:
-                coords = slicefit.find_slice(
-                    mesh, occupied, shape=pod.group.shape, broken=broken
-                )
-                if coords is not None and len(coords) != total:
+                sx, sy, sz = pod.group.shape
+                if sx * sy * sz != total:
                     raise GangError(
                         f"gang {key}: shape {pod.group.shape} holds "
-                        f"{len(coords)} chips but the gang needs {total}"
+                        f"{sx * sy * sz} chips but the gang needs {total}"
                     )
-            else:
+            # A gang is ICI-contiguous, hence confined to ONE slice (DCN
+            # crossings are the thing the scorer exists to prevent). Slice
+            # choice bin-packs: the fullest slice that still fits wins, so
+            # emptier slices stay whole for bigger gangs. Deterministic
+            # tie-break on slice id.
+            chosen: Optional[tuple[float, str, list[TopologyCoord]]] = None
+            free_total = 0
+            for sid in slice_ids:
+                occupied = self._state.occupied_coords(sid) | self.reserved_coords(sid)
+                mesh = self._state.slice_mesh(sid)
+                free_total += mesh.num_chips - len(occupied)
                 coords = slicefit.find_slice(
-                    mesh, occupied, count=total, broken=broken
+                    mesh, occupied,
+                    count=None if pod.group.shape is not None else total,
+                    shape=pod.group.shape,
+                    broken=self._state.broken_links(sid),
                 )
-            if coords is None:
+                if coords is None:
+                    continue
+                rank = (-self._state.slice_utilization(sid), sid)
+                if chosen is None or rank < (chosen[0], chosen[1]):
+                    chosen = (rank[0], sid, coords)
+            if chosen is None:
                 raise NoSliceError(
                     f"gang {key}: no contiguous {total}-chip slice available "
-                    f"({mesh.num_chips - len(occupied)} chips free)"
+                    f"in any of {len(slice_ids)} ICI slices "
+                    f"({free_total} chips free)"
                 )
+            _, sid, coords = chosen
             res = GangReservation(
                 group=pod.group,
                 namespace=pod.namespace,
                 coords=set(coords),
                 chips_per_pod=chips_per_pod,
+                slice_id=sid,
                 priority=pod.priority,
             )
             self._reservations[key] = res
@@ -265,12 +300,30 @@ class GangManager:
             if key in self._reservations or not allocs:
                 return self._reservations.get(key)
             chips_per_pod = max(1, len(allocs[0].coords))
+            # the members' nodes know which ICI slice the gang lives in;
+            # with the node view gone, only an unambiguous (single-slice)
+            # cluster lets us proceed — guessing would mix coord spaces
+            slice_id = self._state.slice_of_node(allocs[0].node_name)
+            if slice_id is None:
+                sids = self._state.slice_ids()
+                if len(sids) != 1:
+                    log.warning(
+                        "gang %s/%s: member node %s unknown and cluster has "
+                        "%d slices — rolling back", namespace, group.name,
+                        allocs[0].node_name, len(sids),
+                    )
+                    for a in allocs:
+                        self._state.release(a.pod_key)
+                        self._evictions.append(a.pod_key)
+                    self.rollbacks += 1
+                    return None
+                slice_id = sids[0] if sids else DEFAULT_SLICE
             assigned_coords = {c for a in allocs for c in a.coords}
             committed = len(allocs) >= group.min_member
             coords = set(assigned_coords)
             if not committed:
                 coords_or_none = self._recomplete_slice(
-                    group, chips_per_pod, assigned_coords
+                    group, chips_per_pod, assigned_coords, slice_id
                 )
                 if coords_or_none is None:
                     log.warning(
@@ -289,6 +342,7 @@ class GangManager:
                 namespace=namespace,
                 coords=coords,
                 chips_per_pod=chips_per_pod,
+                slice_id=slice_id,
                 priority=max(a.priority for a in allocs),
             )
             for a in allocs:
@@ -307,19 +361,21 @@ class GangManager:
         group: PodGroup,
         chips_per_pod: int,
         assigned: set[TopologyCoord],
+        slice_id: str,
     ) -> Optional[set[TopologyCoord]]:
         """Full-size contiguous box containing ``assigned``, treating the
         members' own chips as free (they are the gang's). None if the mesh
         is unknown or no such box exists."""
-        mesh = self._state.mesh
-        if mesh is None:
+        try:
+            mesh = self._state.slice_mesh(slice_id)
+        except StateError:
             return None
         total = group.min_member * chips_per_pod
         shape = group.shape
         if shape is not None and shape[0] * shape[1] * shape[2] != total:
             shape = None  # malformed hint: fall back to count search
         occupied = (
-            self._state.occupied_coords() | self.reserved_coords()
+            self._state.occupied_coords(slice_id) | self.reserved_coords(slice_id)
         ) - assigned
         grid = slicefit.occupancy_grid(mesh, occupied)
         best: Optional[tuple] = None
@@ -327,7 +383,7 @@ class GangManager:
             mesh, grid,
             count=total if shape is None else None,
             shape=shape,
-            broken=self._state.broken_links(),
+            broken=self._state.broken_links(slice_id),
         ):
             box_set = set(slicefit.box_coords(mesh, sb.box))
             if assigned <= box_set and (
@@ -337,7 +393,8 @@ class GangManager:
         return best[1] if best is not None else None
 
     def reserve_exact(
-        self, pod: PodInfo, chips_per_pod: int, coords: list[TopologyCoord]
+        self, pod: PodInfo, chips_per_pod: int, coords: list[TopologyCoord],
+        slice_id: str = DEFAULT_SLICE,
     ) -> GangReservation:
         """Reserve a specific chip set (the preemption path: policy already
         chose the box and evicted its victims). Raises if any chip was
@@ -354,13 +411,18 @@ class GangManager:
                     f"gang {key}: preemption opened {len(coords)} chips but "
                     f"the gang needs {expected}"
                 )
-            occupied = self._state.occupied_coords() | self.reserved_coords()
+            occupied = (
+                self._state.occupied_coords(slice_id)
+                | self.reserved_coords(slice_id)
+            )
             clash = [c for c in coords if c in occupied]
             if clash:
                 raise GangError(
                     f"gang {key}: preempted box re-occupied at {clash[:3]}; retry"
                 )
-            if slicefit.coords_break_link(set(coords), self._state.broken_links()):
+            if slicefit.coords_break_link(
+                set(coords), self._state.broken_links(slice_id)
+            ):
                 raise GangError(
                     f"gang {key}: preempted box spans a downed ICI link; retry"
                 )
@@ -369,6 +431,7 @@ class GangManager:
                 namespace=pod.namespace,
                 coords=set(coords),
                 chips_per_pod=chips_per_pod,
+                slice_id=slice_id,
                 priority=pod.priority,
             )
             self._reservations[key] = res
@@ -379,18 +442,22 @@ class GangManager:
             return res
 
     # -- per-node queries for the extender ----------------------------------
+    @staticmethod
+    def _on_node(hosts: dict, node_name: str, coords) -> int:
+        """How many of ``coords`` live on ``node_name``, against a coord->
+        host snapshot (annotation-derived — host naming is not a geometry
+        contract; one snapshot per query, not one lock per coord)."""
+        return sum(1 for c in coords if hosts.get(c) == node_name)
+
     def node_feasibility(
         self, res: GangReservation, node_name: str
     ) -> Optional[str]:
-        mesh = self._state.mesh
-        assert mesh is not None
+        hosts = self._state.hosts_by_coord(res.slice_id)
         with self._lock:
-            avail = [
-                c for c in res.unassigned_coords() if mesh.host_of(c) == node_name
-            ]
-            if len(avail) < res.chips_per_pod:
+            avail = self._on_node(hosts, node_name, res.unassigned_coords())
+            if avail < res.chips_per_pod:
                 return (
-                    f"gang slice has {len(avail)} unassigned chips here, "
+                    f"gang slice has {avail} unassigned chips here, "
                     f"pod needs {res.chips_per_pod}"
                 )
             return None
@@ -398,13 +465,10 @@ class GangManager:
     def node_score(self, res: GangReservation, node_name: str) -> int:
         """More unassigned reserved chips on the node = higher score: fill
         the slice host by host so members land dense, not scattered."""
-        mesh = self._state.mesh
-        assert mesh is not None
+        hosts = self._state.hosts_by_coord(res.slice_id)
         with self._lock:
-            avail = sum(
-                1 for c in res.unassigned_coords() if mesh.host_of(c) == node_name
-            )
-            total = sum(1 for c in res.coords if mesh.host_of(c) == node_name)
+            avail = self._on_node(hosts, node_name, res.unassigned_coords())
+            total = self._on_node(hosts, node_name, res.coords)
             return round(10 * avail / total) if total else 0
 
     def plan_for_bind(
@@ -413,15 +477,16 @@ class GangManager:
         """Pick this member's chips from the reservation on its node,
         preferring chips adjacent to already-assigned ones (members that
         talk most ride the shortest ICI paths)."""
-        mesh = self._state.mesh
-        assert mesh is not None
+        mesh = self._state.slice_mesh(res.slice_id)
+        hosts = self._state.hosts_by_coord(res.slice_id)
         with self._lock:
             if res.key not in self._reservations:
                 raise GangError(f"gang {res.key}: reservation dissolved; retry")
             if pod.key() in res.assigned:
                 raise GangError(f"{pod.key()} already assigned in gang")
             avail = sorted(
-                c for c in res.unassigned_coords() if mesh.host_of(c) == node_name
+                c for c in res.unassigned_coords()
+                if hosts.get(c) == node_name
             )
             if len(avail) < res.chips_per_pod:
                 raise GangError(

@@ -28,7 +28,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from tpukube.core.mesh import MeshSpec
-from tpukube.core.types import TopologyCoord
+from tpukube.core.types import DEFAULT_SLICE, TopologyCoord
 from tpukube.sched import slicefit
 
 log = logging.getLogger("tpukube.policy")
@@ -42,9 +42,11 @@ class Workload:
     priority: int                # blocking priority (max member priority)
     cost: int                    # eviction cost (sum of member priorities)
     coords: frozenset[TopologyCoord]  # every chip it holds (gangs include
-                                      # their unassigned reserved chips)
+                                      # their unassigned reserved chips);
+                                      # coords are local to slice_id
     pod_keys: tuple[str, ...] = ()
     gang_key: Optional[tuple[str, str]] = None
+    slice_id: str = DEFAULT_SLICE  # the ICI domain the chips live in
 
 
 @dataclass(frozen=True)

@@ -88,18 +88,34 @@ class NodeView:
         ]
 
 
+@dataclass
+class SliceView:
+    """One ICI domain: its mesh geometry plus the data-driven coord->host
+    map built from node annotations (host naming is a sim convention, not a
+    contract — the annotation's chip coords are the truth)."""
+
+    mesh: MeshSpec
+    host_by_coord: dict[TopologyCoord, str] = field(default_factory=dict)
+
+
 class ClusterState:
-    """Thread-safe ledger: node views + per-chip share occupancy.
+    """Thread-safe ledger: per-slice node views + per-chip share occupancy.
 
     The extender serves concurrent webhook calls; all mutation goes through
     this object's lock (SURVEY.md §9.3: reservations must be linearizable
     under concurrent filter calls — the gang layer in M7 builds on this).
+
+    A cluster holds one or more ICI slices (SURVEY.md §3 "distributed
+    communication backend": ICI intra-slice, DCN inter-slice). Chip coords
+    are slice-local, so every coord-set accessor takes a slice id; the
+    no-argument forms serve the common single-slice cluster and raise on
+    ambiguity rather than silently mixing coordinate spaces.
     """
 
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self._nodes: dict[str, NodeView] = {}
-        self._mesh: Optional[MeshSpec] = None
+        self._slices: dict[str, SliceView] = {}
         self._allocs: dict[str, AllocResult] = {}  # pod key -> commitment
 
     # -- node ingestion ----------------------------------------------------
@@ -118,14 +134,36 @@ class ClusterState:
             return False
         info, mesh = decoded
         with self._lock:
-            if self._mesh is None:
-                self._mesh = mesh
-            elif self._mesh != mesh:
+            sl = self._slices.get(info.slice_id)
+            if sl is None:
+                sl = self._slices[info.slice_id] = SliceView(mesh=mesh)
+            elif sl.mesh != mesh:
                 raise StateError(
-                    f"node {name} reports mesh {mesh.dims}, cluster has "
-                    f"{self._mesh.dims} — mixed-mesh clusters unsupported"
+                    f"node {name} reports mesh {mesh.dims} for slice "
+                    f"{info.slice_id}, which has {sl.mesh.dims} — nodes of "
+                    f"one slice must agree on its geometry"
                 )
             prev = self._nodes.get(name)
+            if prev is not None and prev.info.slice_id != info.slice_id:
+                raise StateError(
+                    f"node {name} moved from slice {prev.info.slice_id} "
+                    f"to {info.slice_id} — drop and re-add the node"
+                )
+            # validate EVERY claim before mutating anything: a partial
+            # apply would leave phantom claims with no owner on error
+            for chip in info.chips:
+                claimed = sl.host_by_coord.get(chip.coord)
+                if claimed is not None and claimed != name:
+                    raise StateError(
+                        f"nodes {claimed} and {name} both claim chip "
+                        f"{tuple(chip.coord)} in slice {info.slice_id}"
+                    )
+            if prev is not None:
+                for chip in prev.info.chips:
+                    if sl.host_by_coord.get(chip.coord) == name:
+                        del sl.host_by_coord[chip.coord]
+            for chip in info.chips:
+                sl.host_by_coord[chip.coord] = name
             view = NodeView(info=info, raw_payload=payload)
             if prev is not None:
                 view.used_ids = prev.used_ids
@@ -135,8 +173,47 @@ class ClusterState:
     # -- views -------------------------------------------------------------
     @property
     def mesh(self) -> Optional[MeshSpec]:
+        """The sole slice's mesh (single-slice clusters). None before any
+        node is known; StateError when several slices exist — callers on a
+        multi-slice cluster must name the slice (slice_mesh)."""
         with self._lock:
-            return self._mesh
+            if not self._slices:
+                return None
+            if len(self._slices) > 1:
+                raise StateError(
+                    f"cluster has {len(self._slices)} slices; use "
+                    f"slice_mesh(slice_id)"
+                )
+            return next(iter(self._slices.values())).mesh
+
+    def slice_ids(self) -> list[str]:
+        with self._lock:
+            return sorted(self._slices)
+
+    def slice_mesh(self, slice_id: str) -> MeshSpec:
+        with self._lock:
+            sl = self._slices.get(slice_id)
+            if sl is None:
+                raise StateError(f"unknown slice {slice_id!r}")
+            return sl.mesh
+
+    def host_at(self, slice_id: str, coord: TopologyCoord) -> Optional[str]:
+        """Node owning a chip coord within a slice (annotation-derived)."""
+        with self._lock:
+            sl = self._slices.get(slice_id)
+            return sl.host_by_coord.get(coord) if sl is not None else None
+
+    def hosts_by_coord(self, slice_id: str) -> dict[TopologyCoord, str]:
+        """Snapshot of a slice's coord->node map — one lock round-trip for
+        callers that look up many coords (the per-node gang hot path)."""
+        with self._lock:
+            sl = self._slices.get(slice_id)
+            return dict(sl.host_by_coord) if sl is not None else {}
+
+    def slice_of_node(self, name: str) -> Optional[str]:
+        with self._lock:
+            view = self._nodes.get(name)
+            return view.info.slice_id if view is not None else None
 
     def node(self, name: str) -> Optional[NodeView]:
         with self._lock:
@@ -146,12 +223,26 @@ class ClusterState:
         with self._lock:
             return sorted(self._nodes)
 
-    def occupied_coords(self) -> set[TopologyCoord]:
+    def _slice_views(self, slice_id: Optional[str]) -> list[NodeView]:
+        """Node views of one slice — or of the WHOLE cluster only when it is
+        single-slice (mixing coord sets across slices would be meaningless;
+        raise instead)."""
+        if slice_id is None and len(self._slices) > 1:
+            raise StateError(
+                "coord sets are slice-local; pass slice_id on a "
+                f"{len(self._slices)}-slice cluster"
+            )
+        return [
+            v for v in self._nodes.values()
+            if slice_id is None or v.info.slice_id == slice_id
+        ]
+
+    def occupied_coords(self, slice_id: Optional[str] = None) -> set[TopologyCoord]:
         """Coords unusable for a whole-chip/gang placement: any chip with
         used shares, plus unhealthy chips."""
         with self._lock:
             out: set[TopologyCoord] = set()
-            for view in self._nodes.values():
+            for view in self._slice_views(slice_id):
                 for chip in view.info.chips:
                     if (
                         chip.health is not Health.HEALTHY
@@ -160,24 +251,37 @@ class ClusterState:
                         out.add(chip.coord)
             return out
 
-    def unhealthy_coords(self) -> set[TopologyCoord]:
+    def unhealthy_coords(self, slice_id: Optional[str] = None) -> set[TopologyCoord]:
         with self._lock:
             return {
                 chip.coord
-                for view in self._nodes.values()
+                for view in self._slice_views(slice_id)
                 for chip in view.info.chips
                 if chip.health is not Health.HEALTHY
             }
 
-    def broken_links(self) -> set[Link]:
+    def broken_links(self, slice_id: Optional[str] = None) -> set[Link]:
         """Downed ICI links, unioned over node reports. Both endpoint hosts
         may report the same link; canonical pairs dedupe them."""
         with self._lock:
             return {
                 link
-                for view in self._nodes.values()
+                for view in self._slice_views(slice_id)
                 for link in view.info.bad_links
             }
+
+    def slice_utilization(self, slice_id: str) -> float:
+        """Allocated share fraction over healthy capacity of ONE slice —
+        the gang layer's bin-pack signal for slice choice."""
+        with self._lock:
+            total = used = 0
+            for view in self._slice_views(slice_id):
+                n = view.shares_per_chip
+                for chip in view.info.chips:
+                    if chip.health is Health.HEALTHY:
+                        total += n
+                        used += min(n, view.used_share_count(chip.index))
+            return used / total if total else 0.0
 
     def allocation(self, pod_key: str) -> Optional[AllocResult]:
         with self._lock:

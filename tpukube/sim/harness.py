@@ -97,25 +97,44 @@ class SimCluster:
         mesh: Optional[MeshSpec] = None,
         vtpu_nodes: Optional[set[str]] = None,
         vtpu_shares: int = 2,
+        slices: Optional[dict[str, MeshSpec]] = None,
     ):
+        """Single-slice by default (``mesh``); pass ``slices`` (slice id ->
+        MeshSpec) for a multi-slice cluster — node names are then prefixed
+        "<slice>-host-i-j-k" so they stay unique cluster-wide."""
         self.config = config or load_config(env={})
-        self.mesh = mesh or self.config.sim_mesh()
+        if slices is not None and mesh is not None:
+            raise ValueError("pass either mesh or slices, not both")
+        self._prefixed = slices is not None
+        if slices is None:
+            slices = {self.config.slice_id: mesh or self.config.sim_mesh()}
+        self.slices: dict[str, MeshSpec] = dict(slices)
+        # single-slice convenience handle (most tests/scenarios)
+        self.mesh: Optional[MeshSpec] = (
+            next(iter(self.slices.values())) if len(self.slices) == 1 else None
+        )
         self._vtpu_nodes = vtpu_nodes or set()
         self._vtpu_shares = vtpu_shares
         self.nodes: dict[str, NodeInfo] = {}
-        for host in self.mesh.all_hosts():
-            chips = [
-                ChipInfo(
-                    chip_id=f"{host}-chip-{i}",
-                    index=i,
-                    coord=coord,
-                    hbm_bytes=self.config.hbm_bytes_per_chip,
-                    num_cores=self.config.cores_per_chip,
+        for sid in sorted(self.slices):
+            m = self.slices[sid]
+            for host in m.all_hosts():
+                name = f"{sid}-{host}" if self._prefixed else host
+                chips = [
+                    ChipInfo(
+                        chip_id=f"{name}-chip-{i}",
+                        index=i,
+                        coord=coord,
+                        hbm_bytes=self.config.hbm_bytes_per_chip,
+                        num_cores=self.config.cores_per_chip,
+                    )
+                    for i, coord in enumerate(m.coords_of_host(host))
+                ]
+                shares = self._vtpu_shares if name in self._vtpu_nodes else 1
+                self.nodes[name] = NodeInfo(
+                    name=name, chips=chips, shares_per_chip=shares,
+                    slice_id=sid,
                 )
-                for i, coord in enumerate(self.mesh.coords_of_host(host))
-            ]
-            shares = self._vtpu_shares if host in self._vtpu_nodes else 1
-            self.nodes[host] = NodeInfo(name=host, chips=chips, shares_per_chip=shares)
         self.extender = Extender(self.config)
         self.pods: dict[str, dict[str, Any]] = {}  # key -> pod object
         self._port = _free_port()
@@ -158,7 +177,9 @@ class SimCluster:
             {
                 "metadata": {
                     "name": name,
-                    "annotations": codec.annotate_node(info, self.mesh),
+                    "annotations": codec.annotate_node(
+                        info, self.slices[info.slice_id]
+                    ),
                 }
             }
             for name, info in sorted(self.nodes.items())
@@ -310,16 +331,26 @@ class SimCluster:
                 return
         raise KeyError(f"{node_name} has no chip {chip_index}")
 
-    def inject_link_fault(self, a, b, up: bool = False) -> None:
+    def inject_link_fault(
+        self, a, b, up: bool = False, slice_id: Optional[str] = None
+    ) -> None:
         """Drop (or restore) the ICI link between adjacent coords ``a``/``b``
         — each endpoint's owning node agent reports its side, exactly as the
-        real health watch would re-annotate (SURVEY.md §6)."""
+        real health watch would re-annotate (SURVEY.md §6). ``slice_id``
+        names the ICI domain on multi-slice clusters."""
+        if slice_id is None:
+            if len(self.slices) != 1:
+                raise ValueError("multi-slice cluster: pass slice_id")
+            slice_id = next(iter(self.slices))
+        mesh = self.slices[slice_id]
         link = canonical_link(a, b)
         ca, cb = link
-        if cb not in self.mesh.neighbors(ca):
+        if cb not in mesh.neighbors(ca):
             raise ValueError(f"{ca} and {cb} are not ICI-adjacent")
         for coord in link:
-            info = self.nodes[self.mesh.host_of(coord)]
+            host = mesh.host_of(coord)
+            name = f"{slice_id}-{host}" if self._prefixed else host
+            info = self.nodes[name]
             if up:
                 if link in info.bad_links:
                     info.bad_links.remove(link)
@@ -339,16 +370,20 @@ class SimCluster:
         from tpukube.plugin import DevicePluginServer, FakeKubelet
 
         info = self.nodes[alloc.node_name]
+        mesh = self.slices[info.slice_id]
+        origin = min(c.coord for c in info.chips)
         with tempfile.TemporaryDirectory() as td:
             env_overrides = {
                 "TPUKUBE_DEVICE_PLUGIN_DIR": td,
-                "TPUKUBE_SIM_MESH_DIMS": ",".join(str(d) for d in self.mesh.dims),
+                "TPUKUBE_SIM_MESH_DIMS": ",".join(str(d) for d in mesh.dims),
                 "TPUKUBE_SIM_HOST_BLOCK": ",".join(
-                    str(d) for d in self.mesh.host_block
+                    str(d) for d in mesh.host_block
                 ),
                 "TPUKUBE_SIM_TORUS": ",".join(
-                    str(t).lower() for t in self.mesh.torus
+                    str(t).lower() for t in mesh.torus
                 ),
+                "TPUKUBE_SIM_HOST_ORIGIN": ",".join(str(v) for v in origin),
+                "TPUKUBE_SLICE_ID": info.slice_id,
                 "TPUKUBE_HBM_BYTES_PER_CHIP": str(self.config.hbm_bytes_per_chip),
                 "TPUKUBE_SHARES_PER_CHIP": str(info.shares_per_chip),
             }
