@@ -1,0 +1,196 @@
+"""DCN-spanning gang tests (multislice data-parallel jobs).
+
+A gang normally holds one contiguous box in one ICI slice. With the
+``tpu.qiniu.com/pod-group-allow-dcn`` annotation (PodGroup.allow_dcn) a
+DP-style job opts in to splitting across slices — one contiguous sub-box
+per slice — when no single slice fits. Single-slice placement is always
+preferred; the split is the fallback, not the default.
+"""
+
+import pytest
+
+from tpukube.core import codec
+from tpukube.core.config import load_config
+from tpukube.core.mesh import MeshSpec
+from tpukube.core.types import PodGroup
+from tpukube.sim import SimCluster
+
+M44 = MeshSpec(dims=(4, 4, 1), host_block=(2, 2, 1))
+
+
+def _cfg():
+    return load_config(env={"TPUKUBE_RESERVATION_TTL_SECONDS": "30"})
+
+
+def two_slices():
+    return SimCluster(_cfg(), slices={"slice-a": M44, "slice-b": M44})
+
+
+def test_allow_dcn_annotation_roundtrip():
+    g = PodGroup("dp", min_member=4, allow_dcn=True)
+    annos = codec.pod_group_annotations(g)
+    assert annos[codec.ANNO_POD_GROUP_ALLOW_DCN] == "true"
+    back = codec.pod_group_from_annotations(annos)
+    assert back.allow_dcn is True
+    plain = codec.pod_group_from_annotations(
+        codec.pod_group_annotations(PodGroup("x", 2))
+    )
+    assert plain.allow_dcn is False
+
+
+def test_allow_dcn_rejects_shape_hint():
+    annos = codec.pod_group_annotations(PodGroup("dp", 4))
+    annos[codec.ANNO_POD_GROUP_ALLOW_DCN] = "true"
+    annos[codec.ANNO_POD_GROUP_SHAPE] = "2x2"
+    with pytest.raises(codec.CodecError, match="incompatible"):
+        codec.pod_group_from_annotations(annos)
+
+
+def test_dcn_gang_splits_when_no_single_slice_fits():
+    with two_slices() as c:
+        # 24-pod gang > 16 chips/slice: impossible single-slice,
+        # possible as 16 + 8 over DCN
+        group = PodGroup("dp", min_member=24, allow_dcn=True)
+        nodes = []
+        for i in range(24):
+            n, a = c.schedule(c.make_pod(f"d-{i}", tpu=1, group=group))
+            nodes.append((n, a))
+        res = c.extender.gang.reservation("default", "dp")
+        assert res.committed and res.spans_dcn
+        assert set(res.slice_coords) == {"slice-a", "slice-b"}
+        assert res.total_chips() == 24
+        # every member's chips live in exactly one slice
+        for key, (sid, coords) in res.assigned.items():
+            assert sid in ("slice-a", "slice-b")
+            assert len(coords) == 1
+        # gang slice-context env rides the alloc annotation
+        _, alloc = nodes[0]
+        assert alloc.env["TPU_KUBE_GANG_NUM_SLICES"] == "2"
+        assert alloc.env["TPU_KUBE_GANG_SLICES"] == "slice-a,slice-b"
+        assert alloc.env["TPU_KUBE_GANG_SLICE_INDEX"] in ("0", "1")
+
+
+def test_without_allow_dcn_oversized_gang_fails():
+    with two_slices() as c:
+        group = PodGroup("strict", min_member=24)
+        with pytest.raises(RuntimeError, match="no contiguous"):
+            c.schedule(c.make_pod("s-0", tpu=1, group=group))
+
+
+def test_dcn_gang_prefers_single_slice_when_it_fits():
+    with two_slices() as c:
+        group = PodGroup("dp", min_member=8, allow_dcn=True)
+        for i in range(8):
+            c.schedule(c.make_pod(f"d-{i}", tpu=1, group=group))
+        res = c.extender.gang.reservation("default", "dp")
+        assert res.committed and not res.spans_dcn
+
+
+def test_dcn_sub_boxes_are_contiguous_per_slice():
+    with two_slices() as c:
+        group = PodGroup("dp", min_member=20, allow_dcn=True)
+        c.schedule(c.make_pod("d-0", tpu=1, group=group))
+        res = c.extender.gang.reservation("default", "dp")
+        assert res.spans_dcn
+        for sid, coords in res.slice_coords.items():
+            # each sub-hold is a union of axis-aligned boxes; at minimum it
+            # must be connected within the slice mesh
+            mesh = c.slices[sid]
+            region = set(coords)
+            seen = {next(iter(sorted(region)))}
+            frontier = list(seen)
+            while frontier:
+                cur = frontier.pop()
+                for nb in mesh.neighbors(cur):
+                    if nb in region and nb not in seen:
+                        seen.add(nb)
+                        frontier.append(nb)
+            assert seen == region, f"{sid} sub-hold is disconnected"
+
+
+def test_dcn_gang_fault_in_one_subslice_rolls_back_whole_gang():
+    with two_slices() as c:
+        group = PodGroup("fragile", min_member=24, allow_dcn=True)
+        c.schedule(c.make_pod("f-0", tpu=1, group=group))
+        res = c.extender.gang.reservation("default", "fragile")
+        assert res.spans_dcn
+        # fault an UNASSIGNED chip in one sub-slice
+        sid = sorted(res.slice_coords)[0]
+        victim = sorted(res.unassigned_in(sid))[0]
+        hosts = c.extender.state.hosts_by_coord(sid)
+        node = hosts[victim]
+        index = next(
+            ch.index for ch in c.nodes[node].chips if ch.coord == victim
+        )
+        c.inject_fault(node, index)
+        c.schedule(c.make_pod("f-1", tpu=1, group=group))
+        assert c.extender.gang.rollbacks == 1
+        res2 = c.extender.gang.reservation("default", "fragile")
+        assert victim not in res2.slice_coords.get(sid, set())
+        assert c.extender.state.allocation("default/f-0") is None
+
+
+def test_dcn_gang_restart_restore_committed():
+    from tpukube.sched.extender import Extender
+
+    with two_slices() as c:
+        group = PodGroup("dp", min_member=24, allow_dcn=True)
+        for i in range(24):
+            c.schedule(c.make_pod(f"d-{i}", tpu=1, group=group))
+        ext = Extender(c.config)
+        for obj in c.node_objects():
+            ext.state.upsert_node(
+                obj["metadata"]["name"], obj["metadata"]["annotations"]
+            )
+        ext.rebuild_from_pods(
+            [p["metadata"]["annotations"] for p in c.pods.values()]
+        )
+        res = ext.gang.reservation("default", "dp")
+        assert res is not None and res.committed and res.spans_dcn
+        assert res.total_chips() == 24
+
+
+def test_dcn_gang_blocks_non_gang_poaching_in_both_slices():
+    with two_slices() as c:
+        # 28 = 16 (full slice) + 12 (3x4 box) — both single boxes
+        group = PodGroup("dp", min_member=28, allow_dcn=True)
+        c.schedule(c.make_pod("d-0", tpu=1, group=group))
+        res = c.extender.gang.reservation("default", "dp")
+        assert res.total_chips() == 28
+        # only 4 chips remain cluster-wide for non-gang pods
+        for i in range(4):
+            c.schedule(c.make_pod(f"solo-{i}", tpu=1))
+        with pytest.raises(RuntimeError, match="unschedulable"):
+            c.schedule(c.make_pod("solo-4", tpu=1))
+
+
+def test_dcn_split_takes_at_most_one_box_per_slice():
+    """A fragmented slice must contribute at most ONE contiguous box —
+    disjoint unions would break the one-sub-mesh-per-slice contract the
+    TPU_KUBE_GANG_* env promises the in-pod runtime."""
+    with two_slices() as c:
+        # fill BOTH slices completely, remembering who owns which chip
+        owners = {}  # (slice, coord) -> pod name
+        for i in range(32):
+            name = f"fill-{i}"
+            node, a = c.schedule(c.make_pod(name, tpu=1))
+            sid = c.extender.state.slice_of_node(node)
+            for co in a.coords:
+                owners[(sid, co)] = name
+        # free exactly the two OUTER columns (x=0 and x=3) of slice-a:
+        # 8 free chips in two disjoint 4-chip regions; slice-b stays full
+        for (sid, co), name in owners.items():
+            if sid == "slice-a" and co[0] in (0, 3):
+                c.delete_pod(name)
+        occ = c.extender.state.occupied_coords("slice-a")
+        assert {c_[0] for c_ in occ} == {1, 2}
+        # an 8-member DCN gang cannot be served by 4+4 disjoint boxes in
+        # one slice: the split takes one box per slice, so it must refuse
+        group = PodGroup("dp", min_member=8, allow_dcn=True)
+        with pytest.raises(RuntimeError, match="not coverable|no contiguous"):
+            c.schedule(c.make_pod("d-0", tpu=1, group=group))
+        # a 4-member DCN gang fits in one column's single box
+        small = PodGroup("small", min_member=4, allow_dcn=True)
+        for i in range(4):
+            c.schedule(c.make_pod(f"s-{i}", tpu=1, group=small))
+        assert c.extender.gang.reservation("default", "small").committed

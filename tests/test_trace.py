@@ -101,7 +101,9 @@ def test_state_endpoints(traced_cluster):
     assert gangs[0]["group"] == "g1"
     assert gangs[0]["committed"] is True
     assert gangs[0]["members_bound"] == 4
-    assert len(gangs[0]["coords"]) == 4
+    assert gangs[0]["spans_dcn"] is False
+    (slice_chips,) = gangs[0]["slices"].values()
+    assert len(slice_chips) == 4
 
 
 def test_trace_endpoint_incremental(traced_cluster):

@@ -259,9 +259,11 @@ def main_ctl(argv: Optional[list[str]] = None) -> int:
             print("no gang reservations")
         for g in data:
             state = "committed" if g["committed"] else "assembling"
+            chips = sum(len(cs) for cs in g["slices"].values())
+            where = "+".join(sorted(g["slices"]))
             print(f"{g['namespace']}/{g['group']:24s} {state:10s} "
                   f"{g['members_bound']}/{g['min_member']} bound "
-                  f"prio={g['priority']} chips={len(g['coords'])}")
+                  f"prio={g['priority']} chips={chips} in {where}")
     return 0
 
 

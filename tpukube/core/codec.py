@@ -35,6 +35,7 @@ ANNO_ALLOC = ANNO_PREFIX + "alloc"
 ANNO_POD_GROUP = ANNO_PREFIX + "pod-group"
 ANNO_POD_GROUP_MIN_MEMBER = ANNO_PREFIX + "pod-group-min-member"
 ANNO_POD_GROUP_SHAPE = ANNO_PREFIX + "pod-group-shape"
+ANNO_POD_GROUP_ALLOW_DCN = ANNO_PREFIX + "pod-group-allow-dcn"
 
 
 class CodecError(ValueError):
@@ -207,6 +208,8 @@ def pod_group_annotations(group: PodGroup) -> dict[str, str]:
     }
     if group.shape is not None:
         out[ANNO_POD_GROUP_SHAPE] = "x".join(str(s) for s in group.shape)
+    if group.allow_dcn:
+        out[ANNO_POD_GROUP_ALLOW_DCN] = "true"
     return out
 
 
@@ -230,7 +233,17 @@ def pod_group_from_annotations(annotations: dict[str, str]) -> Optional[PodGroup
         shape = (vals[0], vals[1], vals[2])
         if any(v < 1 for v in shape):
             raise CodecError(f"pod-group-shape dims must be >= 1: {shape_s!r}")
-    return PodGroup(name=name, min_member=min_member, shape=shape)
+    allow_dcn = annotations.get(ANNO_POD_GROUP_ALLOW_DCN, "").lower() in (
+        "1", "true", "yes"
+    )
+    if allow_dcn and shape is not None:
+        raise CodecError(
+            "pod-group-allow-dcn is incompatible with pod-group-shape "
+            "(a shape names one contiguous box)"
+        )
+    return PodGroup(
+        name=name, min_member=min_member, shape=shape, allow_dcn=allow_dcn
+    )
 
 
 def attach_group(pod: PodInfo) -> PodInfo:

@@ -204,6 +204,11 @@ class PodGroup:
     name: str
     min_member: int
     shape: Optional[tuple[int, int, int]] = None
+    # Opt-in for data-parallel jobs whose gradient reduction tolerates DCN
+    # hops: when no single ICI slice fits, the gang may split into
+    # contiguous per-slice sub-boxes (multislice training). Incompatible
+    # with a shape hint (a shape names one box).
+    allow_dcn: bool = False
 
 
 @dataclass

@@ -275,26 +275,32 @@ class Extender:
             members = sorted(res.assigned)
             gang_pods.update(members)
             prios = [self.state.priority_of(k) for k in members]
-            coords: set[TopologyCoord] = set(res.coords)
-            for k in members:
-                alloc = self.state.allocation(k)
-                if alloc is not None:
-                    coords.update(alloc.coords)
             # Blocking priority covers members NOT yet bound: the
             # reservation records its gang's priority, so a freshly
             # reserving prio-100 gang is never the cheap victim of a
             # prio-1 preemptor (priority inversion). Cost likewise counts
             # unarrived members at the reservation's priority.
             unarrived = max(0, res.group.min_member - len(members))
-            out.append(policy.Workload(
-                id=f"gang:{res.namespace}/{res.group.name}",
-                priority=max([res.priority, *prios]),
-                cost=sum(prios) + res.priority * unarrived,
-                coords=frozenset(coords),
-                pod_keys=tuple(members),
-                gang_key=res.key,
-                slice_id=res.slice_id,
-            ))
+            priority = max([res.priority, *prios])
+            cost = sum(prios) + res.priority * unarrived
+            # one Workload per slice the gang touches (the planner works
+            # slice-by-slice); evicting ANY part dissolves the whole gang,
+            # so each part carries the gang's full eviction cost
+            for sid, coords in res.slice_coords.items():
+                chips = set(coords)
+                for k in members:
+                    entry = res.assigned.get(k)  # may race with on_release
+                    if entry is not None and entry[0] == sid:
+                        chips.update(entry[1])
+                out.append(policy.Workload(
+                    id=f"gang:{res.namespace}/{res.group.name}@{sid}",
+                    priority=priority,
+                    cost=cost,
+                    coords=frozenset(chips),
+                    pod_keys=tuple(members),
+                    gang_key=res.key,
+                    slice_id=sid,
+                ))
         for alloc in self.state.allocations():
             if alloc.pod_key in gang_pods:
                 continue
@@ -558,17 +564,30 @@ class Extender:
                     f"{key}: node {node_name} can no longer fit {count} x {resource}"
                 )
             device_ids = self._mint_device_ids(view, resource, plan)
+            env: dict[str, str] = {}
+            if res is not None:
+                # gang context for the in-pod runtime (rides the alloc
+                # annotation / downward API — the device plugin's Allocate
+                # only sees device ids, so megascale-style multislice
+                # coordination env cannot come from the node agent)
+                sids = sorted(res.slice_coords)
+                env["TPU_KUBE_GANG_NUM_SLICES"] = str(len(sids))
+                env["TPU_KUBE_GANG_SLICES"] = ",".join(sids)
+                env["TPU_KUBE_GANG_SLICE_INDEX"] = str(
+                    sids.index(view.info.slice_id)
+                )
             alloc = AllocResult(
                 pod_key=key,
                 node_name=node_name,
                 device_ids=device_ids,
                 coords=sorted(set(plan)),
+                env=env,
                 priority=pod.priority,
             )
             self.state.commit(alloc)  # StateError on lost race
             if res is not None:
                 try:
-                    self.gang.on_bound(res, key, plan)
+                    self.gang.on_bound(res, key, plan, node_name)
                 except GangError as e:
                     # reservation changed between plan and commit: undo
                     self.state.release(key)
@@ -717,8 +736,11 @@ class Extender:
                 "members_bound": len(res.assigned),
                 "committed": res.committed,
                 "priority": res.priority,
-                "slice": res.slice_id,
-                "coords": [list(c) for c in sorted(res.coords)],
+                "spans_dcn": res.spans_dcn,
+                "slices": {
+                    sid: [list(c) for c in sorted(coords)]
+                    for sid, coords in sorted(res.slice_coords.items())
+                },
             })
         return sorted(out, key=lambda g: (g["namespace"], g["group"]))
 

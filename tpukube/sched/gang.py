@@ -59,15 +59,23 @@ class NoSliceError(GangError):
 
 @dataclass
 class GangReservation:
+    """A gang's chip hold. Normally one contiguous box in one ICI slice;
+    a gang that opted in to DCN spanning (``PodGroup.allow_dcn``, for
+    data-parallel jobs whose gradient reduction tolerates DCN hops) may
+    hold one contiguous sub-box in EACH of several slices. Members are
+    whole within one slice either way (a pod's chips share a node)."""
+
     group: PodGroup
     namespace: str
-    coords: set[TopologyCoord]  # the whole reserved slice (slice-local)
+    # slice id -> reserved chips in that slice (coords are slice-local)
+    slice_coords: dict[str, set[TopologyCoord]]
     chips_per_pod: int
-    slice_id: str = DEFAULT_SLICE  # the ICI domain the box lives in; gangs
-    # are ICI-contiguous, so a gang never spans slices (DCN is not ICI)
     priority: int = 0  # the reserving pods' priority (preemption blocking)
     created: float = field(default_factory=time.monotonic)
-    assigned: dict[str, list[TopologyCoord]] = field(default_factory=dict)
+    # pod_key -> (slice id, that member's chips)
+    assigned: dict[str, tuple[str, list[TopologyCoord]]] = field(
+        default_factory=dict
+    )
     committed: bool = False
     commit_latency: Optional[float] = None
 
@@ -75,11 +83,50 @@ class GangReservation:
     def key(self) -> tuple[str, str]:
         return (self.namespace, self.group.name)
 
+    @property
+    def spans_dcn(self) -> bool:
+        return len(self.slice_coords) > 1
+
+    @property
+    def slice_id(self) -> str:
+        """The sole slice of an ICI-confined gang. DCN-spanning gangs have
+        no single slice — callers there iterate ``slice_coords``."""
+        if self.spans_dcn:
+            raise GangError(
+                f"gang {self.key} spans {len(self.slice_coords)} slices"
+            )
+        return next(iter(self.slice_coords))
+
+    @property
+    def coords(self) -> set[TopologyCoord]:
+        """Sole slice's chips (single-slice gangs; see slice_id)."""
+        return self.slice_coords[self.slice_id]
+
+    def total_chips(self) -> int:
+        return sum(len(cs) for cs in self.slice_coords.values())
+
+    def assigned_in(self, slice_id: str) -> set[TopologyCoord]:
+        return {
+            c
+            for sid, coords in self.assigned.values()
+            if sid == slice_id
+            for c in coords
+        }
+
+    def unassigned_in(self, slice_id: str) -> set[TopologyCoord]:
+        return self.slice_coords.get(slice_id, set()) - self.assigned_in(slice_id)
+
+    def unassigned_total(self) -> int:
+        return self.total_chips() - sum(
+            len(coords) for _, coords in self.assigned.values()
+        )
+
+    # single-slice conveniences (tests + single-slice call sites)
     def assigned_coords(self) -> set[TopologyCoord]:
-        return {c for coords in self.assigned.values() for c in coords}
+        return self.assigned_in(self.slice_id)
 
     def unassigned_coords(self) -> set[TopologyCoord]:
-        return self.coords - self.assigned_coords()
+        return self.unassigned_in(self.slice_id)
 
 
 class GangManager:
@@ -123,8 +170,11 @@ class GangManager:
         with self._lock:
             out: set[TopologyCoord] = set()
             for res in self._reservations.values():
-                if slice_id is None or res.slice_id == slice_id:
-                    out |= res.unassigned_coords()
+                if slice_id is None:
+                    for sid in res.slice_coords:
+                        out |= res.unassigned_in(sid)
+                else:
+                    out |= res.unassigned_in(slice_id)
             return out
 
     # -- expiry / fault sweep ----------------------------------------------
@@ -146,10 +196,14 @@ class GangManager:
                 if res.committed:
                     continue
                 expired = now - res.created > self._ttl
-                sick = self._has_unhealthy_chip(
-                    res, unhealthy.get(res.slice_id, set())
+                sick = any(
+                    coords & unhealthy.get(sid, set())
+                    for sid, coords in res.slice_coords.items()
                 )
-                cut = self._has_broken_link(res, broken.get(res.slice_id, set()))
+                cut = any(
+                    slicefit.coords_break_link(coords, broken.get(sid, set()))
+                    for sid, coords in res.slice_coords.items()
+                )
                 if expired or sick or cut:
                     why = (
                         "TTL expired" if expired
@@ -160,15 +214,6 @@ class GangManager:
                     self._rollback_locked(res)
                     rolled.append(key)
         return rolled
-
-    def _has_unhealthy_chip(
-        self, res: GangReservation, unhealthy: set[TopologyCoord]
-    ) -> bool:
-        return bool(res.coords & unhealthy)
-
-    @staticmethod
-    def _has_broken_link(res: GangReservation, broken: set) -> bool:
-        return slicefit.coords_break_link(res.coords, broken)
 
     def _rollback_locked(self, res: GangReservation) -> None:
         for pod_key in list(res.assigned):
@@ -211,11 +256,11 @@ class GangManager:
                         f"gang {key}: shape {pod.group.shape} holds "
                         f"{sx * sy * sz} chips but the gang needs {total}"
                     )
-            # A gang is ICI-contiguous, hence confined to ONE slice (DCN
-            # crossings are the thing the scorer exists to prevent). Slice
-            # choice bin-packs: the fullest slice that still fits wins, so
-            # emptier slices stay whole for bigger gangs. Deterministic
-            # tie-break on slice id.
+            # A gang is ICI-contiguous, hence confined to ONE slice by
+            # default (DCN crossings are the thing the scorer exists to
+            # prevent). Slice choice bin-packs: the fullest slice that
+            # still fits wins, so emptier slices stay whole for bigger
+            # gangs. Deterministic tie-break on slice id.
             chosen: Optional[tuple[float, str, list[TopologyCoord]]] = None
             free_total = 0
             for sid in slice_ids:
@@ -233,26 +278,79 @@ class GangManager:
                 rank = (-self._state.slice_utilization(sid), sid)
                 if chosen is None or rank < (chosen[0], chosen[1]):
                     chosen = (rank[0], sid, coords)
-            if chosen is None:
+            if chosen is not None:
+                _, sid, coords = chosen
+                slice_coords = {sid: set(coords)}
+            elif pod.group.allow_dcn and pod.group.shape is None:
+                # DCN-spanning fallback (opt-in, DP-style jobs): one
+                # contiguous sub-box per slice, every sub-box a multiple
+                # of chips_per_pod so members stay slice-whole.
+                slice_coords = self._plan_dcn_split(
+                    total, chips_per_pod, slice_ids
+                )
+                if slice_coords is None:
+                    raise NoSliceError(
+                        f"gang {key}: {total} chips not coverable by "
+                        f"per-slice contiguous boxes across "
+                        f"{len(slice_ids)} ICI slices ({free_total} free)"
+                    )
+            else:
                 raise NoSliceError(
                     f"gang {key}: no contiguous {total}-chip slice available "
                     f"in any of {len(slice_ids)} ICI slices "
                     f"({free_total} chips free)"
                 )
-            _, sid, coords = chosen
             res = GangReservation(
                 group=pod.group,
                 namespace=pod.namespace,
-                coords=set(coords),
+                slice_coords=slice_coords,
                 chips_per_pod=chips_per_pod,
-                slice_id=sid,
                 priority=pod.priority,
             )
             self._reservations[key] = res
             log.info(
-                "gang %s/%s reserved %d chips", key[0], key[1], len(res.coords)
+                "gang %s/%s reserved %d chips over %d slice(s)",
+                key[0], key[1], res.total_chips(), len(slice_coords),
             )
             return res
+
+    def _plan_dcn_split(
+        self, total: int, chips_per_pod: int, slice_ids: list[str]
+    ) -> Optional[dict[str, set[TopologyCoord]]]:
+        """Partition ``total`` chips into per-slice contiguous boxes, each a
+        multiple of chips_per_pod. Greedy: slices in descending free
+        capacity (tie: slice id), taking the largest box that fits the
+        remaining need first — fewest DCN boundaries for the job, emptiest
+        slices consumed first (the single-slice path already failed, so
+        bin-packing has nothing left to protect)."""
+        free_rank = sorted(
+            slice_ids,
+            key=lambda s: (self._state.slice_utilization(s), s),
+        )
+        parts: dict[str, set[TopologyCoord]] = {}
+        remaining = total
+        for sid in free_rank:
+            if remaining == 0:
+                break
+            mesh = self._state.slice_mesh(sid)
+            occupied = set(
+                self._state.occupied_coords(sid) | self.reserved_coords(sid)
+            )
+            broken = self._state.broken_links(sid)
+            # ONE box per slice — the TPU_KUBE_GANG_* contract promises the
+            # in-pod runtime one contiguous ICI sub-mesh per slice part
+            free_here = mesh.num_chips - len(occupied)
+            vol = min(remaining, (free_here // chips_per_pod) * chips_per_pod)
+            while vol >= chips_per_pod:
+                coords = slicefit.find_slice(
+                    mesh, occupied, count=vol, broken=broken
+                )
+                if coords is not None:
+                    parts[sid] = set(coords)
+                    remaining -= len(coords)
+                    break
+                vol -= chips_per_pod
+        return parts if remaining == 0 else None
 
     def snapshot(self) -> list[GangReservation]:
         """Stable copy of live reservations (the preemption planner's view)."""
@@ -300,53 +398,74 @@ class GangManager:
             if key in self._reservations or not allocs:
                 return self._reservations.get(key)
             chips_per_pod = max(1, len(allocs[0].coords))
-            # the members' nodes know which ICI slice the gang lives in;
-            # with the node view gone, only an unambiguous (single-slice)
+
+            def rollback_all(why: str) -> None:
+                log.warning("gang %s/%s: %s — rolling back",
+                            namespace, group.name, why)
+                for a in allocs:
+                    self._state.release(a.pod_key)
+                    self._evictions.append(a.pod_key)
+                self.rollbacks += 1
+
+            # the members' nodes know which ICI slice(s) the gang lives in;
+            # with a node view gone, only an unambiguous (single-slice)
             # cluster lets us proceed — guessing would mix coord spaces
-            slice_id = self._state.slice_of_node(allocs[0].node_name)
-            if slice_id is None:
-                sids = self._state.slice_ids()
-                if len(sids) != 1:
-                    log.warning(
-                        "gang %s/%s: member node %s unknown and cluster has "
-                        "%d slices — rolling back", namespace, group.name,
-                        allocs[0].node_name, len(sids),
-                    )
-                    for a in allocs:
-                        self._state.release(a.pod_key)
-                        self._evictions.append(a.pod_key)
-                    self.rollbacks += 1
-                    return None
-                slice_id = sids[0] if sids else DEFAULT_SLICE
-            assigned_coords = {c for a in allocs for c in a.coords}
+            member_slices: dict[str, str] = {}
+            for a in allocs:
+                sid = self._state.slice_of_node(a.node_name)
+                if sid is None:
+                    sids = self._state.slice_ids()
+                    if len(sids) != 1:
+                        rollback_all(
+                            f"member node {a.node_name} unknown and cluster "
+                            f"has {len(sids)} slices"
+                        )
+                        return None
+                    sid = sids[0] if sids else DEFAULT_SLICE
+                member_slices[a.pod_key] = sid
             committed = len(allocs) >= group.min_member
-            coords = set(assigned_coords)
-            if not committed:
-                coords_or_none = self._recomplete_slice(
-                    group, chips_per_pod, assigned_coords, slice_id
+            by_slice: dict[str, set[TopologyCoord]] = {}
+            for a in allocs:
+                by_slice.setdefault(member_slices[a.pod_key], set()).update(
+                    a.coords
                 )
-                if coords_or_none is None:
-                    log.warning(
-                        "gang %s/%s: restart found %d/%d members and no "
-                        "completable slice — rolling back", namespace,
-                        group.name, len(allocs), group.min_member,
+            if len(by_slice) > 1:
+                # DCN-spanning gang: committed restores with exactly the
+                # members' chips; mid-assembly the split plan is gone and
+                # not re-derivable (which sub-box was whose?) — roll back
+                if not committed:
+                    rollback_all(
+                        f"restart found {len(allocs)}/{group.min_member} "
+                        f"members of a DCN-spanning gang"
                     )
-                    for a in allocs:
-                        self._state.release(a.pod_key)
-                        self._evictions.append(a.pod_key)
-                    self.rollbacks += 1
                     return None
-                coords = coords_or_none
+                slice_coords = by_slice
+            else:
+                slice_id = next(iter(by_slice))
+                coords = set(by_slice[slice_id])
+                if not committed:
+                    coords_or_none = self._recomplete_slice(
+                        group, chips_per_pod, coords, slice_id
+                    )
+                    if coords_or_none is None:
+                        rollback_all(
+                            f"restart found {len(allocs)}/{group.min_member} "
+                            f"members and no completable slice"
+                        )
+                        return None
+                    coords = coords_or_none
+                slice_coords = {slice_id: coords}
             res = GangReservation(
                 group=group,
                 namespace=namespace,
-                coords=coords,
+                slice_coords=slice_coords,
                 chips_per_pod=chips_per_pod,
-                slice_id=slice_id,
                 priority=max(a.priority for a in allocs),
             )
             for a in allocs:
-                res.assigned[a.pod_key] = list(a.coords)
+                res.assigned[a.pod_key] = (
+                    member_slices[a.pod_key], list(a.coords)
+                )
             res.committed = committed
             self._reservations[key] = res
             log.info(
@@ -394,7 +513,7 @@ class GangManager:
 
     def reserve_exact(
         self, pod: PodInfo, chips_per_pod: int, coords: list[TopologyCoord],
-        slice_id: str = DEFAULT_SLICE,
+        slice_id: str,
     ) -> GangReservation:
         """Reserve a specific chip set (the preemption path: policy already
         chose the box and evicted its victims). Raises if any chip was
@@ -429,15 +548,14 @@ class GangManager:
             res = GangReservation(
                 group=pod.group,
                 namespace=pod.namespace,
-                coords=set(coords),
+                slice_coords={slice_id: set(coords)},
                 chips_per_pod=chips_per_pod,
-                slice_id=slice_id,
                 priority=pod.priority,
             )
             self._reservations[key] = res
             log.info(
                 "gang %s/%s reserved %d chips via preemption",
-                key[0], key[1], len(res.coords),
+                key[0], key[1], res.total_chips(),
             )
             return res
 
@@ -449,12 +567,23 @@ class GangManager:
         contract; one snapshot per query, not one lock per coord)."""
         return sum(1 for c in coords if hosts.get(c) == node_name)
 
+    def _node_slice(
+        self, res: GangReservation, node_name: str
+    ) -> Optional[str]:
+        """Which of the reservation's slices this node belongs to (None if
+        the gang holds nothing in the node's ICI domain)."""
+        sid = self._state.slice_of_node(node_name)
+        return sid if sid in res.slice_coords else None
+
     def node_feasibility(
         self, res: GangReservation, node_name: str
     ) -> Optional[str]:
-        hosts = self._state.hosts_by_coord(res.slice_id)
+        sid = self._node_slice(res, node_name)
+        if sid is None:
+            return "gang holds no chips in this node's ICI slice"
+        hosts = self._state.hosts_by_coord(sid)
         with self._lock:
-            avail = self._on_node(hosts, node_name, res.unassigned_coords())
+            avail = self._on_node(hosts, node_name, res.unassigned_in(sid))
             if avail < res.chips_per_pod:
                 return (
                     f"gang slice has {avail} unassigned chips here, "
@@ -465,10 +594,13 @@ class GangManager:
     def node_score(self, res: GangReservation, node_name: str) -> int:
         """More unassigned reserved chips on the node = higher score: fill
         the slice host by host so members land dense, not scattered."""
-        hosts = self._state.hosts_by_coord(res.slice_id)
+        sid = self._node_slice(res, node_name)
+        if sid is None:
+            return 0
+        hosts = self._state.hosts_by_coord(sid)
         with self._lock:
-            avail = self._on_node(hosts, node_name, res.unassigned_coords())
-            total = self._on_node(hosts, node_name, res.coords)
+            avail = self._on_node(hosts, node_name, res.unassigned_in(sid))
+            total = self._on_node(hosts, node_name, res.slice_coords[sid])
             return round(10 * avail / total) if total else 0
 
     def plan_for_bind(
@@ -477,15 +609,20 @@ class GangManager:
         """Pick this member's chips from the reservation on its node,
         preferring chips adjacent to already-assigned ones (members that
         talk most ride the shortest ICI paths)."""
-        mesh = self._state.slice_mesh(res.slice_id)
-        hosts = self._state.hosts_by_coord(res.slice_id)
+        sid = self._node_slice(res, node_name)
+        if sid is None:
+            raise GangError(
+                f"gang {res.key}: no reserved chips in {node_name}'s slice"
+            )
+        mesh = self._state.slice_mesh(sid)
+        hosts = self._state.hosts_by_coord(sid)
         with self._lock:
             if res.key not in self._reservations:
                 raise GangError(f"gang {res.key}: reservation dissolved; retry")
             if pod.key() in res.assigned:
                 raise GangError(f"{pod.key()} already assigned in gang")
             avail = sorted(
-                c for c in res.unassigned_coords()
+                c for c in res.unassigned_in(sid)
                 if hosts.get(c) == node_name
             )
             if len(avail) < res.chips_per_pod:
@@ -493,7 +630,7 @@ class GangManager:
                     f"gang {res.key}: node {node_name} no longer has "
                     f"{res.chips_per_pod} unassigned slice chips"
                 )
-            anchor = res.assigned_coords()
+            anchor = res.assigned_in(sid)
             chosen: list[TopologyCoord] = []
             pool = list(avail)
             for _ in range(res.chips_per_pod):
@@ -509,17 +646,23 @@ class GangManager:
             return chosen
 
     def on_bound(self, res: GangReservation, pod_key: str,
-                 coords: list[TopologyCoord]) -> None:
+                 coords: list[TopologyCoord], node_name: str) -> None:
         """Record a member's successful ledger commit; the quorum member
         commits the whole gang."""
+        sid = self._node_slice(res, node_name)
+        if sid is None:
+            raise GangError(
+                f"gang {res.key}: bound node {node_name} is outside the "
+                f"reservation's slices"
+            )
         with self._lock:
             live = self._reservations.get(res.key)
             if live is not res:
                 raise GangError(f"gang {res.key}: reservation replaced mid-bind")
-            bad = [c for c in coords if c not in res.unassigned_coords()]
+            bad = [c for c in coords if c not in res.unassigned_in(sid)]
             if bad:
                 raise GangError(f"gang {res.key}: coords {bad} not reservable")
-            res.assigned[pod_key] = list(coords)
+            res.assigned[pod_key] = (sid, list(coords))
             if not res.committed and len(res.assigned) >= res.group.min_member:
                 res.committed = True
                 res.commit_latency = time.monotonic() - res.created
@@ -532,11 +675,15 @@ class GangManager:
 
     # -- pod lifecycle -------------------------------------------------------
     def assignable(self, res: GangReservation, chips_per_pod: int) -> bool:
-        """True while the reservation still has room for another member.
+        """True while the reservation still has room for another member —
+        room in SOME one slice (a member's chips never straddle slices).
         Replicas beyond min_member of a committed gang get False — they
         fall through to normal (non-gang) scheduling in the extender."""
         with self._lock:
-            return len(res.unassigned_coords()) >= chips_per_pod
+            return any(
+                len(res.unassigned_in(sid)) >= chips_per_pod
+                for sid in res.slice_coords
+            )
 
     def on_release(self, pod_key: str) -> None:
         """A gang member's pod went away. Uncommitted gang: the chips return

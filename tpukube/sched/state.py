@@ -117,6 +117,9 @@ class ClusterState:
         self._nodes: dict[str, NodeView] = {}
         self._slices: dict[str, SliceView] = {}
         self._allocs: dict[str, AllocResult] = {}  # pod key -> commitment
+        # frozen coord->host snapshots handed to hot-path callers; rebuilt
+        # lazily after any host-map mutation (annotations rarely change)
+        self._hosts_cache: dict[str, dict[TopologyCoord, str]] = {}
 
     # -- node ingestion ----------------------------------------------------
     def upsert_node(self, name: str, annotations: dict[str, str]) -> bool:
@@ -164,6 +167,7 @@ class ClusterState:
                         del sl.host_by_coord[chip.coord]
             for chip in info.chips:
                 sl.host_by_coord[chip.coord] = name
+            self._hosts_cache.pop(info.slice_id, None)
             view = NodeView(info=info, raw_payload=payload)
             if prev is not None:
                 view.used_ids = prev.used_ids
@@ -205,10 +209,16 @@ class ClusterState:
 
     def hosts_by_coord(self, slice_id: str) -> dict[TopologyCoord, str]:
         """Snapshot of a slice's coord->node map — one lock round-trip for
-        callers that look up many coords (the per-node gang hot path)."""
+        callers that look up many coords (the per-node gang hot path).
+        The returned dict is a shared frozen snapshot: do NOT mutate it."""
         with self._lock:
+            cached = self._hosts_cache.get(slice_id)
+            if cached is not None:
+                return cached
             sl = self._slices.get(slice_id)
-            return dict(sl.host_by_coord) if sl is not None else {}
+            snap = dict(sl.host_by_coord) if sl is not None else {}
+            self._hosts_cache[slice_id] = snap
+            return snap
 
     def slice_of_node(self, name: str) -> Optional[str]:
         with self._lock:
