@@ -41,6 +41,31 @@ def test_config2_four_pod_dp_job():
             }
             assert got == {tuple(c) for c in alloc.coords}
 
+        # compute leg: the actual ResNet DP step over a 4-device mesh (one
+        # device per scheduled replica), batch sharded over 'dp' — the job
+        # these 4 pods exist to run
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from tpukube.workload.resnet import (
+            ResNetConfig, init_params, make_dp_train_step,
+        )
+
+        rcfg = ResNetConfig(num_classes=10, width=8, stage_blocks=(1,),
+                            groups=4, image_size=8)
+        mesh = Mesh(np.asarray(jax.devices("cpu")[:len(allocs)]), ("dp",))
+        params = init_params(jax.random.PRNGKey(0), rcfg)
+        step = make_dp_train_step(rcfg, mesh, learning_rate=0.05)
+        images = jax.random.normal(jax.random.PRNGKey(1), (8, 8, 8, 3))
+        labels = jax.random.randint(jax.random.PRNGKey(2), (8,), 0, 10)
+        l0 = l = None
+        for _ in range(3):
+            params, loss = step(params, images, labels)
+            l = float(loss)
+            l0 = l if l0 is None else l0
+        assert l < l0
+
 
 def test_config2_without_topology_hint_still_packs_tightly():
     # DP pods carry no shape/topology hint, but topology scoring should

@@ -194,3 +194,46 @@ def test_dcn_split_takes_at_most_one_box_per_slice():
         for i in range(4):
             c.schedule(c.make_pod(f"s-{i}", tpu=1, group=small))
         assert c.extender.gang.reservation("default", "small").committed
+
+
+def test_dcn_split_preemption_evicts_to_cover():
+    """A full-cluster allow_dcn gang preempts across slices: one cheap
+    victim blocks the 16+16 split; it must be evicted, not wedge the gang."""
+    with two_slices() as c:
+        burst = []
+        n0, _ = c.schedule(c.make_pod("burst-0", tpu=1, priority=1))
+        group = PodGroup("mega", min_member=32, allow_dcn=True)
+        for i in range(32):
+            c.schedule(c.make_pod(f"m-{i}", tpu=1, group=group, priority=100))
+        res = c.extender.gang.reservation("default", "mega")
+        assert res.committed and res.spans_dcn
+        assert res.total_chips() == 32
+        assert c.extender.preemptions == 1
+        assert c.extender.state.allocation("default/burst-0") is None
+
+
+def test_mesh_from_alloc_env_builds_dcn_mesh():
+    import jax
+
+    from tpukube.workload.meshenv import mesh_from_alloc_env
+
+    env = {
+        "TPU_VISIBLE_DEVICES": "0",
+        "TPU_KUBE_DEVICE_IDS": "tpu-0",
+        "TPU_KUBE_CHIP_COORDS": "0,0,0",
+        "TPU_KUBE_MESH_DIMS": "4,4,1",
+        "TPU_KUBE_GANG_NUM_SLICES": "2",
+        "TPU_KUBE_GANG_SLICES": "slice-a,slice-b",
+        "TPU_KUBE_GANG_SLICE_INDEX": "0",
+    }
+    mesh, pe = mesh_from_alloc_env(env, devices=jax.devices()[:8], tp=2)
+    assert pe.spans_dcn
+    assert mesh.axis_names == ("dcn", "dp", "tp")
+    assert mesh.devices.shape == (2, 2, 2)
+    with pytest.raises(ValueError, match="divide"):
+        mesh_from_alloc_env(env, devices=jax.devices()[:7])
+
+
+def test_shaped_allow_dcn_pod_group_rejected_at_construction():
+    with pytest.raises(ValueError, match="incompatible"):
+        PodGroup("bad", min_member=4, shape=(2, 2, 1), allow_dcn=True)

@@ -1,0 +1,139 @@
+"""ResNet workload (BASELINE config 2's model family) on the virtual
+8-device CPU mesh, mirroring the Llama tests' structure."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpukube.workload.meshenv import (
+    ENV_GANG_NUM_SLICES,
+    ENV_GANG_SLICE_INDEX,
+    ENV_GANG_SLICES,
+    PodTpuEnv,
+    build_multislice_mesh,
+)
+from tpukube.workload.resnet import (
+    ResNetConfig,
+    forward,
+    init_params,
+    loss_fn,
+    make_dp_train_step,
+)
+
+TINY = ResNetConfig(num_classes=10, width=8, stage_blocks=(1, 1), groups=4,
+                    image_size=8)
+
+
+def _batch(n=4, cfg=TINY, seed=0):
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    images = jax.random.normal(k1, (n, cfg.image_size, cfg.image_size, 3))
+    labels = jax.random.randint(k2, (n,), 0, cfg.num_classes)
+    return images, labels
+
+
+def test_forward_shapes_and_dtype():
+    params = init_params(jax.random.PRNGKey(0), TINY)
+    images, _ = _batch(3)
+    logits = forward(params, images, TINY)
+    assert logits.shape == (3, TINY.num_classes)
+    assert logits.dtype == jnp.float32  # accumulate/classify in f32
+
+
+def test_bottleneck_variant():
+    cfg = ResNetConfig(num_classes=5, width=8, stage_blocks=(1, 1),
+                       bottleneck=True, groups=4, image_size=8)
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    logits = forward(params, _batch(2, cfg)[0], cfg)
+    assert logits.shape == (2, 5)
+
+
+def test_downsampling_halves_spatial():
+    # stage 1 strides: 8x8 -> 4x4 before pooling; just assert it runs and
+    # the head sees the doubled width
+    cfg = TINY
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    assert params["head"].shape[0] == cfg.stage_width(len(cfg.stage_blocks) - 1)
+
+
+def test_dp_loss_decreases():
+    from jax.sharding import Mesh
+
+    devs = jax.devices()[:4]
+    mesh = Mesh(np.asarray(devs), ("dp",))
+    params = init_params(jax.random.PRNGKey(0), TINY)
+    step = make_dp_train_step(TINY, mesh, learning_rate=0.05)
+    images, labels = _batch(8)
+    losses = []
+    for _ in range(5):
+        params, loss = step(params, images, labels)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_dp_matches_single_device():
+    from jax.sharding import Mesh
+
+    params = init_params(jax.random.PRNGKey(0), TINY)
+    images, labels = _batch(8)
+    single = float(loss_fn(params, images, labels, TINY))
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("dp",))
+    step = make_dp_train_step(TINY, mesh)
+    _, sharded_loss = step(jax.tree_util.tree_map(jnp.copy, params),
+                           images, labels)
+    assert abs(float(sharded_loss) - single) < 1e-2  # bf16 tolerance
+
+
+def test_pod_env_gang_slice_context():
+    env = {
+        "TPU_VISIBLE_DEVICES": "0",
+        "TPU_KUBE_DEVICE_IDS": "tpu-0",
+        "TPU_KUBE_CHIP_COORDS": "0,0,0",
+        "TPU_KUBE_MESH_DIMS": "4,4,1",
+        "TPU_KUBE_SLICE_ID": "slice-b",
+        ENV_GANG_NUM_SLICES: "2",
+        ENV_GANG_SLICES: "slice-a,slice-b",
+        ENV_GANG_SLICE_INDEX: "1",
+    }
+    pe = PodTpuEnv.from_env(env)
+    assert pe.spans_dcn
+    assert pe.slice_id == "slice-b"
+    assert pe.gang_slices == ("slice-a", "slice-b")
+    assert pe.gang_slice_index == 1
+    # absent gang env -> single-slice defaults
+    for k in (ENV_GANG_NUM_SLICES, ENV_GANG_SLICES, ENV_GANG_SLICE_INDEX):
+        env.pop(k)
+    pe2 = PodTpuEnv.from_env(env)
+    assert not pe2.spans_dcn and pe2.gang_num_slices == 1
+
+
+def test_multislice_mesh_axes():
+    mesh = build_multislice_mesh(jax.devices(), num_slices=2, dp=2, tp=2)
+    assert mesh.axis_names == ("dcn", "dp", "tp")
+    assert mesh.devices.shape == (2, 2, 2)
+
+
+def test_multislice_dp_step_runs():
+    """A DCN-spanning DP step: batch sharded over ('dcn','dp'), params
+    replicated — the multislice pattern the DCN gang env describes."""
+    from functools import partial
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = build_multislice_mesh(jax.devices(), num_slices=2, dp=4, tp=1)
+    # fold tp=1 away: batch over both dcn and dp
+    params = init_params(jax.random.PRNGKey(0), TINY)
+    batch_spec = NamedSharding(mesh, P(("dcn", "dp")))
+    repl = NamedSharding(mesh, P())
+
+    @partial(jax.jit, in_shardings=(repl, batch_spec, batch_spec),
+             out_shardings=(repl, None))
+    def step(params, images, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(params, images, labels, TINY)
+        return jax.tree_util.tree_map(lambda p, g: p - 0.05 * g, params,
+                                      grads), loss
+
+    images, labels = _batch(16)
+    with mesh:
+        params2, loss = step(params, images, labels)
+    assert jnp.isfinite(loss)

@@ -210,6 +210,13 @@ class PodGroup:
     # with a shape hint (a shape names one box).
     allow_dcn: bool = False
 
+    def __post_init__(self) -> None:
+        if self.allow_dcn and self.shape is not None:
+            raise ValueError(
+                f"pod group {self.name!r}: allow_dcn is incompatible with a "
+                f"shape hint (a shape names one contiguous box)"
+            )
+
 
 @dataclass
 class ContainerInfo:

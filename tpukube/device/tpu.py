@@ -47,6 +47,13 @@ ENV_KUBE_CHIP_COORDS = "TPU_KUBE_CHIP_COORDS"
 ENV_KUBE_MESH_DIMS = "TPU_KUBE_MESH_DIMS"
 ENV_KUBE_HOST = "TPU_KUBE_HOST"
 ENV_KUBE_SLICE = "TPU_KUBE_SLICE_ID"  # ICI domain (multi-slice clusters)
+# Gang slice context for DCN-spanning gangs. PRODUCED by the extender in
+# the alloc annotation (the device plugin's Allocate only sees device ids);
+# consumed by tpukube.workload.meshenv. Defined here so producer and
+# consumer share one set of names.
+ENV_GANG_NUM_SLICES = "TPU_KUBE_GANG_NUM_SLICES"
+ENV_GANG_SLICES = "TPU_KUBE_GANG_SLICES"
+ENV_GANG_SLICE_INDEX = "TPU_KUBE_GANG_SLICE_INDEX"
 ENV_HBM_LIMIT = "TPU_HBM_LIMIT_BYTES"
 ENV_MEM_FRACTION = "XLA_PYTHON_CLIENT_MEM_FRACTION"
 # vTPU TensorCore partition (BASELINE: "partitions TPU HBM and TensorCores"):

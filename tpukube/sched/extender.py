@@ -240,20 +240,33 @@ class Extender:
             if best_rank is None or rank < best_rank:
                 best_rank, plan, plan_slice = rank, cand, sid
         if plan is None:
+            if pod.group.allow_dcn and pod.group.shape is None:
+                split = self._plan_split_preemption(
+                    workloads, total, count, pod.priority
+                )
+                if split is not None:
+                    victims = {
+                        (w.gang_key or w.id): w
+                        for p in split.values() for w in p.victims
+                    }
+                    evicted_pods = self._apply_victims(victims.values())
+                    self.preemptions += evicted_pods
+                    log.warning(
+                        "gang %s/%s preempts %d pods for a DCN-split "
+                        "%d-chip reservation over %s",
+                        pod.namespace, pod.group.name, evicted_pods, total,
+                        sorted(split),
+                    )
+                    return self.gang.reserve_exact_split(
+                        pod, count,
+                        {sid: p.coords for sid, p in split.items()},
+                    )
             raise GangError(
                 f"gang {pod.namespace}/{pod.group.name}: no victim set opens "
                 f"a contiguous {total}-chip slice at priority {pod.priority} "
                 f"in any of {len(slice_ids)} ICI slices"
             )
-        evicted_pods = 0
-        for victim in plan.victims:
-            if victim.gang_key is not None:
-                evicted_pods += len(self.gang.dissolve(victim.gang_key))
-            else:
-                for pk in victim.pod_keys:
-                    self.state.release(pk)
-                    self.pending_evictions.append(pk)
-                    evicted_pods += 1
+        evicted_pods = self._apply_victims(plan.victims)
         self.preemptions += evicted_pods
         log.warning(
             "gang %s/%s preempts %d workloads / %d pods (priority sum %d) "
@@ -265,6 +278,64 @@ class Extender:
         return self.gang.reserve_exact(
             pod, count, plan.coords, slice_id=plan_slice
         )
+
+    def _apply_victims(self, victims) -> int:
+        """Evict a victim set: gangs dissolve wholesale (once, even when a
+        DCN-spanning gang appears as several per-slice workloads), plain
+        pods release + queue for eviction. Returns pods evicted."""
+        evicted_pods = 0
+        dissolved: set[tuple[str, str]] = set()
+        for victim in victims:
+            if victim.gang_key is not None:
+                if victim.gang_key in dissolved:
+                    continue
+                dissolved.add(victim.gang_key)
+                evicted_pods += len(self.gang.dissolve(victim.gang_key))
+            else:
+                for pk in victim.pod_keys:
+                    self.state.release(pk)
+                    self.pending_evictions.append(pk)
+                    evicted_pods += 1
+        return evicted_pods
+
+    def _plan_split_preemption(
+        self, workloads: list[policy.Workload], total: int,
+        chips_per_pod: int, priority: int,
+    ) -> Optional[dict[str, policy.PreemptionPlan]]:
+        """Preemption for a DCN-split gang: one cost-optimal box per slice
+        (greedy over slices by free capacity, largest feasible volume
+        first — the preemption mirror of GangManager._plan_dcn_split).
+        Returns slice -> plan covering exactly ``total`` chips, or None."""
+        order = sorted(
+            self.state.slice_ids(),
+            key=lambda s: (self.state.slice_utilization(s), s),
+        )
+        parts: dict[str, policy.PreemptionPlan] = {}
+        remaining = total
+        for sid in order:
+            if remaining == 0:
+                break
+            mesh = self.state.slice_mesh(sid)
+            in_slice = [w for w in workloads if w.slice_id == sid]
+            unhealthy = self.state.unhealthy_coords(sid)
+            broken = self.state.broken_links(sid)
+            max_vol = min(
+                remaining,
+                ((mesh.num_chips - len(unhealthy)) // chips_per_pod)
+                * chips_per_pod,
+            )
+            vol = max_vol
+            while vol >= chips_per_pod:
+                cand = policy.find_preemption_plan(
+                    in_slice, mesh, unhealthy, vol, None, priority,
+                    broken=broken,
+                )
+                if cand is not None:
+                    parts[sid] = cand
+                    remaining -= vol
+                    break
+                vol -= chips_per_pod
+        return parts if remaining == 0 else None
 
     def _preemption_workloads(self) -> list[policy.Workload]:
         """Current workloads at preemption granularity: whole gangs (with
@@ -570,12 +641,16 @@ class Extender:
                 # annotation / downward API — the device plugin's Allocate
                 # only sees device ids, so megascale-style multislice
                 # coordination env cannot come from the node agent)
-                sids = sorted(res.slice_coords)
-                env["TPU_KUBE_GANG_NUM_SLICES"] = str(len(sids))
-                env["TPU_KUBE_GANG_SLICES"] = ",".join(sids)
-                env["TPU_KUBE_GANG_SLICE_INDEX"] = str(
-                    sids.index(view.info.slice_id)
+                from tpukube.device.tpu import (
+                    ENV_GANG_NUM_SLICES,
+                    ENV_GANG_SLICE_INDEX,
+                    ENV_GANG_SLICES,
                 )
+
+                sids = sorted(res.slice_coords)
+                env[ENV_GANG_NUM_SLICES] = str(len(sids))
+                env[ENV_GANG_SLICES] = ",".join(sids)
+                env[ENV_GANG_SLICE_INDEX] = str(sids.index(view.info.slice_id))
             alloc = AllocResult(
                 pod_key=key,
                 node_name=node_name,
@@ -757,7 +832,16 @@ class Extender:
         restored = self.state.rebuild_from_pods(pods)
         members: dict[tuple[str, str], list] = {}  # (ns, group) -> [(alloc, group)]
         for annotations, alloc in restored:
-            group = codec.pod_group_from_annotations(annotations)
+            try:
+                group = codec.pod_group_from_annotations(annotations)
+            except codec.CodecError as e:
+                # one pod's malformed gang annotation must not abort the
+                # whole cluster's state reconstruction
+                log.warning(
+                    "pod %s: undecodable pod-group annotation (%s); "
+                    "restored as non-gang", alloc.pod_key, e,
+                )
+                continue
             if group is None:
                 continue
             ns = alloc.pod_key.split("/", 1)[0]

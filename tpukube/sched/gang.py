@@ -116,11 +116,6 @@ class GangReservation:
     def unassigned_in(self, slice_id: str) -> set[TopologyCoord]:
         return self.slice_coords.get(slice_id, set()) - self.assigned_in(slice_id)
 
-    def unassigned_total(self) -> int:
-        return self.total_chips() - sum(
-            len(coords) for _, coords in self.assigned.values()
-        )
-
     # single-slice conveniences (tests + single-slice call sites)
     def assigned_coords(self) -> set[TopologyCoord]:
         return self.assigned_in(self.slice_id)
@@ -518,6 +513,17 @@ class GangManager:
         """Reserve a specific chip set (the preemption path: policy already
         chose the box and evicted its victims). Raises if any chip was
         re-taken between eviction and this call — the scheduler retries."""
+        return self.reserve_exact_split(
+            pod, chips_per_pod, {slice_id: list(coords)}
+        )
+
+    def reserve_exact_split(
+        self, pod: PodInfo, chips_per_pod: int,
+        parts: dict[str, list[TopologyCoord]],
+    ) -> GangReservation:
+        """Reserve specific per-slice chip sets (single- or multi-slice
+        preemption). Raises if any chip was re-taken between eviction and
+        this call — the scheduler retries."""
         assert pod.group is not None
         with self._lock:
             key = (pod.namespace, pod.group.name)
@@ -525,37 +531,41 @@ class GangManager:
             if existing is not None:
                 return existing  # lost a benign race with a sibling member
             expected = pod.group.min_member * chips_per_pod
-            if len(coords) != expected:
+            got = sum(len(cs) for cs in parts.values())
+            if got != expected:
                 raise GangError(
-                    f"gang {key}: preemption opened {len(coords)} chips but "
+                    f"gang {key}: preemption opened {got} chips but "
                     f"the gang needs {expected}"
                 )
-            occupied = (
-                self._state.occupied_coords(slice_id)
-                | self.reserved_coords(slice_id)
-            )
-            clash = [c for c in coords if c in occupied]
-            if clash:
-                raise GangError(
-                    f"gang {key}: preempted box re-occupied at {clash[:3]}; retry"
+            for slice_id, coords in parts.items():
+                occupied = (
+                    self._state.occupied_coords(slice_id)
+                    | self.reserved_coords(slice_id)
                 )
-            if slicefit.coords_break_link(
-                set(coords), self._state.broken_links(slice_id)
-            ):
-                raise GangError(
-                    f"gang {key}: preempted box spans a downed ICI link; retry"
-                )
+                clash = [c for c in coords if c in occupied]
+                if clash:
+                    raise GangError(
+                        f"gang {key}: preempted box re-occupied at "
+                        f"{clash[:3]} in {slice_id}; retry"
+                    )
+                if slicefit.coords_break_link(
+                    set(coords), self._state.broken_links(slice_id)
+                ):
+                    raise GangError(
+                        f"gang {key}: preempted box in {slice_id} spans a "
+                        f"downed ICI link; retry"
+                    )
             res = GangReservation(
                 group=pod.group,
                 namespace=pod.namespace,
-                slice_coords={slice_id: set(coords)},
+                slice_coords={s: set(cs) for s, cs in parts.items()},
                 chips_per_pod=chips_per_pod,
                 priority=pod.priority,
             )
             self._reservations[key] = res
             log.info(
-                "gang %s/%s reserved %d chips via preemption",
-                key[0], key[1], res.total_chips(),
+                "gang %s/%s reserved %d chips over %d slice(s) via preemption",
+                key[0], key[1], res.total_chips(), len(parts),
             )
             return res
 

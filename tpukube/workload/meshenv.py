@@ -16,11 +16,15 @@ from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
 from tpukube.device.tpu import (
+    ENV_GANG_NUM_SLICES,
+    ENV_GANG_SLICE_INDEX,
+    ENV_GANG_SLICES,
     ENV_HBM_LIMIT,
     ENV_KUBE_CHIP_COORDS,
     ENV_KUBE_DEVICE_IDS,
     ENV_KUBE_HOST,
     ENV_KUBE_MESH_DIMS,
+    ENV_KUBE_SLICE,
     ENV_VISIBLE_DEVICES,
 )
 
@@ -35,6 +39,16 @@ class PodTpuEnv:
     mesh_dims: tuple[int, int, int]
     host: str
     hbm_limit_bytes: int
+    slice_id: str = ""
+    # DCN-spanning gang context (multislice DP): how many ICI slices the
+    # gang covers and which one this pod is in. 1/0 for single-slice gangs.
+    gang_num_slices: int = 1
+    gang_slice_index: int = 0
+    gang_slices: tuple[str, ...] = ()
+
+    @property
+    def spans_dcn(self) -> bool:
+        return self.gang_num_slices > 1
 
     @staticmethod
     def from_env(env: Optional[dict] = None) -> "PodTpuEnv":
@@ -43,6 +57,9 @@ class PodTpuEnv:
             coords = tuple(
                 tuple(int(v) for v in part.split(","))
                 for part in e[ENV_KUBE_CHIP_COORDS].split(";")
+            )
+            gang_slices = tuple(
+                s for s in e.get(ENV_GANG_SLICES, "").split(",") if s
             )
             return PodTpuEnv(
                 visible_chips=tuple(
@@ -53,6 +70,10 @@ class PodTpuEnv:
                 mesh_dims=tuple(int(v) for v in e[ENV_KUBE_MESH_DIMS].split(",")),  # type: ignore[arg-type]
                 host=e.get(ENV_KUBE_HOST, ""),
                 hbm_limit_bytes=int(e.get(ENV_HBM_LIMIT, "0")),
+                slice_id=e.get(ENV_KUBE_SLICE, ""),
+                gang_num_slices=int(e.get(ENV_GANG_NUM_SLICES, "1")),
+                gang_slice_index=int(e.get(ENV_GANG_SLICE_INDEX, "0")),
+                gang_slices=gang_slices,
             )
         except KeyError as k:
             raise RuntimeError(
@@ -107,6 +128,20 @@ def build_mesh(devices, dp: int, tp: int):
     return Mesh(devs, ("dp", "tp"))
 
 
+def build_multislice_mesh(devices, num_slices: int, dp: int, tp: int):
+    """Arrange devices into a Mesh('dcn', 'dp', 'tp') for a DCN-spanning
+    gang: the leading 'dcn' axis crosses slices (gradient-reduction only —
+    shard ONLY the batch over it), 'dp'/'tp' ride ICI within a slice.
+    Device order must be slice-major (gang_slice_index-major), which is
+    what sorted TPU_KUBE_GANG_SLICES indices give."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    n = num_slices * dp * tp
+    devs = np.asarray(devices[:n]).reshape(num_slices, dp, tp)
+    return Mesh(devs, ("dcn", "dp", "tp"))
+
+
 def mesh_from_alloc_env(env: Optional[dict] = None, devices=None,
                         tp: Optional[int] = None):
     """One-call consumer: env → (Mesh, PodTpuEnv).
@@ -114,11 +149,29 @@ def mesh_from_alloc_env(env: Optional[dict] = None, devices=None,
     In a real gang each pod contributes its local chips and the sizes come
     from the gang's box; under the sim/dryrun there is one process, so
     ``devices`` defaults to all of jax.devices().
+
+    DCN-spanning gangs (TPU_KUBE_GANG_NUM_SLICES > 1) get a 3-axis
+    Mesh('dcn', 'dp', 'tp'): shard ONLY the batch over 'dcn' (gradient
+    reduction is the one collective that should cross slices). The device
+    count must divide evenly across slices — per-slice parts of unequal
+    size cannot form one regular mesh, so such jobs treat the extra
+    chips as spare capacity or build their own mesh.
     """
     import jax
 
     pe = PodTpuEnv.from_env(env)
     devs = list(jax.devices()) if devices is None else list(devices)
+    if pe.spans_dcn:
+        n = len(devs)
+        ns = pe.gang_num_slices
+        if n % ns:
+            raise ValueError(
+                f"{n} devices do not divide over {ns} slices; a DCN mesh "
+                f"needs equal per-slice device counts"
+            )
+        per = n // ns
+        dp, tp_ = mesh_axes_from_box((per, 1, 1), tp)
+        return build_multislice_mesh(devs, ns, dp, tp_), pe
     shape = box_shape(pe.coords)
     n = shape[0] * shape[1] * shape[2]
     if len(devs) < n:
