@@ -121,3 +121,90 @@ def test_vtpu_node_advertises_shares(tmp_path):
         assert all("frac" in d for d in devs)
         env = kubelet.allocate("qiniu.com/vtpu", ["tpu-0-frac1of2"])
         assert env[ENV_HBM_LIMIT] == str(HBM // 2)
+
+
+def test_kubelet_restart_triggers_reregistration(tmp_path):
+    """Kubelet restart semantics: the new kubelet wipes the device-plugin
+    dir (unlinking our socket) and expects a fresh Register. The
+    KubeletSessionWatcher must notice both facts, rebind, and re-register
+    — without it the node would advertise zero TPUs until the agent's own
+    next restart."""
+    import os
+
+    from tpukube.plugin import KubeletSessionWatcher
+
+    cfg = load_config(env={
+        "TPUKUBE_DEVICE_PLUGIN_DIR": str(tmp_path),
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        "TPUKUBE_HBM_BYTES_PER_CHIP": str(HBM),
+    })
+    with TpuDeviceManager(cfg, host="host-0-0-0") as device:
+        server = DevicePluginServer(cfg, device)
+        server.start()
+        try:
+            kubelet = FakeKubelet(str(tmp_path))
+            kubelet.start()
+            server.register_with_kubelet()
+            kubelet.wait_for_devices(server.resource_name, 4)
+            watch = KubeletSessionWatcher(server, poll_seconds=999)
+            assert watch.check_once() is False  # steady state: no-op
+
+            # kubelet restarts: old process gone, plugin dir wiped
+            kubelet.stop()
+            assert watch.check_once() is False  # kubelet down: wait
+            if os.path.exists(server.socket_path):
+                os.unlink(server.socket_path)  # the restart wipe
+            kubelet = FakeKubelet(str(tmp_path))
+            kubelet.start()
+
+            assert watch.check_once() is True
+            assert watch.reregistrations == 1
+            kubelet.wait_for_devices(server.resource_name, 4)
+            # allocations work through the re-registered session
+            env = kubelet.allocate(server.resource_name, ["tpu-0"])
+            assert env[ENV_VISIBLE_DEVICES] == "0"
+            assert watch.check_once() is False  # stable again
+            kubelet.stop()
+        finally:
+            server.stop()
+
+
+def test_reregistration_retries_after_failed_register(tmp_path):
+    """A kubelet whose socket exists but whose Registration service is not
+    serving yet must NOT consume the restart event — the next poll retries."""
+    import os
+
+    from tpukube.plugin import KubeletSessionWatcher
+
+    cfg = load_config(env={
+        "TPUKUBE_DEVICE_PLUGIN_DIR": str(tmp_path),
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with TpuDeviceManager(cfg, host="host-0-0-0") as device:
+        server = DevicePluginServer(cfg, device)
+        server.start()
+        try:
+            kubelet = FakeKubelet(str(tmp_path))
+            kubelet.start()
+            server.register_with_kubelet()
+            watch = KubeletSessionWatcher(server, poll_seconds=999)
+            kubelet.stop()
+            # a DIFFERENT file appears at the kubelet socket path (new
+            # inode) but nothing is serving: Register must fail...
+            with open(cfg.kubelet_socket_path(), "w") as f:
+                f.write("")
+            with pytest.raises(Exception):
+                watch.check_once()
+            assert watch.reregistrations == 0
+            os.unlink(cfg.kubelet_socket_path())
+            # ...and once a real kubelet returns, the retry succeeds
+            kubelet = FakeKubelet(str(tmp_path))
+            kubelet.start()
+            assert watch.check_once() is True
+            assert watch.reregistrations == 1
+            kubelet.wait_for_devices(server.resource_name, 4)
+            kubelet.stop()
+        finally:
+            server.stop()

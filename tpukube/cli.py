@@ -82,13 +82,21 @@ def main_plugin(argv: Optional[list[str]] = None) -> int:
     from tpukube.core import codec
     from tpukube.device.tpu import TpuDeviceManager
     from tpukube.metrics import MetricsServer, render_plugin_metrics
-    from tpukube.plugin.server import DevicePluginServer, HealthWatcher
+    from tpukube.plugin.server import (
+        DevicePluginServer,
+        HealthWatcher,
+        KubeletSessionWatcher,
+    )
 
     with TpuDeviceManager(cfg) as device:
         server = DevicePluginServer(cfg, device, socket_path=args.socket)
         server.start()
         watcher = HealthWatcher(device, server)
         watcher.start()
+        kubelet_watch = None
+        if not args.no_register:
+            kubelet_watch = KubeletSessionWatcher(server)
+            kubelet_watch.start()
         metrics = MetricsServer(lambda: render_plugin_metrics(server),
                                 port=args.metrics_port)
         metrics.start()
@@ -113,6 +121,8 @@ def main_plugin(argv: Optional[list[str]] = None) -> int:
         try:
             stop.wait()
         finally:
+            if kubelet_watch is not None:
+                kubelet_watch.stop()
             watcher.stop()
             metrics.stop()
             server.stop()

@@ -1,4 +1,8 @@
 """Node agent (L3): deviceplugin/v1beta1 gRPC server + health watch."""
 
-from tpukube.plugin.server import DevicePluginServer, HealthWatcher  # noqa: F401
+from tpukube.plugin.server import (  # noqa: F401
+    DevicePluginServer,
+    HealthWatcher,
+    KubeletSessionWatcher,
+)
 from tpukube.plugin.fake_kubelet import FakeKubelet  # noqa: F401

@@ -84,6 +84,13 @@ class DevicePluginServer(stubs.DevicePluginServicer):
         if os.path.exists(self._socket_path):
             os.unlink(self._socket_path)
 
+    def restart(self, grace: float = 0.5) -> None:
+        """Rebind the unix socket (kubelet wipes the device-plugin dir on
+        restart, taking our socket file with it — a gRPC server holding a
+        deleted socket's fd serves nobody kubelet can reach)."""
+        self.stop(grace)
+        self.start()
+
     def __enter__(self) -> "DevicePluginServer":
         self.start()
         return self
@@ -240,3 +247,86 @@ class HealthWatcher:
                 self.check_once()
             except Exception:
                 log.exception("health poll failed")
+
+
+class KubeletSessionWatcher:
+    """Re-registers with a restarted kubelet (SURVEY.md §4.1 liveness).
+
+    Kubelet clears its device-plugin directory on restart and expects every
+    plugin to dial the fresh ``kubelet.sock`` and Register again — a plugin
+    that does not is silently absent from the node's allocatable until its
+    own next restart. The reference watches this with fsnotify; here we
+    poll two facts at the health-watch cadence:
+
+      * the kubelet socket's identity (st_ino/st_dev) — a change means a
+        new kubelet is up: re-register;
+      * our OWN socket file's existence — kubelet's restart wipe unlinks
+        it, and a gRPC server holding a deleted socket's fd is
+        unreachable: rebind, then re-register.
+    """
+
+    def __init__(self, server: DevicePluginServer,
+                 poll_seconds: Optional[float] = None):
+        self._server = server
+        if poll_seconds is None:
+            poll_seconds = server.config.health_poll_seconds
+        self._poll = poll_seconds
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._kubelet_ident = self._ident()
+        self.reregistrations = 0  # metrics/tests
+
+    def _ident(self) -> Optional[tuple[int, int, int]]:
+        try:
+            st = os.stat(self._server.config.kubelet_socket_path())
+            # st_ctime_ns guards against inode reuse: a deleted + recreated
+            # socket can get the old inode back (tmpfs does this readily)
+            return (st.st_ino, st.st_dev, st.st_ctime_ns)
+        except OSError:
+            return None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            raise RuntimeError("kubelet watcher already started")
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="tpukube-kubelet-watch")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def check_once(self) -> bool:
+        """One poll; True if a re-registration happened. Exposed so tests
+        step deterministically (same pattern as HealthWatcher)."""
+        ident = self._ident()
+        if ident is None:
+            # kubelet down: nothing to register with; record None so its
+            # return reads as a restart
+            self._kubelet_ident = None
+            return False
+        kubelet_restarted = ident != self._kubelet_ident
+        socket_gone = not os.path.exists(self._server.socket_path)
+        if not (kubelet_restarted or socket_gone):
+            return False
+        if socket_gone:
+            log.warning("plugin socket vanished (kubelet restart wipe); rebinding")
+            self._server.restart()
+        if kubelet_restarted:
+            log.warning("kubelet socket identity changed; re-registering")
+        self._server.register_with_kubelet()
+        # commit the observed identity only AFTER registration succeeded —
+        # a failed Register (new kubelet not serving yet) must leave the
+        # restart event pending so the next poll retries
+        self._kubelet_ident = ident
+        self.reregistrations += 1
+        return True
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll):
+            try:
+                self.check_once()
+            except Exception:
+                log.exception("kubelet session poll failed")

@@ -245,11 +245,11 @@ class Extender:
                     workloads, total, count, pod.priority
                 )
                 if split is not None:
-                    victims = {
-                        (w.gang_key or w.id): w
-                        for p in split.values() for w in p.victims
-                    }
-                    evicted_pods = self._apply_victims(victims.values())
+                    # _apply_victims is the single dedup point (gangs whose
+                    # parts appear in several per-slice plans dissolve once)
+                    evicted_pods = self._apply_victims(
+                        [w for p in split.values() for w in p.victims]
+                    )
                     self.preemptions += evicted_pods
                     log.warning(
                         "gang %s/%s preempts %d pods for a DCN-split "
