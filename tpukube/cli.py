@@ -96,7 +96,6 @@ def main_plugin(argv: Optional[list[str]] = None) -> int:
         kubelet_watch = None
         if not args.no_register:
             kubelet_watch = KubeletSessionWatcher(server)
-            kubelet_watch.start()
         metrics = MetricsServer(lambda: render_plugin_metrics(server),
                                 port=args.metrics_port)
         metrics.start()
@@ -112,8 +111,18 @@ def main_plugin(argv: Optional[list[str]] = None) -> int:
             with open(args.annotation_out, "w") as f:
                 f.write(payload + "\n")
 
-        if not args.no_register:
-            server.register_with_kubelet()
+        if kubelet_watch is not None:
+            try:
+                server.register_with_kubelet()
+            except Exception as e:
+                # kubelet not up yet (DaemonSet boot race): the session
+                # watcher registers on a later poll — do not crash-loop
+                log.warning(
+                    "initial kubelet registration failed (%s); the session "
+                    "watcher will retry", e,
+                )
+                kubelet_watch.mark_unregistered()
+            kubelet_watch.start()
         log.warning(
             "plugin serving %s on %s (metrics :%d)",
             server.resource_name, server.socket_path, metrics.port,

@@ -285,6 +285,13 @@ class KubeletSessionWatcher:
         except OSError:
             return None
 
+    def mark_unregistered(self) -> None:
+        """Forget the observed kubelet identity so the next poll registers
+        (the daemon calls this when its INITIAL registration fails — e.g. a
+        DaemonSet pod that boots before kubelet — turning a would-be crash
+        loop into convergence at the poll cadence)."""
+        self._kubelet_ident = None
+
     def start(self) -> None:
         if self._thread is not None:
             raise RuntimeError("kubelet watcher already started")

@@ -568,6 +568,19 @@ class Extender:
         }
         if len(node_free) < count:
             return None
+        if count == 1:
+            # fast path for the commonest request (1 chip/pod): the full
+            # mask+SAT sweep below reduces, for a 1x1x1 box, to "free chip
+            # with max contact against everything outside node_free" —
+            # computable directly over <= a host block's chips
+            best = max(
+                node_free,
+                key=lambda c: (
+                    slicefit.point_contact(mesh, c, lambda nb: nb not in node_free),
+                    tuple(-v for v in c),
+                ),
+            )
+            return [best]
         # everything outside this node's free set is masked occupied; built
         # directly as a grid — a whole-mesh Python set here was the hottest
         # line of /prioritize (this runs per node per webhook)

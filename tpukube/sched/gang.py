@@ -76,8 +76,26 @@ class GangReservation:
     assigned: dict[str, tuple[str, list[TopologyCoord]]] = field(
         default_factory=dict
     )
+    # per-slice union of assigned coords, maintained by record_assignment/
+    # drop_assignment (assigned_in runs per node per webhook — recomputing
+    # the union there was measurable). Mutate assigned ONLY through those.
+    _assigned_by_slice: dict[str, set[TopologyCoord]] = field(
+        default_factory=dict
+    )
     committed: bool = False
     commit_latency: Optional[float] = None
+
+    def record_assignment(
+        self, pod_key: str, slice_id: str, coords: list[TopologyCoord]
+    ) -> None:
+        self.assigned[pod_key] = (slice_id, list(coords))
+        self._assigned_by_slice.setdefault(slice_id, set()).update(coords)
+
+    def drop_assignment(self, pod_key: str) -> None:
+        entry = self.assigned.pop(pod_key, None)
+        if entry is not None:
+            sid, coords = entry
+            self._assigned_by_slice.get(sid, set()).difference_update(coords)
 
     @property
     def key(self) -> tuple[str, str]:
@@ -106,12 +124,7 @@ class GangReservation:
         return sum(len(cs) for cs in self.slice_coords.values())
 
     def assigned_in(self, slice_id: str) -> set[TopologyCoord]:
-        return {
-            c
-            for sid, coords in self.assigned.values()
-            if sid == slice_id
-            for c in coords
-        }
+        return self._assigned_by_slice.get(slice_id, set())
 
     def unassigned_in(self, slice_id: str) -> set[TopologyCoord]:
         return self.slice_coords.get(slice_id, set()) - self.assigned_in(slice_id)
@@ -458,8 +471,8 @@ class GangManager:
                 priority=max(a.priority for a in allocs),
             )
             for a in allocs:
-                res.assigned[a.pod_key] = (
-                    member_slices[a.pod_key], list(a.coords)
+                res.record_assignment(
+                    a.pod_key, member_slices[a.pod_key], list(a.coords)
                 )
             res.committed = committed
             self._reservations[key] = res
@@ -672,7 +685,7 @@ class GangManager:
             bad = [c for c in coords if c not in res.unassigned_in(sid)]
             if bad:
                 raise GangError(f"gang {res.key}: coords {bad} not reservable")
-            res.assigned[pod_key] = (sid, list(coords))
+            res.record_assignment(pod_key, sid, list(coords))
             if not res.committed and len(res.assigned) >= res.group.min_member:
                 res.committed = True
                 res.commit_latency = time.monotonic() - res.created
@@ -704,7 +717,7 @@ class GangManager:
         with self._lock:
             for res in self._reservations.values():
                 if pod_key in res.assigned:
-                    res.assigned.pop(pod_key)
+                    res.drop_assignment(pod_key)
                     if res.committed and not res.assigned:
                         self._reservations.pop(res.key, None)
                         log.info(

@@ -41,6 +41,36 @@ from tpukube.core.types import Link, TopologyCoord, canonical_link
 Shape = tuple[int, int, int]
 
 
+def point_contact(mesh: MeshSpec, c: TopologyCoord, blocked) -> int:
+    """Contact of one chip against blocked neighbors and mesh walls — the
+    single definition of single-chip snugness. ``blocked(coord) -> bool``
+    says whether a neighbor counts as contact; true mesh walls always do
+    (axes of extent 1 contribute both walls; a length-2 torus axis reaches
+    the same chip in both directions and both count, matching the box
+    sweep's per-face slab sampling). Shared by _Sweep.contact_point
+    (occupancy-grid form) and the extender's single-chip placement fast
+    path (free-set form)."""
+    total = 0
+    for axis in range(3):
+        d = mesh.dims[axis]
+        wrap = mesh.torus[axis] and d > 1
+        for step in (-1, 1):
+            idx = c[axis] + step
+            if wrap:
+                v = list(c)
+                v[axis] = idx % d
+                if blocked(TopologyCoord(*v)):
+                    total += 1
+            elif idx < 0 or idx >= d:
+                total += 1  # true mesh wall
+            else:
+                v = list(c)
+                v[axis] = idx
+                if blocked(TopologyCoord(*v)):
+                    total += 1
+    return total
+
+
 def coords_break_link(chips: set[TopologyCoord], broken: set[Link]) -> bool:
     """True if both endpoints of any downed ICI link are in ``chips``.
 
@@ -166,21 +196,7 @@ class _Sweep:
         per-chip snugness loop of /prioritize calls this per node per pod,
         where the general slab machinery below is ~10x the cost."""
         g = self.grid
-        total = 0
-        for axis, d in enumerate(g.shape):
-            v = c[axis]
-            for idx in (v - 1, v + 1):
-                if self.mesh.torus[axis] and d > 1:
-                    nb = list(c)
-                    nb[axis] = idx % d
-                    total += int(g[tuple(nb)])
-                elif idx < 0 or idx >= d:
-                    total += 1  # true mesh wall
-                else:
-                    nb = list(c)
-                    nb[axis] = idx
-                    total += int(g[tuple(nb)])
-        return total
+        return point_contact(self.mesh, c, lambda nb: bool(g[nb]))
 
     def contact(self, box: Box) -> int:
         """Faces of the box touching a mesh wall or occupied chips.

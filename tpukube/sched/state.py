@@ -38,10 +38,19 @@ class StateError(RuntimeError):
 class NodeView:
     """One node's decoded annotation + live occupancy, tracked at device-id
     granularity (a count would re-mint a released share's id while its twin
-    is still live — ids are the unit of truth, counts are derived)."""
+    is still live — ids are the unit of truth, counts are derived).
+    ``share_counts`` is a per-chip cache of those derived counts, kept in
+    lockstep by add_ids/remove_ids (used_share_count is the hottest call
+    of every webhook — parsing ids per query was measurable)."""
 
     info: NodeInfo
     used_ids: set[str] = field(default_factory=set)
+    share_counts: dict[int, int] = field(default_factory=dict)
+    # weight each id contributed to share_counts AT COMMIT TIME — release
+    # must subtract exactly that, not a recomputation: a node whose
+    # shares_per_chip annotation changes under live allocations would
+    # otherwise leak counts permanently
+    id_weights: dict[str, int] = field(default_factory=dict)
     # verbatim annotation payload this view was decoded from; upsert_node
     # skips re-decoding when a webhook carries the identical string (hot:
     # every /filter and /prioritize re-sends every node's annotations)
@@ -54,14 +63,29 @@ class NodeView:
     def chip(self, index: int) -> ChipInfo:
         return self.info.chip_by_index(index)
 
-    def used_share_count(self, index: int) -> int:
-        n = 0
-        for did in self.used_ids:
+    def add_ids(self, ids) -> None:
+        for did in ids:
             i, frac = parse_device_id(did)
-            if i != index:
+            self.used_ids.add(did)
+            weight = 1 if frac is not None else self.shares_per_chip
+            self.id_weights[did] = weight
+            self.share_counts[i] = self.share_counts.get(i, 0) + weight
+
+    def remove_ids(self, ids) -> None:
+        for did in ids:
+            if did not in self.used_ids:
                 continue
-            n += 1 if frac is not None else self.shares_per_chip
-        return n
+            i, _ = parse_device_id(did)
+            self.used_ids.discard(did)
+            weight = self.id_weights.pop(did, 0)
+            left = self.share_counts.get(i, 0) - weight
+            if left > 0:
+                self.share_counts[i] = left
+            else:
+                self.share_counts.pop(i, None)
+
+    def used_share_count(self, index: int) -> int:
+        return self.share_counts.get(index, 0)
 
     def used_frac_ks(self, index: int) -> set[int]:
         out = set()
@@ -171,6 +195,8 @@ class ClusterState:
             view = NodeView(info=info, raw_payload=payload)
             if prev is not None:
                 view.used_ids = prev.used_ids
+                view.share_counts = prev.share_counts
+                view.id_weights = prev.id_weights
             self._nodes[name] = view
         return True
 
@@ -352,7 +378,7 @@ class ClusterState:
                     raise StateError(f"{did}: insufficient free shares")
                 adding.add(did)
                 pending_shares[index] = pending_shares.get(index, 0) + want
-            view.used_ids |= adding
+            view.add_ids(adding)
             self._allocs[alloc.pod_key] = alloc
 
     def release(self, pod_key: str) -> Optional[AllocResult]:
@@ -363,7 +389,7 @@ class ClusterState:
                 return None
             view = self._nodes.get(alloc.node_name)
             if view is not None:
-                view.used_ids -= set(alloc.device_ids)
+                view.remove_ids(alloc.device_ids)
             return alloc
 
     # -- restart story -----------------------------------------------------

@@ -137,6 +137,7 @@ class SimCluster:
                 )
         self.extender = Extender(self.config)
         self.pods: dict[str, dict[str, Any]] = {}  # key -> pod object
+        self._node_obj_cache: dict[str, dict[str, Any]] = {}
         self._port = _free_port()
         self._http: Optional[_AppThread] = None
         # keep-alive connection per client thread (kube-scheduler likewise
@@ -172,18 +173,29 @@ class SimCluster:
         self.stop()
 
     # -- kube-object minting -----------------------------------------------
+    def _invalidate_node(self, name: str) -> None:
+        self._node_obj_cache.pop(name, None)
+
     def node_objects(self) -> list[dict[str, Any]]:
-        return [
-            {
-                "metadata": {
-                    "name": name,
-                    "annotations": codec.annotate_node(
-                        info, self.slices[info.slice_id]
-                    ),
+        """Node API objects as kube-scheduler would send them. Encoded
+        annotations are cached per node (schedule() resends every node on
+        every webhook; re-encoding 32 nodes per cycle dominated the sim's
+        own overhead) — fault injection invalidates the touched node."""
+        out = []
+        for name, info in sorted(self.nodes.items()):
+            obj = self._node_obj_cache.get(name)
+            if obj is None:
+                obj = {
+                    "metadata": {
+                        "name": name,
+                        "annotations": codec.annotate_node(
+                            info, self.slices[info.slice_id]
+                        ),
+                    }
                 }
-            }
-            for name, info in sorted(self.nodes.items())
-        ]
+                self._node_obj_cache[name] = obj
+            out.append(obj)
+        return out
 
     def make_pod(
         self,
@@ -328,6 +340,7 @@ class SimCluster:
         for chip in info.chips:
             if chip.index == chip_index:
                 chip.health = Health.HEALTHY if healthy else Health.UNHEALTHY
+                self._invalidate_node(node_name)
                 return
         raise KeyError(f"{node_name} has no chip {chip_index}")
 
@@ -356,6 +369,7 @@ class SimCluster:
                     info.bad_links.remove(link)
             elif link not in info.bad_links:
                 info.bad_links.append(link)
+            self._invalidate_node(name)
 
     # -- node-agent composition check (config 2's fan-out leg) ---------------
     def execute_allocation(self, alloc: AllocResult) -> dict[str, str]:
