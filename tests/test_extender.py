@@ -367,3 +367,35 @@ def test_restart_rebuild_mid_assembly_gang_uncompletable():
         assert fresh.gang.rollbacks == 1
         # the 12 solos survive untouched
         assert fresh.state.utilization() == pytest.approx(12 / 16)
+
+
+def test_sharing_mode_switch_rejected_under_live_allocations():
+    """A node flipping shares_per_chip while pods hold its chips would
+    double-book (old ids carry old-mode weights) — the ledger refuses."""
+    import pytest
+
+    from tpukube.core import codec
+    from tpukube.core.mesh import MeshSpec
+    from tpukube.core.types import AllocResult, ChipInfo, NodeInfo, TopologyCoord
+    from tpukube.sched.state import ClusterState, StateError
+
+    mesh = MeshSpec(dims=(2, 2, 1), host_block=(2, 2, 1))
+    def node(shares):
+        return NodeInfo(
+            name="host-0-0-0",
+            chips=[ChipInfo(f"c{i}", i, co, hbm_bytes=16 << 30)
+                   for i, co in enumerate(mesh.coords_of_host("host-0-0-0"))],
+            shares_per_chip=shares,
+        )
+
+    st = ClusterState()
+    st.upsert_node("host-0-0-0", codec.annotate_node(node(1), mesh))
+    st.commit(AllocResult(pod_key="d/p", node_name="host-0-0-0",
+                          device_ids=["tpu-0"],
+                          coords=[TopologyCoord(0, 0, 0)]))
+    with pytest.raises(StateError, match="drain"):
+        st.upsert_node("host-0-0-0", codec.annotate_node(node(4), mesh))
+    # after the pod is gone the switch is fine
+    st.release("d/p")
+    st.upsert_node("host-0-0-0", codec.annotate_node(node(4), mesh))
+    assert st.node("host-0-0-0").shares_per_chip == 4

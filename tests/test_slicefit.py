@@ -291,3 +291,23 @@ def test_irregular_region_never_contains_both_ends_of_dead_link():
     assert got3 is not None
     cs = set(got3)
     assert not (TopologyCoord(0, 0, 0) in cs and TopologyCoord(0, 1, 0) in cs)
+
+
+def test_contact_grid_matches_contact_point():
+    from tpukube.sched.slicefit import _Sweep
+
+    rng = random.Random(7)
+    for dims, torus in [
+        ((4, 4, 4), (False, False, False)),
+        ((4, 4, 1), (True, False, False)),
+        ((2, 3, 1), (True, True, True)),
+        ((1, 4, 2), (False, True, False)),
+    ]:
+        mesh = MeshSpec(dims=dims, host_block=(1, 1, 1), torus=torus)
+        coords = list(mesh.all_coords())
+        occupied = rng.sample(coords, k=len(coords) // 3)
+        grid = occupancy_grid(mesh, occupied)
+        sweep = _Sweep(mesh, grid)
+        cg = sweep.contact_grid()
+        for c in coords:
+            assert int(cg[c]) == sweep.contact_point(c), (dims, torus, c)

@@ -148,3 +148,28 @@ def test_restart_under_load_rebuilds_identical_state():
         assert {k for k in res.assigned} == {
             f"default/g-{i}" for i in range(4)
         }
+
+
+def test_v5p_2048_scale_budget():
+    """Scheduling must stay interactive at v5p-2048 scale (2048 chips,
+    512 hosts): a 64-pod gang and a batch of singles each within a budget
+    ~10x the measured wall (CI headroom, catches complexity cliffs)."""
+    import time
+
+    from tpukube.core.mesh import MeshSpec
+    from tpukube.core.types import PodGroup
+
+    mesh = MeshSpec(dims=(16, 16, 8), host_block=(2, 2, 1))
+    with SimCluster(load_config(env={}), mesh=mesh) as c:
+        t0 = time.perf_counter()
+        g = PodGroup("big", min_member=64)
+        for i in range(64):
+            c.schedule(c.make_pod(f"g-{i}", tpu=1, group=g))
+        gang_wall = time.perf_counter() - t0
+        assert c.extender.gang.reservation("default", "big").committed
+        t1 = time.perf_counter()
+        for i in range(32):
+            c.schedule(c.make_pod(f"s-{i}", tpu=1))
+        singles_wall = time.perf_counter() - t1
+        assert gang_wall < 10.0, f"64-pod gang took {gang_wall:.1f}s"
+        assert singles_wall < 10.0, f"32 singles took {singles_wall:.1f}s"

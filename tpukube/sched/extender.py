@@ -492,6 +492,26 @@ class Extender:
             )
             return round(MAX_SCORE * used_frac)
         # "topology" (default): ICI-mesh locality.
+        if resource == RESOURCE_TPU and count == 1 and sweeps is not None:
+            # vectorized fast path for the commonest request: the node's
+            # score is the snuggest single free chip it offers, read off
+            # the per-request contact grid (bind re-plans the concrete
+            # chip; scoring only needs the node's best)
+            sid = view.info.slice_id
+            sweep = sweeps.get(sid)
+            if sweep is not None:
+                mask_set = (
+                    reserved.get(sid, set()) if reserved is not None else set()
+                )
+                cg = sweep.contact_grid()
+                best = -1
+                for chip in view.free_chips():
+                    if chip.coord in mask_set:
+                        continue
+                    v = int(cg[chip.coord])
+                    if v > best:
+                        best = v
+                return round(MAX_SCORE * best / 6) if best >= 0 else 0
         plan = self._plan_chips(view, resource, count, reserved)
         if plan is None:
             return 0

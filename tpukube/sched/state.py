@@ -176,6 +176,20 @@ class ClusterState:
                     f"node {name} moved from slice {prev.info.slice_id} "
                     f"to {info.slice_id} — drop and re-add the node"
                 )
+            if (
+                prev is not None
+                and prev.used_ids
+                and prev.info.shares_per_chip != info.shares_per_chip
+            ):
+                # a sharing-mode switch under live allocations cannot be
+                # accounted (committed ids carry the OLD mode's weights;
+                # mixing modes double-books chips) — drain the node first
+                raise StateError(
+                    f"node {name} changed shares_per_chip "
+                    f"{prev.info.shares_per_chip} -> {info.shares_per_chip} "
+                    f"with {len(prev.used_ids)} live allocations — drain "
+                    f"the node before switching sharing mode"
+                )
             # validate EVERY claim before mutating anything: a partial
             # apply would leave phantom claims with no owner on error
             for chip in info.chips:
