@@ -1,0 +1,76 @@
+"""Real-TPU legs of BASELINE config 1 (SURVEY.md §5 point 1).
+
+This machine has one real TPU chip behind a tunnel; TPU init can take
+minutes on first touch, so these tests are OPT-IN: set
+``TPUKUBE_TEST_REAL_TPU=1`` to run them (the driver's bench exercises the
+real chip every round regardless). They prove the two real-hardware
+claims: the native layer's ``real`` backend enumerates the local chip via
+libtpu, and the env a tpukube Allocate injects actually steers a JAX
+process (visible devices + a jitted computation on the TPU).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REAL = os.environ.get("TPUKUBE_TEST_REAL_TPU") == "1"
+skip_unless_real = pytest.mark.skipif(
+    not REAL, reason="set TPUKUBE_TEST_REAL_TPU=1 to run real-chip tests"
+)
+
+
+@skip_unless_real
+def test_real_backend_enumerates_local_chip():
+    from tpukube.native import TpuInfo
+
+    with TpuInfo("real") as ti:
+        chips = ti.chips()
+        assert len(chips) >= 1
+        assert chips[0].hbm_bytes > 0
+        assert chips[0].chip_id.startswith("local-")
+
+
+@skip_unless_real
+def test_allocated_env_drives_real_jax_compute():
+    """Allocate env -> subprocess with the REAL platform -> jitted matmul
+    on the TPU. Run in a subprocess because this test session pins
+    JAX_PLATFORMS=cpu (conftest) and JAX platform choice is
+    process-global."""
+    from tpukube.core.config import load_config
+    from tpukube.device import TpuDeviceManager
+
+    cfg = load_config(env={
+        "TPUKUBE_BACKEND": "real",
+    })
+    with TpuDeviceManager(cfg, host="host-0-0-0") as dm:
+        env = dm.allocate_env(["tpu-0"])
+    child_env = dict(os.environ)
+    # undo the conftest's CPU pinning for this child, and keep the
+    # virtual-device XLA flag out of the real-chip process. This machine's
+    # chip rides the "axon" PJRT plugin, loaded from the machine's
+    # original PYTHONPATH — so APPEND the repo, never replace.
+    child_env["JAX_PLATFORMS"] = os.environ.get("TPUKUBE_REAL_PLATFORM", "axon")
+    child_env["XLA_FLAGS"] = " ".join(
+        f for f in child_env.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    )
+    child_env.update(env)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    prior = child_env.get("PYTHONPATH", "")
+    child_env["PYTHONPATH"] = f"{repo}:{prior}" if prior else repo
+    code = (
+        "import jax, jax.numpy as jnp\n"
+        "devs = jax.devices()\n"
+        "assert devs and devs[0].platform != 'cpu', devs\n"
+        "x = jnp.ones((256, 256), jnp.bfloat16)\n"
+        "y = jax.jit(lambda a: (a @ a).sum())(x)\n"
+        "print('REAL_TPU_OK', float(y), devs[0].device_kind)\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=child_env,
+        capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "REAL_TPU_OK" in out.stdout

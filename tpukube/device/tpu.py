@@ -32,6 +32,7 @@ from tpukube.core.types import (
     Health,
     NodeInfo,
     VtpuShare,
+    canonical_link,
     make_device_id,
     parse_device_id,
 )
@@ -304,11 +305,19 @@ class TpuDeviceManager:
                 f"only {len(healthy_avail)} healthy devices for size {size}"
             )
 
+        broken = set(self._ti.link_faults())
+
         def affinity(a: str, b: str) -> int:
             # Two shares of one chip beat mesh neighbors: zero-hop co-location.
             if chip_of[a] == chip_of[b]:
                 return 2
-            return 1 if coords[a] in self._mesh.neighbors(coords[b]) else 0
+            if coords[a] not in self._mesh.neighbors(coords[b]):
+                return 0
+            # a dead ICI link is no affinity at all — recommending chips
+            # joined only by it would hand the pod a degraded pair
+            if canonical_link(coords[a], coords[b]) in broken:
+                return 0
+            return 1
 
         chosen: list[str] = list(required)
         while len(chosen) < size:

@@ -144,6 +144,25 @@ def sim_spec(
     return out
 
 
+def default_libtpu_path() -> Optional[str]:
+    """Locate libtpu.so: loader path first (None lets the C side use the
+    plain soname), else inside the ``libtpu`` Python package (how Cloud
+    TPU images ship it — it is not on the default loader path there)."""
+    import ctypes.util
+    import importlib.util
+
+    if ctypes.util.find_library("tpu"):
+        return None
+    try:
+        spec = importlib.util.find_spec("libtpu")
+    except (ImportError, ValueError):
+        return None
+    if spec is None or spec.origin is None:
+        return None
+    path = os.path.join(os.path.dirname(spec.origin), "libtpu.so")
+    return path if os.path.exists(path) else None
+
+
 class TpuInfo:
     """One initialized enumeration session (context manager).
 
@@ -154,6 +173,13 @@ class TpuInfo:
     _instance_lock = threading.Lock()
 
     def __init__(self, backend: str, spec: Optional[str] = None):
+        if backend == "real" and "libtpu=" not in (spec or ""):
+            found = default_libtpu_path()
+            if found is not None:
+                spec = spec or ""
+                if spec and not spec.endswith("\n"):
+                    spec += "\n"
+                spec += f"libtpu={found}\n"
         self._lib = _load()
         self._lock = threading.Lock()
         self._open = False

@@ -589,14 +589,17 @@ class Extender:
         if len(node_free) < count:
             return None
         if count == 1:
-            # fast path for the commonest request (1 chip/pod): the full
-            # mask+SAT sweep below reduces, for a 1x1x1 box, to "free chip
-            # with max contact against everything outside node_free" —
-            # computable directly over <= a host block's chips
+            # fast path for the commonest request (1 chip/pod): pick the
+            # node's free chip snuggest against GLOBAL occupancy — the
+            # same quantity /prioritize's contact-grid scoring maximizes,
+            # so the bound chip realizes the score the node won on (other
+            # hosts' FREE chips are not blockers; treating them as such,
+            # as the old mask form did, mis-ranked fragmentation)
+            blocked = self.state.occupied_coords(sid) | mask_set
             best = max(
                 node_free,
                 key=lambda c: (
-                    slicefit.point_contact(mesh, c, lambda nb: nb not in node_free),
+                    slicefit.point_contact(mesh, c, lambda nb: nb in blocked),
                     tuple(-v for v in c),
                 ),
             )
