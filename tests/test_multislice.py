@@ -204,5 +204,5 @@ def test_replay_determinism_with_multislice_gang():
             c.schedule(c.make_pod(f"s-{i}", tpu=1))
         events = c.extender.trace.events()
         assert events
-        result = replay(events, cfg)
-        assert result.divergence is None, result.divergence
+        divergences = replay(events, config=cfg)
+        assert not divergences, divergences[0]
