@@ -1,0 +1,442 @@
+"""Apiserver channel: annotation syncer, alloc-intent steering, and the
+extender<->kubelet device-id reconciliation loop (SURVEY.md §4.1/§4.3)."""
+
+import json
+import threading
+
+import pytest
+
+from tpukube import apiserver as apisrv
+from tpukube.core import codec
+from tpukube.core.config import load_config
+from tpukube.sim import SimCluster
+
+HBM = 16 << 30
+
+
+def _node_cfg(tmp_path, dims="4,4,1", block="2,2,1", extra=None):
+    env = {
+        "TPUKUBE_DEVICE_PLUGIN_DIR": str(tmp_path),
+        "TPUKUBE_SIM_MESH_DIMS": dims,
+        "TPUKUBE_SIM_HOST_BLOCK": block,
+        "TPUKUBE_HBM_BYTES_PER_CHIP": str(HBM),
+    }
+    env.update(extra or {})
+    return load_config(env=env)
+
+
+# -- NodeAnnotationSyncer ----------------------------------------------------
+
+def test_extender_learns_topology_only_through_syncer(tmp_path):
+    """E2E for the apiserver writer the round-1 plugin left to 'an external
+    writer': plugin emits its annotation file, the syncer PATCHes the Node,
+    and the extender schedules from what the apiserver now carries —
+    no other topology channel exists in this test."""
+    from tpukube.device import TpuDeviceManager
+    from tpukube.sched.extender import Extender
+
+    cfg = _node_cfg(tmp_path, dims="2,2,1")
+    api = apisrv.FakeApiServer()
+    anno_file = tmp_path / "annotation.json"
+
+    # the node agent side: write the annotation file (what main_plugin's
+    # --annotation-out does), then sync it
+    with TpuDeviceManager(cfg, host="host-0-0-0") as device:
+        anno = codec.annotate_node(device.node_info(), device.mesh)
+    anno_file.write_text(json.dumps(anno) + "\n")
+    syncer = apisrv.NodeAnnotationSyncer(
+        api, "host-0-0-0", str(anno_file), poll_seconds=999
+    )
+    assert syncer.check_once() is True
+    assert syncer.check_once() is False  # unchanged content: no re-patch
+    assert codec.ANNO_NODE_TOPOLOGY in api.get_node_annotations("host-0-0-0")
+
+    # the scheduler side sees ONLY the apiserver's node objects
+    ext = Extender(cfg)
+    pod_obj = {
+        "metadata": {
+            "name": "p0", "namespace": "default", "uid": "u0",
+            "annotations": {},
+        },
+        "spec": {"containers": [{
+            "name": "main",
+            "resources": {"limits": {cfg.resource_tpu: "1"}},
+        }]},
+    }
+    result = ext.handle(
+        "filter", {"Pod": pod_obj, "Nodes": {"Items": api.node_objects()}}
+    )
+    assert [n["metadata"]["name"] for n in result["Nodes"]["Items"]] == [
+        "host-0-0-0"
+    ]
+
+    # a health re-annotation flows the same way: new content -> new patch
+    anno2 = dict(anno)
+    payload = json.loads(anno2[codec.ANNO_NODE_TOPOLOGY])
+    payload["chips"][0]["health"] = "Unhealthy"
+    anno2[codec.ANNO_NODE_TOPOLOGY] = json.dumps(payload)
+    anno_file.write_text(json.dumps(anno2) + "\n")
+    assert syncer.check_once() is True
+    assert syncer.syncs == 2
+
+
+def test_syncer_tolerates_missing_and_garbage_file(tmp_path):
+    api = apisrv.FakeApiServer()
+    syncer = apisrv.NodeAnnotationSyncer(
+        api, "n0", str(tmp_path / "nope.json"), poll_seconds=999
+    )
+    assert syncer.check_once() is False  # agent not up yet
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    syncer = apisrv.NodeAnnotationSyncer(api, "n0", str(bad), poll_seconds=999)
+    assert syncer.check_once() is False
+    assert api.get_node_annotations("n0") == {}
+
+
+# -- RestApiServer -----------------------------------------------------------
+
+def test_rest_apiserver_speaks_merge_patch():
+    """The no-client-library REST writer sends bearer-authed JSON
+    merge-patches and field-selector GETs (verified against a local HTTP
+    stand-in; no cluster exists in this environment)."""
+    import http.server
+
+    seen = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def _reply(self, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_PATCH(self):
+            n = int(self.headers["Content-Length"])
+            seen.append((
+                "PATCH", self.path,
+                self.headers.get("Authorization"),
+                self.headers.get("Content-Type"),
+                json.loads(self.rfile.read(n)),
+            ))
+            self._reply({})
+
+        def do_GET(self):
+            seen.append(("GET", self.path, None, None, None))
+            self._reply({"items": [
+                {"metadata": {"name": "p0", "namespace": "default"}}
+            ]})
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        api = apisrv.RestApiServer(
+            base_url=f"http://127.0.0.1:{httpd.server_address[1]}",
+            token="sekrit",
+        )
+        api.patch_node_annotations("n1", {"a": "b"})
+        api.patch_pod_annotations("default", "p0", {"x": None})
+        pods = api.list_pods("n1")
+        assert pods[0]["metadata"]["name"] == "p0"
+    finally:
+        httpd.shutdown()
+
+    method, path, auth, ctype, body = seen[0]
+    assert (method, path) == ("PATCH", "/api/v1/nodes/n1")
+    assert auth == "Bearer sekrit"
+    assert ctype == "application/merge-patch+json"
+    assert body == {"metadata": {"annotations": {"a": "b"}}}
+    method, path, _, _, body = seen[1]
+    assert (method, path) == ("PATCH", "/api/v1/namespaces/default/pods/p0")
+    assert body == {"metadata": {"annotations": {"x": None}}}  # null deletes
+    assert seen[2][1] == "/api/v1/pods?fieldSelector=spec.nodeName%3Dn1"
+
+
+# -- alloc intents: steering -------------------------------------------------
+
+def test_intent_steers_preferred_allocation(tmp_path):
+    """The extender's planned ids win GetPreferredAllocation over the local
+    adjacency heuristic: a kubelet that honors preference converges on the
+    planned chips without ever knowing the plan's origin."""
+    from tpukube.device import TpuDeviceManager
+    from tpukube.plugin import DevicePluginServer, FakeKubelet
+
+    cfg = _node_cfg(tmp_path, dims="2,2,1")
+    with TpuDeviceManager(cfg, host="host-0-0-0") as device, \
+            DevicePluginServer(cfg, device) as server, \
+            FakeKubelet(str(tmp_path)) as kubelet:
+        server.register_with_kubelet()
+        devs = sorted(kubelet.wait_for_devices(server.resource_name, 4))
+        assert devs == ["tpu-0", "tpu-1", "tpu-2", "tpu-3"]
+
+        # without an intent the heuristic picks its own adjacency-greedy
+        # pair; with the plan in place the answer is exactly the plan
+        baseline = kubelet.preferred(server.resource_name, devs, 2)
+        server.intents.put("default/p0", ["tpu-1", "tpu-3"])
+        steered = kubelet.preferred(server.resource_name, devs, 2)
+        assert sorted(steered) == ["tpu-1", "tpu-3"]
+        assert sorted(steered) != sorted(baseline) or baseline == steered
+
+        # a plan that the available pool cannot satisfy is ignored
+        server.intents.sync({"default/p1": ["tpu-0", "tpu-9"]})
+        fallback = kubelet.preferred(server.resource_name, devs, 2)
+        assert sorted(fallback) == sorted(baseline)
+
+
+def test_intent_watcher_feeds_pod_allocs(tmp_path):
+    """AllocIntentWatcher: pods bound to this node with alloc annotations
+    become intents; pods that vanish drop out on the next poll."""
+    from tpukube.core.types import AllocResult, TopologyCoord
+    from tpukube.device import TpuDeviceManager
+    from tpukube.plugin import DevicePluginServer
+
+    cfg = _node_cfg(tmp_path, dims="2,2,1")
+    api = apisrv.FakeApiServer()
+    alloc = AllocResult(
+        pod_key="default/w0", node_name="host-0-0-0",
+        device_ids=["tpu-0", "tpu-2"],
+        coords=[TopologyCoord(0, 0, 0), TopologyCoord(0, 1, 0)],
+    )
+    api.upsert_pod({
+        "metadata": {"name": "w0", "namespace": "default", "annotations": {
+            codec.ANNO_ALLOC: codec.encode_alloc(alloc),
+        }},
+        "spec": {"nodeName": "host-0-0-0"},
+    })
+    api.upsert_pod({  # other node: not ours
+        "metadata": {"name": "w1", "namespace": "default", "annotations": {}},
+        "spec": {"nodeName": "host-1-0-0"},
+    })
+    with TpuDeviceManager(cfg, host="host-0-0-0") as device:
+        server = DevicePluginServer(cfg, device)
+        watch = apisrv.AllocIntentWatcher(
+            api, "host-0-0-0", server, poll_seconds=999
+        )
+        assert watch.check_once() is True
+        assert server.intents.snapshot() == {
+            "default/w0": ["tpu-0", "tpu-2"]
+        }
+        assert watch.check_once() is False  # no change
+        api.delete_pod("default", "w0")
+        assert watch.check_once() is True
+        assert server.intents.snapshot() == {}
+
+
+# -- the divergence loop -----------------------------------------------------
+
+def test_kubelet_divergence_reconciles_extender_ledger(tmp_path):
+    """The full extender<->kubelet device-id loop, divergent case: the
+    extender plans chips at bind; the kubelet allocates DIFFERENT ids; the
+    node agent reports the actual ids through the pod annotation; the
+    reconcile loop folds reality into the ledger, so follow-up scheduling
+    and release account the chips the container really holds."""
+    from tpukube.device import TpuDeviceManager
+    from tpukube.plugin import DevicePluginServer, FakeKubelet
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as cluster:
+        pod = cluster.make_pod("train-0", tpu=2)
+        node, alloc = cluster.schedule(pod)
+        planned = sorted(alloc.device_ids)
+
+        api = apisrv.FakeApiServer()
+        api.upsert_pod(pod)  # the scheduler's bind annotated + noded it
+
+        # node agent stack for the bound node, intents fed from the pod
+        ncfg = _node_cfg(
+            tmp_path,
+            extra={"TPUKUBE_SIM_HOST_ORIGIN": ",".join(
+                str(v) for v in min(
+                    c.coord for c in cluster.nodes[node].chips
+                )
+            )},
+        )
+        with TpuDeviceManager(ncfg, host=node) as device, \
+                DevicePluginServer(ncfg, device) as server, \
+                FakeKubelet(str(tmp_path)) as kubelet:
+            server.register_with_kubelet()
+            server.set_alloc_reporter(apisrv.alloc_divergence_reporter(api))
+            kubelet.wait_for_devices(server.resource_name, 4)
+            watch = apisrv.AllocIntentWatcher(
+                api, node, server, poll_seconds=999
+            )
+            assert watch.check_once() is True
+
+            # the kubelet ignores preference and allocates the OTHER chips
+            all_ids = ["tpu-0", "tpu-1", "tpu-2", "tpu-3"]
+            actual = [d for d in all_ids if d not in planned][:2]
+            assert sorted(actual) != planned
+            kubelet.allocate(server.resource_name, actual)
+
+        # report landed on the pod
+        pod_key = f"default/train-0"
+        [stored] = [
+            p for p in api.list_pods(node)
+            if p["metadata"]["name"] == "train-0"
+        ]
+        assert apisrv.ANNO_ALLOC_ACTUAL in stored["metadata"]["annotations"]
+
+        # extender folds it in
+        loop = apisrv.AllocReconcileLoop(
+            cluster.extender, api, poll_seconds=999
+        )
+        assert loop.check_once() is True
+        ledger = cluster.extender.state.allocation(pod_key)
+        assert sorted(ledger.device_ids) == sorted(actual)
+        # the pod's alloc annotation now tells the truth; report cleared
+        annos = stored["metadata"]["annotations"]
+        assert apisrv.ANNO_ALLOC_ACTUAL not in annos
+        assert sorted(
+            codec.decode_alloc(annos[codec.ANNO_ALLOC]).device_ids
+        ) == sorted(actual)
+        assert loop.check_once() is False  # idempotent
+
+        # accounting follows reality: the planned chips are free again,
+        # the actual chips are held — a 2-chip pod fits on this node and
+        # must land on the planned (now-free) ids
+        pod2 = cluster.make_pod("train-1", tpu=2)
+        node2, alloc2 = cluster.schedule(pod2)
+        if node2 == node:
+            assert sorted(alloc2.device_ids) == planned
+
+
+def test_consumed_intent_never_reenters_and_ambiguity_refused():
+    """Attribution safety: a consumed intent must not re-enter from the
+    watcher's lifetime-annotation polls, and a divergent Allocate matching
+    several same-size intents is never guessed."""
+    from tpukube.plugin.server import AllocIntentCache
+
+    c = AllocIntentCache()
+    assert c.sync({"default/a": ["tpu-0", "tpu-1"]}) is True
+    key, planned, diverged = c.consume(["tpu-1", "tpu-0"])
+    assert (key, diverged) == ("default/a", False)
+    # the pod keeps its alloc annotation for life; re-delivery is a no-op
+    assert c.sync({"default/a": ["tpu-0", "tpu-1"]}) is False
+    assert c.snapshot() == {}
+    # pod deleted -> satisfied record forgotten -> a NEW pod with the same
+    # key becomes a fresh intent
+    assert c.sync({}) is False
+    assert c.sync({"default/a": ["tpu-2", "tpu-3"]}) is True
+
+    c2 = AllocIntentCache()
+    c2.sync({"default/a": ["tpu-0", "tpu-1"], "default/b": ["tpu-2", "tpu-3"]})
+    key, planned, diverged = c2.consume(["tpu-0", "tpu-3"])
+    assert (key, planned, diverged) == (None, None, False)
+    assert len(c2.snapshot()) == 2  # nothing consumed on ambiguity
+
+
+def test_reconcile_refuses_chips_held_by_another_pod():
+    """A stale/misattributed alloc-actual report naming another pod's chips
+    must not touch the ledger (defense against attribution guesses)."""
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as cluster:
+        _, a0 = cluster.schedule(cluster.make_pod("p0", tpu=1))
+        _, a1 = cluster.schedule(cluster.make_pod("p1", tpu=1))
+        ext = cluster.extender
+        out = ext.handle("reconcile", {
+            "pod_key": "default/p0", "devices": list(a1.device_ids),
+        })
+        assert out == {"changed": False}
+        ledger = ext.state.allocation("default/p0")
+        assert sorted(ledger.device_ids) == sorted(a0.device_ids)
+
+
+def test_pending_preemption_box_clashes_for_other_gangs():
+    """A reservation awaiting deferred evictions still excludes its chips
+    from every OTHER gang's exact-reserve path — only the declared victim
+    gangs are exempt from the clash check."""
+    from tpukube.core.types import (
+        RESOURCE_TPU, ContainerInfo, PodGroup, PodInfo, ResourceList,
+    )
+    from tpukube.sched.gang import GangError
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as cluster:
+        for i in range(16):
+            cluster.schedule(cluster.make_pod(f"s-{i}", tpu=1, priority=5))
+        ext = cluster.extender
+        vip = PodInfo(
+            name="vip-0", namespace="default", priority=100,
+            group=PodGroup("vip", min_member=4),
+            containers=[ContainerInfo("main", ResourceList({RESOURCE_TPU: 1}))],
+        )
+        ext.filter(vip, cluster.node_objects())
+        res = ext.gang.reservation("default", "vip")
+        assert res is not None and res.pending_victims
+        coords = sorted(res.coords)
+
+        rival = PodInfo(
+            name="r-0", namespace="default", priority=100,
+            group=PodGroup("rival", min_member=4),
+            containers=[ContainerInfo("main", ResourceList({RESOURCE_TPU: 1}))],
+        )
+        with pytest.raises(GangError, match="re-occupied"):
+            ext.gang.reserve_exact(rival, 1, coords, slice_id=res.slice_id)
+
+
+def test_reconcile_updates_gang_assignment(tmp_path):
+    """A gang member whose kubelet allocation diverged must have its gang
+    bookkeeping follow: releasing the member afterwards frees the ACTUAL
+    coords, not the planned ones."""
+    from tpukube.core.types import PodGroup
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as cluster:
+        group = PodGroup("g", min_member=2)
+        allocs = {}
+        for i in range(2):
+            _, a = cluster.schedule(
+                cluster.make_pod(f"g-{i}", tpu=1, group=group)
+            )
+            allocs[f"default/g-{i}"] = a
+        ext = cluster.extender
+        res = ext.gang.reservation("default", "g")
+        assert res is not None and res.committed
+
+        # swap one member onto its node's other free chip (if any): find a
+        # node-local id not used by anyone
+        victim_key = "default/g-0"
+        victim = allocs[victim_key]
+        view = ext.state.node(victim.node_name)
+        free = [
+            c for c in view.info.chips
+            if f"tpu-{c.index}" not in view.used_ids
+        ]
+        if not free:
+            pytest.skip("gang packed its node full; no divergent chip")
+        actual_id = f"tpu-{free[0].index}"
+        out = ext.handle("reconcile", {
+            "pod_key": victim_key, "devices": [actual_id],
+        })
+        assert out == {"changed": True}
+        sid, coords = res.assigned[victim_key]
+        assert coords == [free[0].coord]
+        # the reservation's chip pool moved with the member: the abandoned
+        # planned coord is ledger-free and must NOT linger as
+        # reserved-but-unassigned (capacity leak), and assignable() must
+        # not re-open for overflow replicas
+        assert victim.coords[0] not in res.slice_coords[sid]
+        assert free[0].coord in res.slice_coords[sid]
+        assert victim.coords[0] not in ext.gang.reserved_coords(sid)
+        assert not ext.gang.assignable(res, 1)
+        # release frees the actual chip, not the planned one
+        ext.release(victim_key)
+        view2 = ext.state.node(victim.node_name)
+        assert actual_id not in view2.used_ids

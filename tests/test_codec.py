@@ -144,3 +144,31 @@ def test_node_topology_rejects_malformed_bad_links():
     obj["badLinks"] = "nope"
     with pytest.raises(codec.CodecError, match="badLinks"):
         codec.decode_node_topology(json.dumps(obj))
+
+
+def test_node_topology_rejects_out_of_mesh_or_nonadjacent_bad_links():
+    """A stale annotation with an arbitrary coord pair must not flow into
+    link-containment checks, where it would silently veto placements."""
+    import json
+    node, mesh = _node()
+    obj = json.loads(codec.encode_node_topology(node, mesh))
+    obj["badLinks"] = [[[0, 0, 0], [9, 0, 0]]]  # endpoint outside 4x4x1
+    with pytest.raises(codec.CodecError, match="outside mesh"):
+        codec.decode_node_topology(json.dumps(obj))
+    obj["badLinks"] = [[[0, 0, 0], [2, 0, 0]]]  # in-mesh but not adjacent
+    with pytest.raises(codec.CodecError, match="not ICI-adjacent"):
+        codec.decode_node_topology(json.dumps(obj))
+    obj["badLinks"] = [[[0, 0, 0], [0, 0, 0]]]  # degenerate self-link
+    with pytest.raises(codec.CodecError, match="not ICI-adjacent"):
+        codec.decode_node_topology(json.dumps(obj))
+
+
+def test_node_topology_accepts_torus_wrap_bad_links():
+    """On a torus axis, (0,y,z)<->(X-1,y,z) IS an ICI link and a fault on
+    it must decode (the adjacency check is torus-aware)."""
+    node, _ = _node()
+    mesh = MeshSpec(dims=(4, 4, 1), host_block=(2, 2, 1),
+                    torus=(True, False, False))
+    node.bad_links = [(TopologyCoord(0, 0, 0), TopologyCoord(3, 0, 0))]
+    node2, _ = codec.decode_node_topology(codec.encode_node_topology(node, mesh))
+    assert node2.bad_links == [(TopologyCoord(0, 0, 0), TopologyCoord(3, 0, 0))]

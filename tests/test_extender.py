@@ -254,6 +254,13 @@ def test_restart_rebuild_preserves_gang_granularity(cluster):
     )
     feasible, _ = fresh.filter(vip_pod, cluster.node_objects())
     assert feasible, "vip gang found no feasible nodes after preemption"
+    # two-phase preemption: planning at filter evicts NOBODY
+    assert all(
+        fresh.state.allocation(f"default/solo-{i}") is not None
+        for i in range(8)
+    ), "filter must only plan; victims keep chips until first bind"
+    # the first member's bind executes the plan
+    fresh.bind("vip-0", "default", "", feasible[0]["metadata"]["name"])
     low_alive = [
         i for i in range(8)
         if fresh.state.allocation(f"default/lo-{i}") is not None
@@ -266,6 +273,106 @@ def test_restart_rebuild_preserves_gang_granularity(cluster):
         if fresh.state.allocation(f"default/solo-{i}") is None
     ]
     assert len(evicted_solos) == 4
+
+
+def _vip_gang_pod(name: str, min_member: int = 4):
+    from tpukube.core.types import (
+        RESOURCE_TPU, ContainerInfo, PodGroup, PodInfo, ResourceList,
+    )
+
+    return PodInfo(
+        name=name, namespace="default", priority=100,
+        group=PodGroup("vip", min_member=min_member),
+        containers=[ContainerInfo("main", ResourceList({RESOURCE_TPU: 1}))],
+    )
+
+
+def test_unbound_preempting_gang_never_evicts(cluster):
+    """Two-phase preemption, phase one only: a gang that filters (plans
+    victims) but NEVER binds must cost no pod its chips — the TTL sweep
+    drops the reservation and the victims keep running."""
+    import time as _time
+
+    for i in range(16):
+        cluster.schedule(cluster.make_pod(f"s-{i}", tpu=1, priority=5))
+    ext = cluster.extender
+    feasible, _ = ext.filter(_vip_gang_pod("vip-0"), cluster.node_objects())
+    assert feasible, "preemption plan should open feasible nodes"
+    res = ext.gang.reservation("default", "vip")
+    assert res is not None and res.pending_victims
+    assert ext.preemptions == 0
+    assert not ext.pending_evictions
+    assert all(
+        ext.state.allocation(f"default/s-{i}") is not None for i in range(16)
+    ), "filter must only plan, not evict"
+
+    ttl = cluster.config.reservation_ttl_seconds
+    rolled = ext.gang.sweep(now=_time.monotonic() + ttl + 1)
+    assert ("default", "vip") in rolled
+    assert ext.gang.reservation("default", "vip") is None
+    assert all(
+        ext.state.allocation(f"default/s-{i}") is not None for i in range(16)
+    ), "TTL rollback of an unbound preemptor must leave victims running"
+    assert not ext.pending_evictions
+    assert ext.preemptions == 0
+
+
+def test_preemption_executes_once_at_first_bind(cluster):
+    """Phase two: the FIRST member bind executes the eviction plan; later
+    member binds must not evict again."""
+    for i in range(16):
+        cluster.schedule(cluster.make_pod(f"s-{i}", tpu=1, priority=5))
+    ext = cluster.extender
+    feasible, _ = ext.filter(_vip_gang_pod("vip-0"), cluster.node_objects())
+    ext.bind("vip-0", "default", "", feasible[0]["metadata"]["name"])
+    assert ext.preemptions == 4
+    evicted = [
+        i for i in range(16)
+        if ext.state.allocation(f"default/s-{i}") is None
+    ]
+    assert len(evicted) == 4
+    assert len(ext.pending_evictions) == 4
+
+    feasible2, _ = ext.filter(_vip_gang_pod("vip-1"), cluster.node_objects())
+    assert feasible2
+    ext.bind("vip-1", "default", "", feasible2[0]["metadata"]["name"])
+    assert ext.preemptions == 4, "second bind must not re-execute the plan"
+    assert len(ext.pending_evictions) == 4
+
+
+def test_failing_first_bind_leaves_victims_untouched(cluster):
+    """Phase two is guarded: a first bind that cannot commit (a planned
+    chip went unhealthy between filter and bind) must NOT execute the
+    eviction plan — victims keep their chips, the plan stays pending."""
+    from tpukube.sched.extender import ExtenderError
+
+    for i in range(16):
+        cluster.schedule(cluster.make_pod(f"s-{i}", tpu=1, priority=5))
+    ext = cluster.extender
+    feasible, _ = ext.filter(_vip_gang_pod("vip-0"), cluster.node_objects())
+    res = ext.gang.reservation("default", "vip")
+    assert res is not None and res.pending_victims
+    target = feasible[0]["metadata"]["name"]
+
+    # a reserved chip on the bind target dies AFTER the filter; refresh
+    # the extender's node views without a gang sweep (upsert, not filter)
+    view = ext.state.node(target)
+    sick = next(c for c in view.info.chips if c.coord in res.coords)
+    cluster.inject_fault(target, sick.index)
+    for obj in cluster.node_objects():
+        ext.state.upsert_node(
+            obj["metadata"]["name"], obj["metadata"]["annotations"]
+        )
+
+    with pytest.raises(ExtenderError, match="unhealthy"):
+        ext.bind("vip-0", "default", "", target)
+    # no eviction happened and the plan is still pending
+    assert ext.preemptions == 0
+    assert not ext.pending_evictions
+    assert all(
+        ext.state.allocation(f"default/s-{i}") is not None for i in range(16)
+    ), "a failed first bind must not cost victims their chips"
+    assert res.pending_victims
 
 
 def test_restart_rebuild_mid_assembly_gang(cluster):

@@ -208,3 +208,49 @@ def test_reregistration_retries_after_failed_register(tmp_path):
             kubelet.stop()
         finally:
             server.stop()
+
+
+def test_socket_wipe_with_failed_register_is_retried(tmp_path):
+    """Socket vanished but the kubelet identity is UNCHANGED: if the
+    rebind's Register fails, the next poll sees socket-present +
+    identity-equal — only separately-tracked registration state makes it
+    retry instead of leaving the plugin silently unregistered."""
+    import os
+
+    from tpukube.plugin import KubeletSessionWatcher
+
+    cfg = load_config(env={
+        "TPUKUBE_DEVICE_PLUGIN_DIR": str(tmp_path),
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with TpuDeviceManager(cfg, host="host-0-0-0") as device:
+        server = DevicePluginServer(cfg, device)
+        server.start()
+        try:
+            kubelet = FakeKubelet(str(tmp_path))
+            kubelet.start()
+            server.register_with_kubelet()
+            watch = KubeletSessionWatcher(server, poll_seconds=999)
+            assert watch.check_once() is False  # steady state
+
+            os.unlink(server.socket_path)  # wipe; same kubelet stays up
+            real_register = server.register_with_kubelet
+
+            def failing_register(*a, **k):
+                raise RuntimeError("registration refused")
+
+            server.register_with_kubelet = failing_register
+            with pytest.raises(RuntimeError):
+                watch.check_once()
+            assert os.path.exists(server.socket_path)  # rebind DID happen
+            assert watch.reregistrations == 0
+
+            server.register_with_kubelet = real_register
+            # socket present, identity unchanged — must still retry
+            assert watch.check_once() is True
+            assert watch.reregistrations == 1
+            kubelet.wait_for_devices(server.resource_name, 4)
+            kubelet.stop()
+        finally:
+            server.stop()

@@ -119,6 +119,53 @@ def test_trace_endpoint_incremental(traced_cluster):
     ]
 
 
+def test_threaded_capture_replays_clean():
+    """Mutation + trace record are ONE atomic step: a trace captured while
+    schedules (webhook loop) and releases (a different thread) interleave
+    must still replay with zero divergences — trace order is application
+    order, not just webhook-stream order."""
+    import threading
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        for i in range(8):
+            c.schedule(c.make_pod(f"seed-{i}", tpu=1))
+
+        errs: list[BaseException] = []
+
+        def run(fn):
+            try:
+                fn()
+            except BaseException as e:  # surfaced after join
+                errs.append(e)
+
+        def churn_release():
+            for i in range(8):
+                c.delete_pod(f"seed-{i}")
+
+        def churn_schedule():
+            # 8 seeds + 8 late = 16 chips: fits even if no release lands
+            for i in range(8):
+                c.schedule(c.make_pod(f"late-{i}", tpu=1))
+
+        threads = [
+            threading.Thread(target=run, args=(churn_release,)),
+            threading.Thread(target=run, args=(churn_schedule,)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errs, errs[0]
+        events = c.extender.trace.events()
+        assert [e["kind"] for e in events].count("release") == 8
+        divergences = trace_mod.replay(events, config=cfg)
+        assert divergences == []
+
+
 def test_trace_ring_bounded():
     t = trace_mod.DecisionTrace(capacity=4)
     for i in range(10):

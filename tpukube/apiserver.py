@@ -1,0 +1,436 @@
+"""Apiserver channel — node annotations up, pod alloc intents down.
+
+The reference's node agent writes its NodeInfo into a Node annotation
+through the Kubernetes apiserver, and the extender's alloc results ride Pod
+annotations back to the node (SURVEY.md §2 C8, §4.1-§4.3). This environment
+has no cluster and no kubernetes client package, so the channel is a small
+pluggable interface with two implementations:
+
+  * :class:`FakeApiServer`  — in-memory, thread-safe; the sim's apiserver.
+  * :class:`RestApiServer`  — real GET/PATCH against the Kubernetes REST
+    API using the in-cluster serviceaccount token over urllib (merge-patch;
+    the heavyweight kubernetes client package is deliberately NOT a
+    dependency of this framework).
+
+On top of the interface sit the two loops that close SURVEY §4's open ends:
+
+  * :class:`NodeAnnotationSyncer` — tails the plugin's ``--annotation-out``
+    file and PATCHes it onto the Node (the reference's "write NodeInfo
+    annotation to apiserver" step, §4.1). Runs as the DaemonSet's syncer
+    sidecar.
+  * :class:`AllocIntentWatcher` — feeds bound pods' planned alloc
+    annotations to the device plugin, so ``GetPreferredAllocation`` steers
+    the kubelet onto exactly the chips the extender planned; when the
+    kubelet allocates something else anyway, the plugin's divergence
+    reporter (:func:`alloc_divergence_reporter`) writes the ACTUAL ids back
+    onto the pod, and :class:`AllocReconcileLoop` folds them into the
+    extender's ledger — truth flows both ways, so the gang's contiguity
+    score and the container's real chips can never silently diverge.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import ssl
+import threading
+import urllib.error
+import urllib.request
+from typing import Any, Callable, Optional
+
+from tpukube.core import codec
+
+log = logging.getLogger("tpukube.apiserver")
+
+# The node agent's report of what the kubelet ACTUALLY allocated, when it
+# diverged from the planned ``tpu.qiniu.com/alloc`` annotation. Cleared by
+# the extender's reconcile loop once folded into the ledger.
+ANNO_ALLOC_ACTUAL = codec.ANNO_PREFIX + "alloc-actual"
+
+# In-cluster serviceaccount defaults (mounted into every pod by kubelet).
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class ApiServerError(RuntimeError):
+    pass
+
+
+def encode_alloc_actual(device_ids: list[str]) -> str:
+    return json.dumps({"v": 1, "devices": sorted(device_ids)},
+                      separators=(",", ":"))
+
+
+def decode_alloc_actual(payload: str) -> list[str]:
+    try:
+        obj = json.loads(payload)
+        if obj.get("v") != 1:
+            raise ValueError(f"unsupported version {obj.get('v')!r}")
+        return [str(d) for d in obj["devices"]]
+    except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
+        raise codec.CodecError(f"alloc-actual: {e}") from e
+
+
+class FakeApiServer:
+    """In-memory apiserver: Node/Pod metadata only, which is all this
+    framework reads or writes. Thread-safe; the sim's source of truth."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._nodes: dict[str, dict[str, str]] = {}
+        self._pods: dict[str, dict[str, Any]] = {}
+        self.patch_log: list[tuple[str, str]] = []  # (kind, name) for tests
+
+    # -- nodes -------------------------------------------------------------
+    def patch_node_annotations(
+        self, name: str, annotations: dict[str, str]
+    ) -> None:
+        with self._lock:
+            self._nodes.setdefault(name, {}).update(annotations)
+            self.patch_log.append(("node", name))
+
+    def get_node_annotations(self, name: str) -> dict[str, str]:
+        with self._lock:
+            return dict(self._nodes.get(name, {}))
+
+    def node_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._nodes)
+
+    def node_objects(self) -> list[dict[str, Any]]:
+        """Node list in the webhook wire shape (the sim's kube-scheduler
+        builds ExtenderArgs from this)."""
+        with self._lock:
+            return [
+                {"metadata": {"name": n, "annotations": dict(a)}}
+                for n, a in sorted(self._nodes.items())
+            ]
+
+    # -- pods --------------------------------------------------------------
+    def upsert_pod(self, pod: dict[str, Any]) -> None:
+        meta = pod["metadata"]
+        key = f"{meta.get('namespace', 'default')}/{meta['name']}"
+        with self._lock:
+            self._pods[key] = pod
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        with self._lock:
+            self._pods.pop(f"{namespace}/{name}", None)
+
+    def patch_pod_annotations(
+        self, namespace: str, name: str, annotations: dict[str, Optional[str]]
+    ) -> None:
+        """Merge-patch semantics: a None value deletes the key (exactly how
+        a JSON merge-patch null behaves on the real apiserver)."""
+        key = f"{namespace}/{name}"
+        with self._lock:
+            pod = self._pods.get(key)
+            if pod is None:
+                raise ApiServerError(f"pod {key} not found")
+            annos = pod["metadata"].setdefault("annotations", {})
+            for k, v in annotations.items():
+                if v is None:
+                    annos.pop(k, None)
+                else:
+                    annos[k] = v
+            self.patch_log.append(("pod", key))
+
+    def list_pods(self, node_name: Optional[str] = None) -> list[dict[str, Any]]:
+        with self._lock:
+            out = []
+            for pod in self._pods.values():
+                if (node_name is None
+                        or pod.get("spec", {}).get("nodeName") == node_name):
+                    out.append(pod)
+            return out
+
+
+class RestApiServer:
+    """The same surface over the Kubernetes REST API, with no client
+    library: merge-patches and field-selector GETs via urllib, the
+    in-cluster serviceaccount token, and the cluster CA.
+
+    Built for the DaemonSet sidecar (NodeAnnotationSyncer) and the node
+    agent (AllocIntentWatcher); exercised in tests against a local HTTP
+    stand-in since no cluster exists in this environment.
+    """
+
+    def __init__(
+        self,
+        base_url: Optional[str] = None,
+        token: Optional[str] = None,
+        token_path: Optional[str] = None,
+        ca_path: Optional[str] = None,
+        timeout: float = 10.0,
+    ) -> None:
+        if base_url is None:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not host:
+                raise ApiServerError(
+                    "no apiserver URL: pass base_url or run in-cluster"
+                )
+            base_url = f"https://{host}:{port}"
+        self._base = base_url.rstrip("/")
+        if token is None:
+            path = token_path or os.path.join(SA_DIR, "token")
+            if os.path.exists(path):
+                with open(path) as f:
+                    token = f.read().strip()
+        self._token = token
+        self._timeout = timeout
+        if ca_path is None:
+            default_ca = os.path.join(SA_DIR, "ca.crt")
+            ca_path = default_ca if os.path.exists(default_ca) else None
+        if self._base.startswith("https"):
+            self._ssl: Optional[ssl.SSLContext] = ssl.create_default_context(
+                cafile=ca_path
+            )
+        else:
+            self._ssl = None
+
+    def _request(
+        self, method: str, path: str, body: Optional[dict] = None,
+        content_type: str = "application/merge-patch+json",
+    ) -> Any:
+        headers = {"Accept": "application/json"}
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
+        data = None
+        if body is not None:
+            data = json.dumps(body).encode()
+            headers["Content-Type"] = content_type
+        req = urllib.request.Request(
+            self._base + path, data=data, headers=headers, method=method
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self._timeout, context=self._ssl
+            ) as r:
+                payload = r.read()
+        except urllib.error.HTTPError as e:
+            raise ApiServerError(
+                f"{method} {path}: HTTP {e.code} {e.read()[:200]!r}"
+            ) from e
+        except urllib.error.URLError as e:
+            raise ApiServerError(f"{method} {path}: {e.reason}") from e
+        return json.loads(payload) if payload else None
+
+    # -- interface ---------------------------------------------------------
+    def patch_node_annotations(
+        self, name: str, annotations: dict[str, str]
+    ) -> None:
+        self._request(
+            "PATCH", f"/api/v1/nodes/{name}",
+            {"metadata": {"annotations": annotations}},
+        )
+
+    def get_node_annotations(self, name: str) -> dict[str, str]:
+        obj = self._request("GET", f"/api/v1/nodes/{name}")
+        return dict(obj.get("metadata", {}).get("annotations", {}) or {})
+
+    def patch_pod_annotations(
+        self, namespace: str, name: str, annotations: dict[str, Optional[str]]
+    ) -> None:
+        self._request(
+            "PATCH", f"/api/v1/namespaces/{namespace}/pods/{name}",
+            {"metadata": {"annotations": annotations}},
+        )
+
+    def list_pods(self, node_name: Optional[str] = None) -> list[dict[str, Any]]:
+        path = "/api/v1/pods"
+        if node_name is not None:
+            path += f"?fieldSelector=spec.nodeName%3D{node_name}"
+        obj = self._request("GET", path)
+        return list(obj.get("items", []) or [])
+
+
+class _PollLoop:
+    """start/stop/check_once scaffolding shared by the sync loops (the same
+    deterministic-step pattern as HealthWatcher/KubeletSessionWatcher)."""
+
+    def __init__(self, poll_seconds: float, name: str) -> None:
+        self._poll = poll_seconds
+        self._name = name
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def check_once(self) -> bool:  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    def start(self) -> None:
+        if self._thread is not None:
+            raise RuntimeError(f"{self._name} already started")
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=self._name
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll):
+            try:
+                self.check_once()
+            except Exception:
+                log.exception("%s poll failed", self._name)
+
+
+class NodeAnnotationSyncer(_PollLoop):
+    """Applies the plugin's node-annotation file to the Node object.
+
+    ``tpukube-plugin --annotation-out FILE`` writes the node-topology
+    annotation JSON; this loop (the DaemonSet's sidecar, sharing the
+    /var/run/tpukube mount) PATCHes it through the apiserver whenever the
+    content changes — including health-fault re-annotations, which is how
+    the extender learns about dead chips on a real cluster."""
+
+    def __init__(
+        self, api, node_name: str, path: str, poll_seconds: float = 5.0
+    ) -> None:
+        super().__init__(poll_seconds, "tpukube-annotation-sync")
+        self._api = api
+        self._node = node_name
+        self._path = path
+        self._last_applied: Optional[str] = None
+        self.syncs = 0  # applied patches (tests/metrics)
+
+    def check_once(self) -> bool:
+        """One poll; True if a patch was applied."""
+        try:
+            with open(self._path) as f:
+                raw = f.read().strip()
+        except OSError:
+            return False  # agent not up yet
+        if not raw or raw == self._last_applied:
+            return False
+        try:
+            annotations = json.loads(raw)
+        except json.JSONDecodeError as e:
+            log.warning("annotation file %s unparsable: %s", self._path, e)
+            return False
+        if not isinstance(annotations, dict):
+            log.warning("annotation file %s: not a JSON object", self._path)
+            return False
+        self._api.patch_node_annotations(self._node, annotations)
+        # commit only after the PATCH succeeded, so a failed apply retries
+        self._last_applied = raw
+        self.syncs += 1
+        log.info("synced node annotation for %s (%d bytes)",
+                 self._node, len(raw))
+        return True
+
+
+class AllocIntentWatcher(_PollLoop):
+    """Feeds the extender's planned allocations to the device plugin.
+
+    Polls pods bound to this node; every ``tpu.qiniu.com/alloc`` annotation
+    becomes an intent in the plugin's :class:`~tpukube.plugin.server.
+    AllocIntentCache`, which GetPreferredAllocation serves back to the
+    kubelet — closing the loop the reference closes with its annotation
+    channel (SURVEY §4.3): the kubelet's id choice converges on the chips
+    the gang's contiguity score was computed for."""
+
+    def __init__(
+        self, api, node_name: str, server, poll_seconds: float = 5.0
+    ) -> None:
+        super().__init__(poll_seconds, "tpukube-alloc-intents")
+        self._api = api
+        self._node = node_name
+        self._server = server
+
+    def check_once(self) -> bool:
+        """One poll; True if the intent set changed."""
+        intents: dict[str, list[str]] = {}
+        for pod in self._api.list_pods(self._node):
+            meta = pod.get("metadata", {})
+            payload = (meta.get("annotations") or {}).get(codec.ANNO_ALLOC)
+            if not payload:
+                continue
+            try:
+                alloc = codec.decode_alloc(payload)
+            except codec.CodecError as e:
+                log.warning("pod %s: bad alloc annotation: %s",
+                            meta.get("name"), e)
+                continue
+            intents[alloc.pod_key] = list(alloc.device_ids)
+        return self._server.intents.sync(intents)
+
+
+def alloc_divergence_reporter(api) -> Callable[[str, list[str], list[str]], None]:
+    """The plugin's report channel for kubelet-side id divergence: write
+    the ACTUAL allocated ids onto the pod for the extender's reconcile
+    loop. Used as ``server.set_alloc_reporter(alloc_divergence_reporter(api))``."""
+
+    def report(pod_key: str, planned: list[str], actual: list[str]) -> None:
+        namespace, name = pod_key.split("/", 1)
+        try:
+            api.patch_pod_annotations(
+                namespace, name,
+                {ANNO_ALLOC_ACTUAL: encode_alloc_actual(actual)},
+            )
+            log.warning(
+                "reported alloc divergence for %s: kubelet chose %s, "
+                "plan was %s", pod_key, sorted(actual), sorted(planned),
+            )
+        except ApiServerError as e:
+            log.error("divergence report for %s failed: %s", pod_key, e)
+
+    return report
+
+
+class AllocReconcileLoop(_PollLoop):
+    """Extender-side half of the device-id loop: folds reported
+    ``alloc-actual`` annotations into the ledger (via the extender's
+    recorded ``reconcile`` decision) and rewrites the pod's ``alloc``
+    annotation to match reality, clearing the report."""
+
+    def __init__(
+        self, extender, api, poll_seconds: float = 5.0
+    ) -> None:
+        super().__init__(poll_seconds, "tpukube-alloc-reconcile")
+        self._extender = extender
+        self._api = api
+        self.reconciled = 0  # ledger amendments applied (tests/metrics)
+
+    def check_once(self) -> bool:
+        """One poll; True if any pod was reconciled. Divergence reports are
+        rare, so the poll is one unpaginated pod list per interval (the
+        apiserver cannot field-select on annotations); raise poll_seconds
+        on very large clusters. A failing pod never blocks the batch."""
+        did = False
+        for pod in self._api.list_pods():
+            meta = pod.get("metadata", {})
+            annos = meta.get("annotations") or {}
+            payload = annos.get(ANNO_ALLOC_ACTUAL)
+            if not payload:
+                continue
+            namespace = meta.get("namespace", "default")
+            name = meta["name"]
+            pod_key = f"{namespace}/{name}"
+            try:
+                actual = decode_alloc_actual(payload)
+            except codec.CodecError as e:
+                log.warning("pod %s: bad alloc-actual: %s", pod_key, e)
+                continue
+            self._extender.handle(
+                "reconcile", {"pod_key": pod_key, "devices": actual}
+            )
+            patch: dict[str, Optional[str]] = {ANNO_ALLOC_ACTUAL: None}
+            alloc = self._extender.state.allocation(pod_key)
+            if alloc is not None:
+                patch[codec.ANNO_ALLOC] = codec.encode_alloc(alloc)
+            try:
+                self._api.patch_pod_annotations(namespace, name, patch)
+            except ApiServerError as e:
+                # pod deleted mid-poll, transient apiserver error: the
+                # reconcile above is idempotent, the patch retries next poll
+                log.warning("reconcile ack for %s failed: %s", pod_key, e)
+                continue
+            self.reconciled += 1
+            did = True
+        return did

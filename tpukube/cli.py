@@ -52,6 +52,37 @@ def _setup(args: argparse.Namespace) -> TpuKubeConfig:
     return load_config(yaml_path=args.config)
 
 
+def _add_kube_api_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--kube-api", metavar="URL", default=None,
+                   help="Kubernetes apiserver base URL (default: in-cluster "
+                        "KUBERNETES_SERVICE_HOST autodetect; 'off' disables "
+                        "the apiserver channel)")
+    p.add_argument("--kube-token-file", default=None,
+                   help="bearer token file (default: serviceaccount token)")
+    p.add_argument("--kube-ca-file", default=None,
+                   help="apiserver CA bundle (default: serviceaccount ca.crt)")
+
+
+def _make_apiserver(args: argparse.Namespace):
+    """RestApiServer from flags / in-cluster env, or None when no
+    apiserver is reachable-by-configuration (sim/dev runs)."""
+    if args.kube_api == "off":
+        return None
+    from tpukube.apiserver import ApiServerError, RestApiServer
+
+    try:
+        return RestApiServer(
+            base_url=args.kube_api,
+            token_path=args.kube_token_file,
+            ca_path=args.kube_ca_file,
+        )
+    except ApiServerError as e:
+        if args.kube_api:  # explicitly requested: configuration error
+            raise
+        log.info("no apiserver channel (%s); running standalone", e)
+        return None
+
+
 def _install_stop_handlers() -> threading.Event:
     """Install SIGINT/SIGTERM handlers NOW (before any serving starts, so a
     supervisor's early TERM still shuts down cleanly); returns the event the
@@ -74,10 +105,13 @@ def main_plugin(argv: Optional[list[str]] = None) -> int:
                    help="serve /metrics on this port (0 = ephemeral)")
     p.add_argument("--annotation-out", metavar="FILE", default="-",
                    help="write the node-topology annotation JSON here "
-                        "('-' = stdout); an apiserver syncer applies it")
+                        "('-' = stdout); tpukube-syncer applies it")
+    _add_kube_api_args(p)
     args = p.parse_args(argv)
     cfg = _setup(args)
     stop = _install_stop_handlers()
+
+    import os
 
     from tpukube.core import codec
     from tpukube.device.tpu import TpuDeviceManager
@@ -88,7 +122,21 @@ def main_plugin(argv: Optional[list[str]] = None) -> int:
         KubeletSessionWatcher,
     )
 
-    with TpuDeviceManager(cfg) as device:
+    host = os.environ.get("NODE_NAME")
+    if host and cfg.backend == "sim" and not cfg.sim_host_origin:
+        # the sim backend derives this host's chip-coord origin from the
+        # host-i-j-k naming convention; a free-form cluster node name
+        # needs TPUKUBE_SIM_HOST_ORIGIN — without it, keep the default
+        # host name rather than crash at startup
+        try:
+            cfg.sim_mesh().host_origin(host)
+        except ValueError:
+            log.warning(
+                "NODE_NAME %r is not host-i-j-k and sim_host_origin is "
+                "unset; using the default sim host name", host,
+            )
+            host = None
+    with TpuDeviceManager(cfg, host=host) as device:
         server = DevicePluginServer(cfg, device, socket_path=args.socket)
         server.start()
         watcher = HealthWatcher(device, server)
@@ -111,6 +159,25 @@ def main_plugin(argv: Optional[list[str]] = None) -> int:
             with open(args.annotation_out, "w") as f:
                 f.write(payload + "\n")
 
+        # the extender<->kubelet device-id loop: feed bound pods' planned
+        # allocs into GetPreferredAllocation steering, report divergent
+        # kubelet choices back onto the pod (apiserver channel optional —
+        # the sim drives these objects directly)
+        intent_watch = None
+        api = _make_apiserver(args)
+        if api is not None:
+            from tpukube.apiserver import (
+                AllocIntentWatcher,
+                alloc_divergence_reporter,
+            )
+
+            server.set_alloc_reporter(alloc_divergence_reporter(api))
+            intent_watch = AllocIntentWatcher(
+                api, device.host, server,
+                poll_seconds=cfg.health_poll_seconds,
+            )
+            intent_watch.start()
+
         if kubelet_watch is not None:
             try:
                 server.register_with_kubelet()
@@ -130,11 +197,61 @@ def main_plugin(argv: Optional[list[str]] = None) -> int:
         try:
             stop.wait()
         finally:
+            if intent_watch is not None:
+                intent_watch.stop()
             if kubelet_watch is not None:
                 kubelet_watch.stop()
             watcher.stop()
             metrics.stop()
             server.stop()
+    return 0
+
+
+# -- tpukube-syncer ----------------------------------------------------------
+
+def main_syncer(argv: Optional[list[str]] = None) -> int:
+    """Annotation syncer sidecar: applies the plugin's node-annotation file
+    to the Node object through the apiserver (SURVEY.md §4.1's 'write
+    NodeInfo annotation to apiserver' step — the component the DaemonSet's
+    /var/run/tpukube mount exists for)."""
+    import os
+
+    p = _base_parser(
+        "tpukube-syncer",
+        "apply the node agent's annotation file to the Node via the apiserver",
+    )
+    p.add_argument("--annotation-file", metavar="FILE", required=True,
+                   help="the plugin's --annotation-out file to watch")
+    p.add_argument("--node", default=None,
+                   help="Node object name (default: $NODE_NAME)")
+    p.add_argument("--poll", type=float, default=5.0,
+                   help="file poll interval seconds")
+    p.add_argument("--once", action="store_true",
+                   help="apply once and exit (init-container mode)")
+    _add_kube_api_args(p)
+    args = p.parse_args(argv)
+    _setup(args)
+    node = args.node or os.environ.get("NODE_NAME")
+    if not node:
+        p.error("--node or $NODE_NAME required")
+
+    from tpukube.apiserver import NodeAnnotationSyncer
+
+    api = _make_apiserver(args)
+    if api is None:
+        p.error("no apiserver: pass --kube-api or run in-cluster")
+    syncer = NodeAnnotationSyncer(
+        api, node, args.annotation_file, poll_seconds=args.poll
+    )
+    if args.once:
+        return 0 if syncer.check_once() else 1
+    stop = _install_stop_handlers()
+    syncer.start()
+    log.warning("syncing %s -> node %s", args.annotation_file, node)
+    try:
+        stop.wait()
+    finally:
+        syncer.stop()
     return 0
 
 
@@ -144,6 +261,7 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
     p = _base_parser("tpukube-extender", "scheduler extender HTTP daemon")
     p.add_argument("--host", default=None, help="override extender_host")
     p.add_argument("--port", type=int, default=None, help="override extender_port")
+    _add_kube_api_args(p)
     args = p.parse_args(argv)
     cfg = _setup(args)
 
@@ -154,10 +272,23 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
     host = args.host or cfg.extender_host
     port = args.port if args.port is not None else cfg.extender_port
     extender = Extender(cfg)
+    reconcile = None
+    api = _make_apiserver(args)
+    if api is not None:
+        from tpukube.apiserver import AllocReconcileLoop
+
+        reconcile = AllocReconcileLoop(
+            extender, api, poll_seconds=cfg.health_poll_seconds
+        )
+        reconcile.start()
     log.warning("extender serving on %s:%d (score_mode=%s)",
                 host, port, cfg.score_mode)
-    web.run_app(make_app(extender), host=host, port=port,
-                print=None, handle_signals=True)
+    try:
+        web.run_app(make_app(extender), host=host, port=port,
+                    print=None, handle_signals=True)
+    finally:
+        if reconcile is not None:
+            reconcile.stop()
     return 0
 
 

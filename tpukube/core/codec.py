@@ -129,6 +129,21 @@ def decode_node_topology(payload: str) -> tuple[NodeInfo, MeshSpec]:
         bad_links = [canonical_link(a, b) for a, b in raw_links]
     except (TypeError, ValueError) as e:
         raise CodecError(f"node-topology: malformed badLinks entry: {e}") from e
+    # A stale/buggy annotation carrying an out-of-mesh or non-adjacent pair
+    # would otherwise flow silently into link-containment checks and veto
+    # placements with no diagnostic; the C side (tpuinfo_inject_link_fault)
+    # enforces adjacency, so enforce it here too (torus-aware).
+    for a, b in bad_links:
+        if not (mesh.contains(a) and mesh.contains(b)):
+            raise CodecError(
+                f"node-topology: badLinks endpoint outside mesh "
+                f"{mesh.dims}: {[a.as_list(), b.as_list()]}"
+            )
+        if b not in mesh.neighbors(a):
+            raise CodecError(
+                f"node-topology: badLinks pair not ICI-adjacent: "
+                f"{[a.as_list(), b.as_list()]}"
+            )
     slice_id = obj.get("slice", DEFAULT_SLICE)
     if not isinstance(slice_id, str) or not slice_id:
         raise CodecError(f"node-topology: bad slice id {slice_id!r}")

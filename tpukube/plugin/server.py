@@ -29,6 +29,108 @@ from tpukube.plugin.proto import deviceplugin_pb2 as pb
 log = logging.getLogger("tpukube.plugin")
 
 
+class AllocIntentCache:
+    """Planned device-id sets for pods bound to this node, fed from their
+    ``tpu.qiniu.com/alloc`` annotations (apiserver.AllocIntentWatcher).
+
+    The kubelet — not the extender — decides which advertised ids go into
+    Allocate; these intents are how the extender's plan reaches that
+    decision: GetPreferredAllocation answers with the matching planned set,
+    and Allocate checks the kubelet's actual choice against it, reporting
+    divergence for ledger reconciliation.
+
+    Attribution limits: deviceplugin/v1beta1 carries no pod identity, so
+    matching an Allocate to a pod is inference. A consumed intent is marked
+    satisfied and never re-enters from the watcher's polls while its pod
+    lives (a running pod's lifetime alloc annotation must not masquerade
+    as a fresh plan). A divergent Allocate is attributed ONLY when exactly
+    one unsatisfied same-size intent exists — ambiguity means no report,
+    never a guess (the extender additionally refuses reconcile reports
+    naming chips the ledger shows held by another pod, so a wrong guess
+    after an agent restart cannot corrupt the ledger).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._intents: dict[str, list[str]] = {}  # pod_key -> planned ids
+        self._satisfied: set[str] = set()  # pod_keys whose Allocate happened
+
+    def sync(self, intents: dict[str, list[str]]) -> bool:
+        """Replace the set from a watcher poll (satisfied pods excluded;
+        vanished pods forgotten entirely). True if the live set changed."""
+        with self._lock:
+            fresh = {
+                k: list(v) for k, v in intents.items()
+                if k not in self._satisfied
+            }
+            self._satisfied &= set(intents)
+            if fresh == self._intents:
+                return False
+            self._intents = fresh
+            return True
+
+    def put(self, pod_key: str, device_ids: list[str]) -> None:
+        with self._lock:
+            self._intents[pod_key] = list(device_ids)
+            self._satisfied.discard(pod_key)
+
+    def remove(self, pod_key: str) -> None:
+        with self._lock:
+            self._intents.pop(pod_key, None)
+            self._satisfied.discard(pod_key)
+
+    def snapshot(self) -> dict[str, list[str]]:
+        with self._lock:
+            return {k: list(v) for k, v in self._intents.items()}
+
+    def preferred(
+        self, available: list[str], required: list[str], size: int
+    ) -> Optional[list[str]]:
+        """The planned id set satisfying this preference query, if any:
+        right size, inside the kubelet's available pool, containing every
+        must-include id. Not consumed — the kubelet may ask repeatedly."""
+        avail = set(available)
+        req = set(required)
+        with self._lock:
+            for ids in self._intents.values():
+                if (len(ids) == size and req <= set(ids)
+                        and set(ids) <= avail):
+                    return list(ids)
+        return None
+
+    def consume(
+        self, allocated: list[str]
+    ) -> tuple[Optional[str], Optional[list[str]], bool]:
+        """Match an Allocate against the intents: exact id-set match wins
+        (consumed, no divergence); otherwise a same-size intent is the
+        diverged plan ONLY if it is unambiguous (see class docstring).
+        Returns (pod_key, planned, diverged); (None, None, False) when no
+        intent can safely be attributed."""
+        got = set(allocated)
+        with self._lock:
+            for key, ids in self._intents.items():
+                if set(ids) == got:
+                    del self._intents[key]
+                    self._satisfied.add(key)
+                    return key, ids, False
+            same = [
+                (k, v) for k, v in self._intents.items()
+                if len(v) == len(allocated)
+            ]
+            if len(same) == 1:
+                key, ids = same[0]
+                del self._intents[key]
+                self._satisfied.add(key)
+                return key, ids, True
+            if same:
+                log.warning(
+                    "divergent Allocate %s matches %d same-size intents; "
+                    "refusing to guess attribution",
+                    sorted(allocated), len(same),
+                )
+        return None, None, False
+
+
 class DevicePluginServer(stubs.DevicePluginServicer):
     """Serves one extended resource on one unix socket.
 
@@ -48,6 +150,17 @@ class DevicePluginServer(stubs.DevicePluginServicer):
         self._watch_queues: list[queue.SimpleQueue] = []
         self._watch_lock = threading.Lock()
         self._allocations = 0  # served Allocate calls (metrics)
+        # extender-planned device ids for pods bound here (see
+        # AllocIntentCache); fed by apiserver.AllocIntentWatcher
+        self.intents = AllocIntentCache()
+        self._alloc_reporter = None  # divergence callback (apiserver chan)
+
+    def set_alloc_reporter(self, reporter) -> None:
+        """Install the divergence report channel: called as
+        ``reporter(pod_key, planned_ids, actual_ids)`` when the kubelet
+        allocates ids other than the planned intent
+        (apiserver.alloc_divergence_reporter builds one)."""
+        self._alloc_reporter = reporter
 
     # -- lifecycle ---------------------------------------------------------
     @property
@@ -164,14 +277,19 @@ class DevicePluginServer(stubs.DevicePluginServicer):
     def GetPreferredAllocation(self, request, context) -> pb.PreferredAllocationResponse:
         resp = pb.PreferredAllocationResponse()
         for creq in request.container_requests:
-            try:
-                chosen = self._device.preferred_allocation(
-                    list(creq.available_deviceIDs),
-                    list(creq.must_include_deviceIDs),
-                    creq.allocation_size,
-                )
-            except DeviceError as e:
-                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            available = list(creq.available_deviceIDs)
+            required = list(creq.must_include_deviceIDs)
+            size = creq.allocation_size
+            # the extender's planned ids outrank local adjacency: the gang
+            # contiguity score was computed for exactly those chips
+            chosen = self.intents.preferred(available, required, size)
+            if chosen is None:
+                try:
+                    chosen = self._device.preferred_allocation(
+                        available, required, size,
+                    )
+                except DeviceError as e:
+                    context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
             resp.container_responses.append(
                 pb.ContainerPreferredAllocationResponse(deviceIDs=chosen)
             )
@@ -180,11 +298,26 @@ class DevicePluginServer(stubs.DevicePluginServicer):
     def Allocate(self, request, context) -> pb.AllocateResponse:
         resp = pb.AllocateResponse()
         for creq in request.container_requests:
+            ids = list(creq.devicesIDs)
             try:
-                env = self._device.allocate_env(list(creq.devicesIDs))
+                env = self._device.allocate_env(ids)
             except DeviceError as e:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
             resp.container_responses.append(pb.ContainerAllocateResponse(envs=env))
+            pod_key, planned, diverged = self.intents.consume(ids)
+            if diverged and planned is not None and pod_key is not None:
+                log.warning(
+                    "kubelet allocated %s but %s was planned %s — reporting",
+                    sorted(ids), pod_key, sorted(planned),
+                )
+                if self._alloc_reporter is not None:
+                    # off the kubelet's pod-start critical path: the report
+                    # is an apiserver PATCH that may block seconds
+                    threading.Thread(
+                        target=self._alloc_reporter,
+                        args=(pod_key, planned, ids),
+                        daemon=True, name="tpukube-alloc-report",
+                    ).start()
         self._allocations += 1
         log.info("allocated %s", [list(c.devicesIDs) for c in request.container_requests])
         return resp
@@ -274,6 +407,7 @@ class KubeletSessionWatcher:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._kubelet_ident = self._ident()
+        self._needs_register = False
         self.reregistrations = 0  # metrics/tests
 
     def _ident(self) -> Optional[tuple[int, int, int]]:
@@ -291,6 +425,7 @@ class KubeletSessionWatcher:
         DaemonSet pod that boots before kubelet — turning a would-be crash
         loop into convergence at the poll cadence)."""
         self._kubelet_ident = None
+        self._needs_register = True
 
     def start(self) -> None:
         if self._thread is not None:
@@ -316,18 +451,24 @@ class KubeletSessionWatcher:
             return False
         kubelet_restarted = ident != self._kubelet_ident
         socket_gone = not os.path.exists(self._server.socket_path)
-        if not (kubelet_restarted or socket_gone):
+        if not (kubelet_restarted or socket_gone or self._needs_register):
             return False
         if socket_gone:
             log.warning("plugin socket vanished (kubelet restart wipe); rebinding")
             self._server.restart()
         if kubelet_restarted:
             log.warning("kubelet socket identity changed; re-registering")
+        # registration state is tracked separately from kubelet identity:
+        # after a rebind whose Register failed, the next poll sees the
+        # socket present and the identity unchanged — only this flag makes
+        # it retry instead of leaving the plugin silently unregistered
+        self._needs_register = True
         self._server.register_with_kubelet()
         # commit the observed identity only AFTER registration succeeded —
         # a failed Register (new kubelet not serving yet) must leave the
         # restart event pending so the next poll retries
         self._kubelet_ident = ident
+        self._needs_register = False
         self.reregistrations += 1
         return True
 

@@ -95,6 +95,13 @@ class Extender:
         # recover the request: key -> (pod, uid, seen_monotonic).
         self._pending: dict[str, tuple[PodInfo, str, float]] = {}
         self._pending_lock = threading.Lock()
+        # Serializes every decision (mutation + trace record as ONE step):
+        # webhooks run on the aiohttp loop but releases arrive from other
+        # threads (sim pod-lifecycle, watchers); without this lock a trace
+        # captured under concurrent load can interleave recording against
+        # application order and replay divergent. RLock: bind() may release
+        # inside a decision (gang undo path).
+        self._decision_lock = threading.RLock()
         # latency capture for the north-star p50 (SURVEY.md §6 tracing);
         # bounded windows, not unbounded lists — this is a daemon
         self.latencies: dict[str, deque[float]] = {
@@ -199,11 +206,16 @@ class Extender:
         }
 
     def _try_preemption(self, pod: PodInfo, count: int) -> GangReservation:
-        """Open a contiguous slice for a gang by evicting lower-priority
-        pods. Plans per ICI slice (victim chips only help inside their own
-        slice) and applies the cheapest plan across slices. Raises GangError
-        (propagates unschedulability) if no eligible victim set exists or
-        the pod has no priority to preempt with."""
+        """Open a contiguous slice for a gang by planning the eviction of
+        lower-priority pods. Plans per ICI slice (victim chips only help
+        inside their own slice) and reserves the cheapest plan across
+        slices — TWO-PHASE: victims are recorded on the reservation, not
+        evicted; the evictions execute at the gang's first bind
+        (_execute_pending_preemption). A gang that filters but never binds
+        (crash, queue churn) costs no innocent pod its chips — the TTL
+        sweep drops the reservation and the victims were never touched.
+        Raises GangError (propagates unschedulability) if no eligible
+        victim set exists or the pod has no priority to preempt with."""
         assert pod.group is not None
         slice_ids = self.state.slice_ids()
         if not slice_ids or pod.priority <= 0:
@@ -245,44 +257,93 @@ class Extender:
                     workloads, total, count, pod.priority
                 )
                 if split is not None:
-                    # _apply_victims is the single dedup point (gangs whose
-                    # parts appear in several per-slice plans dissolve once)
-                    evicted_pods = self._apply_victims(
-                        [w for p in split.values() for w in p.victims]
-                    )
-                    self.preemptions += evicted_pods
+                    victims = [w for p in split.values() for w in p.victims]
                     log.warning(
-                        "gang %s/%s preempts %d pods for a DCN-split "
-                        "%d-chip reservation over %s",
-                        pod.namespace, pod.group.name, evicted_pods, total,
+                        "gang %s/%s plans to preempt %d workload(s) for a "
+                        "DCN-split %d-chip reservation over %s (deferred "
+                        "to first bind)",
+                        pod.namespace, pod.group.name, len(victims), total,
                         sorted(split),
                     )
                     return self.gang.reserve_exact_split(
                         pod, count,
                         {sid: p.coords for sid, p in split.items()},
+                        pending_victims=victims,
                     )
             raise GangError(
                 f"gang {pod.namespace}/{pod.group.name}: no victim set opens "
                 f"a contiguous {total}-chip slice at priority {pod.priority} "
                 f"in any of {len(slice_ids)} ICI slices"
             )
-        evicted_pods = self._apply_victims(plan.victims)
-        self.preemptions += evicted_pods
         log.warning(
-            "gang %s/%s preempts %d workloads / %d pods (priority sum %d) "
-            "for a %d-chip slice in %s",
+            "gang %s/%s plans to preempt %d workloads (priority sum %d) "
+            "for a %d-chip slice in %s (deferred to first bind)",
             pod.namespace, pod.group.name,
-            plan.victim_count, evicted_pods, plan.cost_priority_sum, total,
-            plan_slice,
+            plan.victim_count, plan.cost_priority_sum, total, plan_slice,
         )
         return self.gang.reserve_exact(
-            pod, count, plan.coords, slice_id=plan_slice
+            pod, count, plan.coords, slice_id=plan_slice,
+            pending_victims=plan.victims,
+        )
+
+    def _execute_pending_preemption(
+        self, res: GangReservation, view: NodeView, device_ids: list[str]
+    ) -> None:
+        """Phase two of preemption, at the gang's first bind: the planned
+        victims actually lose their chips. Runs under the decision lock
+        (handle()), so exactly one member executes the plan.
+
+        Evictions are irreversible, so they run only after this member's
+        commit is certain to succeed: every minted id must be on a healthy
+        chip and held by nobody — or by a declared victim about to be
+        evicted. A failed pre-check raises WITHOUT touching the victims
+        (the reservation stays pending; a sick slice is the sweep's job)."""
+        from tpukube.core.types import Health, parse_device_id
+
+        victims = self.gang.peek_pending_victims(res)
+        if not victims:
+            return
+        victim_pods: set[str] = set()
+        for w in victims:
+            victim_pods.update(w.pod_keys)
+            if w.gang_key is not None:
+                vres = self.gang.reservation(*w.gang_key)
+                if vres is not None:
+                    victim_pods.update(vres.assigned)
+        holders = {
+            did: a.pod_key
+            for a in self.state.allocations()
+            if a.node_name == view.info.name
+            for did in a.device_ids
+        }
+        for did in device_ids:
+            index, _ = parse_device_id(did)
+            if view.chip(index).health is not Health.HEALTHY:
+                raise ExtenderError(
+                    f"{did}: chip unhealthy; preemption not executed "
+                    "(reservation will be swept)"
+                )
+            holder = holders.get(did)
+            if holder is not None and holder not in victim_pods:
+                raise ExtenderError(
+                    f"{did}: held by non-victim {holder}; preemption not "
+                    "executed, scheduler will re-run the cycle"
+                )
+        victims = self.gang.take_pending_victims(res)
+        evicted_pods = self._apply_victims(victims)
+        self.preemptions += evicted_pods
+        log.warning(
+            "gang %s/%s executes deferred preemption at first bind: "
+            "%d workload(s) / %d pod(s) evicted",
+            res.namespace, res.group.name, len(victims), evicted_pods,
         )
 
     def _apply_victims(self, victims) -> int:
         """Evict a victim set: gangs dissolve wholesale (once, even when a
         DCN-spanning gang appears as several per-slice workloads), plain
-        pods release + queue for eviction. Returns pods evicted."""
+        pods release + queue for eviction. Victims that vanished between
+        plan and execution (released naturally) are skipped. Returns pods
+        evicted."""
         evicted_pods = 0
         dissolved: set[tuple[str, str]] = set()
         for victim in victims:
@@ -293,9 +354,9 @@ class Extender:
                 evicted_pods += len(self.gang.dissolve(victim.gang_key))
             else:
                 for pk in victim.pod_keys:
-                    self.state.release(pk)
-                    self.pending_evictions.append(pk)
-                    evicted_pods += 1
+                    if self.state.release(pk) is not None:
+                        self.pending_evictions.append(pk)
+                        evicted_pods += 1
         return evicted_pods
 
     def _plan_split_preemption(
@@ -671,6 +732,13 @@ class Extender:
                     f"{key}: node {node_name} can no longer fit {count} x {resource}"
                 )
             device_ids = self._mint_device_ids(view, resource, plan)
+            if res is not None:
+                # two-phase preemption: the first member to bind executes
+                # the eviction plan recorded at filter time — but only
+                # after this member's commit is pre-validated, so a bind
+                # that would fail anyway (chip went unhealthy, chip taken
+                # by a non-victim) never costs the victims their chips
+                self._execute_pending_preemption(res, view, device_ids)
             env: dict[str, str] = {}
             if res is not None:
                 # gang context for the in-pod runtime (rides the alloc
@@ -736,19 +804,138 @@ class Extender:
 
     # -- pod lifecycle ------------------------------------------------------
     def release(self, pod_key: str) -> None:
+        self.handle("release", {"pod_key": pod_key})
+
+    # -- atomic webhook dispatch --------------------------------------------
+    def handle(self, kind: str, body: Any) -> Any:
+        """Process one decision request body and return the wire response.
+
+        Every decision path — the HTTP handlers, the sim harness's direct
+        releases, trace replay — comes through here: mutation and trace
+        recording happen under one lock, so trace order IS application
+        order even with releases arriving from threads other than the
+        webhook loop (the round-1 determinism caveat this removes).
+
+        Schema errors raise ``kube.KubeSchemaError`` before any mutation;
+        the HTTP layer maps them to 400 without recording.
+        """
+        with self._decision_lock:
+            if kind == "filter":
+                pod, nodes = kube.parse_extender_args(body)
+                try:
+                    feasible, failed = self.filter(pod, nodes)
+                    response: Any = kube.filter_result(feasible, failed)
+                except (ExtenderError, GangError, StateError,
+                        codec.CodecError) as e:
+                    response = kube.filter_result([], {}, error=str(e))
+            elif kind == "prioritize":
+                pod, nodes = kube.parse_extender_args(body)
+                try:
+                    scores = self.prioritize(pod, nodes)
+                except (ExtenderError, GangError, StateError,
+                        codec.CodecError) as e:
+                    log.warning("prioritize failed: %s", e)
+                    scores = {}
+                response = kube.host_priority_list(scores)
+            elif kind == "bind":
+                name, ns, uid, node = kube.parse_binding_args(body)
+                try:
+                    alloc = self.bind(name, ns, uid, node)
+                    # the alloc annotation rides back to the
+                    # harness/apiserver-writer
+                    response = kube.binding_result()
+                    response["Annotations"] = {
+                        codec.ANNO_ALLOC: codec.encode_alloc(alloc)
+                    }
+                except (ExtenderError, GangError, StateError,
+                        codec.CodecError) as e:
+                    response = kube.binding_result(str(e))
+            elif kind == "release":
+                pod_key = body["pod_key"]
+                self.state.release(pod_key)
+                self.gang.on_release(pod_key)
+                with self._pending_lock:
+                    self._pending.pop(pod_key, None)
+                response = None
+            elif kind == "reconcile":
+                response = {
+                    "changed": self._reconcile_devices(
+                        body["pod_key"], list(body["devices"])
+                    )
+                }
+            else:
+                raise ValueError(f"unknown decision kind {kind!r}")
+            if self.trace is not None:
+                self.trace.record(kind, body, response)
+            return response
+
+    def _reconcile_devices(self, pod_key: str, device_ids: list[str]) -> bool:
+        """Fold the kubelet's ACTUAL device choice into the ledger when it
+        diverged from the plan (reported through the pod's ``alloc-actual``
+        annotation — apiserver.AllocReconcileLoop drives this as a recorded
+        ``reconcile`` decision). The container is already running on those
+        chips, so reality wins: the planned allocation is released, the
+        actual one committed, and gang bookkeeping follows. Returns True if
+        the ledger changed."""
+        from tpukube.core.types import parse_device_id
+
+        alloc = self.state.allocation(pod_key)
+        if alloc is None:
+            log.warning("reconcile for %s: no allocation in ledger", pod_key)
+            return False
+        if sorted(alloc.device_ids) == sorted(device_ids):
+            return False
+        view = self.state.node(alloc.node_name)
+        if view is None:
+            log.warning("reconcile for %s: node %s unknown",
+                        pod_key, alloc.node_name)
+            return False
+        try:
+            coords = sorted({
+                view.chip(parse_device_id(did)[0]).coord
+                for did in device_ids
+            })
+        except (ValueError, KeyError) as e:
+            log.warning("reconcile for %s: bad actual ids %s: %s",
+                        pod_key, device_ids, e)
+            return False
+        # A report naming chips the ledger shows held by ANOTHER pod is
+        # wrong (stale, or a misattributed divergence after an agent
+        # restart) — refuse rather than evict a running pod's entry.
+        held_by_others = [
+            did for did in device_ids
+            if did in view.used_ids and did not in alloc.device_ids
+        ]
+        if held_by_others:
+            log.warning(
+                "reconcile for %s refused: %s already held by other pods",
+                pod_key, held_by_others,
+            )
+            return False
         self.state.release(pod_key)
-        self.gang.on_release(pod_key)
-        with self._pending_lock:
-            self._pending.pop(pod_key, None)
-        # recorded AFTER the mutation, matching the webhook handlers
-        # (which record their response post-processing) so trace order
-        # tracks application order. Caveat: with releases arriving from a
-        # different thread than the webhook loop, mutation and recording
-        # are not one atomic step — a trace captured under concurrent
-        # multi-writer load can interleave and replay divergent; replay's
-        # determinism guarantee is for the serialized request stream.
-        if self.trace is not None:
-            self.trace.record("release", {"pod_key": pod_key}, None)
+        actual = AllocResult(
+            pod_key=pod_key,
+            node_name=alloc.node_name,
+            device_ids=sorted(device_ids),
+            coords=coords,
+            env=alloc.env,
+            priority=alloc.priority,
+        )
+        try:
+            self.state.commit(actual)
+        except StateError:
+            # never leave the pod ledger-less: restore the planned entry
+            self.state.commit(alloc)
+            log.warning("reconcile for %s: commit of %s failed; restored "
+                        "planned allocation", pod_key, sorted(device_ids))
+            return False
+        self.gang.reassign(pod_key, coords)
+        log.warning(
+            "reconciled %s on %s: kubelet allocated %s (planned %s)",
+            pod_key, alloc.node_name, sorted(device_ids),
+            sorted(alloc.device_ids),
+        )
+        return True
 
     # -- inspection (tpukubectl + /state endpoints) --------------------------
     def topology_snapshot(self) -> dict[str, Any]:
@@ -899,51 +1086,20 @@ def make_app(extender: Extender) -> web.Application:
         except json.JSONDecodeError as e:
             raise web.HTTPBadRequest(text=f"bad JSON: {e}")
 
-    def _traced(kind: str, body: Any, response: Any) -> web.Response:
-        if extender.trace is not None:
-            extender.trace.record(kind, body, response)
-        return web.json_response(response)
+    def _webhook(kind: str):
+        # mutation + trace record are one atomic step inside handle()
+        async def handler(request: web.Request) -> web.Response:
+            body = await _json(request)
+            try:
+                return web.json_response(extender.handle(kind, body))
+            except kube.KubeSchemaError as e:
+                raise web.HTTPBadRequest(text=str(e))
 
-    async def filter_handler(request: web.Request) -> web.Response:
-        body = await _json(request)
-        try:
-            pod, nodes = kube.parse_extender_args(body)
-        except kube.KubeSchemaError as e:
-            raise web.HTTPBadRequest(text=str(e))
-        try:
-            feasible, failed = extender.filter(pod, nodes)
-            result = kube.filter_result(feasible, failed)
-        except (ExtenderError, GangError, StateError, codec.CodecError) as e:
-            result = kube.filter_result([], {}, error=str(e))
-        return _traced("filter", body, result)
+        return handler
 
-    async def prioritize_handler(request: web.Request) -> web.Response:
-        body = await _json(request)
-        try:
-            pod, nodes = kube.parse_extender_args(body)
-        except kube.KubeSchemaError as e:
-            raise web.HTTPBadRequest(text=str(e))
-        try:
-            scores = extender.prioritize(pod, nodes)
-        except (ExtenderError, GangError, StateError, codec.CodecError) as e:
-            log.warning("prioritize failed: %s", e)
-            scores = {}
-        return _traced("prioritize", body, kube.host_priority_list(scores))
-
-    async def bind_handler(request: web.Request) -> web.Response:
-        body = await _json(request)
-        try:
-            name, ns, uid, node = kube.parse_binding_args(body)
-        except kube.KubeSchemaError as e:
-            raise web.HTTPBadRequest(text=str(e))
-        try:
-            alloc = extender.bind(name, ns, uid, node)
-            # the alloc annotation rides back to the harness/apiserver-writer
-            result = kube.binding_result()
-            result["Annotations"] = {codec.ANNO_ALLOC: codec.encode_alloc(alloc)}
-        except (ExtenderError, GangError, StateError, codec.CodecError) as e:
-            result = kube.binding_result(str(e))
-        return _traced("bind", body, result)
+    filter_handler = _webhook("filter")
+    prioritize_handler = _webhook("prioritize")
+    bind_handler = _webhook("bind")
 
     async def healthz(request: web.Request) -> web.Response:
         return web.json_response({"ok": True, "nodes": extender.state.node_names()})

@@ -35,7 +35,6 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from tpukube.core.types import (
-    DEFAULT_SLICE,
     Health,
     PodGroup,
     PodInfo,
@@ -84,6 +83,13 @@ class GangReservation:
     )
     committed: bool = False
     commit_latency: Optional[float] = None
+    # Two-phase preemption: the victim workloads this reservation plans to
+    # evict, planned at /filter but EXECUTED only at the gang's first
+    # /bind (extender._execute_pending_preemption). Until then the victims
+    # keep running on the reserved chips; a reservation that TTLs out
+    # unbound never evicts anyone. None once executed (or when the
+    # reservation needed no preemption).
+    pending_victims: Optional[list] = None
 
     def record_assignment(
         self, pod_key: str, slice_id: str, coords: list[TopologyCoord]
@@ -429,7 +435,7 @@ class GangManager:
                             f"has {len(sids)} slices"
                         )
                         return None
-                    sid = sids[0] if sids else DEFAULT_SLICE
+                    sid = sids[0]  # guard above guarantees exactly one
                 member_slices[a.pod_key] = sid
             committed = len(allocs) >= group.min_member
             by_slice: dict[str, set[TopologyCoord]] = {}
@@ -521,23 +527,33 @@ class GangManager:
 
     def reserve_exact(
         self, pod: PodInfo, chips_per_pod: int, coords: list[TopologyCoord],
-        slice_id: str,
+        slice_id: str, pending_victims: Optional[list] = None,
     ) -> GangReservation:
         """Reserve a specific chip set (the preemption path: policy already
-        chose the box and evicted its victims). Raises if any chip was
-        re-taken between eviction and this call — the scheduler retries."""
+        chose the box and its victims). ``pending_victims`` defers the
+        evictions to the gang's first bind (two-phase preemption). Raises
+        if any non-victim chip was taken since planning — the scheduler
+        retries."""
         return self.reserve_exact_split(
-            pod, chips_per_pod, {slice_id: list(coords)}
+            pod, chips_per_pod, {slice_id: list(coords)},
+            pending_victims=pending_victims,
         )
 
     def reserve_exact_split(
         self, pod: PodInfo, chips_per_pod: int,
         parts: dict[str, list[TopologyCoord]],
+        pending_victims: Optional[list] = None,
     ) -> GangReservation:
         """Reserve specific per-slice chip sets (single- or multi-slice
-        preemption). Raises if any chip was re-taken between eviction and
-        this call — the scheduler retries."""
+        preemption). ``pending_victims`` (policy.Workload list) records the
+        eviction plan WITHOUT executing it: their chips may legitimately
+        still be occupied, and stay so until the gang's first bind. Raises
+        if any chip outside the victim set is occupied — the scheduler
+        retries."""
         assert pod.group is not None
+        victim_held: dict[str, set[TopologyCoord]] = {}
+        for w in pending_victims or ():
+            victim_held.setdefault(w.slice_id, set()).update(w.coords)
         with self._lock:
             key = (pod.namespace, pod.group.name)
             existing = self._reservations.get(key)
@@ -550,11 +566,23 @@ class GangManager:
                     f"gang {key}: preemption opened {got} chips but "
                     f"the gang needs {expected}"
                 )
+            victim_gangs = {
+                w.gang_key for w in pending_victims or () if w.gang_key
+            }
             for slice_id, coords in parts.items():
+                # victim-held chips may legitimately still be OCCUPIED
+                # (their eviction is deferred), but another reservation's
+                # coords always clash — only reservations that are
+                # themselves declared victims (dissolved at execution)
+                # are exempt
+                reserved: set[TopologyCoord] = set()
+                for other in self._reservations.values():
+                    if other.key not in victim_gangs:
+                        reserved |= other.unassigned_in(slice_id)
                 occupied = (
                     self._state.occupied_coords(slice_id)
-                    | self.reserved_coords(slice_id)
-                )
+                    - victim_held.get(slice_id, set())
+                ) | reserved
                 clash = [c for c in coords if c in occupied]
                 if clash:
                     raise GangError(
@@ -574,13 +602,37 @@ class GangManager:
                 slice_coords={s: set(cs) for s, cs in parts.items()},
                 chips_per_pod=chips_per_pod,
                 priority=pod.priority,
+                pending_victims=(
+                    list(pending_victims) if pending_victims else None
+                ),
             )
             self._reservations[key] = res
             log.info(
-                "gang %s/%s reserved %d chips over %d slice(s) via preemption",
+                "gang %s/%s reserved %d chips over %d slice(s) via preemption"
+                " (%d victim workload(s) pending first bind)",
                 key[0], key[1], res.total_chips(), len(parts),
+                len(pending_victims or ()),
             )
             return res
+
+    def peek_pending_victims(self, res: GangReservation) -> list:
+        """The deferred eviction plan, without claiming it (the extender
+        pre-validates the bind against it before executing)."""
+        with self._lock:
+            if self._reservations.get(res.key) is not res:
+                return []
+            return list(res.pending_victims or [])
+
+    def take_pending_victims(self, res: GangReservation) -> list:
+        """Atomically claim a reservation's deferred eviction plan (empty
+        if already executed, or if the reservation was replaced). The
+        caller — extender bind, under the decision lock — executes it."""
+        with self._lock:
+            if self._reservations.get(res.key) is not res:
+                return []
+            victims = res.pending_victims or []
+            res.pending_victims = None
+            return list(victims)
 
     # -- per-node queries for the extender ----------------------------------
     @staticmethod
@@ -725,6 +777,29 @@ class GangManager:
                             res.namespace, res.group.name,
                         )
                     return
+
+    def reassign(self, pod_key: str, coords: list[TopologyCoord]) -> bool:
+        """Repoint a bound member's recorded chips (device-id reconcile:
+        the kubelet allocated different chips than planned — the ledger
+        already follows reality; gang bookkeeping must too, or released
+        members would free the WRONG coords back into the pool). The
+        reservation's chip pool moves with it: the abandoned planned
+        coords leave slice_coords (they are ledger-free — keeping them
+        'reserved but unassigned' would mask free chips forever and
+        re-open assignable()), and the actual coords join it."""
+        with self._lock:
+            for res in self._reservations.values():
+                entry = res.assigned.get(pod_key)
+                if entry is not None:
+                    sid, old = entry
+                    res.drop_assignment(pod_key)
+                    pool = res.slice_coords.get(sid, set())
+                    pool.difference_update(old)
+                    pool.update(coords)
+                    res.slice_coords[sid] = pool
+                    res.record_assignment(pod_key, sid, list(coords))
+                    return True
+        return False
 
     def forget(self, namespace: str, group_name: str) -> None:
         """Drop a committed gang's bookkeeping once its job is done (the

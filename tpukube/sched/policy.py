@@ -17,8 +17,12 @@ NP-flavored (SURVEY.md §9.3); this is the bounded exact-sweep heuristic:
      box surface, -contact): prefer cheap evictions, then few, then a
      compact snug box. Deterministic tie-break on origin.
 
-The extender applies the winning plan: non-gang victims are released and
-queued for eviction; gang victims are dissolved wholesale.
+The extender applies the winning plan in TWO PHASES: at /filter it only
+records the victims on the gang's reservation; at the gang's first /bind
+it executes them — non-gang victims released and queued for eviction,
+gang victims dissolved wholesale. A planned-but-never-bound gang (crash,
+higher-priority queue churn) therefore costs no victim its chips: the TTL
+sweep drops the reservation and the victims were never touched.
 """
 
 from __future__ import annotations

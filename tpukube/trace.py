@@ -30,8 +30,9 @@ from typing import Any, Callable, Iterable, Optional
 
 # Event kinds. filter/prioritize/bind carry the webhook request/response
 # verbatim; release carries the pod key (the apiserver-side pod deletion
-# the extender observed); fault carries a node re-annotation event.
-KINDS = ("filter", "prioritize", "bind", "release")
+# the extender observed); reconcile carries a kubelet device-id divergence
+# report being folded into the ledger (apiserver.AllocReconcileLoop).
+KINDS = ("filter", "prioritize", "bind", "release", "reconcile")
 
 
 @dataclass
@@ -119,11 +120,7 @@ def replay(
     """
     # local import: trace must stay importable from the extender module
     from tpukube.core.config import load_config
-    from tpukube.sched import kube
-    from tpukube.sched.extender import Extender, ExtenderError
-    from tpukube.sched.gang import GangError
-    from tpukube.sched.state import StateError
-    from tpukube.core import codec
+    from tpukube.sched.extender import Extender
 
     if extender is None:
         from dataclasses import replace as _dc_replace
@@ -144,40 +141,21 @@ def replay(
 
     for ev in events:
         kind, req = ev["kind"], ev["request"]
-        if kind == "filter":
-            pod, nodes = kube.parse_extender_args(req)
-            try:
-                feasible, failed = extender.filter(pod, nodes)
-                got = kube.filter_result(feasible, failed)
-            except (ExtenderError, GangError, StateError, codec.CodecError) as e:
-                got = kube.filter_result([], {}, error=str(e))
-            if _check(ev, got):
-                break
-        elif kind == "prioritize":
-            pod, nodes = kube.parse_extender_args(req)
-            try:
-                scores = extender.prioritize(pod, nodes)
-            except (ExtenderError, GangError, StateError, codec.CodecError):
-                scores = {}
-            if _check(ev, kube.host_priority_list(scores)):
-                break
-        elif kind == "bind":
-            name, ns, uid, node = kube.parse_binding_args(req)
-            try:
-                alloc = extender.bind(name, ns, uid, node)
-                got = kube.binding_result()
-                got["Annotations"] = {codec.ANNO_ALLOC: codec.encode_alloc(alloc)}
-            except (ExtenderError, GangError, StateError, codec.CodecError) as e:
-                got = kube.binding_result(str(e))
-            if _check(ev, got):
-                break
-        elif kind == "release":
-            extender.release(req["pod_key"])
-            # releases have no response to compare
-        else:  # unknown kind in a newer trace format: report, don't crash
+        if kind not in KINDS:  # newer trace format: report, don't crash
             divergences.append(Divergence(ev.get("seq", -1), kind, ev, None))
             if stop_on_divergence:
                 break
+            continue
+        # replay through the SAME dispatch the live daemon uses (the
+        # scratch extender has tracing disabled, so nothing re-records)
+        try:
+            replayed = extender.handle(kind, req)
+        except Exception as e:  # a recorded request must re-dispatch cleanly
+            replayed = {"replayError": f"{type(e).__name__}: {e}"}
+        if kind == "release":
+            continue  # releases have no response to compare
+        if _check(ev, replayed):
+            break
     return divergences
 
 
