@@ -113,6 +113,68 @@ def test_config3_quota_accumulates_not_just_single_alloc(guard_lib):
     assert "refused at 3" in proc.stdout
 
 
+def test_guard_meters_aligned_allocators(guard_lib):
+    """numpy >= 1.26 obtains large buffers via posix_memalign /
+    aligned_alloc; those paths must hit the quota exactly like malloc
+    (round-1 gap: they sailed past it)."""
+    env = {ENV_HBM_LIMIT: str(128 << 20)}
+    code = (
+        "import ctypes, ctypes.util\n"
+        "libc = ctypes.CDLL(None, use_errno=True)\n"
+        "out = ctypes.c_void_p()\n"
+        "rc = libc.posix_memalign(ctypes.byref(out), 64, 64 << 20)\n"
+        "assert rc == 0 and out.value, 'within-quota posix_memalign failed'\n"
+        "rc = libc.posix_memalign(ctypes.byref(out), 64, 200 << 20)\n"
+        "assert rc != 0, 'over-quota posix_memalign succeeded'\n"
+        "libc.aligned_alloc.restype = ctypes.c_void_p\n"
+        "p = libc.aligned_alloc(64, 200 << 20)\n"
+        "assert not p, 'over-quota aligned_alloc succeeded'\n"
+        "print('aligned allocators metered')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env={**os.environ, **env, "LD_PRELOAD": GUARD},
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "aligned allocators metered" in proc.stdout
+
+
+def test_guard_meters_numpy_under_aligned_policy(guard_lib):
+    """End-to-end: whatever allocator numpy's build uses (malloc or the
+    aligned path), a quota-busting ndarray must raise MemoryError and a
+    within-quota one must succeed."""
+    env = {ENV_HBM_LIMIT: str(128 << 20)}
+    assert _alloc_in_guarded_process(env, 64) is True
+    assert _alloc_in_guarded_process(env, 200) is False
+
+
+def test_guard_meters_anonymous_mmap(guard_lib):
+    """Direct anonymous maps (Python's mmap module) are metered too, and
+    munmap returns the quota."""
+    env = {ENV_HBM_LIMIT: str(128 << 20)}
+    code = (
+        "import mmap\n"
+        "m = mmap.mmap(-1, 64 << 20)\n"
+        "try:\n"
+        "    m2 = mmap.mmap(-1, 200 << 20)\n"
+        "    raise SystemExit('over-quota mmap succeeded')\n"
+        "except (OSError, MemoryError):\n"
+        "    pass\n"
+        "m.close()\n"  # munmap returns the quota...
+        "m3 = mmap.mmap(-1, 100 << 20)\n"  # ...so this fits again
+        "m3.close()\n"
+        "print('mmap metered')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env={**os.environ, **env, "LD_PRELOAD": GUARD},
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "mmap metered" in proc.stdout
+
+
 def test_guard_inert_without_limit(guard_lib):
     # no TPU_HBM_LIMIT_BYTES -> the shim must not interfere at all
     proc = subprocess.run(

@@ -11,22 +11,27 @@
  * device HBM in the simulation.
  *
  * Mechanics:
- *  - interposes malloc/calloc/realloc/free via dlsym(RTLD_NEXT, ...)
+ *  - interposes malloc/calloc/realloc/free plus the aligned allocators
+ *    (posix_memalign/aligned_alloc/memalign — numpy >= 1.26 takes these
+ *    paths for large buffers) and anonymous mmap/munmap, via
+ *    dlsym(RTLD_NEXT, ...)
  *  - only allocations with usable size >= HBMGUARD_THRESHOLD_BYTES
  *    (default 1 MiB) are metered — interpreter small-object churn is
  *    invisible; big tensor buffers are not
- *  - metered blocks are remembered in a lock-free pointer table, so a
- *    free() of memory the shim never metered (posix_memalign, pre-init
- *    blocks) cannot corrupt the ledger
+ *  - metered blocks are remembered in a lock-free (pointer, size) table,
+ *    so a free() of memory the shim never metered (pre-init blocks,
+ *    glibc-internal arenas) cannot corrupt the ledger
  *  - over-quota requests return NULL with errno=ENOMEM (numpy raises
- *    MemoryError, exactly how a real HBM OOM surfaces to the user)
+ *    MemoryError, exactly how a real HBM OOM surfaces to the user);
+ *    posix_memalign returns ENOMEM per its contract
  *  - introspection for tests: hbmguard_used()/hbmguard_limit()
  *
- * Limits of the model (documented trust model, SURVEY.md §9.3): memory
- * obtained through interfaces the shim does not interpose (posix_memalign,
- * raw mmap) is not metered; if the pointer table fills, overflow blocks
- * pass unmetered rather than corrupting accounting. An audit shim, not a
- * security boundary (neither is the reference's).
+ * Limits of the model (documented trust model, SURVEY.md §9.3): glibc
+ * malloc's INTERNAL mmaps do not re-enter this interposer (they call the
+ * non-PLT alias), so big malloc'd buffers are metered exactly once, at the
+ * malloc layer; mremap-grown maps are not re-metered; if the pointer table
+ * fills, overflow blocks pass unmetered rather than corrupting accounting.
+ * An audit shim, not a security boundary (neither is the reference's).
  */
 
 #include <dlfcn.h>
@@ -36,6 +41,9 @@
 #include <stdint.h>
 #include <stdlib.h>
 #include <string.h>
+#include <sys/mman.h>
+#include <sys/syscall.h>
+#include <unistd.h>
 
 #include <atomic>
 
@@ -45,10 +53,24 @@ typedef void* (*malloc_t)(size_t);
 typedef void* (*calloc_t)(size_t, size_t);
 typedef void* (*realloc_t)(void*, size_t);
 typedef void (*free_t)(void*);
+typedef int (*posix_memalign_t)(void**, size_t, size_t);
+typedef void* (*aligned_alloc_t)(size_t, size_t);
+typedef void* (*memalign_t)(size_t, size_t);
+typedef void* (*mmap_t)(void*, size_t, int, int, int, off_t);
+typedef int (*munmap_t)(void*, size_t);
 
 static std::atomic<int64_t> g_used{0};
+/* Re-entrancy depth: >0 while we are inside a real_* allocator call. An
+ * mmap arriving then is the allocator's own backing map for a block the
+ * outer call is already metering — metering it too would double-count. */
+static __thread int t_in_alloc = 0;
 static int64_t g_limit = -1;      /* -1 = unlimited (shim inert) */
 static int64_t g_threshold = 1 << 20;
+/* Direct-mmap metering threshold. Higher than the malloc threshold:
+ * CPython's pymalloc arenas are 1 MiB anonymous mmaps, and metering the
+ * interpreter's own object heap is exactly the churn the threshold model
+ * excludes. Tensor-scale direct maps are far larger. */
+static int64_t g_mmap_threshold = 16 << 20;
 static std::atomic<int> g_init_state{0}; /* 0=uninit, 1=initializing, 2=ready */
 static pthread_t g_init_thread;
 
@@ -56,12 +78,17 @@ static malloc_t real_malloc = nullptr;
 static calloc_t real_calloc = nullptr;
 static realloc_t real_realloc = nullptr;
 static free_t real_free = nullptr;
+static posix_memalign_t real_posix_memalign = nullptr;
+static aligned_alloc_t real_aligned_alloc = nullptr;
+static memalign_t real_memalign = nullptr;
+static mmap_t real_mmap = nullptr;
+static munmap_t real_munmap = nullptr;
 
 /* -- boot arena ------------------------------------------------------------
  * dlsym may itself allocate during init: serve those from a static arena
  * (never freed; a few KiB at most). Each block carries a size header so a
  * later realloc can copy exactly the old contents. */
-static char g_boot_arena[16384];
+alignas(16) static char g_boot_arena[16384];
 static size_t g_boot_off = 0;
 
 static int in_boot_arena(const void* p) {
@@ -83,44 +110,72 @@ static size_t boot_size(const void* p) {
 }
 
 /* -- metered-pointer table -------------------------------------------------
- * Open-addressed, lock-free table of blocks the shim actually metered.
- * Metered allocations are big (>= 1 MiB), so live count is small; 64Ki
- * slots is generous. If the table ever fills, the block passes unmetered —
- * losing one block's metering is strictly better than corrupting g_used. */
+ * Open-addressed, lock-free table of (block, metered size) the shim
+ * actually metered. Metered allocations are big (>= 1 MiB), so live count
+ * is small; 64Ki slots is generous. If the table ever fills, the block
+ * passes unmetered — losing one block's metering is strictly better than
+ * corrupting g_used. Sizes are stored so unmetering is exact for blocks
+ * without malloc_usable_size (mmap regions). The size slot is written
+ * BEFORE the pointer CAS publishes it, so a reader that matched the
+ * pointer sees the matching size. */
 #define TABLE_SLOTS 65536
 static std::atomic<uintptr_t> g_table[TABLE_SLOTS];
+static std::atomic<int64_t> g_table_size[TABLE_SLOTS];
 
 static size_t slot_of(uintptr_t p) {
   /* fibonacci hash on the address */
   return (size_t)((p * 11400714819323198485ull) >> 48) & (TABLE_SLOTS - 1);
 }
 
-static int table_remove(void* p) {
+/* Returns the metered size (removing the entry), or -1 if never metered. */
+static int64_t table_remove(void* p) {
   uintptr_t v = reinterpret_cast<uintptr_t>(p);
   size_t i = slot_of(v);
   for (int probe = 0; probe < TABLE_SLOTS; ++probe) {
     uintptr_t cur = g_table[i].load();
     if (cur == v) {
+      int64_t sz = g_table_size[i].load();
       /* tombstone-free removal is unsafe in open addressing; use a
        * tombstone value so probe chains stay intact */
-      if (g_table[i].compare_exchange_strong(cur, UINTPTR_MAX)) return 1;
+      if (g_table[i].compare_exchange_strong(cur, UINTPTR_MAX)) return sz;
     }
-    if (cur == 0) return 0; /* end of probe chain: never metered */
+    if (cur == 0) return -1; /* end of probe chain: never metered */
     i = (i + 1) & (TABLE_SLOTS - 1);
   }
-  return 0;
+  return -1;
 }
 
-/* tombstones are reusable on insert */
-static int table_insert_reuse(void* p) {
+/* Metered size of a live entry without removing it, or -1. */
+static int64_t table_lookup(void* p) {
+  uintptr_t v = reinterpret_cast<uintptr_t>(p);
+  size_t i = slot_of(v);
+  for (int probe = 0; probe < TABLE_SLOTS; ++probe) {
+    uintptr_t cur = g_table[i].load();
+    if (cur == v) return g_table_size[i].load();
+    if (cur == 0) return -1;
+    i = (i + 1) & (TABLE_SLOTS - 1);
+  }
+  return -1;
+}
+
+/* tombstones are reusable on insert. The slot is first claimed with a
+ * sentinel, the size written, THEN the pointer published — a lost CAS can
+ * therefore never scribble a size into another entry's slot, and readers
+ * that match the pointer always see its size. Readers skip claim-sentinel
+ * slots naturally (the sentinel matches neither their pointer nor 0). */
+static int table_insert_reuse(void* p, int64_t sz) {
+  const uintptr_t kClaim = UINTPTR_MAX - 1;
   uintptr_t v = reinterpret_cast<uintptr_t>(p);
   size_t i = slot_of(v);
   for (int probe = 0; probe < TABLE_SLOTS; ++probe) {
     uintptr_t cur = g_table[i].load();
     if (cur == 0 || cur == UINTPTR_MAX) {
-      if (g_table[i].compare_exchange_strong(cur, v)) return 1;
-    } else if (cur == v) {
-      return 1;
+      if (g_table[i].compare_exchange_strong(cur, kClaim)) {
+        g_table_size[i].store(sz);
+        g_table[i].store(v, std::memory_order_release);
+        return 1;
+      }
+      /* slot just taken by another thread: probe on */
     }
     i = (i + 1) & (TABLE_SLOTS - 1);
   }
@@ -143,6 +198,12 @@ static void hbmguard_init(void) {
   real_calloc = (calloc_t)dlsym(RTLD_NEXT, "calloc");
   real_realloc = (realloc_t)dlsym(RTLD_NEXT, "realloc");
   real_free = (free_t)dlsym(RTLD_NEXT, "free");
+  real_posix_memalign =
+      (posix_memalign_t)dlsym(RTLD_NEXT, "posix_memalign");
+  real_aligned_alloc = (aligned_alloc_t)dlsym(RTLD_NEXT, "aligned_alloc");
+  real_memalign = (memalign_t)dlsym(RTLD_NEXT, "memalign");
+  real_mmap = (mmap_t)dlsym(RTLD_NEXT, "mmap");
+  real_munmap = (munmap_t)dlsym(RTLD_NEXT, "munmap");
   const char* lim = getenv("TPU_HBM_LIMIT_BYTES");
   if (lim != nullptr && *lim != '\0') {
     char* end = nullptr;
@@ -155,6 +216,12 @@ static void hbmguard_init(void) {
     char* end = nullptr;
     int64_t t = strtoll(thr, &end, 10);
     if (end != thr && t > 0) g_threshold = t;
+  }
+  const char* mthr = getenv("HBMGUARD_MMAP_THRESHOLD_BYTES");
+  if (mthr != nullptr && *mthr != '\0') {
+    char* end = nullptr;
+    int64_t t = strtoll(mthr, &end, 10);
+    if (end != mthr && t > 0) g_mmap_threshold = t;
   }
   g_init_state.store(2);
 }
@@ -179,23 +246,26 @@ static int meter_block(void* p, int64_t sz) {
     g_used.fetch_sub(sz);
     return -1;
   }
-  if (!table_insert_reuse(p)) {
+  if (!table_insert_reuse(p, sz)) {
     /* table full: pass unmetered rather than corrupt the ledger later */
     g_used.fetch_sub(sz);
   }
   return 0;
 }
 
-static void unmeter_block(void* p, int64_t sz) {
+static void unmeter_block(void* p) {
   if (g_limit < 0) return;
-  if (table_remove(p)) g_used.fetch_sub(sz);
+  int64_t sz = table_remove(p);
+  if (sz >= 0) g_used.fetch_sub(sz);
 }
 
 /* -- interposed allocator -------------------------------------------------- */
 
 void* malloc(size_t size) {
   if (ensure_init()) return boot_alloc(size);
+  t_in_alloc++;
   void* p = real_malloc(size);
+  t_in_alloc--;
   if (p == nullptr) return nullptr;
   if (meter_block(p, (int64_t)malloc_usable_size(p)) != 0) {
     real_free(p);
@@ -212,7 +282,9 @@ void* calloc(size_t nmemb, size_t size) {
     if (p != nullptr) memset(p, 0, total);
     return p;
   }
+  t_in_alloc++;
   void* p = real_calloc(nmemb, size);
+  t_in_alloc--;
   if (p == nullptr) return nullptr;
   if (meter_block(p, (int64_t)malloc_usable_size(p)) != 0) {
     real_free(p);
@@ -245,32 +317,29 @@ void* realloc(void* ptr, size_t size) {
    * break realloc's "old block intact on failure" contract (the caller
    * would use-after-free). Pre-meter with the requested size; after a
    * successful realloc, true up to the actual usable sizes. */
-  int64_t old_sz = ptr ? (int64_t)malloc_usable_size(ptr) : 0;
-  int old_metered = 0;
-  if (ptr != nullptr && g_limit >= 0) {
-    /* peek (remove+reinsert) to learn whether the old block was metered */
-    old_metered = table_remove(ptr);
-    if (old_metered) table_insert_reuse(ptr);
-  }
+  int64_t old_metered_sz = ptr ? table_lookup(ptr) : -1;
   if (g_limit >= 0 && (int64_t)size >= g_threshold) {
     int64_t projected =
-        g_used.load() - (old_metered ? old_sz : 0) + (int64_t)size;
+        g_used.load() - (old_metered_sz > 0 ? old_metered_sz : 0) +
+        (int64_t)size;
     if (projected > g_limit) {
       errno = ENOMEM;
       return nullptr; /* old block untouched */
     }
   }
+  t_in_alloc++;
   void* p = real_realloc(ptr, size);
+  t_in_alloc--;
   if (p == nullptr) return nullptr; /* old block intact, accounting holds */
-  if (old_metered) {
-    table_remove(ptr == p ? p : ptr);
-    g_used.fetch_sub(old_sz);
+  if (old_metered_sz >= 0) {
+    int64_t removed = table_remove(ptr == p ? p : ptr);
+    if (removed >= 0) g_used.fetch_sub(removed);
   }
   int64_t new_sz = (int64_t)malloc_usable_size(p);
   if (g_limit >= 0 && new_sz >= g_threshold) {
     /* account unconditionally — a post-hoc refusal would leak the move */
     g_used.fetch_add(new_sz);
-    if (!table_insert_reuse(p)) g_used.fetch_sub(new_sz);
+    if (!table_insert_reuse(p, new_sz)) g_used.fetch_sub(new_sz);
   }
   return p;
 }
@@ -278,8 +347,110 @@ void* realloc(void* ptr, size_t size) {
 void free(void* ptr) {
   if (ptr == nullptr || in_boot_arena(ptr)) return;
   if (ensure_init()) return; /* init-window real pointer: leak one block */
-  unmeter_block(ptr, (int64_t)malloc_usable_size(ptr));
+  unmeter_block(ptr);
   real_free(ptr);
+}
+
+/* -- aligned allocators (numpy >= 1.26's large-buffer path) ---------------- */
+
+int posix_memalign(void** memptr, size_t alignment, size_t size) {
+  if (ensure_init()) {
+    if (alignment > 16) return ENOMEM; /* boot arena is 16-aligned */
+    void* p = boot_alloc(size);
+    if (p == nullptr) return ENOMEM;
+    *memptr = p;
+    return 0;
+  }
+  t_in_alloc++;
+  int rc = real_posix_memalign(memptr, alignment, size);
+  t_in_alloc--;
+  if (rc != 0) return rc;
+  if (meter_block(*memptr, (int64_t)malloc_usable_size(*memptr)) != 0) {
+    real_free(*memptr);
+    *memptr = nullptr;
+    return ENOMEM;
+  }
+  return 0;
+}
+
+void* aligned_alloc(size_t alignment, size_t size) {
+  if (ensure_init()) {
+    return alignment <= 16 ? boot_alloc(size) : nullptr;
+  }
+  t_in_alloc++;
+  void* p = real_aligned_alloc(alignment, size);
+  t_in_alloc--;
+  if (p == nullptr) return nullptr;
+  if (meter_block(p, (int64_t)malloc_usable_size(p)) != 0) {
+    real_free(p);
+    errno = ENOMEM;
+    return nullptr;
+  }
+  return p;
+}
+
+void* memalign(size_t alignment, size_t size) {
+  if (ensure_init()) {
+    return alignment <= 16 ? boot_alloc(size) : nullptr;
+  }
+  t_in_alloc++;
+  void* p = real_memalign(alignment, size);
+  t_in_alloc--;
+  if (p == nullptr) return nullptr;
+  if (meter_block(p, (int64_t)malloc_usable_size(p)) != 0) {
+    real_free(p);
+    errno = ENOMEM;
+    return nullptr;
+  }
+  return p;
+}
+
+/* -- anonymous mmap (Python's mmap module, arena allocators) ---------------
+ * glibc malloc's internal large-block mmaps call the non-PLT alias and do
+ * NOT re-enter here, so malloc'd buffers stay metered exactly once. */
+
+void* mmap(void* addr, size_t length, int prot, int flags, int fd,
+           off_t offset) {
+  if (ensure_init()) {
+    /* init-window map (dlsym machinery): hand through to the kernel */
+    return (void*)syscall(SYS_mmap, addr, length, prot, flags, fd, offset);
+  }
+  int meterable = t_in_alloc == 0 && (flags & MAP_ANONYMOUS) && fd == -1 &&
+                  (prot & PROT_WRITE) && !(flags & MAP_STACK) &&
+                  g_limit >= 0 && (int64_t)length >= g_mmap_threshold;
+  if (meterable) {
+    int64_t now = g_used.fetch_add((int64_t)length) + (int64_t)length;
+    if (now > g_limit) {
+      g_used.fetch_sub((int64_t)length);
+      errno = ENOMEM;
+      return MAP_FAILED;
+    }
+  }
+  void* p = real_mmap(addr, length, prot, flags, fd, offset);
+  if (p == MAP_FAILED) {
+    if (meterable) g_used.fetch_sub((int64_t)length);
+    return p;
+  }
+  if (meterable && !table_insert_reuse(p, (int64_t)length)) {
+    g_used.fetch_sub((int64_t)length); /* table full: pass unmetered */
+  }
+  return p;
+}
+
+/* _FILE_OFFSET_BITS=64 builds (CPython among them) call mmap64 */
+void* mmap64(void* addr, size_t length, int prot, int flags, int fd,
+             off_t offset) {
+  return mmap(addr, length, prot, flags, fd, offset);
+}
+
+int munmap(void* addr, size_t length) {
+  if (ensure_init()) {
+    return (int)syscall(SYS_munmap, addr, length);
+  }
+  /* partial unmaps of a metered region are rare (Python unmaps whole
+   * regions); a base-pointer unmap releases the whole metered size */
+  unmeter_block(addr);
+  return real_munmap(addr, length);
 }
 
 /* -- test introspection --------------------------------------------------- */

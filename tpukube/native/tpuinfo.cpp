@@ -8,13 +8,22 @@
 #include "tpuinfo.h"
 
 #include <dlfcn.h>
+#include <stddef.h>
 
 #include <algorithm>
 #include <array>
 #include <cstdio>
 #include <cstring>
+#include <map>
 #include <string>
 #include <vector>
+
+#ifdef TPUINFO_HAVE_PJRT
+/* Public OpenXLA PJRT C API header (shipped in this image by the
+ * tensorflow wheel; see Makefile PJRT_INC autodiscovery). Pure ABI
+ * declarations — versioned via struct_size, checked below. */
+#include "xla/pjrt/c/pjrt_c_api.h"
+#endif
 
 namespace {
 
@@ -26,6 +35,7 @@ struct State {
   tpuinfo_mesh mesh{};
   std::vector<tpuinfo_chip> chips;
   std::vector<LinkPair> bad_links;
+  std::string source = "";  /* "sim" | "pjrt" | "table (<why no pjrt>)" */
 };
 
 State g_state;
@@ -161,8 +171,225 @@ int init_sim(const char* spec) {
         ++idx;
       }
   g_state.is_sim = true;
+  g_state.source = "sim";
   return 0;
 }
+
+#ifdef TPUINFO_HAVE_PJRT
+/* Real enumeration through the PJRT C API (SURVEY.md §2 C2: the NVML
+ * device-query analog). Creates a client, reads each addressable device's
+ * id / kind / coords / HBM limit, and destroys the client immediately —
+ * TPU runtimes are single-owner, so the agent must not squat on the chips
+ * past enumeration. Any failure returns false with a reason; the caller
+ * falls back to the static generation table. */
+bool enumerate_pjrt(void* get_api_sym, std::string* why,
+                    std::vector<tpuinfo_chip>* chips_out,
+                    tpuinfo_mesh* mesh_out) {
+  typedef const PJRT_Api* (*GetPjrtApiFn)();
+  const PJRT_Api* api = reinterpret_cast<GetPjrtApiFn>(get_api_sym)();
+  if (api == nullptr) { *why = "GetPjrtApi returned null"; return false; }
+  if (api->pjrt_api_version.major_version != PJRT_API_MAJOR) {
+    *why = "PJRT major version mismatch";
+    return false;
+  }
+  /* The plugin may implement an older minor version with a smaller PJRT_Api
+   * struct: every function pointer we touch must lie inside it. */
+#define TPUINFO_HAVE_FN(f) \
+  (api->struct_size >= offsetof(PJRT_Api, f) + sizeof(void*) && api->f)
+  if (!TPUINFO_HAVE_FN(PJRT_Error_Destroy) ||
+      !TPUINFO_HAVE_FN(PJRT_Error_Message) ||
+      !TPUINFO_HAVE_FN(PJRT_Plugin_Initialize) ||
+      !TPUINFO_HAVE_FN(PJRT_Client_Create) ||
+      !TPUINFO_HAVE_FN(PJRT_Client_Destroy) ||
+      !TPUINFO_HAVE_FN(PJRT_Client_Devices) ||
+      !TPUINFO_HAVE_FN(PJRT_Device_GetDescription) ||
+      !TPUINFO_HAVE_FN(PJRT_Device_IsAddressable) ||
+      !TPUINFO_HAVE_FN(PJRT_DeviceDescription_Id) ||
+      !TPUINFO_HAVE_FN(PJRT_DeviceDescription_Kind) ||
+      !TPUINFO_HAVE_FN(PJRT_DeviceDescription_Attributes)) {
+    *why = "plugin PJRT_Api too old (missing required entry points)";
+    return false;
+  }
+  bool have_memstats = TPUINFO_HAVE_FN(PJRT_Device_MemoryStats);
+#undef TPUINFO_HAVE_FN
+
+  auto take_error = [api](PJRT_Error* e) -> std::string {
+    if (e == nullptr) return "";
+    PJRT_Error_Message_Args ma;
+    std::memset(&ma, 0, sizeof ma);
+    ma.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+    ma.error = e;
+    api->PJRT_Error_Message(&ma);
+    std::string msg(ma.message, ma.message_size);
+    PJRT_Error_Destroy_Args da;
+    std::memset(&da, 0, sizeof da);
+    da.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+    da.error = e;
+    api->PJRT_Error_Destroy(&da);
+    return msg.empty() ? "unknown PJRT error" : msg;
+  };
+
+  PJRT_Plugin_Initialize_Args pia;
+  std::memset(&pia, 0, sizeof pia);
+  pia.struct_size = PJRT_Plugin_Initialize_Args_STRUCT_SIZE;
+  std::string err = take_error(api->PJRT_Plugin_Initialize(&pia));
+  if (!err.empty()) { *why = "Plugin_Initialize: " + err; return false; }
+
+  PJRT_Client_Create_Args ca;
+  std::memset(&ca, 0, sizeof ca);
+  ca.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  err = take_error(api->PJRT_Client_Create(&ca));
+  if (!err.empty()) { *why = "Client_Create: " + err; return false; }
+  PJRT_Client* client = ca.client;
+
+  auto destroy_client = [api, client]() {
+    PJRT_Client_Destroy_Args cda;
+    std::memset(&cda, 0, sizeof cda);
+    cda.struct_size = PJRT_Client_Destroy_Args_STRUCT_SIZE;
+    cda.client = client;
+    PJRT_Error* e = api->PJRT_Client_Destroy(&cda);
+    if (e != nullptr) {
+      PJRT_Error_Destroy_Args da;
+      std::memset(&da, 0, sizeof da);
+      da.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+      da.error = e;
+      api->PJRT_Error_Destroy(&da);
+    }
+  };
+
+  PJRT_Client_Devices_Args dva;
+  std::memset(&dva, 0, sizeof dva);
+  dva.struct_size = PJRT_Client_Devices_Args_STRUCT_SIZE;
+  dva.client = client;
+  err = take_error(api->PJRT_Client_Devices(&dva));
+  if (!err.empty()) {
+    destroy_client();
+    *why = "Client_Devices: " + err;
+    return false;
+  }
+
+  /* One PJRT device == one core (or one megacore); group by chip coords.
+   * coords come from the TPU plugin's "coords" int64[3] attribute. */
+  struct ChipAgg {
+    int32_t coord[3] = {0, 0, 0};
+    bool have_coord = false;
+    int32_t cores = 0;
+    int64_t hbm = 0;
+    int min_id = INT32_MAX;
+    std::string kind;
+  };
+  std::map<std::array<int64_t, 3>, ChipAgg> by_coord;
+  int fallback_x = 0;
+
+  for (size_t i = 0; i < dva.num_devices; ++i) {
+    PJRT_Device* dev = dva.devices[i];
+    PJRT_Device_IsAddressable_Args aa;
+    std::memset(&aa, 0, sizeof aa);
+    aa.struct_size = PJRT_Device_IsAddressable_Args_STRUCT_SIZE;
+    aa.device = dev;
+    if (!take_error(api->PJRT_Device_IsAddressable(&aa)).empty() ||
+        !aa.is_addressable) {
+      continue;  /* another host's device: not this node's inventory */
+    }
+    PJRT_Device_GetDescription_Args ga;
+    std::memset(&ga, 0, sizeof ga);
+    ga.struct_size = PJRT_Device_GetDescription_Args_STRUCT_SIZE;
+    ga.device = dev;
+    err = take_error(api->PJRT_Device_GetDescription(&ga));
+    if (!err.empty()) { destroy_client(); *why = "GetDescription: " + err; return false; }
+
+    PJRT_DeviceDescription_Id_Args ida;
+    std::memset(&ida, 0, sizeof ida);
+    ida.struct_size = PJRT_DeviceDescription_Id_Args_STRUCT_SIZE;
+    ida.device_description = ga.device_description;
+    take_error(api->PJRT_DeviceDescription_Id(&ida));
+
+    PJRT_DeviceDescription_Kind_Args ka;
+    std::memset(&ka, 0, sizeof ka);
+    ka.struct_size = PJRT_DeviceDescription_Kind_Args_STRUCT_SIZE;
+    ka.device_description = ga.device_description;
+    std::string kind;
+    if (take_error(api->PJRT_DeviceDescription_Kind(&ka)).empty())
+      kind.assign(ka.device_kind, ka.device_kind_size);
+
+    std::array<int64_t, 3> coords{fallback_x, 0, 0};
+    bool have_coord = false;
+    PJRT_DeviceDescription_Attributes_Args ata;
+    std::memset(&ata, 0, sizeof ata);
+    ata.struct_size = PJRT_DeviceDescription_Attributes_Args_STRUCT_SIZE;
+    ata.device_description = ga.device_description;
+    if (take_error(api->PJRT_DeviceDescription_Attributes(&ata)).empty()) {
+      for (size_t a = 0; a < ata.num_attributes; ++a) {
+        const PJRT_NamedValue& nv = ata.attributes[a];
+        if (std::string(nv.name, nv.name_size) == "coords" &&
+            nv.type == PJRT_NamedValue_kInt64List && nv.value_size == 3) {
+          coords = {nv.int64_array_value[0], nv.int64_array_value[1],
+                    nv.int64_array_value[2]};
+          have_coord = true;
+        }
+      }
+    }
+    if (!have_coord) ++fallback_x;
+
+    int64_t hbm = 0;
+    if (have_memstats) {
+      PJRT_Device_MemoryStats_Args msa;
+      std::memset(&msa, 0, sizeof msa);
+      msa.struct_size = PJRT_Device_MemoryStats_Args_STRUCT_SIZE;
+      msa.device = dev;
+      if (take_error(api->PJRT_Device_MemoryStats(&msa)).empty() &&
+          msa.bytes_limit_is_set) {
+        hbm = msa.bytes_limit;
+      }
+    }
+
+    ChipAgg& agg = by_coord[coords];
+    agg.coord[0] = (int32_t)coords[0];
+    agg.coord[1] = (int32_t)coords[1];
+    agg.coord[2] = (int32_t)coords[2];
+    agg.have_coord = have_coord;
+    agg.cores += 1;
+    if (hbm > agg.hbm) agg.hbm = hbm;  /* cores share the chip's HBM */
+    if (ida.id < agg.min_id) agg.min_id = ida.id;
+    if (agg.kind.empty()) agg.kind = kind;
+  }
+  destroy_client();
+  if (by_coord.empty()) { *why = "no addressable PJRT devices"; return false; }
+
+  /* Local coords may sit anywhere in the global slice; the mesh this
+   * enumeration can honestly report is the bounding box of what it saw
+   * (single-host dev boxes get exact dims; multi-host layouts override
+   * geometry via config/annotations). */
+  int32_t mn[3] = {INT32_MAX, INT32_MAX, INT32_MAX}, mx[3] = {0, 0, 0};
+  for (const auto& [c, agg] : by_coord) {
+    for (int a = 0; a < 3; ++a) {
+      mn[a] = std::min(mn[a], agg.coord[a]);
+      mx[a] = std::max(mx[a], agg.coord[a]);
+    }
+  }
+  for (int a = 0; a < 3; ++a) {
+    mesh_out->dims[a] = mx[a] + 1;
+    mesh_out->host_block[a] = mx[a] - mn[a] + 1;
+    mesh_out->torus[a] = 0;
+  }
+  chips_out->clear();
+  int32_t idx = 0;
+  for (const auto& [c, agg] : by_coord) {
+    tpuinfo_chip chip{};
+    chip.index = idx++;
+    chip.coord[0] = agg.coord[0];
+    chip.coord[1] = agg.coord[1];
+    chip.coord[2] = agg.coord[2];
+    std::snprintf(chip.chip_id, TPUINFO_MAX_ID, "%s-%d",
+                  agg.kind.empty() ? "tpu" : agg.kind.c_str(), agg.min_id);
+    chip.hbm_bytes = agg.hbm;
+    chip.num_cores = agg.cores;
+    chip.healthy = 1;
+    chips_out->push_back(chip);
+  }
+  return true;
+}
+#endif  /* TPUINFO_HAVE_PJRT */
 
 int init_real(const char* spec) {
   std::string libtpu_path = "libtpu.so";
@@ -195,13 +422,29 @@ int init_real(const char* spec) {
     set_error(std::string("real: cannot load libtpu: ") + dlerror());
     return -1;
   }
-  if (dlsym(h, "GetPjrtApi") == nullptr) {
+  void* get_api = dlsym(h, "GetPjrtApi");
+  if (get_api == nullptr) {
     set_error("real: libtpu loaded but GetPjrtApi missing — not a PJRT libtpu");
     dlclose(h);
     return -1;
   }
   /* handle intentionally retained for process lifetime (liveness probe) */
 
+  /* First choice: ask the runtime itself (PJRT client; device id, kind,
+   * coords, HBM limit). The spec string / generation table is the
+   * FALLBACK for environments where a client cannot be created (chip
+   * already owned by another process, version-skewed tunnel, ...). */
+  std::string why = "built without PJRT header";
+#ifdef TPUINFO_HAVE_PJRT
+  if (enumerate_pjrt(get_api, &why, &g_state.chips, &g_state.mesh)) {
+    for (auto& c : g_state.chips) {
+      if (c.hbm_bytes <= 0) c.hbm_bytes = gi->hbm_bytes;  /* stats absent */
+    }
+    g_state.is_sim = false;
+    g_state.source = "pjrt";
+    return 0;
+  }
+#endif
   g_state.mesh = tpuinfo_mesh{{nchips, 1, 1}, {nchips, 1, 1}, {0, 0, 0}};
   g_state.chips.clear();
   for (int32_t i = 0; i < nchips; ++i) {
@@ -215,6 +458,7 @@ int init_real(const char* spec) {
     g_state.chips.push_back(c);
   }
   g_state.is_sim = false;
+  g_state.source = "table (" + why + ")";
   return 0;
 }
 
@@ -383,5 +627,7 @@ int tpuinfo_inject_fault(int32_t index, int32_t healthy) {
 }
 
 const char* tpuinfo_last_error(void) { return g_last_error.c_str(); }
+
+const char* tpuinfo_source(void) { return g_state.source.c_str(); }
 
 }  // extern "C"

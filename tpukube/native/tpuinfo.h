@@ -10,10 +10,14 @@
  *   "sim"  — topology from a key=value spec (the load-bearing backend: no
  *            cluster or multi-chip hardware exists in CI; BASELINE config 1
  *            requires a fake-device path).
- *   "real" — minimal local-chip enumeration: libtpu.so liveness via
- *            dlopen/dlsym + per-generation HBM/core tables. Full topology
- *            introspection on real fleets rides the in-pod PJRT runtime;
- *            the node agent only needs enumerate + liveness (SURVEY.md §9.3).
+ *   "real" — runtime introspection through the PJRT C API (libtpu's
+ *            GetPjrtApi): device count, kind, chip coords, and HBM limit
+ *            read from a short-lived PJRT client, released immediately
+ *            (TPU runtimes are single-owner). Falls back to libtpu.so
+ *            liveness + per-generation HBM/core tables when a client
+ *            cannot be created (chip owned by another process, version
+ *            skew); tpuinfo_source() reports which path produced the
+ *            inventory.
  *
  * Consumed from Python via ctypes (tpukube/native/tpuinfo.py). All calls
  * return 0 on success, -1 on error; tpuinfo_last_error() describes the
@@ -29,7 +33,7 @@
 extern "C" {
 #endif
 
-#define TPUINFO_ABI_VERSION 2
+#define TPUINFO_ABI_VERSION 3
 #define TPUINFO_MAX_ID 64
 
 typedef struct {
@@ -84,6 +88,11 @@ int tpuinfo_inject_link_fault(int32_t ax, int32_t ay, int32_t az,
 int tpuinfo_link_faults(int32_t* out, int32_t max);
 
 const char* tpuinfo_last_error(void);
+
+/* Where the current inventory came from (ABI v3): "sim", "pjrt" (runtime
+ * introspection), or "table (<reason pjrt was unavailable>)". Empty string
+ * before init. */
+const char* tpuinfo_source(void);
 
 #ifdef __cplusplus
 }

@@ -24,7 +24,7 @@ from tpukube.core.types import ChipInfo, Health, TopologyCoord
 _NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libtpuinfo.so")
 
-ABI_VERSION = 2
+ABI_VERSION = 3
 _MAX_LINKS = 6
 
 
@@ -111,6 +111,7 @@ def _load() -> ctypes.CDLL:
         ]
         lib.tpuinfo_link_faults.restype = ctypes.c_int
         lib.tpuinfo_last_error.restype = ctypes.c_char_p
+        lib.tpuinfo_source.restype = ctypes.c_char_p
         _lib = lib
         return lib
 
@@ -240,6 +241,14 @@ class TpuInfo:
             if n < 0:
                 raise TpuInfoError(self._last_error())
             return n
+
+    def source(self) -> str:
+        """Where the inventory came from: "sim", "pjrt" (runtime
+        introspection through the PJRT C API), or "table (<reason>)"
+        (liveness-only fallback)."""
+        with self._lock:
+            self._check_open()
+            return (self._lib.tpuinfo_source() or b"").decode()
 
     def chips(self) -> list[ChipInfo]:
         with self._lock:
