@@ -440,3 +440,204 @@ def test_reconcile_updates_gang_assignment(tmp_path):
         ext.release(victim_key)
         view2 = ext.state.node(victim.node_name)
         assert actual_id not in view2.used_ids
+
+
+# -- eviction executor -------------------------------------------------------
+
+def _vip_gang_pod(name: str, min_member: int = 4):
+    from tpukube.core.types import (
+        RESOURCE_TPU, ContainerInfo, PodGroup, PodInfo, ResourceList,
+    )
+
+    return PodInfo(
+        name=name, namespace="default", priority=100,
+        group=PodGroup("vip", min_member=min_member),
+        containers=[ContainerInfo("main", ResourceList({RESOURCE_TPU: 1}))],
+    )
+
+
+def test_eviction_executor_e2e_preemption():
+    """Decision -> effector, end to end on the apiserver channel: a
+    priority gang's first bind executes its preemption plan, victims land
+    on pending_evictions, and EvictionExecutor deletes them THROUGH the
+    (fake) apiserver — the real-cluster path; the sim's drain_evictions
+    is a thin wrapper over the same executor."""
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        api = apisrv.FakeApiServer()
+        for i in range(16):
+            pod = c.make_pod(f"s-{i}", tpu=1, priority=5)
+            c.schedule(pod)
+            api.upsert_pod(pod)
+        ext = c.extender
+        feasible, _ = ext.filter(_vip_gang_pod("vip-0"), c.node_objects())
+        ext.bind("vip-0", "default", "", feasible[0]["metadata"]["name"])
+        victims = list(ext.pending_evictions)
+        assert len(victims) == 4
+
+        execu = apisrv.EvictionExecutor(ext, api, poll_seconds=999)
+        assert execu.depth() == 4
+        assert execu.check_once() is True
+        assert not ext.pending_evictions
+        assert execu.evicted == 4
+        remaining = {
+            f"{p['metadata']['namespace']}/{p['metadata']['name']}"
+            for p in api.list_pods()
+        }
+        assert not remaining & set(victims), "victims must be gone"
+        assert len(remaining) == 12
+        assert execu.check_once() is False  # queue empty: idempotent
+
+
+def test_eviction_executor_requeues_blocked_and_failed():
+    """A PDB-blocked (429) or transiently-failing eviction is requeued and
+    retried next poll — never dropped: the ledger already freed the chips,
+    so losing the eviction would double-allocate."""
+    from collections import deque
+    from types import SimpleNamespace
+
+    api = apisrv.FakeApiServer()
+    for n in ("a", "b"):
+        api.upsert_pod({"metadata": {"name": n, "namespace": "default"}})
+    api.pdb_blocked.add("default/b")
+    ext = SimpleNamespace(pending_evictions=deque(["default/a", "default/b"]))
+
+    execu = apisrv.EvictionExecutor(ext, api, poll_seconds=999)
+    assert execu.drain() == ["default/a"]
+    assert list(ext.pending_evictions) == ["default/b"]
+    assert (execu.evicted, execu.blocked) == (1, 1)
+
+    api.pdb_blocked.clear()  # the PDB lifts: the retry lands
+    assert execu.drain() == ["default/b"]
+    assert not ext.pending_evictions
+    assert execu.evicted == 2
+
+    class DownApi:
+        def evict_pod(self, namespace, name):
+            raise apisrv.ApiServerError("apiserver unreachable")
+
+    ext.pending_evictions.append("default/c")
+    down = apisrv.EvictionExecutor(ext, DownApi(), poll_seconds=999)
+    assert down.drain() == []
+    assert list(ext.pending_evictions) == ["default/c"]
+    assert down.failures == 1
+
+
+def test_rest_eviction_subresource():
+    """RestApiServer.evict_pod POSTs the policy/v1 Eviction subresource
+    and maps the apiserver's verdicts: 2xx/404 -> True (gone), 429 -> False
+    (PDB says retry later), others raise. delete_pod DELETEs, tolerating
+    404."""
+    import http.server
+    from collections import deque as _dq
+
+    seen = []
+    post_codes = _dq([201, 429, 404, 500])
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def _reply(self, code, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n)) if n else None
+            seen.append(("POST", self.path, body))
+            self._reply(post_codes.popleft(), {})
+
+        def do_DELETE(self):
+            seen.append(("DELETE", self.path, None))
+            self._reply(404 if "gone" in self.path else 200, {})
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        api = apisrv.RestApiServer(
+            base_url=f"http://127.0.0.1:{httpd.server_address[1]}",
+            token="sekrit",
+        )
+        assert api.evict_pod("default", "p0") is True    # 201: evicted
+        assert api.evict_pod("default", "p0") is False   # 429: PDB
+        assert api.evict_pod("default", "p0") is True    # 404: already gone
+        with pytest.raises(apisrv.ApiServerError) as ei:  # 500: surfaced
+            api.evict_pod("default", "p0")
+        assert ei.value.code == 500
+        api.delete_pod("default", "p1")        # 200
+        api.delete_pod("default", "gone-p2")   # 404 tolerated
+    finally:
+        httpd.shutdown()
+
+    method, path, body = seen[0]
+    assert (method, path) == (
+        "POST", "/api/v1/namespaces/default/pods/p0/eviction"
+    )
+    assert body == {
+        "apiVersion": "policy/v1",
+        "kind": "Eviction",
+        "metadata": {"name": "p0", "namespace": "default"},
+    }
+    assert seen[4][:2] == ("DELETE", "/api/v1/namespaces/default/pods/p1")
+    assert seen[5][:2] == ("DELETE", "/api/v1/namespaces/default/pods/gone-p2")
+
+
+def test_eviction_executor_waits_for_graceful_termination():
+    """A 2xx on the Eviction subresource only STARTS graceful termination
+    — the pod keeps its devices until its containers stop. The executor
+    must keep tracking the key (without re-POSTing) and count it evicted
+    only once the pod object is actually gone."""
+    from collections import deque
+    from types import SimpleNamespace
+
+    class GracefulApi:
+        def __init__(self):
+            self.pods = {"default/a": {
+                "metadata": {"name": "a", "namespace": "default"}}}
+            self.evict_calls = 0
+
+        def evict_pod(self, namespace, name):
+            self.evict_calls += 1
+            pod = self.pods.get(f"{namespace}/{name}")
+            if pod is not None:  # the apiserver stamps deletionTimestamp
+                pod["metadata"]["deletionTimestamp"] = "2026-07-29T00:00:00Z"
+            return True  # accepted; pod still terminating
+
+        def get_pod(self, namespace, name):
+            return self.pods.get(f"{namespace}/{name}")
+
+    api = GracefulApi()
+    ext = SimpleNamespace(pending_evictions=deque(["default/a"]))
+    execu = apisrv.EvictionExecutor(ext, api, poll_seconds=999)
+    assert execu.drain() == []            # accepted, not yet gone
+    assert execu.evicted == 0
+    assert execu.depth() == 1             # still tracked (terminating)
+    assert not ext.pending_evictions      # but no eviction re-POST
+    assert execu.drain() == []            # grace period still running
+    assert api.evict_calls == 1
+    api.pods.clear()                      # termination completes
+    assert execu.drain() == ["default/a"]
+    assert execu.evicted == 1
+    assert execu.depth() == 0
+
+    # a controller recreating the same name (fresh object, no
+    # deletionTimestamp) must confirm too — the ORIGINAL victim is gone;
+    # waiting on the newcomer would track a phantom eviction forever
+    ext.pending_evictions.append("default/a")
+    api.pods["default/a"] = {
+        "metadata": {"name": "a", "namespace": "default"}}
+    execu2 = apisrv.EvictionExecutor(ext, api, poll_seconds=999)
+    execu2.drain()                         # accept: stamps the original
+    api.pods["default/a"] = {              # controller replaces it
+        "metadata": {"name": "a", "namespace": "default"}}
+    assert execu2.drain() == ["default/a"]
+    assert execu2.depth() == 0

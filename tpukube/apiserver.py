@@ -53,7 +53,13 @@ SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
 
 class ApiServerError(RuntimeError):
-    pass
+    """Apiserver request failure; ``code`` carries the HTTP status when the
+    server answered (None for transport errors), so callers can branch on
+    429 (PDB-blocked eviction) / 404 (already gone)."""
+
+    def __init__(self, message: str, code: Optional[int] = None) -> None:
+        super().__init__(message)
+        self.code = code
 
 
 def encode_alloc_actual(device_ids: list[str]) -> str:
@@ -80,6 +86,10 @@ class FakeApiServer:
         self._nodes: dict[str, dict[str, str]] = {}
         self._pods: dict[str, dict[str, Any]] = {}
         self.patch_log: list[tuple[str, str]] = []  # (kind, name) for tests
+        # pod keys whose eviction a PodDisruptionBudget would deny (the
+        # fake's stand-in for the real apiserver's 429): tests add keys
+        # here to exercise the executor's requeue path
+        self.pdb_blocked: set[str] = set()
 
     # -- nodes -------------------------------------------------------------
     def patch_node_annotations(
@@ -116,6 +126,22 @@ class FakeApiServer:
     def delete_pod(self, namespace: str, name: str) -> None:
         with self._lock:
             self._pods.pop(f"{namespace}/{name}", None)
+
+    def evict_pod(self, namespace: str, name: str) -> bool:
+        """Graceful eviction: True once the pod is gone (or already was),
+        False when a PodDisruptionBudget blocks it — the same contract
+        RestApiServer derives from 2xx/404 vs 429."""
+        key = f"{namespace}/{name}"
+        with self._lock:
+            if key in self.pdb_blocked:
+                return False
+            self._pods.pop(key, None)
+            self.patch_log.append(("evict", key))
+        return True
+
+    def get_pod(self, namespace: str, name: str) -> Optional[dict[str, Any]]:
+        with self._lock:
+            return self._pods.get(f"{namespace}/{name}")
 
     def patch_pod_annotations(
         self, namespace: str, name: str, annotations: dict[str, Optional[str]]
@@ -210,7 +236,8 @@ class RestApiServer:
                 payload = r.read()
         except urllib.error.HTTPError as e:
             raise ApiServerError(
-                f"{method} {path}: HTTP {e.code} {e.read()[:200]!r}"
+                f"{method} {path}: HTTP {e.code} {e.read()[:200]!r}",
+                code=e.code,
             ) from e
         except urllib.error.URLError as e:
             raise ApiServerError(f"{method} {path}: {e.reason}") from e
@@ -243,6 +270,54 @@ class RestApiServer:
             path += f"?fieldSelector=spec.nodeName%3D{node_name}"
         obj = self._request("GET", path)
         return list(obj.get("items", []) or [])
+
+    def get_pod(self, namespace: str, name: str) -> Optional[dict[str, Any]]:
+        """One pod object, or None when it does not exist (404)."""
+        try:
+            return self._request(
+                "GET", f"/api/v1/namespaces/{namespace}/pods/{name}"
+            )
+        except ApiServerError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        """Hard delete (no PDB check). The eviction executor uses
+        :meth:`evict_pod`; this exists for operator tooling parity with
+        FakeApiServer."""
+        try:
+            self._request(
+                "DELETE", f"/api/v1/namespaces/{namespace}/pods/{name}"
+            )
+        except ApiServerError as e:
+            if e.code != 404:  # already gone is success
+                raise
+
+    def evict_pod(self, namespace: str, name: str) -> bool:
+        """POST the policy/v1 Eviction subresource — the polite way to
+        delete a preemption victim, because it lets the apiserver enforce
+        PodDisruptionBudgets. Returns True once the pod is gone (2xx, or
+        404 = already deleted), False when a PDB blocks it right now
+        (HTTP 429: retry later, exactly what the executor's requeue does)."""
+        body = {
+            "apiVersion": "policy/v1",
+            "kind": "Eviction",
+            "metadata": {"name": name, "namespace": namespace},
+        }
+        try:
+            self._request(
+                "POST",
+                f"/api/v1/namespaces/{namespace}/pods/{name}/eviction",
+                body, content_type="application/json",
+            )
+        except ApiServerError as e:
+            if e.code == 429:
+                return False
+            if e.code == 404:
+                return True
+            raise
+        return True
 
 
 class _PollLoop:
@@ -434,3 +509,106 @@ class AllocReconcileLoop(_PollLoop):
             self.reconciled += 1
             did = True
         return did
+
+
+class EvictionExecutor(_PollLoop):
+    """The effector for the extender's eviction decisions.
+
+    Preemption and gang rollback leave victim pod keys on
+    ``extender.pending_evictions`` — the ledger already shows their chips
+    free, so a victim left running would double-allocate on first reuse.
+    This loop drains the queue through the apiserver channel's
+    ``evict_pod`` (the Eviction subresource on a real cluster). A
+    PDB-blocked or transiently-failing eviction is requeued and retried
+    every poll, forever: eviction is a correctness obligation, not
+    best-effort, so the only terminal states are "pod gone" and "operator
+    intervened". The sim harness's ``drain_evictions`` is a thin wrapper
+    over :meth:`drain`."""
+
+    def __init__(self, extender, api, poll_seconds: float = 1.0) -> None:
+        super().__init__(poll_seconds, "tpukube-evictions")
+        self._extender = extender
+        self._api = api
+        # eviction accepted by the apiserver but deletion not yet
+        # confirmed: a 2xx on the Eviction subresource only STARTS
+        # graceful termination; the pod keeps its devices until its
+        # containers actually stop, so "evicted" is only counted once the
+        # pod object is gone
+        self._terminating: set[str] = set()
+        self.evicted = 0   # pods confirmed gone (tests/metrics)
+        self.blocked = 0   # PDB 429s requeued (tests/metrics)
+        self.failures = 0  # transport/API errors requeued (tests/metrics)
+
+    def depth(self) -> int:
+        """Evictions not yet confirmed done: queued + terminating."""
+        return len(self._extender.pending_evictions) + len(self._terminating)
+
+    def check_once(self) -> bool:
+        """One poll; True if any pod was evicted."""
+        return bool(self.drain())
+
+    def drain(self) -> list[str]:
+        """Attempt every currently-queued eviction once; returns the pod
+        keys whose deletion is CONFIRMED (object absent from the
+        apiserver). Blocked/failed keys go back on the queue, accepted-
+        but-still-terminating keys wait in ``_terminating`` — a key only
+        leaves the executor as a confirmed deletion, never dropped."""
+        q = self._extender.pending_evictions
+        requeue: list[str] = []
+        try:
+            # bounded by the snapshot length: keys appended by other
+            # threads mid-drain, like requeued keys, wait for the next poll
+            for _ in range(len(q)):
+                try:
+                    pod_key = q.popleft()
+                except IndexError:  # racing consumer emptied it
+                    break
+                try:
+                    namespace, name = pod_key.split("/", 1)
+                    ok = self._api.evict_pod(namespace, name)
+                except Exception as e:
+                    # broad on purpose: ANY failure (transport timeout,
+                    # junk response body, ...) must requeue, not drop —
+                    # a lost key is a silent double-allocation
+                    log.warning("eviction of %s failed, requeued: %s",
+                                pod_key, e)
+                    self.failures += 1
+                    requeue.append(pod_key)
+                    continue
+                if ok:
+                    self._terminating.add(pod_key)
+                else:
+                    self.blocked += 1
+                    requeue.append(pod_key)
+                    log.warning("eviction of %s blocked by PDB, requeued",
+                                pod_key)
+        finally:
+            q.extend(requeue)
+        return self._confirm_terminated()
+
+    def _confirm_terminated(self) -> list[str]:
+        """Count a terminating pod as evicted once its object is gone —
+        one tiny GET per in-flight key, not a cluster-wide list. A
+        same-name pod WITHOUT a deletionTimestamp also confirms: the
+        apiserver stamps deletionTimestamp the moment it accepts an
+        eviction, so an unstamped pod is a controller's recreation (e.g.
+        a StatefulSet member) — the original is gone and the newcomer is
+        someone else's allocation, not our victim."""
+        done = []
+        for pod_key in sorted(self._terminating):
+            namespace, name = pod_key.split("/", 1)
+            try:
+                pod = self._api.get_pod(namespace, name)
+            except Exception as e:
+                log.warning("eviction confirm of %s failed, retrying: %s",
+                            pod_key, e)
+                continue
+            if pod is not None and (
+                (pod.get("metadata") or {}).get("deletionTimestamp")
+            ):
+                continue  # graceful termination still running
+            self._terminating.discard(pod_key)
+            self.evicted += 1
+            done.append(pod_key)
+            log.warning("evicted %s (extender preemption/rollback)", pod_key)
+        return done

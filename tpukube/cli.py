@@ -272,23 +272,27 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
     host = args.host or cfg.extender_host
     port = args.port if args.port is not None else cfg.extender_port
     extender = Extender(cfg)
-    reconcile = None
+    loops = []
     api = _make_apiserver(args)
     if api is not None:
-        from tpukube.apiserver import AllocReconcileLoop
+        from tpukube.apiserver import AllocReconcileLoop, EvictionExecutor
 
-        reconcile = AllocReconcileLoop(
+        loops.append(AllocReconcileLoop(
             extender, api, poll_seconds=cfg.health_poll_seconds
-        )
-        reconcile.start()
+        ))
+        # the effector for preemption/rollback decisions: without it a
+        # victim pod keeps running on chips the ledger shows free
+        loops.append(EvictionExecutor(extender, api))
+        for loop in loops:
+            loop.start()
     log.warning("extender serving on %s:%d (score_mode=%s)",
                 host, port, cfg.score_mode)
     try:
         web.run_app(make_app(extender), host=host, port=port,
                     print=None, handle_signals=True)
     finally:
-        if reconcile is not None:
-            reconcile.stop()
+        for loop in loops:
+            loop.stop()
     return 0
 
 

@@ -39,7 +39,26 @@ from tpukube.core.types import (
     TopologyCoord,
     canonical_link,
 )
+from tpukube.apiserver import EvictionExecutor
 from tpukube.sched.extender import Extender, make_app
+
+
+class _PodStoreApi:
+    """Adapter giving EvictionExecutor the apiserver ``evict_pod`` surface
+    over the harness's in-memory pod store (no PDBs in the sim)."""
+
+    def __init__(self, pods: dict[str, dict[str, Any]]) -> None:
+        self._pods = pods
+
+    def evict_pod(self, namespace: str, name: str) -> bool:
+        pod = self._pods.pop(f"{namespace}/{name}", None)
+        if pod is not None:
+            pod["metadata"].get("annotations", {}).pop(codec.ANNO_ALLOC, None)
+            pod["spec"].pop("nodeName", None)
+        return True
+
+    def get_pod(self, namespace: str, name: str) -> Optional[dict[str, Any]]:
+        return self._pods.get(f"{namespace}/{name}")
 
 
 def _free_port() -> int:
@@ -137,6 +156,9 @@ class SimCluster:
                 )
         self.extender = Extender(self.config)
         self.pods: dict[str, dict[str, Any]] = {}  # key -> pod object
+        self._evictions = EvictionExecutor(
+            self.extender, _PodStoreApi(self.pods)
+        )  # drained inline by schedule(); not started as a thread
         self._node_obj_cache: dict[str, dict[str, Any]] = {}
         self._port = _free_port()
         self._http: Optional[_AppThread] = None
@@ -273,17 +295,10 @@ class SimCluster:
     def drain_evictions(self) -> list[str]:
         """Delete pods the gang layer rolled back (all-or-nothing: a
         half-assembled gang's running members must not keep their chips).
-        On a real cluster an apiserver writer does this."""
-        evicted = []
-        q = self.extender.pending_evictions
-        while q:
-            pod_key = q.popleft()
-            pod = self.pods.pop(pod_key, None)
-            if pod is not None:
-                pod["metadata"].get("annotations", {}).pop(codec.ANNO_ALLOC, None)
-                pod["spec"].pop("nodeName", None)
-            evicted.append(pod_key)
-        return evicted
+        Thin wrapper over the same :class:`~tpukube.apiserver.
+        EvictionExecutor` a real cluster runs, pointed at this harness's
+        pod store instead of the REST channel."""
+        return self._evictions.drain()
 
     def schedule(
         self, pod: dict[str, Any], retries: int = 8
