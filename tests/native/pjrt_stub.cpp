@@ -1,0 +1,255 @@
+/* Stub PJRT plugin for testing libtpuinfo's real-backend enumeration
+ * (tpuinfo.cpp enumerate_pjrt) without TPU hardware.
+ *
+ * Exposes GetPjrtApi serving a configurable set of fake devices; pointing
+ * the real backend's `libtpu=<this .so>` at it executes the entire PJRT
+ * C-API enumeration path in CI. Behavior is driven by env vars READ AT
+ * PJRT_Client_Create / GetPjrtApi TIME (not dlopen time), so one loaded
+ * .so can play every scenario across tests in one process:
+ *
+ *   PJRT_STUB_DEVICES      total addressable devices (default 8)
+ *   PJRT_STUB_CORES        devices (cores) per chip coord (default 2)
+ *   PJRT_STUB_GRID_X       chip-grid x extent for coords minting (default 2)
+ *   PJRT_STUB_HBM          bytes_limit per device (default 16 GiB)
+ *   PJRT_STUB_KIND         device kind string (default "stubtpu")
+ *   PJRT_STUB_REMOTE       extra NON-addressable devices appended (default 0)
+ *   PJRT_STUB_NO_COORDS    omit the "coords" attribute entirely
+ *   PJRT_STUB_PARTIAL_COORDS  only even-id devices get a coords attribute
+ *   PJRT_STUB_WRAP         "x,y,z" torus wrap flags served as the "wrap"
+ *                          int64[3] attribute
+ *   PJRT_STUB_NO_MEMSTATS  null out PJRT_Device_MemoryStats (old plugin)
+ *   PJRT_STUB_OLD_STRUCT   report a struct_size predating Client_Create
+ *   PJRT_STUB_BAD_MAJOR    report an incompatible PJRT major version
+ *   PJRT_STUB_FAIL_CLIENT  PJRT_Client_Create returns an error (chip busy)
+ *   PJRT_STUB_FAIL_FILE    path: Client_Create fails WHILE this file
+ *                          exists — lets another process flip a running
+ *                          daemon's canary (env can't be changed from
+ *                          outside)
+ */
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "xla/pjrt/c/pjrt_c_api.h"
+
+namespace {
+
+struct StubError {
+  std::string msg;
+};
+
+/* PJRT_Device and PJRT_DeviceDescription are opaque to callers; both are
+ * cast to/from this. */
+struct StubDevice {
+  int id = 0;
+  bool addressable = true;
+  int64_t coords[3] = {0, 0, 0};
+  int64_t wrap[3] = {0, 0, 0};
+  std::string kind;
+  int64_t hbm = 0;
+  std::vector<PJRT_NamedValue> attrs;
+};
+
+std::vector<StubDevice> g_devices;
+std::vector<PJRT_Device*> g_device_ptrs;
+int g_client_token;  /* PJRT_Client* points here */
+PJRT_Api g_api;
+
+int64_t env_i64(const char* name, int64_t dflt) {
+  const char* v = std::getenv(name);
+  return (v && *v) ? std::strtoll(v, nullptr, 10) : dflt;
+}
+
+bool env_flag(const char* name) {
+  const char* v = std::getenv(name);
+  return v != nullptr && *v != '\0' && std::strcmp(v, "0") != 0;
+}
+
+PJRT_Error* make_error(const std::string& m) {
+  return reinterpret_cast<PJRT_Error*>(new StubError{m});
+}
+
+void build_devices() {
+  int n = (int)env_i64("PJRT_STUB_DEVICES", 8);
+  int cores = (int)env_i64("PJRT_STUB_CORES", 2);
+  if (cores <= 0) cores = 1;
+  int grid_x = (int)env_i64("PJRT_STUB_GRID_X", 2);
+  if (grid_x <= 0) grid_x = 1;
+  int remote = (int)env_i64("PJRT_STUB_REMOTE", 0);
+  int64_t hbm = env_i64("PJRT_STUB_HBM", 16LL << 30);
+  const char* kind = std::getenv("PJRT_STUB_KIND");
+  bool no_coords = env_flag("PJRT_STUB_NO_COORDS");
+  bool partial_coords = env_flag("PJRT_STUB_PARTIAL_COORDS");
+  int64_t wrap[3] = {0, 0, 0};
+  bool have_wrap = false;
+  if (const char* w = std::getenv("PJRT_STUB_WRAP")) {
+    long wx = 0, wy = 0, wz = 0;
+    if (std::sscanf(w, "%ld,%ld,%ld", &wx, &wy, &wz) == 3) {
+      wrap[0] = wx; wrap[1] = wy; wrap[2] = wz;
+      have_wrap = true;
+    }
+  }
+
+  g_devices.clear();
+  g_device_ptrs.clear();
+  g_devices.resize(n + remote);
+  for (int i = 0; i < n + remote; ++i) {
+    StubDevice& d = g_devices[i];
+    d.id = i;
+    d.addressable = i < n;
+    d.kind = (kind && *kind) ? kind : "stubtpu";
+    d.hbm = hbm;
+    int chip = i / cores;
+    d.coords[0] = chip % grid_x;
+    d.coords[1] = chip / grid_x;
+    d.coords[2] = 0;
+    d.wrap[0] = wrap[0];
+    d.wrap[1] = wrap[1];
+    d.wrap[2] = wrap[2];
+  }
+  /* attrs reference per-device storage: build only after g_devices is at
+   * its final size (no reallocation moves the pointed-to coords) */
+  for (auto& d : g_devices) {
+    d.attrs.clear();
+    PJRT_NamedValue pi;
+    std::memset(&pi, 0, sizeof pi);
+    pi.struct_size = PJRT_NamedValue_STRUCT_SIZE;
+    pi.name = "process_index";
+    pi.name_size = std::strlen(pi.name);
+    pi.type = PJRT_NamedValue_kInt64;
+    pi.int64_value = 0;
+    pi.value_size = 1;
+    d.attrs.push_back(pi);  /* a scalar attr enumerators must skip over */
+    if (!no_coords && !(partial_coords && d.id % 2 == 1)) {
+      PJRT_NamedValue nv;
+      std::memset(&nv, 0, sizeof nv);
+      nv.struct_size = PJRT_NamedValue_STRUCT_SIZE;
+      nv.name = "coords";
+      nv.name_size = std::strlen(nv.name);
+      nv.type = PJRT_NamedValue_kInt64List;
+      nv.int64_array_value = d.coords;
+      nv.value_size = 3;
+      d.attrs.push_back(nv);
+    }
+    if (have_wrap) {
+      PJRT_NamedValue nv;
+      std::memset(&nv, 0, sizeof nv);
+      nv.struct_size = PJRT_NamedValue_STRUCT_SIZE;
+      nv.name = "wrap";
+      nv.name_size = std::strlen(nv.name);
+      nv.type = PJRT_NamedValue_kInt64List;
+      nv.int64_array_value = d.wrap;
+      nv.value_size = 3;
+      d.attrs.push_back(nv);
+    }
+    g_device_ptrs.push_back(reinterpret_cast<PJRT_Device*>(&d));
+  }
+}
+
+void stub_error_destroy(PJRT_Error_Destroy_Args* a) {
+  delete reinterpret_cast<StubError*>(a->error);
+}
+
+void stub_error_message(PJRT_Error_Message_Args* a) {
+  const auto* e = reinterpret_cast<const StubError*>(a->error);
+  a->message = e->msg.c_str();
+  a->message_size = e->msg.size();
+}
+
+PJRT_Error* stub_plugin_initialize(PJRT_Plugin_Initialize_Args*) {
+  return nullptr;
+}
+
+PJRT_Error* stub_client_create(PJRT_Client_Create_Args* a) {
+  if (env_flag("PJRT_STUB_FAIL_CLIENT"))
+    return make_error("stub: device busy (owned by another process)");
+  if (const char* f = std::getenv("PJRT_STUB_FAIL_FILE")) {
+    FILE* fp = std::fopen(f, "r");
+    if (fp != nullptr) {
+      std::fclose(fp);
+      return make_error("stub: chip fault (fail-file present)");
+    }
+  }
+  build_devices();
+  a->client = reinterpret_cast<PJRT_Client*>(&g_client_token);
+  return nullptr;
+}
+
+PJRT_Error* stub_client_destroy(PJRT_Client_Destroy_Args*) { return nullptr; }
+
+PJRT_Error* stub_client_devices(PJRT_Client_Devices_Args* a) {
+  a->devices = g_device_ptrs.data();
+  a->num_devices = g_device_ptrs.size();
+  return nullptr;
+}
+
+PJRT_Error* stub_device_get_description(PJRT_Device_GetDescription_Args* a) {
+  a->device_description =
+      reinterpret_cast<PJRT_DeviceDescription*>(a->device);
+  return nullptr;
+}
+
+PJRT_Error* stub_device_is_addressable(PJRT_Device_IsAddressable_Args* a) {
+  a->is_addressable = reinterpret_cast<StubDevice*>(a->device)->addressable;
+  return nullptr;
+}
+
+PJRT_Error* stub_desc_id(PJRT_DeviceDescription_Id_Args* a) {
+  a->id = reinterpret_cast<StubDevice*>(a->device_description)->id;
+  return nullptr;
+}
+
+PJRT_Error* stub_desc_kind(PJRT_DeviceDescription_Kind_Args* a) {
+  const auto* d = reinterpret_cast<StubDevice*>(a->device_description);
+  a->device_kind = d->kind.c_str();
+  a->device_kind_size = d->kind.size();
+  return nullptr;
+}
+
+PJRT_Error* stub_desc_attributes(PJRT_DeviceDescription_Attributes_Args* a) {
+  const auto* d = reinterpret_cast<StubDevice*>(a->device_description);
+  a->attributes = d->attrs.data();
+  a->num_attributes = d->attrs.size();
+  return nullptr;
+}
+
+PJRT_Error* stub_device_memory_stats(PJRT_Device_MemoryStats_Args* a) {
+  const auto* d = reinterpret_cast<StubDevice*>(a->device);
+  a->bytes_in_use = 0;
+  a->bytes_limit = d->hbm;
+  a->bytes_limit_is_set = true;
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" const PJRT_Api* GetPjrtApi() {
+  std::memset(&g_api, 0, sizeof g_api);
+  g_api.struct_size = PJRT_Api_STRUCT_SIZE;
+  g_api.pjrt_api_version.struct_size = PJRT_Api_Version_STRUCT_SIZE;
+  g_api.pjrt_api_version.major_version =
+      env_flag("PJRT_STUB_BAD_MAJOR") ? PJRT_API_MAJOR + 1 : PJRT_API_MAJOR;
+  g_api.pjrt_api_version.minor_version = PJRT_API_MINOR;
+  g_api.PJRT_Error_Destroy = stub_error_destroy;
+  g_api.PJRT_Error_Message = stub_error_message;
+  g_api.PJRT_Plugin_Initialize = stub_plugin_initialize;
+  g_api.PJRT_Client_Create = stub_client_create;
+  g_api.PJRT_Client_Destroy = stub_client_destroy;
+  g_api.PJRT_Client_Devices = stub_client_devices;
+  g_api.PJRT_Device_GetDescription = stub_device_get_description;
+  g_api.PJRT_Device_IsAddressable = stub_device_is_addressable;
+  g_api.PJRT_DeviceDescription_Id = stub_desc_id;
+  g_api.PJRT_DeviceDescription_Kind = stub_desc_kind;
+  g_api.PJRT_DeviceDescription_Attributes = stub_desc_attributes;
+  if (!env_flag("PJRT_STUB_NO_MEMSTATS"))
+    g_api.PJRT_Device_MemoryStats = stub_device_memory_stats;
+  if (env_flag("PJRT_STUB_OLD_STRUCT")) {
+    /* a plugin built against an ancient header: its PJRT_Api ends before
+     * the entry points the enumerator requires */
+    g_api.struct_size = offsetof(PJRT_Api, PJRT_Client_Create);
+  }
+  return &g_api;
+}

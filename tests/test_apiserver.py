@@ -641,3 +641,45 @@ def test_eviction_executor_waits_for_graceful_termination():
         "metadata": {"name": "a", "namespace": "default"}}
     assert execu2.drain() == ["default/a"]
     assert execu2.depth() == 0
+
+
+def test_ambiguous_intents_defer_to_local_choice(tmp_path):
+    """Two identical pending pods (VERDICT round-2 weak #4): the
+    preference query carries no pod identity, so steering would be a coin
+    flip onto the other pod's plan. preferred() must refuse (local
+    heuristic answers), and a non-plan Allocate must not be attributed to
+    either plan — zero manufactured divergences."""
+    from tpukube.device import TpuDeviceManager
+    from tpukube.plugin import DevicePluginServer, FakeKubelet
+
+    cfg = _node_cfg(tmp_path, dims="2,2,1")
+    with TpuDeviceManager(cfg, host="host-0-0-0") as device, \
+            DevicePluginServer(cfg, device) as server, \
+            FakeKubelet(str(tmp_path)) as kubelet:
+        server.register_with_kubelet()
+        devs = sorted(kubelet.wait_for_devices(server.resource_name, 4))
+        baseline = kubelet.preferred(server.resource_name, devs, 2)
+
+        server.intents.sync({
+            "default/a": ["tpu-0", "tpu-1"],
+            "default/b": ["tpu-2", "tpu-3"],
+        })
+        # kubelet asks twice: both times the ambiguous plans defer to the
+        # local heuristic instead of handing out pod A's plan
+        for _ in range(2):
+            got = kubelet.preferred(server.resource_name, devs, 2)
+            assert sorted(got) == sorted(baseline)
+
+        # kubelet allocates something that is NEITHER plan: consume must
+        # refuse attribution (no divergence report, both plans pending)
+        kubelet.allocate(server.resource_name, ["tpu-1", "tpu-2"])
+        assert server.divergences == 0
+        assert server.intents.depth() == 2
+
+        # once one plan is satisfied exactly, the remaining single plan
+        # steers again — ambiguity was the only blocker
+        kubelet.allocate(server.resource_name, ["tpu-0", "tpu-1"])
+        assert server.intents.depth() == 1
+        steered = kubelet.preferred(server.resource_name, devs, 2)
+        assert sorted(steered) == ["tpu-2", "tpu-3"]
+        assert server.divergences == 0

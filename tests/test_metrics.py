@@ -59,3 +59,92 @@ def test_plugin_metrics_server(tmp_path):
                 assert e.code == 404
         finally:
             ms.stop()
+
+
+def test_plugin_metrics_export_round2_loops(tmp_path):
+    """VERDICT round-2 task 4: the loop counters operators alarm on —
+    inventory source, intent depth, divergences, health transitions,
+    kubelet re-registrations — all appear on /metrics."""
+    from types import SimpleNamespace
+
+    cfg = load_config(env={
+        "TPUKUBE_DEVICE_PLUGIN_DIR": str(tmp_path),
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with TpuDeviceManager(cfg) as device, \
+            DevicePluginServer(cfg, device) as server:
+        server.intents.put("default/p0", ["tpu-0"])
+        server.divergences = 3
+        health = SimpleNamespace(transitions=2)
+        kubelet_watch = SimpleNamespace(reregistrations=1)
+        text = render_plugin_metrics(
+            server, health=health, kubelet_watch=kubelet_watch
+        )
+        assert 'tpukube_plugin_inventory_source{source="sim"} 1' in text
+        assert "tpukube_plugin_intent_depth 1" in text
+        assert "tpukube_plugin_divergences_total 3" in text
+        assert "tpukube_plugin_health_transitions_total 2" in text
+        assert "tpukube_plugin_reregistrations_total 1" in text
+
+
+def test_extender_metrics_export_reconcile_and_evictions():
+    """The extender's /metrics tells the divergence/reconcile/eviction
+    story end to end when the daemon loops are attached."""
+    import json as _json
+
+    from tpukube.apiserver import (
+        AllocReconcileLoop, EvictionExecutor, FakeApiServer,
+    )
+    from tpukube.sched.extender import make_app
+    from tpukube.sim.harness import _AppThread, _free_port
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        api = FakeApiServer()
+        reconcile = AllocReconcileLoop(c.extender, api, poll_seconds=999)
+        evictions = EvictionExecutor(c.extender, api, poll_seconds=999)
+        reconcile.reconciled = 5
+        evictions.evicted, evictions.blocked, evictions.failures = 7, 1, 2
+        c.extender.pending_evictions.append("default/x")
+
+        port = _free_port()
+        app = _AppThread(
+            make_app(c.extender, reconcile=reconcile, evictions=evictions),
+            "127.0.0.1", port,
+        )
+        app.start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ) as r:
+                text = r.read().decode()
+        finally:
+            app.stop()
+        assert "tpukube_evictions_pending 1" in text
+        assert "tpukube_evictions_total 7" in text
+        assert "tpukube_evictions_blocked_total 1" in text
+        assert "tpukube_eviction_failures_total 2" in text
+        assert "tpukube_reconciles_total 5" in text
+        c.extender.pending_evictions.clear()
+
+
+def test_syncer_metrics_render():
+    from types import SimpleNamespace
+
+    from tpukube.metrics import render_syncer_metrics
+
+    text = render_syncer_metrics(SimpleNamespace(syncs=4))
+    assert "tpukube_syncer_syncs_total 4" in text
+
+
+def test_label_values_escaped():
+    """Arbitrary runtime text in label values (inventory_source carries
+    PJRT error strings) must not corrupt the exposition format."""
+    from tpukube.metrics import _fmt
+
+    line = _fmt("m", 1, {"source": 'table (err "quoted"\nline\\x)'})
+    assert line == 'm{source="table (err \\"quoted\\"\\nline\\\\x)"} 1\n'

@@ -1,0 +1,308 @@
+"""Executes the real backend's PJRT enumeration path (tpuinfo.cpp
+enumerate_pjrt) against a stub PJRT plugin — no TPU hardware required.
+
+The stub (tests/native/pjrt_stub.cpp) is compiled here and handed to the
+real backend as its ``libtpu=``; every scenario the enumeration must
+survive — happy path, non-addressable peers, missing coords, absent
+MemoryStats, too-old plugin struct, major-version skew, busy chip — is an
+env knob on the stub. This is the test the PJRT code path runs under in
+CI every round (previously it had never executed anywhere)."""
+
+import glob
+import os
+import subprocess
+
+import pytest
+
+from tpukube.native import TpuInfo
+from tpukube.native.tpuinfo import TpuInfoError
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+STUB_SRC = os.path.join(HERE, "native", "pjrt_stub.cpp")
+
+_STUB_KNOBS = [
+    "PJRT_STUB_DEVICES", "PJRT_STUB_CORES", "PJRT_STUB_GRID_X",
+    "PJRT_STUB_HBM", "PJRT_STUB_KIND", "PJRT_STUB_REMOTE",
+    "PJRT_STUB_NO_COORDS", "PJRT_STUB_NO_MEMSTATS", "PJRT_STUB_OLD_STRUCT",
+    "PJRT_STUB_BAD_MAJOR", "PJRT_STUB_FAIL_CLIENT", "PJRT_STUB_PARTIAL_COORDS",
+    "PJRT_STUB_WRAP",
+]
+
+
+def _pjrt_include() -> str | None:
+    for pat in (
+        "/opt/venv/lib/python*/site-packages/tensorflow/include",
+        "/usr/lib/python*/site-packages/tensorflow/include",
+    ):
+        hits = glob.glob(pat)
+        if hits:
+            return hits[0]
+    return None
+
+
+@pytest.fixture(scope="session")
+def stub_so(tmp_path_factory):
+    inc = _pjrt_include()
+    if inc is None:
+        pytest.skip("no PJRT C API header on this machine")
+    out = tmp_path_factory.mktemp("pjrt_stub") / "libpjrtstub.so"
+    subprocess.run(
+        ["g++", "-O1", "-Wall", "-Werror", "-fPIC", "-shared", "-std=c++17",
+         f"-I{inc}", "-o", str(out), STUB_SRC],
+        check=True, capture_output=True, text=True,
+    )
+    return str(out)
+
+
+@pytest.fixture(autouse=True)
+def clean_stub_env(monkeypatch):
+    for k in _STUB_KNOBS:
+        monkeypatch.delenv(k, raising=False)
+    yield
+
+
+def test_pjrt_enumeration_happy_path(stub_so, monkeypatch):
+    """8 cores / 2 per chip -> 4 chips on a 2x2 grid, ids <kind>-<min id>,
+    HBM from MemoryStats, and source()=="pjrt" (runtime introspection, not
+    the table fallback)."""
+    monkeypatch.setenv("PJRT_STUB_HBM", str(20 << 30))
+    with TpuInfo("real", f"libtpu={stub_so}") as ti:
+        assert ti.source() == "pjrt"
+        chips = ti.chips()
+        assert len(chips) == 4
+        # chips are coord-sorted (x,y,z lexicographic); device ids 0+1
+        # share chip (0,0,0), 2+3 share (1,0,0), ...
+        assert [c.chip_id for c in chips] == [
+            "stubtpu-0", "stubtpu-4", "stubtpu-2", "stubtpu-6",
+        ]
+        assert [(c.coord.x, c.coord.y, c.coord.z) for c in chips] == [
+            (0, 0, 0), (0, 1, 0), (1, 0, 0), (1, 1, 0),
+        ]
+        assert all(c.num_cores == 2 for c in chips)
+        assert all(c.hbm_bytes == 20 << 30 for c in chips)
+        mesh = ti.mesh()
+        assert mesh.dims == (2, 2, 1)
+        assert mesh.host_block == (2, 2, 1)
+
+
+def test_pjrt_skips_non_addressable_devices(stub_so, monkeypatch):
+    """Another host's devices (non-addressable) are not this node's
+    inventory."""
+    monkeypatch.setenv("PJRT_STUB_DEVICES", "4")
+    monkeypatch.setenv("PJRT_STUB_REMOTE", "4")
+    with TpuInfo("real", f"libtpu={stub_so}") as ti:
+        assert ti.source() == "pjrt"
+        assert ti.chip_count() == 2  # 4 local cores / 2 per chip
+
+
+def test_pjrt_missing_coords_mints_fallback_ids(stub_so, monkeypatch):
+    """A plugin without the coords attribute still enumerates: each device
+    gets a distinct synthetic (i,0,0) coord."""
+    monkeypatch.setenv("PJRT_STUB_DEVICES", "3")
+    monkeypatch.setenv("PJRT_STUB_CORES", "1")
+    monkeypatch.setenv("PJRT_STUB_NO_COORDS", "1")
+    with TpuInfo("real", f"libtpu={stub_so}") as ti:
+        assert ti.source() == "pjrt"
+        chips = ti.chips()
+        assert len(chips) == 3
+        assert [(c.coord.x, c.coord.y, c.coord.z) for c in chips] == [
+            (0, 0, 0), (1, 0, 0), (2, 0, 0),
+        ]
+        assert ti.mesh().dims == (3, 1, 1)
+
+
+def test_pjrt_absent_memstats_uses_gen_table_hbm(stub_so, monkeypatch):
+    """An old plugin without PJRT_Device_MemoryStats still enumerates via
+    PJRT; HBM comes from the generation table (gen=v4 -> 32 GiB)."""
+    monkeypatch.setenv("PJRT_STUB_NO_MEMSTATS", "1")
+    with TpuInfo("real", f"libtpu={stub_so}\ngen=v4") as ti:
+        assert ti.source() == "pjrt"
+        assert all(c.hbm_bytes == 32 << 30 for c in ti.chips())
+
+
+def test_pjrt_old_struct_falls_back_to_table(stub_so, monkeypatch):
+    """A plugin whose PJRT_Api predates the required entry points is
+    rejected cleanly: table fallback, with the reason in source()."""
+    monkeypatch.setenv("PJRT_STUB_OLD_STRUCT", "1")
+    with TpuInfo("real", f"libtpu={stub_so}\ngen=v5e\nchips=2") as ti:
+        assert ti.source().startswith("table (")
+        assert "too old" in ti.source()
+        chips = ti.chips()
+        assert len(chips) == 2
+        assert chips[0].chip_id.startswith("local-v5e-")
+        assert chips[0].hbm_bytes == 16 << 30
+
+
+def test_pjrt_major_version_skew_falls_back(stub_so, monkeypatch):
+    monkeypatch.setenv("PJRT_STUB_BAD_MAJOR", "1")
+    with TpuInfo("real", f"libtpu={stub_so}") as ti:
+        assert ti.source().startswith("table (")
+        assert "major version" in ti.source()
+
+
+def test_pjrt_busy_chip_falls_back(stub_so, monkeypatch):
+    """Client_Create failing (chip owned by another process — this
+    machine's actual situation with the tunnel) degrades to the table."""
+    monkeypatch.setenv("PJRT_STUB_FAIL_CLIENT", "1")
+    with TpuInfo("real", f"libtpu={stub_so}") as ti:
+        assert ti.source().startswith("table (Client_Create:")
+        assert "busy" in ti.source()
+
+
+def test_pjrt_device_manager_over_stub(stub_so, monkeypatch):
+    """The full device-manager path over PJRT enumeration: discovery,
+    device minting, and node_info all ride the runtime-reported chips."""
+    from tpukube.core.config import load_config
+    from tpukube.device import TpuDeviceManager
+
+    cfg = load_config(env={
+        "TPUKUBE_BACKEND": "real",
+        "TPUKUBE_LIBTPU_PATH": stub_so,
+    })
+    with TpuDeviceManager(cfg, host="host-0-0-0") as dm:
+        info = dm.node_info()
+        assert len(info.chips) == 4
+        assert {c.chip_id for c in info.chips} == {
+            "stubtpu-0", "stubtpu-2", "stubtpu-4", "stubtpu-6",
+        }
+        ids = [d for d, _ in dm.device_list()]
+        assert ids == ["tpu-0", "tpu-1", "tpu-2", "tpu-3"]
+
+
+def test_real_backend_missing_libtpu_still_errors(tmp_path):
+    """The liveness gate is untouched: a bogus libtpu path fails init."""
+    bogus = tmp_path / "not_a_lib.so"
+    bogus.write_bytes(b"\x7fELF-not-really")
+    with pytest.raises(TpuInfoError, match="cannot load libtpu"):
+        TpuInfo("real", f"libtpu={bogus}")
+
+
+def test_pjrt_wrap_attribute_sets_torus(stub_so, monkeypatch):
+    """When the runtime exposes per-axis wrap flags (the "wrap" int64[3]
+    attribute), the mesh reports a real torus instead of the bounding-box
+    default."""
+    monkeypatch.setenv("PJRT_STUB_WRAP", "1,1,0")
+    with TpuInfo("real", f"libtpu={stub_so}") as ti:
+        assert ti.source() == "pjrt"
+        assert ti.mesh().torus == (True, True, False)
+
+
+def test_pjrt_partial_coords_rejected_to_table(stub_so, monkeypatch):
+    """A plugin reporting coords for only SOME devices would let synthetic
+    fallback coords collide with real ones (corrupting core counts/ids):
+    enumeration must reject and fall back to the honest table."""
+    monkeypatch.setenv("PJRT_STUB_PARTIAL_COORDS", "1")
+    with TpuInfo("real", f"libtpu={stub_so}\nchips=1") as ti:
+        assert ti.source().startswith("table (")
+        assert "collide" in ti.source()
+
+
+def test_real_torus_config_override(stub_so, monkeypatch):
+    """Operator-configured torus flags apply to real nodes when the
+    runtime reported none; a runtime-reported wrap always wins."""
+    from tpukube.core.config import load_config
+    from tpukube.device import TpuDeviceManager
+
+    cfg = load_config(env={
+        "TPUKUBE_BACKEND": "real",
+        "TPUKUBE_LIBTPU_PATH": stub_so,
+        "TPUKUBE_REAL_TORUS": "1,1,0",
+    })
+    with TpuDeviceManager(cfg, host="host-0-0-0") as dm:
+        assert dm.mesh.torus == (True, True, False)
+
+    monkeypatch.setenv("PJRT_STUB_WRAP", "0,0,1")  # runtime knows better
+    with TpuDeviceManager(cfg, host="host-0-0-0") as dm:
+        assert dm.mesh.torus == (False, False, True)
+
+
+# -- health canary (SURVEY §4.4 real-mode, previously unreachable) ----------
+
+def test_probe_client_mode_flips_health(stub_so, monkeypatch):
+    """probe=client: a failing canary enumeration marks every chip
+    Unhealthy; a passing one restores them."""
+    with TpuInfo("real", f"libtpu={stub_so}\nprobe=client") as ti:
+        assert ti.source() == "pjrt"
+        assert ti.probe() is True
+        assert all(c.health.value == "Healthy" for c in ti.chips())
+
+        monkeypatch.setenv("PJRT_STUB_FAIL_CLIENT", "1")
+        assert ti.probe() is False
+        assert all(c.health.value == "Unhealthy" for c in ti.chips())
+
+        monkeypatch.delenv("PJRT_STUB_FAIL_CLIENT")
+        assert ti.probe() is True
+        assert all(c.health.value == "Healthy" for c in ti.chips())
+
+
+def test_probe_default_liveness_no_false_alarm(stub_so, monkeypatch):
+    """The DEFAULT probe is liveness (libtpu loadable): a busy chip —
+    client create failing while a workload holds it — must NOT flip
+    health (the single-owner false-alarm the client mode documents)."""
+    with TpuInfo("real", f"libtpu={stub_so}") as ti:
+        assert ti.source() == "pjrt"
+        monkeypatch.setenv("PJRT_STUB_FAIL_CLIENT", "1")  # workload arrived
+        assert ti.probe() is True
+        assert all(c.health.value == "Healthy" for c in ti.chips())
+
+
+def test_probe_failure_shrinks_allocatable_via_listandwatch(
+    stub_so, tmp_path, monkeypatch
+):
+    """VERDICT round-2 task 3's 'done' bar: a failing probe on a
+    real-backend plugin server shrinks the kubelet's allocatable through
+    the live ListAndWatch stream — SURVEY §4.4 end to end without
+    hardware."""
+    from tpukube.core.config import load_config
+    from tpukube.device import TpuDeviceManager
+    from tpukube.plugin import DevicePluginServer, FakeKubelet
+    from tpukube.plugin.server import HealthWatcher
+
+    cfg = load_config(env={
+        "TPUKUBE_BACKEND": "real",
+        "TPUKUBE_LIBTPU_PATH": stub_so,
+        "TPUKUBE_PROBE_MODE": "client",
+        "TPUKUBE_DEVICE_PLUGIN_DIR": str(tmp_path),
+    })
+    with TpuDeviceManager(cfg, host="host-0-0-0") as dm, \
+            DevicePluginServer(cfg, dm) as server, \
+            FakeKubelet(str(tmp_path)) as kubelet:
+        server.register_with_kubelet()
+        devs = kubelet.wait_for_devices(server.resource_name, 4)
+        assert len(devs) == 4
+
+        watcher = HealthWatcher(dm, server, poll_seconds=999)
+        watcher._last = dm.health_snapshot()  # what start() does
+        assert watcher.check_once() is False  # healthy, no transition
+
+        monkeypatch.setenv("PJRT_STUB_FAIL_CLIENT", "1")  # chip dies
+        assert watcher.check_once() is True
+        for d in devs:
+            kubelet.wait_for_health(server.resource_name, d, "Unhealthy")
+        assert watcher.transitions == 1
+
+        monkeypatch.delenv("PJRT_STUB_FAIL_CLIENT")  # chip recovers
+        assert watcher.check_once() is True
+        for d in devs:
+            kubelet.wait_for_health(server.resource_name, d, "Healthy")
+
+
+def test_node_info_carries_inventory_source(stub_so):
+    """The annotation channel surfaces WHERE the inventory came from, so
+    operators can spot table-fallback nodes cluster-wide."""
+    from tpukube.core import codec
+    from tpukube.core.config import load_config
+    from tpukube.device import TpuDeviceManager
+
+    cfg = load_config(env={
+        "TPUKUBE_BACKEND": "real",
+        "TPUKUBE_LIBTPU_PATH": stub_so,
+    })
+    with TpuDeviceManager(cfg, host="host-0-0-0") as dm:
+        info = dm.node_info()
+        assert info.source == "pjrt"
+        anno = codec.annotate_node(info, dm.mesh)
+        decoded, _ = codec.decode_node_topology(
+            anno[codec.ANNO_NODE_TOPOLOGY]
+        )
+        assert decoded.source == "pjrt"

@@ -144,8 +144,12 @@ def main_plugin(argv: Optional[list[str]] = None) -> int:
         kubelet_watch = None
         if not args.no_register:
             kubelet_watch = KubeletSessionWatcher(server)
-        metrics = MetricsServer(lambda: render_plugin_metrics(server),
-                                port=args.metrics_port)
+        metrics = MetricsServer(
+            lambda: render_plugin_metrics(
+                server, health=watcher, kubelet_watch=kubelet_watch
+            ),
+            port=args.metrics_port,
+        )
         metrics.start()
 
         # the reference's "write NodeInfo annotation to apiserver" step
@@ -228,6 +232,8 @@ def main_syncer(argv: Optional[list[str]] = None) -> int:
                    help="file poll interval seconds")
     p.add_argument("--once", action="store_true",
                    help="apply once and exit (init-container mode)")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="serve /metrics on this port (0 = disabled)")
     _add_kube_api_args(p)
     args = p.parse_args(argv)
     _setup(args)
@@ -247,10 +253,19 @@ def main_syncer(argv: Optional[list[str]] = None) -> int:
         return 0 if syncer.check_once() else 1
     stop = _install_stop_handlers()
     syncer.start()
+    metrics = None
+    if args.metrics_port:
+        from tpukube.metrics import MetricsServer, render_syncer_metrics
+
+        metrics = MetricsServer(lambda: render_syncer_metrics(syncer),
+                                port=args.metrics_port)
+        metrics.start()
     log.warning("syncing %s -> node %s", args.annotation_file, node)
     try:
         stop.wait()
     finally:
+        if metrics is not None:
+            metrics.stop()
         syncer.stop()
     return 0
 
@@ -273,22 +288,26 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
     port = args.port if args.port is not None else cfg.extender_port
     extender = Extender(cfg)
     loops = []
+    reconcile = evictions = None
     api = _make_apiserver(args)
     if api is not None:
         from tpukube.apiserver import AllocReconcileLoop, EvictionExecutor
 
-        loops.append(AllocReconcileLoop(
+        reconcile = AllocReconcileLoop(
             extender, api, poll_seconds=cfg.health_poll_seconds
-        ))
+        )
         # the effector for preemption/rollback decisions: without it a
         # victim pod keeps running on chips the ledger shows free
-        loops.append(EvictionExecutor(extender, api))
+        evictions = EvictionExecutor(extender, api)
+        loops = [reconcile, evictions]
         for loop in loops:
             loop.start()
     log.warning("extender serving on %s:%d (score_mode=%s)",
                 host, port, cfg.score_mode)
     try:
-        web.run_app(make_app(extender), host=host, port=port,
+        web.run_app(make_app(extender, reconcile=reconcile,
+                             evictions=evictions),
+                    host=host, port=port,
                     print=None, handle_signals=True)
     finally:
         for loop in loops:

@@ -82,6 +82,7 @@ def encode_node_topology(node: NodeInfo, mesh: MeshSpec) -> str:
             "badLinks": [
                 [a.as_list(), b.as_list()] for a, b in node.bad_links
             ],
+            **({"source": node.source} if node.source else {}),
         },
         separators=(",", ":"),
     )
@@ -153,6 +154,7 @@ def decode_node_topology(payload: str) -> tuple[NodeInfo, MeshSpec]:
         shares_per_chip=shares,
         bad_links=bad_links,
         slice_id=slice_id,
+        source=str(obj.get("source", "")),
     )
     return node, mesh
 

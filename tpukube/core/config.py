@@ -53,6 +53,17 @@ class TpuKubeConfig:
 
     # sim topology (used when backend == "sim")
     backend: str = "sim"  # sim | real
+    # explicit libtpu.so path for the real backend (Cloud TPU images ship
+    # it off the loader path); empty = autodiscover (loader path, then the
+    # libtpu Python package)
+    libtpu_path: str = ""
+    # real-backend health canary: "" = native default (liveness), or
+    # client|liveness|off — see native/tpuinfo.h tpuinfo_probe
+    probe_mode: str = ""
+    # per-axis torus wrap for real nodes when the runtime doesn't report
+    # the "wrap" attribute (PJRT exposes only a bounding box); a
+    # runtime-reported wrap always wins over this
+    real_torus: tuple[bool, bool, bool] = (False, False, False)
     sim_mesh_dims: tuple[int, int, int] = (4, 4, 4)
     sim_host_block: tuple[int, int, int] = (2, 2, 1)
     sim_torus: tuple[bool, bool, bool] = (False, False, False)
@@ -77,7 +88,7 @@ class TpuKubeConfig:
         return os.path.join(self.device_plugin_dir, self.kubelet_socket)
 
 
-_TUPLE_FIELDS = {"sim_mesh_dims", "sim_host_block", "sim_torus"}
+_TUPLE_FIELDS = {"sim_mesh_dims", "sim_host_block", "sim_torus", "real_torus"}
 
 
 def _coerce(name: str, raw, current):
@@ -135,6 +146,8 @@ def load_config(
         raise ValueError(f"unknown score_mode {cfg.score_mode!r}")
     if cfg.backend not in ("sim", "real"):
         raise ValueError(f"unknown backend {cfg.backend!r}")
+    if cfg.probe_mode not in ("", "client", "liveness", "off"):
+        raise ValueError(f"unknown probe_mode {cfg.probe_mode!r}")
     if cfg.sim_host_origin:
         parts = cfg.sim_host_origin.split(",")
         if len(parts) != 3 or not all(p.strip().lstrip("-").isdigit() for p in parts):

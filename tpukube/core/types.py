@@ -180,6 +180,10 @@ class NodeInfo:
     # within one slice, so every coord the scheduler touches is implicitly
     # (slice_id, coord). Gangs are ICI-contiguous and thus slice-confined.
     slice_id: str = DEFAULT_SLICE
+    # Where the chip inventory came from ("sim", "pjrt", "table (<why>)");
+    # surfaced in the node annotation so operators can spot nodes running
+    # on the static generation table instead of runtime introspection.
+    source: str = ""
 
     def healthy_chips(self) -> list[ChipInfo]:
         return [c for c in self.chips if c.health is Health.HEALTHY]

@@ -94,9 +94,26 @@ class TpuDeviceManager:
             )
             self._ti = TpuInfo("sim", spec)
         else:
-            spec = f"libtpu={libtpu_path}\n" if libtpu_path else None
-            self._ti = TpuInfo("real", spec)
+            libtpu_path = libtpu_path or config.libtpu_path
+            spec = ""
+            if libtpu_path:
+                spec += f"libtpu={libtpu_path}\n"
+            if config.probe_mode:
+                spec += f"probe={config.probe_mode}\n"
+            self._ti = TpuInfo("real", spec or None)
         self._mesh = self._ti.mesh()
+        if (
+            config.backend == "real"
+            and any(config.real_torus)
+            and not any(self._mesh.torus)
+        ):
+            # the runtime reported no wrap flags (bounding-box mesh);
+            # operator config supplies the real geometry
+            self._mesh = MeshSpec(
+                dims=self._mesh.dims,
+                host_block=self._mesh.host_block,
+                torus=config.real_torus,
+            )
 
     # -- lifecycle ---------------------------------------------------------
     def close(self) -> None:
@@ -143,7 +160,17 @@ class TpuDeviceManager:
             shares_per_chip=self._config.shares_per_chip,
             bad_links=bad_links,
             slice_id=self._config.slice_id,
+            source=self._ti.source(),
         )
+
+    def inventory_source(self) -> str:
+        """Where the inventory came from: "sim", "pjrt", or "table (...)"."""
+        return self._ti.source()
+
+    def probe(self) -> bool:
+        """Run the backend's health canary (no-op True on sim); chips()
+        and health_snapshot() reflect the outcome."""
+        return self._ti.probe()
 
     def shares_of(self, chip: ChipInfo) -> list[VtpuShare]:
         n = self._config.shares_per_chip

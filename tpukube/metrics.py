@@ -33,15 +33,27 @@ def quantile(values: Iterable[float], q: float) -> float:
     return vs[idx]
 
 
+def _esc(value: str) -> str:
+    """Prometheus text-format label-value escaping. Label values here can
+    carry arbitrary runtime text (e.g. inventory_source embeds PJRT error
+    messages); an unescaped quote or newline would corrupt the whole
+    scrape — on exactly the degraded nodes the metric exists to flag."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt(name: str, value: float, labels: Optional[dict[str, str]] = None) -> str:
     if labels:
-        inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        inner = ",".join(f'{k}="{_esc(v)}"' for k, v in sorted(labels.items()))
         return f"{name}{{{inner}}} {value:.6g}\n"
     return f"{name} {value:.6g}\n"
 
 
-def render_extender_metrics(extender) -> str:
-    """Prometheus text for an Extender (tpukube.sched.extender)."""
+def render_extender_metrics(extender, reconcile=None, evictions=None) -> str:
+    """Prometheus text for an Extender (tpukube.sched.extender); pass the
+    daemon's AllocReconcileLoop / EvictionExecutor to export their
+    counters (the divergence/reconcile/eviction story operators alarm
+    on)."""
     out: list[str] = []
     out.append("# TYPE tpu_chip_utilization_percent gauge\n")
     out.append(_fmt("tpu_chip_utilization_percent",
@@ -75,11 +87,31 @@ def render_extender_metrics(extender) -> str:
             out.append(_fmt("tpukube_webhook_latency_seconds",
                             quantile(vs, q),
                             {"handler": handler, "quantile": str(q)}))
+
+    out.append("# TYPE tpukube_evictions_pending gauge\n")
+    if evictions is not None:
+        out.append(_fmt("tpukube_evictions_pending", evictions.depth()))
+        out.append("# TYPE tpukube_evictions_total counter\n")
+        out.append(_fmt("tpukube_evictions_total", evictions.evicted))
+        out.append("# TYPE tpukube_evictions_blocked_total counter\n")
+        out.append(_fmt("tpukube_evictions_blocked_total", evictions.blocked))
+        out.append("# TYPE tpukube_eviction_failures_total counter\n")
+        out.append(_fmt("tpukube_eviction_failures_total", evictions.failures))
+    else:
+        # no executor (sim/dev): the queue depth is still the operator's
+        # double-allocation early-warning
+        out.append(_fmt("tpukube_evictions_pending",
+                        len(extender.pending_evictions)))
+    if reconcile is not None:
+        out.append("# TYPE tpukube_reconciles_total counter\n")
+        out.append(_fmt("tpukube_reconciles_total", reconcile.reconciled))
     return "".join(out)
 
 
-def render_plugin_metrics(server) -> str:
-    """Prometheus text for a DevicePluginServer (tpukube.plugin.server)."""
+def render_plugin_metrics(server, health=None, kubelet_watch=None) -> str:
+    """Prometheus text for a DevicePluginServer (tpukube.plugin.server);
+    pass the daemon's HealthWatcher / KubeletSessionWatcher to export
+    their transition counters."""
     out: list[str] = []
     out.append("# TYPE tpukube_plugin_allocations_total counter\n")
     out.append(_fmt("tpukube_plugin_allocations_total", server.allocation_count))
@@ -94,7 +126,32 @@ def render_plugin_metrics(server) -> str:
     out.append(_fmt("tpukube_plugin_devices", unhealthy, {"health": "Unhealthy"}))
     out.append(_fmt("tpukube_plugin_resource_info", 1,
                     {"resource": server.resource_name}))
+    # operators alarm on table-fallback nodes: their HBM/core facts are
+    # static guesses, not runtime truth
+    out.append("# TYPE tpukube_plugin_inventory_source gauge\n")
+    out.append(_fmt("tpukube_plugin_inventory_source", 1,
+                    {"source": server._device.inventory_source()}))
+    out.append("# TYPE tpukube_plugin_intent_depth gauge\n")
+    out.append(_fmt("tpukube_plugin_intent_depth", server.intents.depth()))
+    out.append("# TYPE tpukube_plugin_divergences_total counter\n")
+    out.append(_fmt("tpukube_plugin_divergences_total", server.divergences))
+    if health is not None:
+        out.append("# TYPE tpukube_plugin_health_transitions_total counter\n")
+        out.append(_fmt("tpukube_plugin_health_transitions_total",
+                        health.transitions))
+    if kubelet_watch is not None:
+        out.append("# TYPE tpukube_plugin_reregistrations_total counter\n")
+        out.append(_fmt("tpukube_plugin_reregistrations_total",
+                        kubelet_watch.reregistrations))
     return "".join(out)
+
+
+def render_syncer_metrics(syncer) -> str:
+    """Prometheus text for a NodeAnnotationSyncer sidecar."""
+    return (
+        "# TYPE tpukube_syncer_syncs_total counter\n"
+        + _fmt("tpukube_syncer_syncs_total", syncer.syncs)
+    )
 
 
 class MetricsServer:

@@ -36,6 +36,10 @@ struct State {
   std::vector<tpuinfo_chip> chips;
   std::vector<LinkPair> bad_links;
   std::string source = "";  /* "sim" | "pjrt" | "table (<why no pjrt>)" */
+  /* real-backend probe context (ABI v4, see tpuinfo.h tpuinfo_probe) */
+  std::string probe_mode = "";  /* "client" | "liveness" | "off"; "" = sim */
+  std::string libtpu_path;
+  void* get_api_sym = nullptr;
 };
 
 State g_state;
@@ -280,6 +284,8 @@ bool enumerate_pjrt(void* get_api_sym, std::string* why,
   };
   std::map<std::array<int64_t, 3>, ChipAgg> by_coord;
   int fallback_x = 0;
+  int64_t wrap[3] = {0, 0, 0};
+  bool have_wrap = false;
 
   for (size_t i = 0; i < dva.num_devices; ++i) {
     PJRT_Device* dev = dva.devices[i];
@@ -321,15 +327,47 @@ bool enumerate_pjrt(void* get_api_sym, std::string* why,
     if (take_error(api->PJRT_DeviceDescription_Attributes(&ata)).empty()) {
       for (size_t a = 0; a < ata.num_attributes; ++a) {
         const PJRT_NamedValue& nv = ata.attributes[a];
-        if (std::string(nv.name, nv.name_size) == "coords" &&
+        std::string name(nv.name, nv.name_size);
+        if (name == "coords" &&
             nv.type == PJRT_NamedValue_kInt64List && nv.value_size == 3) {
           coords = {nv.int64_array_value[0], nv.int64_array_value[1],
                     nv.int64_array_value[2]};
           have_coord = true;
+        } else if (name == "wrap" &&
+                   nv.type == PJRT_NamedValue_kInt64List &&
+                   nv.value_size == 3) {
+          /* per-axis torus wrap flags, when the runtime exposes them */
+          wrap[0] = nv.int64_array_value[0];
+          wrap[1] = nv.int64_array_value[1];
+          wrap[2] = nv.int64_array_value[2];
+          have_wrap = true;
         }
       }
     }
-    if (!have_coord) ++fallback_x;
+    /* A device without the coords attribute gets a synthetic (i,0,0) —
+     * but ONLY while no real coord occupies that slot: silently merging a
+     * synthetic chip into a real one would corrupt the inventory (core
+     * counts, HBM, ids). Mixed real/synthetic coords that collide mean
+     * the plugin's metadata cannot be trusted — reject enumeration and
+     * let the caller fall back to the honest table. */
+    if (!have_coord) {
+      auto it = by_coord.find(coords);
+      if (it != by_coord.end() && it->second.have_coord) {
+        destroy_client();
+        *why = "synthetic fallback coord collides with a runtime-reported "
+               "coord (plugin reports coords for only some devices)";
+        return false;
+      }
+      ++fallback_x;
+    } else {
+      auto it = by_coord.find(coords);
+      if (it != by_coord.end() && !it->second.have_coord) {
+        destroy_client();
+        *why = "runtime-reported coord collides with a synthetic fallback "
+               "coord (plugin reports coords for only some devices)";
+        return false;
+      }
+    }
 
     int64_t hbm = 0;
     if (have_memstats) {
@@ -370,7 +408,10 @@ bool enumerate_pjrt(void* get_api_sym, std::string* why,
   for (int a = 0; a < 3; ++a) {
     mesh_out->dims[a] = mx[a] + 1;
     mesh_out->host_block[a] = mx[a] - mn[a] + 1;
-    mesh_out->torus[a] = 0;
+    /* torus wraps only when the runtime said so (the "wrap" attribute);
+     * otherwise 0 — the honest default for a bounding-box mesh. Config
+     * can still override for real nodes (device manager, real_torus). */
+    mesh_out->torus[a] = have_wrap && wrap[a] ? 1 : 0;
   }
   chips_out->clear();
   int32_t idx = 0;
@@ -394,12 +435,25 @@ bool enumerate_pjrt(void* get_api_sym, std::string* why,
 int init_real(const char* spec) {
   std::string libtpu_path = "libtpu.so";
   std::string gen = "v5e";
+  std::string probe_mode = "";  /* "" = default per enumeration outcome */
   int32_t nchips = 1;
   if (const char* env_gen = std::getenv("PALLAS_AXON_TPU_GEN")) gen = env_gen;
   for (const auto& [key, val] : parse_spec(spec)) {
     if (key == "libtpu") libtpu_path = val;
     else if (key == "gen") gen = val;
-    else if (key == "chips") {
+    else if (key == "probe") {
+      if (val != "client" && val != "liveness" && val != "off") {
+        set_error("real: probe must be client|liveness|off, got: " + val);
+        return -1;
+      }
+#ifndef TPUINFO_HAVE_PJRT
+      if (val == "client") {
+        set_error("real: probe=client requires a PJRT-enabled build");
+        return -1;
+      }
+#endif
+      probe_mode = val;
+    } else if (key == "chips") {
       nchips = std::atoi(val.c_str());
       if (nchips <= 0) { set_error("real: bad chips: " + val); return -1; }
     } else { set_error("real: unknown spec key: " + key); return -1; }
@@ -429,6 +483,8 @@ int init_real(const char* spec) {
     return -1;
   }
   /* handle intentionally retained for process lifetime (liveness probe) */
+  g_state.libtpu_path = libtpu_path;
+  g_state.get_api_sym = get_api;
 
   /* First choice: ask the runtime itself (PJRT client; device id, kind,
    * coords, HBM limit). The spec string / generation table is the
@@ -442,6 +498,7 @@ int init_real(const char* spec) {
     }
     g_state.is_sim = false;
     g_state.source = "pjrt";
+    g_state.probe_mode = probe_mode.empty() ? "liveness" : probe_mode;
     return 0;
   }
 #endif
@@ -459,6 +516,7 @@ int init_real(const char* spec) {
   }
   g_state.is_sim = false;
   g_state.source = "table (" + why + ")";
+  g_state.probe_mode = probe_mode.empty() ? "liveness" : probe_mode;
   return 0;
 }
 
@@ -629,5 +687,50 @@ int tpuinfo_inject_fault(int32_t index, int32_t healthy) {
 const char* tpuinfo_last_error(void) { return g_last_error.c_str(); }
 
 const char* tpuinfo_source(void) { return g_state.source.c_str(); }
+
+int tpuinfo_probe(void) {
+  if (!g_state.initialized) { set_error("not initialized"); return -1; }
+  if (g_state.is_sim || g_state.probe_mode == "off") return 1;
+  int ok = 0;
+  std::string why;
+  if (g_state.probe_mode == "client") {
+#ifdef TPUINFO_HAVE_PJRT
+    /* the canary IS a fresh enumeration (SURVEY §6 C5: "device liveness
+     * probe via a canary enumeration") into scratch buffers — the live
+     * inventory's identity (ids, coords, mesh) must not shift mid-session
+     * under the device manager's minted device ids */
+    std::vector<tpuinfo_chip> scratch_chips;
+    tpuinfo_mesh scratch_mesh{};
+    ok = enumerate_pjrt(g_state.get_api_sym, &why, &scratch_chips,
+                        &scratch_mesh)
+             ? 1 : 0;
+#else
+    /* an ERROR, not a failed canary: marking healthy chips Unhealthy
+     * because the BINARY lacks a header would poison the whole node
+     * (init_real also rejects this spec; belt and braces) */
+    set_error("probe=client requires a PJRT-enabled build");
+    return -1;
+#endif
+  } else {  /* liveness */
+    /* the retained init handle keeps the image mapped forever, so the
+     * RTLD_NOLOAD lookup alone is a tautology; the on-disk check is the
+     * part that can actually fail (driver volume unmounted, node image
+     * rot). Only possible when libtpu was given as a path — a bare
+     * soname has no checkable location. */
+    bool on_disk = true;
+    if (g_state.libtpu_path.find('/') != std::string::npos) {
+      FILE* fp = std::fopen(g_state.libtpu_path.c_str(), "r");
+      on_disk = fp != nullptr;
+      if (fp != nullptr) std::fclose(fp);
+    }
+    void* h = dlopen(g_state.libtpu_path.c_str(), RTLD_LAZY | RTLD_NOLOAD);
+    ok = (on_disk && h != nullptr && dlsym(h, "GetPjrtApi") != nullptr)
+             ? 1 : 0;
+    if (!ok) why = "libtpu no longer loadable/present";
+  }
+  for (auto& c : g_state.chips) c.healthy = ok;
+  if (!ok) set_error("probe failed: " + why);
+  return ok;
+}
 
 }  // extern "C"

@@ -33,7 +33,7 @@
 extern "C" {
 #endif
 
-#define TPUINFO_ABI_VERSION 3
+#define TPUINFO_ABI_VERSION 4
 #define TPUINFO_MAX_ID 64
 
 typedef struct {
@@ -93,6 +93,24 @@ const char* tpuinfo_last_error(void);
  * introspection), or "table (<reason pjrt was unavailable>)". Empty string
  * before init. */
 const char* tpuinfo_source(void);
+
+/* Device liveness re-probe (ABI v4) — the real backend's health canary,
+ * closing SURVEY §4.4's real-mode gap (sim health comes from
+ * inject_fault; without this the real backend set healthy=1 at init and
+ * could never change its mind). Modes, via the real spec key `probe=`:
+ *   client   — re-run the PJRT canary enumeration (client create ->
+ *              devices -> destroy); failure flips EVERY chip Unhealthy,
+ *              recovery flips them back. OPT-IN: on single-owner TPU
+ *              runtimes a workload holding the chip fails the canary
+ *              while the chip is perfectly healthy — choose this only
+ *              where the runtime tolerates a second short-lived client
+ *              (multi-client runtimes, dedicated-agent nodes).
+ *   liveness — libtpu.so still loaded and exposing GetPjrtApi. Weak but
+ *              false-alarm-free; the default.
+ *   off      — probe never changes health.
+ * Returns 1 (canary passed: chips healthy), 0 (failed: chips marked
+ * unhealthy), -1 on error. Sim backend: no-op, returns 1. */
+int tpuinfo_probe(void);
 
 #ifdef __cplusplus
 }

@@ -24,7 +24,7 @@ from tpukube.core.types import ChipInfo, Health, TopologyCoord
 _NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libtpuinfo.so")
 
-ABI_VERSION = 3
+ABI_VERSION = 4
 _MAX_LINKS = 6
 
 
@@ -112,6 +112,7 @@ def _load() -> ctypes.CDLL:
         lib.tpuinfo_link_faults.restype = ctypes.c_int
         lib.tpuinfo_last_error.restype = ctypes.c_char_p
         lib.tpuinfo_source.restype = ctypes.c_char_p
+        lib.tpuinfo_probe.restype = ctypes.c_int
         _lib = lib
         return lib
 
@@ -249,6 +250,18 @@ class TpuInfo:
         with self._lock:
             self._check_open()
             return (self._lib.tpuinfo_source() or b"").decode()
+
+    def probe(self) -> bool:
+        """Real-backend health canary (see tpuinfo.h tpuinfo_probe): True
+        when the canary passed (chips healthy), False when it failed and
+        every chip was marked unhealthy. Sim backend: always True (sim
+        health is driven by inject_fault)."""
+        with self._lock:
+            self._check_open()
+            rc = self._lib.tpuinfo_probe()
+            if rc < 0:
+                raise TpuInfoError(self._last_error())
+            return bool(rc)
 
     def chips(self) -> list[ChipInfo]:
         with self._lock:

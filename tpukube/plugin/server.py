@@ -83,19 +83,37 @@ class AllocIntentCache:
         with self._lock:
             return {k: list(v) for k, v in self._intents.items()}
 
+    def depth(self) -> int:
+        """Pending (unconsumed) intents — exported on /metrics."""
+        with self._lock:
+            return len(self._intents)
+
     def preferred(
         self, available: list[str], required: list[str], size: int
     ) -> Optional[list[str]]:
         """The planned id set satisfying this preference query, if any:
         right size, inside the kubelet's available pool, containing every
-        must-include id. Not consumed — the kubelet may ask repeatedly."""
+        must-include id. Not consumed — the kubelet may ask repeatedly.
+
+        The query carries no pod identity, so when MORE than one pending
+        intent fits, any answer is a coin flip that can steer this pod
+        onto the OTHER pod's plan — manufacturing divergences. Mirror
+        consume()'s refuse-to-guess: return None and let the device
+        manager's local heuristic decide."""
         avail = set(available)
         req = set(required)
         with self._lock:
-            for ids in self._intents.values():
-                if (len(ids) == size and req <= set(ids)
-                        and set(ids) <= avail):
-                    return list(ids)
+            fits = [
+                ids for ids in self._intents.values()
+                if len(ids) == size and req <= set(ids) and set(ids) <= avail
+            ]
+        if len(fits) == 1:
+            return list(fits[0])
+        if fits:
+            log.info(
+                "preference query (size %d) matches %d pending intents; "
+                "deferring to the local heuristic", size, len(fits),
+            )
         return None
 
     def consume(
@@ -150,6 +168,7 @@ class DevicePluginServer(stubs.DevicePluginServicer):
         self._watch_queues: list[queue.SimpleQueue] = []
         self._watch_lock = threading.Lock()
         self._allocations = 0  # served Allocate calls (metrics)
+        self.divergences = 0   # kubelet-vs-plan id divergences (metrics)
         # extender-planned device ids for pods bound here (see
         # AllocIntentCache); fed by apiserver.AllocIntentWatcher
         self.intents = AllocIntentCache()
@@ -306,6 +325,7 @@ class DevicePluginServer(stubs.DevicePluginServicer):
             resp.container_responses.append(pb.ContainerAllocateResponse(envs=env))
             pod_key, planned, diverged = self.intents.consume(ids)
             if diverged and planned is not None and pod_key is not None:
+                self.divergences += 1
                 log.warning(
                     "kubelet allocated %s but %s was planned %s — reporting",
                     sorted(ids), pod_key, sorted(planned),
@@ -364,6 +384,14 @@ class HealthWatcher:
     def check_once(self) -> bool:
         """One poll; returns True if a transition was pushed. Exposed so
         tests (and the sim harness) can step deterministically."""
+        try:
+            # real backend: run the liveness canary first so the snapshot
+            # below reflects current chip health (sim: no-op — health is
+            # driven by inject_fault); a probe ERROR must not kill the
+            # watch loop, the last snapshot simply persists
+            self._device.probe()
+        except Exception:
+            log.exception("health probe failed; keeping last snapshot")
         snap = self._device.health_snapshot()
         if snap != self._last:
             changed = {k for k in snap if snap[k] != self._last.get(k)}

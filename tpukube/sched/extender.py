@@ -1077,7 +1077,11 @@ class Extender:
 
 # -- aiohttp application ----------------------------------------------------
 
-def make_app(extender: Extender) -> web.Application:
+def make_app(
+    extender: Extender, reconcile=None, evictions=None
+) -> web.Application:
+    """``reconcile``/``evictions`` are the daemon's AllocReconcileLoop /
+    EvictionExecutor, exported on /metrics when present."""
     app = web.Application()
 
     async def _json(request: web.Request) -> Any:
@@ -1108,7 +1112,9 @@ def make_app(extender: Extender) -> web.Application:
         from tpukube.metrics import render_extender_metrics
 
         return web.Response(
-            text=render_extender_metrics(extender),
+            text=render_extender_metrics(
+                extender, reconcile=reconcile, evictions=evictions
+            ),
             content_type="text/plain",
         )
 
