@@ -603,10 +603,10 @@ class Extender:
 
     @staticmethod
     def _index_at(view: NodeView, coord: TopologyCoord) -> int:
-        for c in view.info.chips:
-            if c.coord == coord:
-                return c.index
-        raise ExtenderError(f"no chip at {coord} on {view.info.name}")
+        try:
+            return view.index_at(coord)  # O(1) via the view's coord map
+        except StateError as e:
+            raise ExtenderError(str(e)) from None
 
     # -- placement planning -------------------------------------------------
     def _plan_chips(

@@ -71,13 +71,20 @@ def make_train_step(cfg: LlamaConfig, mesh: Mesh,
     """Return (step, opt_init) where step(params, opt_state, tokens) ->
     (params, opt_state, loss) is jitted over the mesh.
 
+    Works over both mesh shapes the framework places gangs for: a
+    single-slice Mesh('dp','tp') and a DCN-spanning Mesh('dcn','dp','tp')
+    (build_multislice_mesh) — with a 'dcn' axis present, the batch shards
+    over ('dcn','dp') so the only cross-slice collective is the gradient
+    reduction, exactly the multislice DP contract of the gang env.
+
     remat applies jax.checkpoint to the loss (per-layer rematerialization via
     the scan body), trading FLOPs for HBM — the standard TPU memory lever.
     """
     opt = opt or make_optimizer()
     pspecs = param_specs(cfg)
     param_sh = _shardings(mesh, pspecs)
-    batch_sh = NamedSharding(mesh, P("dp", None))
+    batch_axes = (("dcn", "dp") if "dcn" in mesh.axis_names else "dp")
+    batch_sh = NamedSharding(mesh, P(batch_axes, None))
 
     def compute_loss(params, tokens):
         if seq_parallel:
@@ -87,13 +94,15 @@ def make_train_step(cfg: LlamaConfig, mesh: Mesh,
             # XLA places the boundary collectives.
             def sp_forward(p, t):
                 h = p["embed"].astype(jnp.bfloat16)[t]
-                h = jax.lax.with_sharding_constraint(h, P("dp", "tp", None))
+                h = jax.lax.with_sharding_constraint(
+                    h, P(batch_axes, "tp", None)
+                )
                 from tpukube.workload.llama import _block, _rmsnorm
 
                 def body(h, layer):
                     h = _block(h, layer, cfg)
                     return jax.lax.with_sharding_constraint(
-                        h, P("dp", "tp", None)
+                        h, P(batch_axes, "tp", None)
                     ), None
 
                 h, _ = jax.lax.scan(body, h, p["layers"])
