@@ -506,3 +506,43 @@ def test_sharing_mode_switch_rejected_under_live_allocations():
     st.release("d/p")
     st.upsert_node("host-0-0-0", codec.annotate_node(node(4), mesh))
     assert st.node("host-0-0-0").shares_per_chip == 4
+
+
+def test_node_cache_capable_names_mode(cluster):
+    """The nodeCacheCapable leg of the extender protocol: after the node
+    cache is primed, NodeNames-only requests are answered purely from the
+    cache with a names-only result — the hot-path shape that keeps webhook
+    payloads off the wire."""
+    pod = cluster.make_pod("p0", tpu=1)
+    primed = cluster._post(
+        "/filter", {"Pod": pod, "Nodes": {"Items": cluster.node_objects()}}
+    )
+    assert primed["Nodes"]["Items"]
+
+    names = [o["metadata"]["name"] for o in cluster.node_objects()]
+    pod2 = cluster.make_pod("p1", tpu=1)
+    res = cluster._post("/filter", {"Pod": pod2, "NodeNames": names})
+    assert "Nodes" not in res  # names-only response in names mode
+    assert sorted(res["NodeNames"]) == sorted(names)
+    assert res["FailedNodes"] == {}
+
+    pres = cluster._post(
+        "/prioritize", {"Pod": pod2, "NodeNames": res["NodeNames"]}
+    )
+    assert {e["Host"] for e in pres} == set(names)
+    assert all(e["Score"] >= 0 for e in pres)
+
+    # a name the cache has never seen is infeasible with a reason
+    res2 = cluster._post(
+        "/filter",
+        {"Pod": cluster.make_pod("p2", tpu=1), "NodeNames": ["ghost"]},
+    )
+    assert res2["NodeNames"] == []
+    assert "ghost" in res2["FailedNodes"]
+
+    # neither nodes nor names is a schema error (HTTP 400), not a crash
+    try:
+        cluster._post("/filter", {"Pod": pod})
+        raise AssertionError("expected HTTP 400")
+    except RuntimeError as e:
+        assert "400" in str(e)

@@ -151,15 +151,27 @@ class Extender:
 
     # -- /filter -----------------------------------------------------------
     def filter(
-        self, pod: PodInfo, raw_nodes: list[dict[str, Any]]
-    ) -> tuple[list[dict[str, Any]], dict[str, str]]:
+        self,
+        pod: PodInfo,
+        raw_nodes: Optional[list[dict[str, Any]]] = None,
+        node_names: Optional[list[str]] = None,
+    ) -> tuple[list[Any], dict[str, str]]:
+        """Feasibility webhook. Two request modes, matching the upstream
+        protocol: full node objects (ingested into the state cache), or
+        nodeCacheCapable ``node_names`` answered purely from the cache.
+        The feasible list holds objects or names respectively."""
         t0 = time.monotonic()
         try:
-            self._ingest_nodes(raw_nodes)
+            if raw_nodes is not None:
+                names = self._ingest_nodes(raw_nodes)
+            else:
+                names = list(node_names or [])
             ask = self.device_request(pod)
             if ask is None:
                 # not a TPU pod: everything is feasible, nothing to track
-                return raw_nodes, {}
+                return (raw_nodes if raw_nodes is not None else names), {}
+            by_name = (dict(zip(names, raw_nodes))
+                       if raw_nodes is not None else None)
             resource, count = ask
             self._remember(pod)
             res: Optional[GangReservation] = None
@@ -185,14 +197,14 @@ class Extender:
                 self.gang.sweep()
             reserved = self._reserved_by_slice() if res is None else None
             feasible, failed = [], {}
-            for obj in raw_nodes:
-                name, _ = kube.node_name_and_annotations(obj)
+            for name in names:
                 if res is not None:
                     reason = self.gang.node_feasibility(res, name)
                 else:
                     reason = self._node_feasibility(name, resource, count, reserved)
                 if reason is None:
-                    feasible.append(obj)
+                    feasible.append(by_name[name] if by_name is not None
+                                    else name)
                 else:
                     failed[name] = reason
             return feasible, failed
@@ -489,11 +501,17 @@ class Extender:
 
     # -- /prioritize -------------------------------------------------------
     def prioritize(
-        self, pod: PodInfo, raw_nodes: list[dict[str, Any]]
+        self,
+        pod: PodInfo,
+        raw_nodes: Optional[list[dict[str, Any]]] = None,
+        node_names: Optional[list[str]] = None,
     ) -> dict[str, int]:
         t0 = time.monotonic()
         try:
-            names = self._ingest_nodes(raw_nodes)
+            if raw_nodes is not None:
+                names = self._ingest_nodes(raw_nodes)
+            else:
+                names = list(node_names or [])
             try:
                 ask = self.device_request(pod)
             except ExtenderError:
@@ -821,17 +839,23 @@ class Extender:
         """
         with self._decision_lock:
             if kind == "filter":
-                pod, nodes = kube.parse_extender_args(body)
+                pod, nodes, names = kube.parse_extender_args(body)
+                mk = (kube.filter_result if nodes is not None
+                      else kube.filter_result_names)
                 try:
-                    feasible, failed = self.filter(pod, nodes)
-                    response: Any = kube.filter_result(feasible, failed)
+                    feasible, failed = self.filter(
+                        pod, raw_nodes=nodes, node_names=names
+                    )
+                    response: Any = mk(feasible, failed)
                 except (ExtenderError, GangError, StateError,
                         codec.CodecError) as e:
-                    response = kube.filter_result([], {}, error=str(e))
+                    response = mk([], {}, error=str(e))
             elif kind == "prioritize":
-                pod, nodes = kube.parse_extender_args(body)
+                pod, nodes, names = kube.parse_extender_args(body)
                 try:
-                    scores = self.prioritize(pod, nodes)
+                    scores = self.prioritize(
+                        pod, raw_nodes=nodes, node_names=names
+                    )
                 except (ExtenderError, GangError, StateError,
                         codec.CodecError) as e:
                     log.warning("prioritize failed: %s", e)

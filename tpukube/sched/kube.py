@@ -68,9 +68,16 @@ def node_name_and_annotations(obj: dict[str, Any]) -> tuple[str, dict[str, str]]
     return name, dict(meta.get("annotations") or {})
 
 
-def parse_extender_args(body: dict[str, Any]) -> tuple[PodInfo, list[dict[str, Any]]]:
-    """ExtenderArgs -> (pod, raw node objects). Non-cache-capable mode:
-    full node objects (with annotations) ride in each request."""
+def parse_extender_args(
+    body: dict[str, Any],
+) -> tuple[PodInfo, Optional[list[dict[str, Any]]], Optional[list[str]]]:
+    """ExtenderArgs -> (pod, raw node objects | None, node names | None).
+
+    Exactly one of the last two is set. ``NodeNames`` is the
+    nodeCacheCapable mode of the upstream extender protocol: the
+    scheduler sends only names and the extender answers from its own node
+    cache (here: ClusterState, fed by the annotation syncer) — the big
+    per-webhook node payload disappears from the hot path."""
     if not isinstance(body, dict):
         raise KubeSchemaError("ExtenderArgs must be a JSON object")
     pod_obj = body.get("Pod")
@@ -78,11 +85,20 @@ def parse_extender_args(body: dict[str, Any]) -> tuple[PodInfo, list[dict[str, A
         raise KubeSchemaError("ExtenderArgs.Pod missing")
     pod = pod_from_k8s(pod_obj)
     nodes = (body.get("Nodes") or {}).get("Items")
-    if nodes is None:
+    if nodes is not None:
+        return pod, list(nodes), None
+    names = body.get("NodeNames")
+    if names is None:
         raise KubeSchemaError(
-            "ExtenderArgs.Nodes.Items missing (node-cache mode unsupported)"
+            "ExtenderArgs carries neither Nodes.Items nor NodeNames"
         )
-    return pod, list(nodes)
+    if not isinstance(names, list) or not all(
+        isinstance(n, str) for n in names
+    ):
+        raise KubeSchemaError(
+            "ExtenderArgs.NodeNames must be a list of strings"
+        )
+    return pod, None, list(names)
 
 
 def filter_result(
@@ -95,6 +111,19 @@ def filter_result(
         "NodeNames": [
             (n.get("metadata") or {}).get("name") for n in feasible
         ],
+        "FailedNodes": failed,
+        "Error": error,
+    }
+
+
+def filter_result_names(
+    feasible_names: list[str],
+    failed: dict[str, str],
+    error: str = "",
+) -> dict[str, Any]:
+    """ExtenderFilterResult in nodeCacheCapable mode: names only."""
+    return {
+        "NodeNames": list(feasible_names),
         "FailedNodes": failed,
         "Error": error,
     }

@@ -160,6 +160,7 @@ class SimCluster:
             self.extender, _PodStoreApi(self.pods)
         )  # drained inline by schedule(); not started as a thread
         self._node_obj_cache: dict[str, dict[str, Any]] = {}
+        self._synced_objs: list[dict[str, Any]] = []  # see _extender_node_args
         self._port = _free_port()
         self._http: Optional[_AppThread] = None
         # keep-alive connection per client thread (kube-scheduler likewise
@@ -218,6 +219,32 @@ class SimCluster:
                 self._node_obj_cache[name] = obj
             out.append(obj)
         return out
+
+    def _extender_node_args(
+        self,
+    ) -> tuple[dict[str, Any], Optional[list[int]]]:
+        """The node half of ExtenderArgs, nodeCacheCapable style: full
+        node objects only when some annotation changed since the last full
+        send (playing the annotation syncer's cache-refresh role), names
+        only otherwise — the same traffic shape a kube-scheduler
+        configured with nodeCacheCapable:true produces, and the reason the
+        per-cycle webhook payload is ~1KB instead of the whole topology.
+
+        Returns (args, pending_objs): the caller commits pending_objs to
+        ``_synced_objs`` only AFTER the full send's response arrives —
+        marking earlier would let a concurrent scheduler thread go
+        names-only against an extender that has not ingested yet."""
+        objs = self.node_objects()
+        # cached objects are reused between cycles, so identity comparison
+        # catches "nothing changed" without hashing annotation payloads.
+        # _synced_objs holds real references (not bare id()s): a freed
+        # object's address can be reused, which would fake "unchanged"
+        synced = self._synced_objs
+        if len(objs) == len(synced) and all(
+            a is b for a, b in zip(objs, synced)
+        ):
+            return {"NodeNames": [o["metadata"]["name"] for o in objs]}, None
+        return {"Nodes": {"Items": objs}}, objs
 
     def make_pod(
         self,
@@ -309,15 +336,20 @@ class SimCluster:
         self.drain_evictions()
         last_err = ""
         for _ in range(retries):
-            args = {"Pod": pod, "Nodes": {"Items": self.node_objects()}}
+            node_args, pending_objs = self._extender_node_args()
+            args = {"Pod": pod, **node_args}
             fres = self._post("/filter", args)
             if fres.get("Error"):
                 raise RuntimeError(f"filter error: {fres['Error']}")
-            feasible = fres["Nodes"]["Items"]
-            if not feasible:
+            if pending_objs is not None:
+                # the extender ingested this node set error-free; later
+                # cycles (any thread) may go names-only
+                self._synced_objs = pending_objs
+            feasible_names = fres["NodeNames"]
+            if not feasible_names:
                 raise RuntimeError(f"unschedulable: {fres['FailedNodes']}")
             pres = self._post(
-                "/prioritize", {"Pod": pod, "Nodes": {"Items": feasible}}
+                "/prioritize", {"Pod": pod, "NodeNames": feasible_names}
             )
             scores = {e["Host"]: e["Score"] for e in pres}
             best = max(sorted(scores), key=lambda h: scores[h])
