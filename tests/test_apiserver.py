@@ -154,7 +154,56 @@ def test_rest_apiserver_speaks_merge_patch():
     method, path, _, _, body = seen[1]
     assert (method, path) == ("PATCH", "/api/v1/namespaces/default/pods/p0")
     assert body == {"metadata": {"annotations": {"x": None}}}  # null deletes
-    assert seen[2][1] == "/api/v1/pods?fieldSelector=spec.nodeName%3Dn1"
+    assert seen[2][1] == (
+        "/api/v1/pods?limit=500&fieldSelector=spec.nodeName%3Dn1"
+    )
+
+
+def test_rest_list_pods_paginates():
+    """Large clusters: list_pods follows the apiserver's limit/continue
+    protocol and returns the concatenation of all pages."""
+    import http.server
+
+    pages = {
+        "": {"items": [{"metadata": {"name": "p0"}}],
+             "metadata": {"continue": "tok 1"}},
+        "tok 1": {"items": [{"metadata": {"name": "p1"}}],
+                  "metadata": {"continue": "tok2"}},
+        "tok2": {"items": [{"metadata": {"name": "p2"}}], "metadata": {}},
+    }
+    paths = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            paths.append(self.path)
+            from urllib.parse import parse_qs, urlparse
+
+            q = parse_qs(urlparse(self.path).query)
+            cont = q.get("continue", [""])[0]
+            body = json.dumps(pages[cont]).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        api = apisrv.RestApiServer(
+            base_url=f"http://127.0.0.1:{httpd.server_address[1]}",
+            token="t",
+        )
+        pods = api.list_pods()
+    finally:
+        httpd.shutdown()
+    assert [p["metadata"]["name"] for p in pods] == ["p0", "p1", "p2"]
+    assert len(paths) == 3
+    assert "continue=tok%201" in paths[1]  # token is URL-quoted
 
 
 # -- alloc intents: steering -------------------------------------------------

@@ -100,6 +100,8 @@ def test_rbac_covers_bindings_and_evictions():
             for r0 in r["resources"] for v in r["verbs"]]
     assert ("pods/binding", "create") in flat
     assert ("pods", "delete") in flat      # preemption evictions
+    # the EvictionExecutor's channel: policy/v1 Eviction subresource POST
+    assert ("pods/eviction", "create") in flat
     assert ("nodes", "watch") in flat
     agent_rules = roles["tpukube-node-agent"]["rules"]
     flat_a = [(r0, v) for r in agent_rules

@@ -36,6 +36,7 @@ import os
 import ssl
 import threading
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Any, Callable, Optional
 
@@ -264,12 +265,28 @@ class RestApiServer:
             {"metadata": {"annotations": annotations}},
         )
 
+    # chunked pod LISTs: big enough that small clusters stay one request,
+    # small enough that a v5p-128-scale cluster's poll never materializes
+    # thousands of pod objects in one apiserver response
+    LIST_PAGE_LIMIT = 500
+
     def list_pods(self, node_name: Optional[str] = None) -> list[dict[str, Any]]:
-        path = "/api/v1/pods"
+        """Pod list, paginated with limit/continue so reconcile-loop polls
+        on large clusters ask for bounded chunks instead of one giant
+        LIST (round-2 weak #6 made a non-limit)."""
+        base = f"/api/v1/pods?limit={self.LIST_PAGE_LIMIT}"
         if node_name is not None:
-            path += f"?fieldSelector=spec.nodeName%3D{node_name}"
-        obj = self._request("GET", path)
-        return list(obj.get("items", []) or [])
+            base += f"&fieldSelector=spec.nodeName%3D{node_name}"
+        items: list[dict[str, Any]] = []
+        cont = ""
+        while True:
+            path = base + (f"&continue={urllib.parse.quote(cont)}" if cont
+                           else "")
+            obj = self._request("GET", path)
+            items.extend(obj.get("items", []) or [])
+            cont = (obj.get("metadata") or {}).get("continue") or ""
+            if not cont:
+                return items
 
     def get_pod(self, namespace: str, name: str) -> Optional[dict[str, Any]]:
         """One pod object, or None when it does not exist (404)."""
@@ -473,10 +490,10 @@ class AllocReconcileLoop(_PollLoop):
         self.reconciled = 0  # ledger amendments applied (tests/metrics)
 
     def check_once(self) -> bool:
-        """One poll; True if any pod was reconciled. Divergence reports are
-        rare, so the poll is one unpaginated pod list per interval (the
-        apiserver cannot field-select on annotations); raise poll_seconds
-        on very large clusters. A failing pod never blocks the batch."""
+        """One poll; True if any pod was reconciled. Divergence reports
+        are rare, but the apiserver cannot field-select on annotations, so
+        the poll lists all pods — in bounded limit/continue pages (see
+        RestApiServer.list_pods). A failing pod never blocks the batch."""
         did = False
         for pod in self._api.list_pods():
             meta = pod.get("metadata", {})
