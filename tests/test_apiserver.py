@@ -732,3 +732,173 @@ def test_ambiguous_intents_defer_to_local_choice(tmp_path):
         steered = kubelet.preferred(server.resource_name, devs, 2)
         assert sorted(steered) == ["tpu-2", "tpu-3"]
         assert server.divergences == 0
+
+
+# -- bind effector -----------------------------------------------------------
+
+def test_bind_effector_creates_real_binding():
+    """With bindVerb delegated to the extender, a successful /bind must
+    bind THROUGH the apiserver — nodeName set, alloc annotation persisted.
+    The webhook response's annotations alone start nothing on a real
+    cluster."""
+    from tpukube.core.types import PodGroup
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        api = apisrv.FakeApiServer()
+        c.extender.binder = apisrv.pod_binder(api)
+        pod = c.make_pod("p0", tpu=2)
+        api.upsert_pod(pod)
+        node, alloc = c.schedule(pod)
+        bound = api.get_pod("default", "p0")
+        assert bound["spec"]["nodeName"] == node
+        assert codec.ANNO_ALLOC in bound["metadata"]["annotations"]
+        assert ("bind", "default/p0") in api.patch_log
+
+        # gang members bind through the same effector
+        group = PodGroup("g", min_member=2)
+        for i in range(2):
+            gp = c.make_pod(f"g-{i}", tpu=1, group=group)
+            api.upsert_pod(gp)
+            c.schedule(gp)
+        for i in range(2):
+            assert api.get_pod("default", f"g-{i}")["spec"]["nodeName"]
+
+
+def test_bind_effector_failure_rolls_back_ledger():
+    """A failed Binding POST must not leave the ledger claiming the pod is
+    bound — undo and let the scheduler re-run the cycle."""
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        api = apisrv.FakeApiServer()
+        c.extender.binder = apisrv.pod_binder(api)
+        pod = c.make_pod("p0", tpu=1)  # NOT upserted into the api: 404
+        with pytest.raises(RuntimeError, match="apiserver bind failed"):
+            c.schedule(pod, retries=2)
+        assert c.extender.state.allocation("default/p0") is None
+        assert c.utilization() == 0.0
+
+        api.upsert_pod(pod)  # pod appears; the retried cycle binds clean
+        node, _ = c.schedule(pod)
+        assert api.get_pod("default", "p0")["spec"]["nodeName"] == node
+        assert c.extender.state.allocation("default/p0") is not None
+
+
+def test_rest_bind_pod_posts_binding_subresource():
+    """RestApiServer.bind_pod PATCHes the alloc annotation FIRST (the pod
+    is still Pending: intent lands before the kubelet's Allocate, and a
+    partial failure leaves the pod unbound/retryable), then POSTs the v1
+    Binding; a 409 on the Binding (already bound) is idempotent success
+    ONLY when the pod is bound to the requested node."""
+    import http.server
+
+    seen = []
+    post_codes = []
+    bound_node = [""]
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def _reply(self, code, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            seen.append(("POST", self.path, json.loads(self.rfile.read(n))))
+            self._reply(post_codes.pop(0), {})
+
+        def do_PATCH(self):
+            n = int(self.headers.get("Content-Length", 0))
+            seen.append(("PATCH", self.path, json.loads(self.rfile.read(n))))
+            self._reply(200, {})
+
+        def do_GET(self):  # the 409 path verifies via get_pod
+            self._reply(200, {
+                "metadata": {"name": "p0", "namespace": "default"},
+                "spec": {"nodeName": bound_node[0]},
+            })
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        api = apisrv.RestApiServer(
+            base_url=f"http://127.0.0.1:{httpd.server_address[1]}",
+            token="t",
+        )
+        post_codes.append(201)
+        api.bind_pod("default", "p0", "host-3-1-0", {"k": "v"})
+        # retry of a pod already bound to the SAME node: success
+        post_codes.append(409)
+        bound_node[0] = "host-3-1-0"
+        api.bind_pod("default", "p0", "host-3-1-0", {"k": "v"})
+        # 409 with the pod bound ELSEWHERE: a real conflict, surfaced
+        post_codes.append(409)
+        bound_node[0] = "host-0-0-0"
+        with pytest.raises(apisrv.ApiServerError, match="already bound"):
+            api.bind_pod("default", "p0", "host-3-1-0", {"k": "v"})
+        post_codes.append(500)  # a real failure still surfaces
+        with pytest.raises(apisrv.ApiServerError):
+            api.bind_pod("default", "p0", "host-3-1-0", {"k": "v"})
+    finally:
+        httpd.shutdown()
+
+    # annotation PATCH precedes the Binding POST
+    assert seen[0][:2] == ("PATCH", "/api/v1/namespaces/default/pods/p0")
+    assert seen[0][2] == {"metadata": {"annotations": {"k": "v"}}}
+    method, path, body = seen[1]
+    assert (method, path) == (
+        "POST", "/api/v1/namespaces/default/pods/p0/binding"
+    )
+    assert body["kind"] == "Binding"
+    assert body["target"] == {
+        "apiVersion": "v1", "kind": "Node", "name": "host-3-1-0",
+    }
+
+
+def test_bind_effector_failure_uncommits_quorum():
+    """When the QUORUM member's Binding POST fails, the gang's commit must
+    be reverted: no committed-below-quorum reservation exempt from the
+    sweep, and no north-star latency sample for a commit that never
+    happened on the cluster."""
+    from tpukube.core.types import PodGroup
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        api = apisrv.FakeApiServer()
+        c.extender.binder = apisrv.pod_binder(api)
+        group = PodGroup("g", min_member=2)
+        p0 = c.make_pod("g-0", tpu=1, group=group)
+        api.upsert_pod(p0)
+        c.schedule(p0)
+        res = c.extender.gang.reservation("default", "g")
+        assert res is not None and not res.committed
+
+        p1 = c.make_pod("g-1", tpu=1, group=group)  # NOT in the api: 404
+        with pytest.raises(RuntimeError, match="apiserver bind failed"):
+            c.schedule(p1, retries=2)
+        res = c.extender.gang.reservation("default", "g")
+        assert res is not None
+        assert not res.committed, "quorum bind failed: commit must revert"
+        assert len(c.extender.gang.commit_latencies) == 0
+
+        api.upsert_pod(p1)  # the pod appears; the retried cycle commits
+        c.schedule(p1)
+        res = c.extender.gang.reservation("default", "g")
+        assert res is not None and res.committed
+        assert len(c.extender.gang.commit_latencies) == 1

@@ -144,6 +144,34 @@ class FakeApiServer:
         with self._lock:
             return self._pods.get(f"{namespace}/{name}")
 
+    def bind_pod(
+        self, namespace: str, name: str, node: str,
+        annotations: Optional[dict[str, str]] = None,
+    ) -> None:
+        """The Binding-subresource equivalent: annotations first (the pod
+        is still Pending — retry-safe), then nodeName; 404s like the real
+        apiserver. Already bound to the SAME node = idempotent-retry
+        success; bound elsewhere = 409 conflict (mirroring
+        RestApiServer.bind_pod's verified-409 semantics)."""
+        key = f"{namespace}/{name}"
+        with self._lock:
+            pod = self._pods.get(key)
+            if pod is None:
+                raise ApiServerError(f"pod {key} not found", code=404)
+            if annotations:
+                pod["metadata"].setdefault("annotations", {}).update(
+                    annotations
+                )
+            spec = pod.setdefault("spec", {})
+            bound_to = spec.get("nodeName")
+            if bound_to and bound_to != node:
+                raise ApiServerError(
+                    f"pod {key} is already bound to {bound_to!r}, "
+                    f"not {node!r}", code=409,
+                )
+            spec["nodeName"] = node
+            self.patch_log.append(("bind", key))
+
     def patch_pod_annotations(
         self, namespace: str, name: str, annotations: dict[str, Optional[str]]
     ) -> None:
@@ -311,6 +339,49 @@ class RestApiServer:
             if e.code != 404:  # already gone is success
                 raise
 
+    def bind_pod(
+        self, namespace: str, name: str, node: str,
+        annotations: Optional[dict[str, str]] = None,
+    ) -> None:
+        """POST the Binding subresource (what kube-scheduler does for
+        non-extender pods). With bindVerb delegated to the extender, THIS
+        is what actually starts the pod on its node.
+
+        Ordering is load-bearing: the alloc annotation is PATCHed FIRST,
+        while the pod is still Pending — so the node agent's intent
+        watcher can see the plan before the kubelet's Allocate, and a
+        partial failure always leaves the pod unbound (safe to retry).
+        A 409 on the Binding POST means the pod is already bound; that is
+        idempotent success ONLY if it is bound to the node we asked for
+        (our earlier retry landed) — bound elsewhere is a real conflict
+        (e.g. a re-planned bind after an extender restart) that must
+        surface, not silently mis-annotate a pod running on another
+        host."""
+        if annotations:
+            self.patch_pod_annotations(namespace, name, dict(annotations))
+        body = {
+            "apiVersion": "v1",
+            "kind": "Binding",
+            "metadata": {"name": name, "namespace": namespace},
+            "target": {"apiVersion": "v1", "kind": "Node", "name": node},
+        }
+        try:
+            self._request(
+                "POST",
+                f"/api/v1/namespaces/{namespace}/pods/{name}/binding",
+                body, content_type="application/json",
+            )
+        except ApiServerError as e:
+            if e.code != 409:
+                raise
+            pod = self.get_pod(namespace, name)
+            bound_to = ((pod or {}).get("spec") or {}).get("nodeName")
+            if bound_to != node:
+                raise ApiServerError(
+                    f"pod {namespace}/{name} is already bound to "
+                    f"{bound_to!r}, not {node!r}", code=409,
+                ) from e
+
     def evict_pod(self, namespace: str, name: str) -> bool:
         """POST the policy/v1 Eviction subresource — the polite way to
         delete a preemption victim, because it lets the apiserver enforce
@@ -451,6 +522,23 @@ class AllocIntentWatcher(_PollLoop):
                 continue
             intents[alloc.pod_key] = list(alloc.device_ids)
         return self._server.intents.sync(intents)
+
+
+def pod_binder(api) -> Callable[[Any], None]:
+    """The extender's bind effector: ``extender.binder = pod_binder(api)``
+    makes a successful /bind create the real Binding (pod starts on its
+    node) and persist the alloc annotation the node agent's intent watcher
+    reads. Raises ApiServerError upward — the extender undoes its ledger
+    commit and the scheduler re-runs the cycle."""
+
+    def bind(alloc) -> None:
+        namespace, name = alloc.pod_key.split("/", 1)
+        api.bind_pod(
+            namespace, name, alloc.node_name,
+            {codec.ANNO_ALLOC: codec.encode_alloc(alloc)},
+        )
+
+    return bind
 
 
 def alloc_divergence_reporter(api) -> Callable[[str, list[str], list[str]], None]:

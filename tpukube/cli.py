@@ -291,8 +291,15 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
     reconcile = evictions = None
     api = _make_apiserver(args)
     if api is not None:
-        from tpukube.apiserver import AllocReconcileLoop, EvictionExecutor
+        from tpukube.apiserver import (
+            AllocReconcileLoop,
+            EvictionExecutor,
+            pod_binder,
+        )
 
+        # with bindVerb delegated here, the extender must create the real
+        # Binding — kube-scheduler won't
+        extender.binder = pod_binder(api)
         reconcile = AllocReconcileLoop(
             extender, api, poll_seconds=cfg.health_poll_seconds
         )

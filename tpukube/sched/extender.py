@@ -111,6 +111,19 @@ class Extender:
         }
         self.preemptions = 0   # victims evicted for higher-priority gangs
         self.binds_total = 0   # successful binds (metrics counter)
+        # The bind EFFECTOR: with bindVerb configured, kube-scheduler
+        # delegates the binding itself to the extender — returning success
+        # without creating the Binding object leaves the pod Pending
+        # forever on a real cluster. cli wires apiserver.pod_binder(api)
+        # here; None in sim (the harness plays the apiserver and applies
+        # the response's annotations itself). The call runs OUTSIDE the
+        # decision lock (_handle_bind) so apiserver latency never stalls
+        # filter/prioritize for the whole cluster.
+        self.binder = None
+        # pod_key -> (reservation, this-bind-committed-the-gang), written
+        # by bind() when a binder is set, consumed by _handle_bind's
+        # effector undo
+        self._bind_gang_info: dict[str, tuple[Any, bool]] = {}
 
     def _remember(self, pod: PodInfo) -> None:
         now = time.monotonic()
@@ -784,11 +797,18 @@ class Extender:
             self.state.commit(alloc)  # StateError on lost race
             if res is not None:
                 try:
-                    self.gang.on_bound(res, key, plan, node_name)
+                    committed_now = self.gang.on_bound(
+                        res, key, plan, node_name
+                    )
                 except GangError as e:
                     # reservation changed between plan and commit: undo
                     self.state.release(key)
                     raise ExtenderError(str(e)) from e
+                if self.binder is not None:
+                    # _handle_bind's effector undo needs to know whether
+                    # THIS bind committed the gang (keyed, since other
+                    # binds may interleave once the decision lock drops)
+                    self._bind_gang_info[key] = (res, committed_now)
             with self._pending_lock:
                 self._pending.pop(key, None)
             self.binds_total += 1
@@ -837,6 +857,8 @@ class Extender:
         Schema errors raise ``kube.KubeSchemaError`` before any mutation;
         the HTTP layer maps them to 400 without recording.
         """
+        if kind == "bind":
+            return self._handle_bind(body)
         with self._decision_lock:
             if kind == "filter":
                 pod, nodes, names = kube.parse_extender_args(body)
@@ -861,19 +883,6 @@ class Extender:
                     log.warning("prioritize failed: %s", e)
                     scores = {}
                 response = kube.host_priority_list(scores)
-            elif kind == "bind":
-                name, ns, uid, node = kube.parse_binding_args(body)
-                try:
-                    alloc = self.bind(name, ns, uid, node)
-                    # the alloc annotation rides back to the
-                    # harness/apiserver-writer
-                    response = kube.binding_result()
-                    response["Annotations"] = {
-                        codec.ANNO_ALLOC: codec.encode_alloc(alloc)
-                    }
-                except (ExtenderError, GangError, StateError,
-                        codec.CodecError) as e:
-                    response = kube.binding_result(str(e))
             elif kind == "release":
                 pod_key = body["pod_key"]
                 self.state.release(pod_key)
@@ -892,6 +901,58 @@ class Extender:
             if self.trace is not None:
                 self.trace.record(kind, body, response)
             return response
+
+    def _handle_bind(self, body: Any) -> Any:
+        """The bind decision, split around the external effector: ledger
+        mutation + trace record run under the decision lock; the binder's
+        apiserver I/O (Binding POST + annotation PATCH, potentially slow)
+        runs OUTSIDE it so one apiserver hiccup cannot stall every
+        concurrent filter/prioritize. A failed effector undoes through a
+        regular recorded ``release`` decision — the trace then replays as
+        (bind ok, release), which IS the ledger's true history; only the
+        wire response reports the failure to the scheduler for a retry."""
+        name, ns, uid, node = kube.parse_binding_args(body)
+        key = f"{ns}/{name}"
+        alloc = None
+        gang_info = None
+        with self._decision_lock:
+            try:
+                alloc = self.bind(name, ns, uid, node)
+                # the alloc annotation rides back to the
+                # harness/apiserver-writer
+                response: Any = kube.binding_result()
+                response["Annotations"] = {
+                    codec.ANNO_ALLOC: codec.encode_alloc(alloc)
+                }
+                # consume THIS bind's gang marker under the same lock; a
+                # FAILED bind must not pop (the key may belong to another
+                # in-flight bind's pending effector)
+                gang_info = self._bind_gang_info.pop(key, None)
+            except (ExtenderError, GangError, StateError,
+                    codec.CodecError) as e:
+                response = kube.binding_result(str(e))
+            if self.trace is not None:
+                self.trace.record("bind", body, response)
+        if alloc is None or self.binder is None:
+            return response
+        try:
+            self.binder(alloc)
+        except Exception as e:
+            # the Binding POST/annotation PATCH failed: the pod is NOT
+            # bound on the cluster (annotation-first ordering guarantees
+            # partial failures leave it Pending), so the ledger must not
+            # claim it is. Preemption evictions already executed stand:
+            # the victims were released either way.
+            log.error("bind effector for %s failed: %s", key, e)
+            if gang_info is not None and gang_info[1]:
+                # this very bind committed the gang: the quorum never
+                # truly assembled — revert flag + latency sample
+                self.gang.undo_commit(gang_info[0])
+            self.handle("release", {"pod_key": key})
+            with self._decision_lock:
+                self.binds_total -= 1  # the bind did not survive
+            return kube.binding_result(f"{key}: apiserver bind failed: {e}")
+        return response
 
     def _reconcile_devices(self, pod_key: str, device_ids: list[str]) -> bool:
         """Fold the kubelet's ACTUAL device choice into the ledger when it

@@ -721,9 +721,11 @@ class GangManager:
             return chosen
 
     def on_bound(self, res: GangReservation, pod_key: str,
-                 coords: list[TopologyCoord], node_name: str) -> None:
+                 coords: list[TopologyCoord], node_name: str) -> bool:
         """Record a member's successful ledger commit; the quorum member
-        commits the whole gang."""
+        commits the whole gang. Returns True when THIS bind triggered the
+        commit — the caller needs it to undo truthfully if its external
+        bind effector subsequently fails (undo_commit)."""
         sid = self._node_slice(res, node_name)
         if sid is None:
             raise GangError(
@@ -747,6 +749,32 @@ class GangManager:
                     res.namespace, res.group.name,
                     len(res.assigned), res.commit_latency,
                 )
+                return True
+        return False
+
+    def undo_commit(self, res: GangReservation) -> None:
+        """Revert a commit whose triggering bind failed at the apiserver:
+        the quorum never truly assembled, so the committed flag (which
+        exempts the reservation from the TTL/health sweep) and the
+        recorded north-star latency sample must both go — otherwise a
+        failing apiserver leaves a committed-below-quorum reservation
+        masking chips forever and a latency sample for a commit that
+        never happened."""
+        with self._lock:
+            if not res.committed:
+                return
+            res.committed = False
+            try:
+                # remove by value, not tail position: the effector runs
+                # outside the decision lock, so another gang's commit can
+                # land between this gang's commit and its undo
+                self.commit_latencies.remove(res.commit_latency)
+            except ValueError:
+                pass  # window overflow evicted it already
+            log.warning(
+                "gang %s/%s commit UNDONE (quorum bind failed at the "
+                "apiserver)", res.namespace, res.group.name,
+            )
 
     # -- pod lifecycle -------------------------------------------------------
     def assignable(self, res: GangReservation, chips_per_pod: int) -> bool:
