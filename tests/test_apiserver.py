@@ -840,15 +840,19 @@ def test_rest_bind_pod_posts_binding_subresource():
         )
         post_codes.append(201)
         api.bind_pod("default", "p0", "host-3-1-0", {"k": "v"})
-        # retry of a pod already bound to the SAME node: success
+        # retry of a pod already bound to the SAME node: success (POST
+        # 409 re-verified against the bound node)
         post_codes.append(409)
         bound_node[0] = "host-3-1-0"
         api.bind_pod("default", "p0", "host-3-1-0", {"k": "v"})
-        # 409 with the pod bound ELSEWHERE: a real conflict, surfaced
-        post_codes.append(409)
+        # pod bound ELSEWHERE: the pre-check conflicts BEFORE any PATCH —
+        # a pod running on another host is never touched
         bound_node[0] = "host-0-0-0"
+        patches_before = sum(1 for e in seen if e[0] == "PATCH")
         with pytest.raises(apisrv.ApiServerError, match="already bound"):
             api.bind_pod("default", "p0", "host-3-1-0", {"k": "v"})
+        assert sum(1 for e in seen if e[0] == "PATCH") == patches_before
+        bound_node[0] = ""
         post_codes.append(500)  # a real failure still surfaces
         with pytest.raises(apisrv.ApiServerError):
             api.bind_pod("default", "p0", "host-3-1-0", {"k": "v"})
@@ -902,3 +906,52 @@ def test_bind_effector_failure_uncommits_quorum():
         res = c.extender.gang.reservation("default", "g")
         assert res is not None and res.committed
         assert len(c.extender.gang.commit_latencies) == 1
+
+
+# -- restart rebuild over the apiserver channel ------------------------------
+
+def test_rebuild_extender_from_apiserver():
+    """SURVEY §6 restart story on the REAL channel: a fresh extender
+    reconstructs ledger + gang reservations purely from what the
+    apiserver holds (node topology annotations + pod alloc/pod-group
+    annotations); malformed entries are skipped, not fatal."""
+    from tpukube.core.types import PodGroup
+    from tpukube.sched.extender import Extender
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        api = apisrv.FakeApiServer()
+        group = PodGroup("g", min_member=4)
+        for i in range(4):
+            pod = c.make_pod(f"g-{i}", tpu=1, group=group)
+            c.schedule(pod)
+            api.upsert_pod(pod)
+        pod = c.make_pod("solo", tpu=2)
+        c.schedule(pod)
+        api.upsert_pod(pod)
+        for obj in c.node_objects():
+            api.patch_node_annotations(
+                obj["metadata"]["name"], obj["metadata"]["annotations"]
+            )
+        util_before = c.utilization()
+
+        # a junk pod annotation and a junk node must be skipped loudly,
+        # never abort the rebuild
+        api.upsert_pod({"metadata": {
+            "name": "junk", "namespace": "default",
+            "annotations": {codec.ANNO_ALLOC: "{not json"},
+        }})
+        api.patch_node_annotations(
+            "junk-node", {codec.ANNO_NODE_TOPOLOGY: "{not json"}
+        )
+
+        fresh = Extender(cfg)
+        restored = apisrv.rebuild_extender(fresh, api)
+        assert restored == 5
+        assert fresh.state.utilization() == pytest.approx(util_before)
+        res = fresh.gang.reservation("default", "g")
+        assert res is not None and res.committed
+        assert len(res.assigned) == 4

@@ -108,6 +108,9 @@ class FakeApiServer:
         with self._lock:
             return sorted(self._nodes)
 
+    def list_nodes(self) -> list[dict[str, Any]]:
+        return self.node_objects()
+
     def node_objects(self) -> list[dict[str, Any]]:
         """Node list in the webhook wire shape (the sim's kube-scheduler
         builds ExtenderArgs from this)."""
@@ -148,26 +151,27 @@ class FakeApiServer:
         self, namespace: str, name: str, node: str,
         annotations: Optional[dict[str, str]] = None,
     ) -> None:
-        """The Binding-subresource equivalent: annotations first (the pod
-        is still Pending — retry-safe), then nodeName; 404s like the real
-        apiserver. Already bound to the SAME node = idempotent-retry
-        success; bound elsewhere = 409 conflict (mirroring
-        RestApiServer.bind_pod's verified-409 semantics)."""
+        """The Binding-subresource equivalent: conflict check FIRST (a pod
+        bound elsewhere must not be touched at all — not even its
+        annotations), then annotations (the pod is still Pending —
+        retry-safe), then nodeName; 404s like the real apiserver. Already
+        bound to the SAME node = idempotent-retry success (mirroring
+        RestApiServer.bind_pod)."""
         key = f"{namespace}/{name}"
         with self._lock:
             pod = self._pods.get(key)
             if pod is None:
                 raise ApiServerError(f"pod {key} not found", code=404)
-            if annotations:
-                pod["metadata"].setdefault("annotations", {}).update(
-                    annotations
-                )
             spec = pod.setdefault("spec", {})
             bound_to = spec.get("nodeName")
             if bound_to and bound_to != node:
                 raise ApiServerError(
                     f"pod {key} is already bound to {bound_to!r}, "
                     f"not {node!r}", code=409,
+                )
+            if annotations:
+                pod["metadata"].setdefault("annotations", {}).update(
+                    annotations
                 )
             spec["nodeName"] = node
             self.patch_log.append(("bind", key))
@@ -293,18 +297,15 @@ class RestApiServer:
             {"metadata": {"annotations": annotations}},
         )
 
-    # chunked pod LISTs: big enough that small clusters stay one request,
+    # chunked LISTs: big enough that small clusters stay one request,
     # small enough that a v5p-128-scale cluster's poll never materializes
-    # thousands of pod objects in one apiserver response
+    # thousands of objects in one apiserver response
     LIST_PAGE_LIMIT = 500
 
-    def list_pods(self, node_name: Optional[str] = None) -> list[dict[str, Any]]:
-        """Pod list, paginated with limit/continue so reconcile-loop polls
-        on large clusters ask for bounded chunks instead of one giant
-        LIST (round-2 weak #6 made a non-limit)."""
-        base = f"/api/v1/pods?limit={self.LIST_PAGE_LIMIT}"
-        if node_name is not None:
-            base += f"&fieldSelector=spec.nodeName%3D{node_name}"
+    def _list_paginated(self, base: str) -> list[dict[str, Any]]:
+        """Follow the apiserver's limit/continue protocol; returns the
+        concatenation of all pages. ``base`` already carries its query
+        string (limit, selectors)."""
         items: list[dict[str, Any]] = []
         cont = ""
         while True:
@@ -315,6 +316,21 @@ class RestApiServer:
             cont = (obj.get("metadata") or {}).get("continue") or ""
             if not cont:
                 return items
+
+    def list_pods(self, node_name: Optional[str] = None) -> list[dict[str, Any]]:
+        """Pod list, paginated so reconcile-loop polls on large clusters
+        ask for bounded chunks instead of one giant LIST."""
+        base = f"/api/v1/pods?limit={self.LIST_PAGE_LIMIT}"
+        if node_name is not None:
+            base += f"&fieldSelector=spec.nodeName%3D{node_name}"
+        return self._list_paginated(base)
+
+    def list_nodes(self) -> list[dict[str, Any]]:
+        """Node list, paginated like list_pods (startup rebuild reads
+        every node's topology annotation)."""
+        return self._list_paginated(
+            f"/api/v1/nodes?limit={self.LIST_PAGE_LIMIT}"
+        )
 
     def get_pod(self, namespace: str, name: str) -> Optional[dict[str, Any]]:
         """One pod object, or None when it does not exist (404)."""
@@ -355,8 +371,16 @@ class RestApiServer:
         idempotent success ONLY if it is bound to the node we asked for
         (our earlier retry landed) — bound elsewhere is a real conflict
         (e.g. a re-planned bind after an extender restart) that must
-        surface, not silently mis-annotate a pod running on another
-        host."""
+        surface. The bound-elsewhere check runs BEFORE the annotation
+        PATCH, so a conflicting pod running on another host is never
+        touched at all — not even its annotations."""
+        current = self.get_pod(namespace, name)
+        bound_to = ((current or {}).get("spec") or {}).get("nodeName")
+        if bound_to and bound_to != node:
+            raise ApiServerError(
+                f"pod {namespace}/{name} is already bound to "
+                f"{bound_to!r}, not {node!r}", code=409,
+            )
         if annotations:
             self.patch_pod_annotations(namespace, name, dict(annotations))
         body = {
@@ -374,6 +398,8 @@ class RestApiServer:
         except ApiServerError as e:
             if e.code != 409:
                 raise
+            # a binding raced in between our check and POST: success only
+            # if it targets our node
             pod = self.get_pod(namespace, name)
             bound_to = ((pod or {}).get("spec") or {}).get("nodeName")
             if bound_to != node:
@@ -522,6 +548,32 @@ class AllocIntentWatcher(_PollLoop):
                 continue
             intents[alloc.pod_key] = list(alloc.device_ids)
         return self._server.intents.sync(intents)
+
+
+def rebuild_extender(extender, api) -> int:
+    """Reconstruct a restarted extender's ledger AND gang reservations
+    from the apiserver (SURVEY §6 restart story, wired to the real
+    channel): node topology annotations first — the ledger can only
+    commit onto known nodes — then every pod's alloc annotation. A node
+    whose annotation is malformed is skipped loudly; its pods then fail
+    to restore (also loudly) and the reconcile machinery takes over.
+    Returns the number of allocations restored."""
+    for obj in api.list_nodes():
+        meta = obj.get("metadata") or {}
+        name = meta.get("name")
+        if not name:
+            continue
+        try:
+            extender.state.upsert_node(
+                name, dict(meta.get("annotations") or {})
+            )
+        except Exception as e:
+            log.error("rebuild: node %s annotation rejected: %s", name, e)
+    pods = [
+        dict((p.get("metadata") or {}).get("annotations") or {})
+        for p in api.list_pods()
+    ]
+    return extender.rebuild_from_pods(pods)
 
 
 def pod_binder(api) -> Callable[[Any], None]:

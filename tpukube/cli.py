@@ -295,8 +295,17 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
             AllocReconcileLoop,
             EvictionExecutor,
             pod_binder,
+            rebuild_extender,
         )
 
+        # restart story (SURVEY §6): reconstruct the ledger + gang
+        # reservations from node/pod annotations BEFORE serving — a
+        # freshly-restarted extender otherwise re-plans chips that are
+        # already running someone's containers
+        restored = rebuild_extender(extender, api)
+        if restored:
+            log.warning("rebuilt %d allocation(s) from the apiserver",
+                        restored)
         # with bindVerb delegated here, the extender must create the real
         # Binding — kube-scheduler won't
         extender.binder = pod_binder(api)

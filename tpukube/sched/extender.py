@@ -918,18 +918,22 @@ class Extender:
         with self._decision_lock:
             try:
                 alloc = self.bind(name, ns, uid, node)
+                # consume THIS bind's gang marker under the same lock; a
+                # FAILED bind must not pop (the key may belong to another
+                # in-flight bind's pending effector)
+                gang_info = self._bind_gang_info.pop(key, None)
                 # the alloc annotation rides back to the
                 # harness/apiserver-writer
                 response: Any = kube.binding_result()
                 response["Annotations"] = {
                     codec.ANNO_ALLOC: codec.encode_alloc(alloc)
                 }
-                # consume THIS bind's gang marker under the same lock; a
-                # FAILED bind must not pop (the key may belong to another
-                # in-flight bind's pending effector)
-                gang_info = self._bind_gang_info.pop(key, None)
             except (ExtenderError, GangError, StateError,
                     codec.CodecError) as e:
+                # an errored response must NEVER run the effector, even
+                # when bind() itself succeeded and a later step threw —
+                # the scheduler will retry a bind we told it failed
+                alloc = None
                 response = kube.binding_result(str(e))
             if self.trace is not None:
                 self.trace.record("bind", body, response)
@@ -944,12 +948,16 @@ class Extender:
             # claim it is. Preemption evictions already executed stand:
             # the victims were released either way.
             log.error("bind effector for %s failed: %s", key, e)
-            if gang_info is not None and gang_info[1]:
-                # this very bind committed the gang: the quorum never
-                # truly assembled — revert flag + latency sample
-                self.gang.undo_commit(gang_info[0])
-            self.handle("release", {"pod_key": key})
             with self._decision_lock:
+                # undo atomically w.r.t. other binds (which also hold the
+                # decision lock): a sibling member interleaving between
+                # the uncommit and the release could otherwise re-commit
+                # a quorum that counts this phantom member
+                if gang_info is not None and gang_info[1]:
+                    # this very bind committed the gang: the quorum never
+                    # truly assembled — revert flag + latency sample
+                    self.gang.undo_commit(gang_info[0])
+                self.handle("release", {"pod_key": key})
                 self.binds_total -= 1  # the bind did not survive
             return kube.binding_result(f"{key}: apiserver bind failed: {e}")
         return response

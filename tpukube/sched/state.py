@@ -13,6 +13,7 @@ n=1 case of a vTPU node, so one ledger covers both resources.
 
 from __future__ import annotations
 
+import logging
 import threading
 from dataclasses import dataclass, field
 from typing import Optional
@@ -28,6 +29,9 @@ from tpukube.core.types import (
     TopologyCoord,
     parse_device_id,
 )
+
+
+log = logging.getLogger("tpukube.state")
 
 
 class StateError(RuntimeError):
@@ -436,7 +440,24 @@ class ClusterState:
             payload = annotations.get(codec.ANNO_ALLOC)
             if not payload:
                 continue
-            alloc = codec.decode_alloc(payload)
-            self.commit(alloc)
+            # a real cluster can hold annotations we did not write
+            # (malformed edits, pods bound to vanished nodes): one bad
+            # pod must not abort the whole rebuild. LOUD skips — until
+            # reconciled the ledger under-counts the skipped pod's chips.
+            try:
+                alloc = codec.decode_alloc(payload)
+            except codec.CodecError as e:
+                # undecodable payloads carry no pod key; log a snippet so
+                # the operator can find the offending annotation
+                log.error("rebuild: undecodable alloc annotation (%s): "
+                          "%.120s", e, payload)
+                continue
+            try:
+                self.commit(alloc)
+            except StateError as e:
+                log.error("rebuild: skipping %s (%s) — the ledger "
+                          "under-counts its chips until reconciled",
+                          alloc.pod_key, e)
+                continue
             restored.append((annotations, alloc))
         return restored
