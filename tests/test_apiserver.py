@@ -955,3 +955,238 @@ def test_rebuild_extender_from_apiserver():
         res = fresh.gang.reservation("default", "g")
         assert res is not None and res.committed
         assert len(res.assigned) == 4
+
+
+# -- watch channel -----------------------------------------------------------
+
+def test_rest_watch_pods_streams_events():
+    """RestApiServer.watch_pods speaks the k8s watch protocol: chunked
+    stream of {"type", "object"} lines, field-selected, ending when the
+    server closes at timeoutSeconds."""
+    import http.server
+
+    paths = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_GET(self):
+            paths.append(self.path)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def chunk(data: bytes):
+                self.wfile.write(f"{len(data):x}\r\n".encode()
+                                 + data + b"\r\n")
+                self.wfile.flush()
+
+            for i, etype in enumerate(("ADDED", "MODIFIED", "DELETED")):
+                chunk(json.dumps({
+                    "type": etype,
+                    "object": {"metadata": {"name": f"p{i}"}},
+                }).encode() + b"\n")
+            chunk(b"{not json\n")  # garbage line must be skipped
+            self.wfile.write(b"0\r\n\r\n")
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        api = apisrv.RestApiServer(
+            base_url=f"http://127.0.0.1:{httpd.server_address[1]}",
+            token="t",
+        )
+        events = list(api.watch_pods("n1", timeout_seconds=30))
+    finally:
+        httpd.shutdown()
+    assert [(e, p["metadata"]["name"]) for e, p in events] == [
+        ("ADDED", "p0"), ("MODIFIED", "p1"), ("DELETED", "p2"),
+    ]
+    assert paths[0] == (
+        "/api/v1/pods?watch=1&timeoutSeconds=30"
+        "&fieldSelector=spec.nodeName%3Dn1"
+    )
+
+
+def test_intent_watcher_watch_mode(tmp_path):
+    """Watch-mode AllocIntentWatcher: intents land as events arrive (no
+    poll-interval race against the kubelet's Allocate), DELETED removes,
+    and a closed stream resyncs+reconnects."""
+    import queue
+    import time as _time
+
+    from tpukube.core.types import AllocResult, TopologyCoord
+    from tpukube.device import TpuDeviceManager
+    from tpukube.plugin import DevicePluginServer
+
+    class WatchApi:
+        def __init__(self):
+            self.pods = []
+            self.events: queue.Queue = queue.Queue()
+            self.connects = 0
+
+        def list_pods(self, node_name=None):
+            return list(self.pods)
+
+        def watch_pods(self, node_name=None, timeout_seconds=300):
+            self.connects += 1
+            while True:
+                ev = self.events.get()
+                if ev is None:  # server closes the stream
+                    return
+                yield ev
+
+    def pod_with_alloc(name, ids):
+        alloc = AllocResult(
+            pod_key=f"default/{name}", node_name="host-0-0-0",
+            device_ids=ids, coords=[TopologyCoord(0, 0, 0)],
+        )
+        return {"metadata": {
+            "name": name, "namespace": "default",
+            "annotations": {codec.ANNO_ALLOC: codec.encode_alloc(alloc)},
+        }}
+
+    cfg = _node_cfg(tmp_path, dims="2,2,1")
+    api = WatchApi()
+    with TpuDeviceManager(cfg, host="host-0-0-0") as device, \
+            DevicePluginServer(cfg, device) as server:
+        w = apisrv.AllocIntentWatcher(api, "host-0-0-0", server,
+                                      poll_seconds=0.05)
+        assert w._use_watch
+        w.start()
+        try:
+            api.events.put(("ADDED", pod_with_alloc("w0", ["tpu-2"])))
+            deadline = _time.monotonic() + 5
+            while (server.intents.snapshot().get("default/w0") != ["tpu-2"]
+                   and _time.monotonic() < deadline):
+                _time.sleep(0.01)
+            assert server.intents.snapshot()["default/w0"] == ["tpu-2"]
+
+            api.events.put(("DELETED", pod_with_alloc("w0", ["tpu-2"])))
+            deadline = _time.monotonic() + 5
+            while (server.intents.snapshot() and
+                   _time.monotonic() < deadline):
+                _time.sleep(0.01)
+            assert server.intents.snapshot() == {}
+
+            # stream close -> resync (list_pods) + reconnect
+            api.pods = [pod_with_alloc("w1", ["tpu-3"])]
+            api.events.put(None)
+            deadline = _time.monotonic() + 5
+            while (server.intents.snapshot().get("default/w1") != ["tpu-3"]
+                   and _time.monotonic() < deadline):
+                _time.sleep(0.01)
+            assert server.intents.snapshot()["default/w1"] == ["tpu-3"]
+            assert api.connects >= 2
+            assert w.watch_events == 2
+        finally:
+            api.events.put(None)  # unblock the generator for stop()
+            w.stop()
+
+
+def test_watch_event_semantics(tmp_path):
+    """Watch events must not resurrect consumed intents (a running pod's
+    lifetime alloc annotation rides every MODIFIED/replay), and DELETED
+    kills the intent even when the final object's annotation is corrupt."""
+    from types import SimpleNamespace
+
+    from tpukube.core.types import AllocResult, TopologyCoord
+    from tpukube.plugin.server import AllocIntentCache
+
+    class Api:  # just enough for __init__'s watch detection
+        def watch_pods(self, *a, **k):
+            return iter(())
+
+        def list_pods(self, node_name=None):
+            return []
+
+    intents = AllocIntentCache()
+    server = SimpleNamespace(intents=intents)
+    w = apisrv.AllocIntentWatcher(Api(), "host-0-0-0", server,
+                                  poll_seconds=999)
+    assert w._use_watch
+
+    def pod(name, ids, annotation=True):
+        alloc = AllocResult(
+            pod_key=f"default/{name}", node_name="host-0-0-0",
+            device_ids=ids, coords=[TopologyCoord(0, 0, 0)],
+        )
+        annos = ({codec.ANNO_ALLOC: codec.encode_alloc(alloc)}
+                 if annotation else {codec.ANNO_ALLOC: "{corrupt"})
+        return {"metadata": {"name": name, "namespace": "default",
+                             "annotations": annos}}
+
+    w._apply_watch_event("ADDED", pod("a", ["tpu-0"]))
+    assert intents.snapshot() == {"default/a": ["tpu-0"]}
+
+    # the kubelet allocates exactly the plan: consumed + satisfied
+    assert intents.consume(["tpu-0"]) == ("default/a", ["tpu-0"], False)
+    # the pod's later MODIFIED event replays the same annotation: the
+    # consumed intent must NOT come back
+    w._apply_watch_event("MODIFIED", pod("a", ["tpu-0"]))
+    assert intents.snapshot() == {}
+
+    # DELETED with a CORRUPT annotation still kills the intent by key
+    w._apply_watch_event("ADDED", pod("b", ["tpu-1"]))
+    assert intents.snapshot() == {"default/b": ["tpu-1"]}
+    w._apply_watch_event("DELETED", pod("b", ["tpu-1"], annotation=False))
+    assert intents.snapshot() == {}
+
+
+def test_watch_stop_interrupts_blocked_stream():
+    """stop() must not hang behind a quiet watch: closing the stream
+    unblocks the reader and the thread exits promptly."""
+    import http.server
+    import time as _time
+    from types import SimpleNamespace
+
+    from tpukube.plugin.server import AllocIntentCache
+
+    connected = threading.Event()
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_GET(self):
+            if "watch=1" in self.path:
+                self.send_response(200)
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                self.wfile.flush()
+                connected.set()
+                _time.sleep(30)  # a quiet node: no events
+            else:
+                body = json.dumps({"items": [], "metadata": {}}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        api = apisrv.RestApiServer(
+            base_url=f"http://127.0.0.1:{httpd.server_address[1]}",
+            token="t",
+        )
+        w = apisrv.AllocIntentWatcher(
+            api, "n0", SimpleNamespace(intents=AllocIntentCache()),
+            poll_seconds=0.1,
+        )
+        w.start()
+        assert connected.wait(timeout=10), "watch never connected"
+        t0 = _time.monotonic()
+        w.stop()
+        assert _time.monotonic() - t0 < 5, "stop() hung behind the stream"
+        assert w._thread is None
+    finally:
+        httpd.shutdown()

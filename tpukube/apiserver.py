@@ -33,6 +33,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import socket
 import ssl
 import threading
 import urllib.error
@@ -332,6 +333,49 @@ class RestApiServer:
             f"/api/v1/nodes?limit={self.LIST_PAGE_LIMIT}"
         )
 
+    def watch_pods(self, node_name: Optional[str] = None,
+                   timeout_seconds: int = 300,
+                   handle_box: Optional[list] = None):
+        """One watch request (the informer pattern's transport): yields
+        (event_type, pod) as the apiserver streams them, ending when the
+        server closes the stream at ``timeoutSeconds`` — callers loop to
+        reconnect, resyncing with list_pods in between. This is what
+        makes intent steering real on a live cluster: a 5s LIST poll
+        loses the race against the kubelet's Allocate; a watch delivers
+        the bound pod's alloc annotation within milliseconds."""
+        path = f"/api/v1/pods?watch=1&timeoutSeconds={timeout_seconds}"
+        if node_name is not None:
+            path += f"&fieldSelector=spec.nodeName%3D{node_name}"
+        headers = {"Accept": "application/json"}
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
+        req = urllib.request.Request(self._base + path, headers=headers)
+        try:
+            with urllib.request.urlopen(
+                req, timeout=timeout_seconds + 30, context=self._ssl
+            ) as r:
+                if handle_box is not None:
+                    # the caller's stop() closes this to interrupt a
+                    # blocked read (the stream is otherwise uninterruptible
+                    # for up to the socket timeout)
+                    handle_box.append(r)
+                for line in r:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        ev = json.loads(line)
+                    except json.JSONDecodeError as e:
+                        log.warning("watch: unparsable event line: %s", e)
+                        continue
+                    yield str(ev.get("type", "")), dict(ev.get("object") or {})
+        except urllib.error.HTTPError as e:
+            raise ApiServerError(
+                f"watch pods: HTTP {e.code}", code=e.code
+            ) from e
+        except urllib.error.URLError as e:
+            raise ApiServerError(f"watch pods: {e.reason}") from e
+
     def get_pod(self, namespace: str, name: str) -> Optional[dict[str, Any]]:
         """One pod object, or None when it does not exist (404)."""
         try:
@@ -525,29 +569,107 @@ class AllocIntentWatcher(_PollLoop):
     the gang's contiguity score was computed for."""
 
     def __init__(
-        self, api, node_name: str, server, poll_seconds: float = 5.0
+        self, api, node_name: str, server, poll_seconds: float = 5.0,
+        use_watch: bool = True,
     ) -> None:
         super().__init__(poll_seconds, "tpukube-alloc-intents")
         self._api = api
         self._node = node_name
         self._server = server
+        # watch mode (the informer pattern): intents land within ms of
+        # the bind instead of a poll interval later — steering would
+        # otherwise routinely lose the race against the kubelet's
+        # Allocate on a real cluster. Full list_pods resync on every
+        # (re)connect; the fake apiserver has no watch, so sim keeps
+        # polling.
+        self._use_watch = use_watch and hasattr(api, "watch_pods")
+        self.watch_events = 0  # processed watch events (tests/metrics)
+
+    @staticmethod
+    def _intent_of(pod: dict[str, Any]):
+        """(pod_key, device_ids) from a pod's alloc annotation, or None."""
+        meta = pod.get("metadata", {})
+        payload = (meta.get("annotations") or {}).get(codec.ANNO_ALLOC)
+        if not payload:
+            return None
+        try:
+            alloc = codec.decode_alloc(payload)
+        except codec.CodecError as e:
+            log.warning("pod %s: bad alloc annotation: %s",
+                        meta.get("name"), e)
+            return None
+        return alloc.pod_key, list(alloc.device_ids)
 
     def check_once(self) -> bool:
-        """One poll; True if the intent set changed."""
+        """One full resync; True if the intent set changed."""
         intents: dict[str, list[str]] = {}
         for pod in self._api.list_pods(self._node):
-            meta = pod.get("metadata", {})
-            payload = (meta.get("annotations") or {}).get(codec.ANNO_ALLOC)
-            if not payload:
-                continue
-            try:
-                alloc = codec.decode_alloc(payload)
-            except codec.CodecError as e:
-                log.warning("pod %s: bad alloc annotation: %s",
-                            meta.get("name"), e)
-                continue
-            intents[alloc.pod_key] = list(alloc.device_ids)
+            entry = self._intent_of(pod)
+            if entry is not None:
+                intents[entry[0]] = entry[1]
         return self._server.intents.sync(intents)
+
+    def _apply_watch_event(self, etype: str, pod: dict[str, Any]) -> None:
+        if etype == "DELETED":
+            # the pod key needs no annotation decode (the final object's
+            # annotation may be corrupt; the intent must still die NOW,
+            # not at the next reconnect resync)
+            meta = pod.get("metadata") or {}
+            name = meta.get("name")
+            if name:
+                self.watch_events += 1
+                self._server.intents.remove(
+                    f"{meta.get('namespace', 'default')}/{name}"
+                )
+            return
+        entry = self._intent_of(pod)
+        if entry is None:
+            return
+        self.watch_events += 1
+        # offer, not put: a consumed intent must not be resurrected by
+        # the pod's later MODIFIED events / reconnect replays
+        self._server.intents.offer(entry[0], entry[1])
+
+    def _run(self) -> None:
+        if not self._use_watch:
+            return super()._run()
+        while not self._stop.is_set():
+            box: list = []
+            self._stream_box = box
+            try:
+                self.check_once()  # resync at every (re)connect
+                try:
+                    gen = self._api.watch_pods(self._node, handle_box=box)
+                except TypeError:  # test stubs without handle_box
+                    gen = self._api.watch_pods(self._node)
+                for etype, pod in gen:
+                    if self._stop.is_set():
+                        return
+                    self._apply_watch_event(etype, pod)
+            except Exception:
+                if self._stop.is_set():
+                    return  # stop() closed the stream under us
+                log.exception("%s watch failed; reconnecting", self._name)
+            self._stop.wait(self._poll)  # backoff, then reconnect
+
+    def stop(self) -> None:
+        self._stop.set()
+        # a watch thread blocked mid-read can't see the stop event, and
+        # close() alone does NOT wake a thread parked in recv() — only a
+        # socket shutdown does; then close for good measure
+        for r in getattr(self, "_stream_box", []) or []:
+            try:
+                sock = getattr(getattr(r, "fp", None), "raw", None)
+                sock = getattr(sock, "_sock", None)
+                if sock is not None:
+                    sock.shutdown(socket.SHUT_RDWR)
+            except Exception:
+                pass
+            try:
+                r.close()
+            except Exception:
+                pass
+        super().stop()
 
 
 def rebuild_extender(extender, api) -> int:

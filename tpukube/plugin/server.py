@@ -74,6 +74,18 @@ class AllocIntentCache:
             self._intents[pod_key] = list(device_ids)
             self._satisfied.discard(pod_key)
 
+    def offer(self, pod_key: str, device_ids: list[str]) -> bool:
+        """put() for watch-event paths: refuses to resurrect an intent the
+        kubelet already consumed — a running pod's lifetime alloc
+        annotation rides every subsequent MODIFIED event (and reconnect
+        replay), and re-inserting it would let a stale plan masquerade as
+        fresh for some later pod's Allocate."""
+        with self._lock:
+            if pod_key in self._satisfied:
+                return False
+            self._intents[pod_key] = list(device_ids)
+            return True
+
     def remove(self, pod_key: str) -> None:
         with self._lock:
             self._intents.pop(pod_key, None)
