@@ -1190,3 +1190,73 @@ def test_watch_stop_interrupts_blocked_stream():
         assert w._thread is None
     finally:
         httpd.shutdown()
+
+
+def test_health_transition_reannotates_node(tmp_path):
+    """SURVEY §4.4 full circle: a chip fault re-emits the node-topology
+    annotation (HealthWatcher's on_transition hook, as the daemon wires
+    it) and the syncer PATCHes it onto the Node — so the EXTENDER, not
+    just the kubelet, stops placing on the dead chip."""
+    from tpukube.device import TpuDeviceManager
+    from tpukube.plugin import DevicePluginServer
+    from tpukube.plugin.server import HealthWatcher
+    from tpukube.sched.extender import Extender
+
+    cfg = _node_cfg(tmp_path, dims="2,2,1")
+    api = apisrv.FakeApiServer()
+    anno_file = tmp_path / "annotation.json"
+
+    with TpuDeviceManager(cfg, host="host-0-0-0") as device, \
+            DevicePluginServer(cfg, device) as server:
+
+        def write_annotation():
+            anno = codec.annotate_node(device.node_info(), device.mesh)
+            anno_file.write_text(json.dumps(anno) + "\n")
+
+        write_annotation()
+        watcher = HealthWatcher(device, server, poll_seconds=999,
+                                on_transition=write_annotation)
+        watcher._last = device.health_snapshot()
+        syncer = apisrv.NodeAnnotationSyncer(
+            api, "host-0-0-0", str(anno_file), poll_seconds=999
+        )
+        assert syncer.check_once() is True  # initial topology applied
+
+        device.inject_fault(0)              # chip 0 dies
+        assert watcher.check_once() is True
+        assert syncer.check_once() is True  # re-annotation flows
+
+        ext = Extender(cfg)
+        pod = {
+            "metadata": {"name": "p0", "namespace": "default", "uid": "u",
+                         "annotations": {}},
+            "spec": {"containers": [{
+                "name": "m",
+                "resources": {"requests": {cfg.resource_tpu: "4"}},
+            }]},
+        }
+        out = ext.handle("filter", {
+            "Pod": pod, "Nodes": {"Items": api.node_objects()},
+        })
+        # 4 chips requested, only 3 healthy: the extender knows
+        assert out["NodeNames"] == []
+        assert "host-0-0-0" in out["FailedNodes"]
+
+        device.inject_fault(0, healthy=True)  # recovery flows too
+        assert watcher.check_once() is True
+        assert syncer.check_once() is True
+        out = ext.handle("filter", {
+            "Pod": pod, "Nodes": {"Items": api.node_objects()},
+        })
+        assert out["NodeNames"] == ["host-0-0-0"]
+
+        # an ICI link fault (all chips healthy) must re-annotate too:
+        # badLinks is the extender's gang-placement input
+        device.inject_link_fault((0, 0, 0), (1, 0, 0))
+        assert watcher.check_once() is True
+        assert syncer.check_once() is True
+        topo = json.loads(
+            api.get_node_annotations("host-0-0-0")[codec.ANNO_NODE_TOPOLOGY]
+        )
+        assert topo["badLinks"] == [[[0, 0, 0], [1, 0, 0]]]
+        assert watcher.check_once() is False  # steady state: no re-emit

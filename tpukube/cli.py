@@ -139,7 +139,25 @@ def main_plugin(argv: Optional[list[str]] = None) -> int:
     with TpuDeviceManager(cfg, host=host) as device:
         server = DevicePluginServer(cfg, device, socket_path=args.socket)
         server.start()
-        watcher = HealthWatcher(device, server)
+
+        def write_annotation() -> None:
+            # SURVEY §4.1's "write NodeInfo annotation" step, re-run on
+            # every health/link transition so the SCHEDULER (via the
+            # syncer's Node PATCH) sees faults, not just the kubelet.
+            # Atomic tmp+rename: the syncer polls this file from another
+            # process — a truncate-then-write would hand it torn JSON.
+            anno = codec.annotate_node(device.node_info(), device.mesh)
+            payload = json.dumps(anno)
+            if args.annotation_out == "-":
+                print(payload, flush=True)
+            else:
+                tmp_path = args.annotation_out + ".tmp"
+                with open(tmp_path, "w") as f:
+                    f.write(payload + "\n")
+                os.replace(tmp_path, args.annotation_out)
+
+        watcher = HealthWatcher(device, server,
+                                on_transition=write_annotation)
         watcher.start()
         kubelet_watch = None
         if not args.no_register:
@@ -152,16 +170,10 @@ def main_plugin(argv: Optional[list[str]] = None) -> int:
         )
         metrics.start()
 
-        # the reference's "write NodeInfo annotation to apiserver" step
-        # (SURVEY.md §4.1): no apiserver in this environment, so emit the
-        # annotation for an external writer / the sim harness
-        anno = codec.annotate_node(device.node_info(), device.mesh)
-        payload = json.dumps(anno)
-        if args.annotation_out == "-":
-            print(payload, flush=True)
-        else:
-            with open(args.annotation_out, "w") as f:
-                f.write(payload + "\n")
+        # initial annotation emit (tpukube-syncer / the sim harness
+        # applies it to the Node object); health transitions re-emit via
+        # the watcher hook above
+        write_annotation()
 
         # the extender<->kubelet device-id loop: feed bound pods' planned
         # allocs into GetPreferredAllocation steering, report divergent

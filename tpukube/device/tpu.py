@@ -167,6 +167,16 @@ class TpuDeviceManager:
         """Where the inventory came from: "sim", "pjrt", or "table (...)"."""
         return self._ti.source()
 
+    def link_fault_snapshot(self) -> list:
+        """Downed ICI links visible to this node (node_info's badLinks),
+        canonical pairs, sorted — the health watcher diffs this so link
+        faults re-annotate the Node just like chip faults."""
+        mine = {c.coord for c in self.chips()}
+        return sorted(
+            (a, b) for a, b in self._ti.link_faults()
+            if a in mine or b in mine
+        )
+
     def probe(self) -> bool:
         """Run the backend's health canary (no-op True on sim); chips()
         and health_snapshot() reflect the outcome."""

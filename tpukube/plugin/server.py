@@ -368,7 +368,8 @@ class HealthWatcher:
     """
 
     def __init__(self, device: TpuDeviceManager, server: DevicePluginServer,
-                 poll_seconds: Optional[float] = None):
+                 poll_seconds: Optional[float] = None,
+                 on_transition=None):
         self._device = device
         self._server = server
         if poll_seconds is None:
@@ -377,12 +378,19 @@ class HealthWatcher:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._last: dict[str, Health] = {}
+        self._last_links: list = []
         self.transitions = 0  # observed health flips (tests/metrics)
+        # called (no args) after each pushed transition: the daemon hooks
+        # its annotation-file rewrite here so the SCHEDULER learns about
+        # dead chips too — the ListAndWatch push only reaches the kubelet,
+        # but the extender reads the node-topology annotation
+        self._on_transition = on_transition
 
     def start(self) -> None:
         if self._thread is not None:
             raise RuntimeError("health watcher already started")
         self._last = self._device.health_snapshot()
+        self._last_links = self._device.link_fault_snapshot()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="tpukube-health")
         self._thread.start()
@@ -405,14 +413,30 @@ class HealthWatcher:
         except Exception:
             log.exception("health probe failed; keeping last snapshot")
         snap = self._device.health_snapshot()
-        if snap != self._last:
+        links = self._device.link_fault_snapshot()
+        health_changed = snap != self._last
+        links_changed = links != self._last_links
+        if not (health_changed or links_changed):
+            return False
+        if health_changed:
             changed = {k for k in snap if snap[k] != self._last.get(k)}
             log.warning("health transition: %s", sorted(changed))
-            self._last = snap
-            self.transitions += 1
+            # the kubelet cares only about device health, not ICI links
             self._server.push_update()
-            return True
-        return False
+        if links_changed:
+            log.warning("ICI link-fault transition: %d downed link(s)",
+                        len(links))
+        self._last = snap
+        self._last_links = links
+        self.transitions += 1
+        if self._on_transition is not None:
+            try:
+                self._on_transition()
+            except Exception:
+                # re-annotation failure must not kill the watch loop;
+                # the kubelet-side shrink already went out
+                log.exception("health re-annotation hook failed")
+        return True
 
     def _run(self) -> None:
         while not self._stop.wait(self._poll):
