@@ -1260,3 +1260,64 @@ def test_health_transition_reannotates_node(tmp_path):
         )
         assert topo["badLinks"] == [[[0, 0, 0], [1, 0, 0]]]
         assert watcher.check_once() is False  # steady state: no re-emit
+
+
+def test_node_refresh_loop_feeds_namescapable_cache():
+    """nodeCacheCapable closes the topology loop through the apiserver:
+    webhooks carry names only, so a health fault reaches the extender via
+    NodeTopologyRefreshLoop's recorded upsert_node decisions — and the
+    capture (names-mode webhooks + refreshes) replays deterministically."""
+    from tpukube import trace as trace_mod
+    from tpukube.core.config import load_config as _load
+    from tpukube.core.types import ChipInfo, NodeInfo
+    from tpukube.sched.extender import Extender
+
+    cfg = _load(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    mesh = cfg.sim_mesh()
+    chips = [
+        ChipInfo(chip_id=f"c{i}", index=i, coord=c,
+                 hbm_bytes=cfg.hbm_bytes_per_chip, num_cores=2)
+        for i, c in enumerate(mesh.coords_of_host("host-0-0-0"))
+    ]
+    info = NodeInfo(name="host-0-0-0", chips=chips, slice_id=cfg.slice_id)
+    api = apisrv.FakeApiServer()
+    api.patch_node_annotations("host-0-0-0",
+                               codec.annotate_node(info, mesh))
+
+    ext = Extender(cfg)
+    loop = apisrv.NodeTopologyRefreshLoop(ext, api, poll_seconds=999)
+    assert loop.check_once() is True   # initial topology applied
+    assert loop.check_once() is False  # unchanged: no re-apply
+    assert loop.refreshed == 1
+
+    pod = {
+        "metadata": {"name": "p", "namespace": "default", "uid": "u",
+                     "annotations": {}},
+        "spec": {"containers": [{
+            "name": "m",
+            "resources": {"requests": {cfg.resource_tpu: "4"}},
+        }]},
+    }
+    out = ext.handle("filter", {"Pod": pod,
+                                "NodeNames": ["host-0-0-0"]})
+    assert out["NodeNames"] == ["host-0-0-0"]
+
+    # the node agent's re-annotation lands on the Node (syncer's PATCH);
+    # the refresh loop folds it into the names-mode cache
+    from tpukube.core.types import Health
+    chips[0].health = Health.UNHEALTHY
+    api.patch_node_annotations("host-0-0-0",
+                               codec.annotate_node(info, mesh))
+    assert loop.check_once() is True
+    out = ext.handle("filter", {"Pod": dict(pod),
+                                "NodeNames": ["host-0-0-0"]})
+    assert out["NodeNames"] == []  # 4 asked, 3 healthy: extender knows
+
+    # the whole capture — names-mode webhooks interleaved with
+    # upsert_node refreshes — replays clean on a fresh extender
+    assert ext.trace is not None
+    divergences = trace_mod.replay(ext.trace.events(), config=cfg)
+    assert divergences == []

@@ -36,6 +36,7 @@ import os
 import socket
 import ssl
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -583,6 +584,7 @@ class AllocIntentWatcher(_PollLoop):
         # (re)connect; the fake apiserver has no watch, so sim keeps
         # polling.
         self._use_watch = use_watch and hasattr(api, "watch_pods")
+        self._box_supported = True  # False after a handle_box TypeError
         self.watch_events = 0  # processed watch events (tests/metrics)
 
     @staticmethod
@@ -641,6 +643,7 @@ class AllocIntentWatcher(_PollLoop):
                 try:
                     gen = self._api.watch_pods(self._node, handle_box=box)
                 except TypeError:  # test stubs without handle_box
+                    self._box_supported = False
                     gen = self._api.watch_pods(self._node)
                 for etype, pod in gen:
                     if self._stop.is_set():
@@ -656,7 +659,16 @@ class AllocIntentWatcher(_PollLoop):
         self._stop.set()
         # a watch thread blocked mid-read can't see the stop event, and
         # close() alone does NOT wake a thread parked in recv() — only a
-        # socket shutdown does; then close for good measure
+        # socket shutdown does; then close for good measure. The stream
+        # handle lands in the box at the thread's FIRST read, so grace a
+        # moment for a connection that is mid-handshake (otherwise the
+        # shutdown below has nothing to act on and join stalls).
+        deadline = time.monotonic() + 2.0
+        while (self._use_watch and self._box_supported
+               and not (getattr(self, "_stream_box", None))
+               and self._thread is not None and self._thread.is_alive()
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
         for r in getattr(self, "_stream_box", []) or []:
             try:
                 sock = getattr(getattr(r, "fp", None), "raw", None)
@@ -672,6 +684,56 @@ class AllocIntentWatcher(_PollLoop):
         super().stop()
 
 
+class NodeTopologyRefreshLoop(_PollLoop):
+    """Keeps a nodeCacheCapable extender's node cache fresh.
+
+    With ``nodeCacheCapable: true``, kube-scheduler sends only NodeNames —
+    the extender would never see node-annotation updates (health faults,
+    link faults, share-mode changes) after its startup rebuild. This loop
+    polls the Node objects and applies CHANGED topology annotations as
+    recorded ``upsert_node`` decisions, so live captures still replay
+    deterministically against a fresh extender."""
+
+    def __init__(self, extender, api, poll_seconds: float = 5.0) -> None:
+        super().__init__(poll_seconds, "tpukube-node-refresh")
+        self._extender = extender
+        self._api = api
+        self._applied: dict[str, str] = {}  # name -> applied topo payload
+        self._rejected: dict[str, str] = {}  # name -> rejected payload
+        self.refreshed = 0  # applied annotation changes (tests/metrics)
+
+    def check_once(self) -> bool:
+        """One poll; True if any node's topology changed."""
+        did = False
+        for obj in self._api.list_nodes():
+            meta = obj.get("metadata") or {}
+            name = meta.get("name")
+            if not name:
+                continue
+            annotations = dict(meta.get("annotations") or {})
+            payload = annotations.get(codec.ANNO_NODE_TOPOLOGY)
+            if payload is None or payload == self._applied.get(name):
+                continue
+            if payload == self._rejected.get(name):
+                # a persistently-bad annotation must not re-record an
+                # identical error decision (trace spam) every poll;
+                # re-dispatch only when the payload changes
+                continue
+            out = self._extender.handle(
+                "upsert_node", {"name": name, "annotations": annotations}
+            )
+            if out.get("error"):
+                log.warning("node refresh for %s rejected: %s",
+                            name, out["error"])
+                self._rejected[name] = payload
+                continue
+            self._rejected.pop(name, None)
+            self._applied[name] = payload
+            self.refreshed += 1
+            did = True
+        return did
+
+
 def rebuild_extender(extender, api) -> int:
     """Reconstruct a restarted extender's ledger AND gang reservations
     from the apiserver (SURVEY §6 restart story, wired to the real
@@ -685,12 +747,16 @@ def rebuild_extender(extender, api) -> int:
         name = meta.get("name")
         if not name:
             continue
-        try:
-            extender.state.upsert_node(
-                name, dict(meta.get("annotations") or {})
-            )
-        except Exception as e:
-            log.error("rebuild: node %s annotation rejected: %s", name, e)
+        # recorded upsert_node decisions, not bare state mutation: a
+        # names-mode capture that starts right after rebuild must replay
+        # with the same node state the live extender had
+        out = extender.handle(
+            "upsert_node",
+            {"name": name, "annotations": dict(meta.get("annotations") or {})},
+        )
+        if out.get("error"):
+            log.error("rebuild: node %s annotation rejected: %s",
+                      name, out["error"])
     pods = [
         dict((p.get("metadata") or {}).get("annotations") or {})
         for p in api.list_pods()

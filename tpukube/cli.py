@@ -306,6 +306,7 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
         from tpukube.apiserver import (
             AllocReconcileLoop,
             EvictionExecutor,
+            NodeTopologyRefreshLoop,
             pod_binder,
             rebuild_extender,
         )
@@ -327,7 +328,12 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
         # the effector for preemption/rollback decisions: without it a
         # victim pod keeps running on chips the ledger shows free
         evictions = EvictionExecutor(extender, api)
-        loops = [reconcile, evictions]
+        # nodeCacheCapable webhooks carry names only: without this loop,
+        # health/link faults would never reach the node cache
+        node_refresh = NodeTopologyRefreshLoop(
+            extender, api, poll_seconds=cfg.health_poll_seconds
+        )
+        loops = [reconcile, evictions, node_refresh]
         for loop in loops:
             loop.start()
     log.warning("extender serving on %s:%d (score_mode=%s)",

@@ -170,12 +170,9 @@ class TpuDeviceManager:
     def link_fault_snapshot(self) -> list:
         """Downed ICI links visible to this node (node_info's badLinks),
         canonical pairs, sorted — the health watcher diffs this so link
-        faults re-annotate the Node just like chip faults."""
-        mine = {c.coord for c in self.chips()}
-        return sorted(
-            (a, b) for a, b in self._ti.link_faults()
-            if a in mine or b in mine
-        )
+        faults re-annotate the Node just like chip faults. Delegates to
+        node_info() so the visibility rule lives in exactly one place."""
+        return sorted(self.node_info().bad_links)
 
     def probe(self) -> bool:
         """Run the backend's health canary (no-op True on sim); chips()

@@ -896,6 +896,16 @@ class Extender:
                         body["pod_key"], list(body["devices"])
                     )
                 }
+            elif kind == "upsert_node":
+                # out-of-band node-annotation refresh (nodeCacheCapable
+                # mode: webhooks carry names only, so topology updates
+                # arrive through this recorded decision instead)
+                try:
+                    response = {"ours": self.state.upsert_node(
+                        body["name"], dict(body.get("annotations") or {})
+                    )}
+                except (codec.CodecError, StateError) as e:
+                    response = {"error": str(e)}
             else:
                 raise ValueError(f"unknown decision kind {kind!r}")
             if self.trace is not None:

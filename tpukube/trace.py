@@ -31,8 +31,13 @@ from typing import Any, Callable, Iterable, Optional
 # Event kinds. filter/prioritize/bind carry the webhook request/response
 # verbatim; release carries the pod key (the apiserver-side pod deletion
 # the extender observed); reconcile carries a kubelet device-id divergence
-# report being folded into the ledger (apiserver.AllocReconcileLoop).
-KINDS = ("filter", "prioritize", "bind", "release", "reconcile")
+# report being folded into the ledger (apiserver.AllocReconcileLoop);
+# upsert_node carries a node-annotation refresh applied outside any
+# webhook (apiserver.NodeTopologyRefreshLoop — nodeCacheCapable mode's
+# out-of-band topology channel), recorded so captures replay with the
+# same node state the live extender saw.
+KINDS = ("filter", "prioritize", "bind", "release", "reconcile",
+         "upsert_node")
 
 
 @dataclass
