@@ -169,7 +169,8 @@ def test_rest_list_pods_paginates():
              "metadata": {"continue": "tok 1"}},
         "tok 1": {"items": [{"metadata": {"name": "p1"}}],
                   "metadata": {"continue": "tok2"}},
-        "tok2": {"items": [{"metadata": {"name": "p2"}}], "metadata": {}},
+        "tok2": {"items": [{"metadata": {"name": "p2"}}],
+                 "metadata": {"resourceVersion": "9001"}},
     }
     paths = []
 
@@ -199,10 +200,13 @@ def test_rest_list_pods_paginates():
             token="t",
         )
         pods = api.list_pods()
+        pods_rv, rv = api.list_pods_with_rv()
     finally:
         httpd.shutdown()
     assert [p["metadata"]["name"] for p in pods] == ["p0", "p1", "p2"]
-    assert len(paths) == 3
+    assert [p["metadata"]["name"] for p in pods_rv] == ["p0", "p1", "p2"]
+    assert rv == "9001"  # the informer's watch starting point
+    assert len(paths) == 6
     assert "continue=tok%201" in paths[1]  # token is URL-quoted
 
 
@@ -1002,6 +1006,8 @@ def test_rest_watch_pods_streams_events():
             token="t",
         )
         events = list(api.watch_pods("n1", timeout_seconds=30))
+        list(api.watch_pods("n1", timeout_seconds=30,
+                            resource_version="4 2"))
     finally:
         httpd.shutdown()
     assert [(e, p["metadata"]["name"]) for e, p in events] == [
@@ -1011,6 +1017,7 @@ def test_rest_watch_pods_streams_events():
         "/api/v1/pods?watch=1&timeoutSeconds=30"
         "&fieldSelector=spec.nodeName%3Dn1"
     )
+    assert paths[1].endswith("&resourceVersion=4%202")  # informer contract
 
 
 def test_intent_watcher_watch_mode(tmp_path):

@@ -1,0 +1,208 @@
+"""A day in the life of a tpukube cluster — every control-plane loop
+composed through one (fake) apiserver, stepped deterministically.
+
+This is the "works on a real cluster" capstone: the node agent and the
+scheduler NEVER talk to each other directly; everything flows the way it
+does in production — annotation file -> syncer -> Node object -> refresh
+loop -> names-only webhooks -> Binding subresource -> alloc annotation ->
+intent watcher -> GetPreferredAllocation -> Allocate -> divergence report
+-> reconcile -> preemption -> Eviction subresource -> health fault ->
+re-annotation -> capacity shrink (SURVEY.md §4.1-§4.4 end to end).
+"""
+
+import json
+
+import pytest
+
+from tpukube import apiserver as apisrv
+from tpukube.core import codec
+from tpukube.core.config import load_config
+from tpukube.core.types import PodGroup
+from tpukube.sched.extender import Extender
+
+
+def _pod_obj(name, tpu, priority=0, group=None, namespace="default"):
+    annotations = {}
+    if group is not None:
+        annotations.update(codec.pod_group_annotations(group))
+    return {
+        "metadata": {
+            "name": name, "namespace": namespace,
+            "uid": f"uid-{name}", "annotations": annotations,
+        },
+        "spec": {
+            "priority": priority,
+            "containers": [{
+                "name": "main",
+                "resources": {
+                    "requests": {"qiniu.com/tpu": str(tpu)},
+                },
+            }],
+        },
+    }
+
+
+def _schedule(ext, api, pod_obj):
+    """One kube-scheduler cycle in nodeCacheCapable mode: names-only
+    filter -> prioritize -> pick max -> bind (the extender's binder does
+    the real Binding against the apiserver)."""
+    names = [n["metadata"]["name"] for n in api.node_objects()]
+    fres = ext.handle("filter", {"Pod": pod_obj, "NodeNames": names})
+    if fres.get("Error"):
+        raise RuntimeError(f"filter error: {fres['Error']}")
+    if not fres["NodeNames"]:
+        raise RuntimeError(f"unschedulable: {fres['FailedNodes']}")
+    pres = ext.handle(
+        "prioritize", {"Pod": pod_obj, "NodeNames": fres["NodeNames"]}
+    )
+    scores = {e["Host"]: e["Score"] for e in pres}
+    best = max(sorted(scores), key=lambda h: scores[h])
+    meta = pod_obj["metadata"]
+    bres = ext.handle("bind", {
+        "PodName": meta["name"], "PodNamespace": meta["namespace"],
+        "PodUID": meta["uid"], "Node": best,
+    })
+    if bres.get("Error"):
+        raise RuntimeError(f"bind error: {bres['Error']}")
+    return best
+
+
+def test_full_cluster_lifecycle(tmp_path):
+    from tpukube.device import TpuDeviceManager
+    from tpukube.plugin import DevicePluginServer, FakeKubelet
+    from tpukube.plugin.server import HealthWatcher
+
+    cfg = load_config(env={
+        "TPUKUBE_DEVICE_PLUGIN_DIR": str(tmp_path),
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        "TPUKUBE_HBM_BYTES_PER_CHIP": str(16 << 30),
+    })
+    api = apisrv.FakeApiServer()
+    anno_file = tmp_path / "annotation.json"
+
+    with TpuDeviceManager(cfg, host="host-0-0-0") as device, \
+            DevicePluginServer(cfg, device) as server, \
+            FakeKubelet(str(tmp_path)) as kubelet:
+        # ---- node agent boots (SURVEY §4.1) ----------------------------
+        server.register_with_kubelet()
+        kubelet.wait_for_devices(server.resource_name, 4)
+
+        def write_annotation():
+            anno_file.write_text(json.dumps(
+                codec.annotate_node(device.node_info(), device.mesh)
+            ) + "\n")
+
+        write_annotation()
+        health = HealthWatcher(device, server, poll_seconds=999,
+                               on_transition=write_annotation)
+        health._last = device.health_snapshot()
+        syncer = apisrv.NodeAnnotationSyncer(
+            api, "host-0-0-0", str(anno_file), poll_seconds=999
+        )
+        assert syncer.check_once() is True
+
+        # ---- scheduler boots: rebuild (empty) + refresh ----------------
+        ext = Extender(cfg)
+        ext.binder = apisrv.pod_binder(api)
+        server.set_alloc_reporter(apisrv.alloc_divergence_reporter(api))
+        refresh = apisrv.NodeTopologyRefreshLoop(ext, api, poll_seconds=999)
+        intent_watch = apisrv.AllocIntentWatcher(
+            api, "host-0-0-0", server, poll_seconds=999, use_watch=False
+        )
+        reconcile = apisrv.AllocReconcileLoop(ext, api, poll_seconds=999)
+        evictions = apisrv.EvictionExecutor(ext, api, poll_seconds=999)
+        assert apisrv.rebuild_extender(ext, api) == 0
+        assert refresh.check_once() is True  # topology flows api -> cache
+
+        # ---- pod lifecycle: schedule -> steer -> allocate (§4.2-§4.3) --
+        pod = _pod_obj("train-0", tpu=2)
+        api.upsert_pod(pod)
+        node = _schedule(ext, api, pod)
+        assert node == "host-0-0-0"
+        bound = api.get_pod("default", "train-0")
+        assert bound["spec"]["nodeName"] == node  # the REAL binding
+        planned = codec.decode_alloc(
+            bound["metadata"]["annotations"][codec.ANNO_ALLOC]
+        ).device_ids
+
+        assert intent_watch.check_once() is True  # plan reaches the agent
+        devs = sorted(kubelet.wait_for_devices(server.resource_name, 4))
+        steered = kubelet.preferred(server.resource_name, devs, 2)
+        assert sorted(steered) == sorted(planned)  # kubelet follows plan
+        env = kubelet.allocate(server.resource_name, steered)
+        assert env["TPU_KUBE_DEVICE_IDS"].split(",") == sorted(steered)
+        assert server.divergences == 0
+
+        # ---- a divergent kubelet choice is reconciled (§4.3 loop) ------
+        pod2 = _pod_obj("train-1", tpu=1)
+        api.upsert_pod(pod2)
+        _schedule(ext, api, pod2)
+        assert intent_watch.check_once() is True
+        planned2 = codec.decode_alloc(
+            api.get_pod("default", "train-1")
+            ["metadata"]["annotations"][codec.ANNO_ALLOC]
+        ).device_ids
+        free = [d for d in devs if d not in steered and d not in planned2]
+        kubelet.allocate(server.resource_name, [free[0]])  # ignores plan
+        assert server.divergences == 1
+        # the reporter thread PATCHes alloc-actual; wait for it, then the
+        # reconcile loop folds reality into the ledger
+        import time as _time
+        deadline = _time.monotonic() + 5
+        while _time.monotonic() < deadline:
+            annos = api.get_pod("default", "train-1")["metadata"]["annotations"]
+            if apisrv.ANNO_ALLOC_ACTUAL in annos:
+                break
+            _time.sleep(0.02)
+        assert reconcile.check_once() is True
+        fixed = codec.decode_alloc(
+            api.get_pod("default", "train-1")
+            ["metadata"]["annotations"][codec.ANNO_ALLOC]
+        )
+        assert fixed.device_ids == [free[0]]
+        assert ext.state.allocation("default/train-1").device_ids == [free[0]]
+
+        # ---- preemption: gang evicts via the Eviction subresource ------
+        gang = PodGroup("vip", min_member=4)
+        victims_before = {p["metadata"]["name"] for p in api.list_pods()}
+        for i in range(4):
+            gp = _pod_obj(f"vip-{i}", tpu=1, priority=100, group=gang)
+            api.upsert_pod(gp)
+            _schedule(ext, api, gp)
+            evictions.check_once()  # drain as the daemon loop would
+        remaining = {p["metadata"]["name"] for p in api.list_pods()}
+        evicted = victims_before - remaining
+        assert evicted == {"train-0", "train-1"}  # preempted via the api
+        assert evictions.evicted == 2
+        res = ext.gang.reservation("default", "vip")
+        assert res is not None and res.committed
+
+        # ---- health fault shrinks capacity end to end (§4.4) -----------
+        device.inject_fault(0)
+        assert health.check_once() is True   # kubelet push + re-annotate
+        assert syncer.check_once() is True   # file -> Node object
+        assert refresh.check_once() is True  # Node -> extender cache
+        pod3 = _pod_obj("late", tpu=1)
+        api.upsert_pod(pod3)
+        with pytest.raises(RuntimeError, match="unschedulable"):
+            _schedule(ext, api, pod3)  # 4 chips: 4 vip + 0 healthy free
+        # recovery reopens the node
+        device.inject_fault(0, healthy=True)
+        assert health.check_once() and syncer.check_once()
+        assert refresh.check_once() is True
+        # all-or-nothing holds: a released gang member's chip stays
+        # reserved for a REPLACEMENT member, never for bystanders
+        api.delete_pod("default", "vip-3")
+        ext.handle("release", {"pod_key": "default/vip-3"})
+        with pytest.raises(RuntimeError, match="unschedulable"):
+            _schedule(ext, api, pod3)
+        replacement = _pod_obj("vip-3b", tpu=1, priority=100, group=gang)
+        api.upsert_pod(replacement)
+        assert _schedule(ext, api, replacement) == "host-0-0-0"
+        assert api.get_pod("default", "vip-3b")["spec"]["nodeName"]
+
+        # the whole day replays deterministically from the trace
+        from tpukube import trace as trace_mod
+        assert ext.trace is not None
+        assert trace_mod.replay(ext.trace.events(), config=cfg) == []

@@ -250,20 +250,31 @@ class RestApiServer:
         else:
             self._ssl = None
 
+    def _authed_request(
+        self, method: str, path: str, data: Optional[bytes] = None,
+        content_type: Optional[str] = None,
+    ) -> urllib.request.Request:
+        """One place for bearer auth + headers — the long-lived watch
+        path and the unary path must never drift apart."""
+        headers = {"Accept": "application/json"}
+        if self._token:
+            headers["Authorization"] = f"Bearer {self._token}"
+        if content_type is not None:
+            headers["Content-Type"] = content_type
+        return urllib.request.Request(
+            self._base + path, data=data, headers=headers, method=method
+        )
+
     def _request(
         self, method: str, path: str, body: Optional[dict] = None,
         content_type: str = "application/merge-patch+json",
     ) -> Any:
-        headers = {"Accept": "application/json"}
-        if self._token:
-            headers["Authorization"] = f"Bearer {self._token}"
-        data = None
+        data = ctype = None
         if body is not None:
             data = json.dumps(body).encode()
-            headers["Content-Type"] = content_type
-        req = urllib.request.Request(
-            self._base + path, data=data, headers=headers, method=method
-        )
+            ctype = content_type
+        req = self._authed_request(method, path, data=data,
+                                   content_type=ctype)
         try:
             with urllib.request.urlopen(
                 req, timeout=self._timeout, context=self._ssl
@@ -304,39 +315,56 @@ class RestApiServer:
     # thousands of objects in one apiserver response
     LIST_PAGE_LIMIT = 500
 
-    def _list_paginated(self, base: str) -> list[dict[str, Any]]:
+    def _list_paginated(
+        self, base: str
+    ) -> tuple[list[dict[str, Any]], str]:
         """Follow the apiserver's limit/continue protocol; returns the
-        concatenation of all pages. ``base`` already carries its query
-        string (limit, selectors)."""
+        concatenation of all pages plus the list's resourceVersion (the
+        consistent point a watch should start from). ``base`` already
+        carries its query string (limit, selectors)."""
         items: list[dict[str, Any]] = []
-        cont = ""
+        cont = rv = ""
         while True:
             path = base + (f"&continue={urllib.parse.quote(cont)}" if cont
                            else "")
             obj = self._request("GET", path)
             items.extend(obj.get("items", []) or [])
-            cont = (obj.get("metadata") or {}).get("continue") or ""
+            meta = obj.get("metadata") or {}
+            rv = meta.get("resourceVersion") or rv
+            cont = meta.get("continue") or ""
             if not cont:
-                return items
+                return items, rv
+
+    def _pods_base(self, node_name: Optional[str]) -> str:
+        base = f"/api/v1/pods?limit={self.LIST_PAGE_LIMIT}"
+        if node_name is not None:
+            base += f"&fieldSelector=spec.nodeName%3D{node_name}"
+        return base
 
     def list_pods(self, node_name: Optional[str] = None) -> list[dict[str, Any]]:
         """Pod list, paginated so reconcile-loop polls on large clusters
         ask for bounded chunks instead of one giant LIST."""
-        base = f"/api/v1/pods?limit={self.LIST_PAGE_LIMIT}"
-        if node_name is not None:
-            base += f"&fieldSelector=spec.nodeName%3D{node_name}"
-        return self._list_paginated(base)
+        return self._list_paginated(self._pods_base(node_name))[0]
+
+    def list_pods_with_rv(
+        self, node_name: Optional[str] = None
+    ) -> tuple[list[dict[str, Any]], str]:
+        """(pods, resourceVersion) — the informer contract's list half:
+        watch from the returned version and no event between the list
+        and the watch is lost."""
+        return self._list_paginated(self._pods_base(node_name))
 
     def list_nodes(self) -> list[dict[str, Any]]:
         """Node list, paginated like list_pods (startup rebuild reads
         every node's topology annotation)."""
         return self._list_paginated(
             f"/api/v1/nodes?limit={self.LIST_PAGE_LIMIT}"
-        )
+        )[0]
 
     def watch_pods(self, node_name: Optional[str] = None,
                    timeout_seconds: int = 300,
-                   handle_box: Optional[list] = None):
+                   handle_box: Optional[list] = None,
+                   resource_version: Optional[str] = None):
         """One watch request (the informer pattern's transport): yields
         (event_type, pod) as the apiserver streams them, ending when the
         server closes the stream at ``timeoutSeconds`` — callers loop to
@@ -347,10 +375,16 @@ class RestApiServer:
         path = f"/api/v1/pods?watch=1&timeoutSeconds={timeout_seconds}"
         if node_name is not None:
             path += f"&fieldSelector=spec.nodeName%3D{node_name}"
-        headers = {"Accept": "application/json"}
-        if self._token:
-            headers["Authorization"] = f"Bearer {self._token}"
-        req = urllib.request.Request(self._base + path, headers=headers)
+        if resource_version:
+            # the informer contract: watching FROM the list's version
+            # closes the list->watch gap (without it, a watch starts at
+            # "most recent" and events in the gap are silently lost); a
+            # too-old version gets HTTP 410, which the caller's reconnect
+            # resolves with a fresh list
+            path += (
+                f"&resourceVersion={urllib.parse.quote(resource_version)}"
+            )
+        req = self._authed_request("GET", path)
         try:
             with urllib.request.urlopen(
                 req, timeout=timeout_seconds + 30, context=self._ssl
@@ -604,12 +638,22 @@ class AllocIntentWatcher(_PollLoop):
 
     def check_once(self) -> bool:
         """One full resync; True if the intent set changed."""
+        return self._resync()[0]
+
+    def _resync(self) -> tuple[bool, Optional[str]]:
+        """Full list resync; returns (changed, resourceVersion) — the
+        version is the watch's safe starting point (None when the api
+        doesn't expose it)."""
+        if hasattr(self._api, "list_pods_with_rv"):
+            pods, rv = self._api.list_pods_with_rv(self._node)
+        else:
+            pods, rv = self._api.list_pods(self._node), None
         intents: dict[str, list[str]] = {}
-        for pod in self._api.list_pods(self._node):
+        for pod in pods:
             entry = self._intent_of(pod)
             if entry is not None:
                 intents[entry[0]] = entry[1]
-        return self._server.intents.sync(intents)
+        return self._server.intents.sync(intents), rv
 
     def _apply_watch_event(self, etype: str, pod: dict[str, Any]) -> None:
         if etype == "DELETED":
@@ -639,10 +683,15 @@ class AllocIntentWatcher(_PollLoop):
             box: list = []
             self._stream_box = box
             try:
-                self.check_once()  # resync at every (re)connect
+                # resync at every (re)connect, then watch FROM the list's
+                # resourceVersion — events in the list->watch gap are the
+                # exact bind-vs-Allocate race this channel exists to win
+                _, rv = self._resync()
                 try:
-                    gen = self._api.watch_pods(self._node, handle_box=box)
-                except TypeError:  # test stubs without handle_box
+                    gen = self._api.watch_pods(
+                        self._node, handle_box=box, resource_version=rv
+                    )
+                except TypeError:  # test stubs without the full signature
                     self._box_supported = False
                     gen = self._api.watch_pods(self._node)
                 for etype, pod in gen:

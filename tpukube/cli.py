@@ -144,18 +144,26 @@ def main_plugin(argv: Optional[list[str]] = None) -> int:
             # SURVEY §4.1's "write NodeInfo annotation" step, re-run on
             # every health/link transition so the SCHEDULER (via the
             # syncer's Node PATCH) sees faults, not just the kubelet.
-            # Atomic tmp+rename: the syncer polls this file from another
-            # process — a truncate-then-write would hand it torn JSON.
+            # Atomic publish via a PER-WRITER temp file + rename: the
+            # syncer polls this file from another process, and a shared
+            # fixed temp name could be truncated by a concurrent writer
+            # mid-publish.
+            import tempfile
+
             anno = codec.annotate_node(device.node_info(), device.mesh)
             payload = json.dumps(anno)
             if args.annotation_out == "-":
                 print(payload, flush=True)
-            else:
-                tmp_path = args.annotation_out + ".tmp"
-                with open(tmp_path, "w") as f:
-                    f.write(payload + "\n")
-                os.replace(tmp_path, args.annotation_out)
+                return
+            out_dir = os.path.dirname(os.path.abspath(args.annotation_out))
+            fd, tmp_path = tempfile.mkstemp(dir=out_dir, suffix=".tmp")
+            with os.fdopen(fd, "w") as f:
+                f.write(payload + "\n")
+            os.replace(tmp_path, args.annotation_out)
 
+        # initial emit BEFORE the watcher starts: exactly one writer at a
+        # time touches the annotation file
+        write_annotation()
         watcher = HealthWatcher(device, server,
                                 on_transition=write_annotation)
         watcher.start()
@@ -170,10 +178,8 @@ def main_plugin(argv: Optional[list[str]] = None) -> int:
         )
         metrics.start()
 
-        # initial annotation emit (tpukube-syncer / the sim harness
-        # applies it to the Node object); health transitions re-emit via
-        # the watcher hook above
-        write_annotation()
+        # (initial annotation already emitted above, before the watcher
+        # started; transitions re-emit through the watcher hook)
 
         # the extender<->kubelet device-id loop: feed bound pods' planned
         # allocs into GetPreferredAllocation steering, report divergent

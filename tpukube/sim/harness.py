@@ -222,7 +222,7 @@ class SimCluster:
 
     def _extender_node_args(
         self,
-    ) -> tuple[dict[str, Any], Optional[list[int]]]:
+    ) -> tuple[dict[str, Any], Optional[list[dict[str, Any]]]]:
         """The node half of ExtenderArgs, nodeCacheCapable style: full
         node objects only when some annotation changed since the last full
         send (playing the annotation syncer's cache-refresh role), names
