@@ -78,6 +78,8 @@ def test_ctl_topo(live_cluster):
     # 5 allocated chips drawn as '#' in the grid rows (legend excluded)
     grid_rows = [l for l in out.splitlines() if l.startswith("  ")]
     assert sum(line.count("#") for line in grid_rows) == 5
+    # sim nodes ride runtime-equivalent inventory: no fallback banner
+    assert "table-fallback" not in out
 
 
 def test_ctl_alloc_and_gangs(live_cluster):

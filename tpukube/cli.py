@@ -157,9 +157,20 @@ def main_plugin(argv: Optional[list[str]] = None) -> int:
                 return
             out_dir = os.path.dirname(os.path.abspath(args.annotation_out))
             fd, tmp_path = tempfile.mkstemp(dir=out_dir, suffix=".tmp")
-            with os.fdopen(fd, "w") as f:
-                f.write(payload + "\n")
-            os.replace(tmp_path, args.annotation_out)
+            try:
+                # mkstemp files are 0600; the syncer sidecar reading this
+                # file may run as a different user — restore umask-style
+                # world-readability before publish
+                os.fchmod(fd, 0o644)
+                with os.fdopen(fd, "w") as f:
+                    f.write(payload + "\n")
+                os.replace(tmp_path, args.annotation_out)
+            except BaseException:
+                try:
+                    os.unlink(tmp_path)  # no orphaned temp per failure
+                except OSError:
+                    pass
+                raise
 
         # initial emit BEFORE the watcher starts: exactly one writer at a
         # time touches the annotation file
@@ -411,6 +422,13 @@ def _render_topo(topo: dict[str, Any], out) -> None:
         for y in range(dy):
             print("  " + " ".join(grid.get((x, y, z), " ")
                                   for x in range(dx)), file=out)
+    # nodes whose inventory rode the static generation table instead of
+    # runtime introspection: their HBM/core facts are guesses
+    fallback = [n["name"] for n in topo["nodes"]
+                if str(n.get("source", "")).startswith("table")]
+    if fallback:
+        print(f"table-fallback nodes: {', '.join(sorted(fallback))}",
+              file=out)
 
 
 def main_ctl(argv: Optional[list[str]] = None) -> int:

@@ -1076,9 +1076,12 @@ class Extender:
                     "used_shares": view.used_share_count(chip.index),
                     "shares": view.shares_per_chip,
                 })
-            nodes.append(
-                {"name": name, "slice": view.info.slice_id, "chips": chips}
-            )
+            nodes.append({
+                "name": name, "slice": view.info.slice_id, "chips": chips,
+                # operators spot table-fallback nodes (static HBM/core
+                # guesses) at a glance in tpukubectl topo
+                "source": view.info.source,
+            })
         return {
             "mesh_dims": (
                 list(self.state.slice_mesh(slice_ids[0]).dims)
