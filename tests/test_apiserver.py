@@ -1328,3 +1328,76 @@ def test_node_refresh_loop_feeds_namescapable_cache():
     assert ext.trace is not None
     divergences = trace_mod.replay(ext.trace.events(), config=cfg)
     assert divergences == []
+
+
+def test_concurrent_binds_with_flaky_binder():
+    """The out-of-lock bind effector under concurrency: interleaved slow
+    and failing binder calls must never corrupt the ledger — every pod
+    eventually binds (scheduler retries), every chip is held by exactly
+    one pod, and the apiserver's nodeName agrees with the ledger."""
+    import itertools
+    import time as _time
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        api = apisrv.FakeApiServer()
+        real_binder = apisrv.pod_binder(api)
+        calls = itertools.count()
+
+        def flaky_binder(alloc):
+            n = next(calls)
+            _time.sleep(0.001 * (n % 3))  # stagger interleavings
+            if n % 3 == 0:
+                raise apisrv.ApiServerError("transient apiserver blip")
+            real_binder(alloc)
+
+        c.extender.binder = flaky_binder
+        errs = []
+
+        def run(i):
+            import copy
+
+            pod = c.make_pod(f"p-{i}", tpu=1)
+            # a DEEP copy into the apiserver: the harness mutates its own
+            # pod dict at bind, and a shared reference would make the
+            # ledger-vs-apiserver assertions below vacuously true
+            api.upsert_pod(copy.deepcopy(pod))
+            try:
+                c.schedule(pod, retries=16)
+            except Exception as e:  # pragma: no cover - failure detail
+                errs.append(f"p-{i}: {e}")
+
+        ts = [threading.Thread(target=run, args=(i,)) for i in range(16)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs, errs
+
+        allocs = list(c.extender.state.allocations())
+        assert len(allocs) == 16
+        # no device id double-held on any node
+        seen: dict[tuple, str] = {}
+        for a in allocs:
+            for did in a.device_ids:
+                key = (a.node_name, did)
+                assert key not in seen, (
+                    f"{key} held by {seen[key]} AND {a.pod_key}"
+                )
+                seen[key] = a.pod_key
+        # every pod bound THROUGH the apiserver channel exactly once
+        binds = [e for e in api.patch_log if e[0] == "bind"]
+        assert len(binds) == 16
+        # the apiserver agrees with the ledger, pod by pod
+        for a in allocs:
+            ns, name = a.pod_key.split("/", 1)
+            pod = api.get_pod(ns, name)
+            assert pod["spec"]["nodeName"] == a.node_name
+            persisted = codec.decode_alloc(
+                pod["metadata"]["annotations"][codec.ANNO_ALLOC]
+            )
+            assert persisted.device_ids == a.device_ids
+        assert c.utilization() == 1.0
