@@ -1401,3 +1401,52 @@ def test_concurrent_binds_with_flaky_binder():
             )
             assert persisted.device_ids == a.device_ids
         assert c.utilization() == 1.0
+
+
+def test_intent_watcher_watch_mode_over_fake_api(tmp_path):
+    """The sim's apiserver speaks the watch protocol too: an intent
+    arrives through a real bind event (the Pending upsert doesn't match
+    the nodeName field selector; the Binding's MODIFIED does), DELETED
+    drops it, and stop() unblocks a quiet watch through the handle."""
+    import time as _time
+    from types import SimpleNamespace
+
+    from tpukube.core.types import AllocResult, TopologyCoord
+    from tpukube.plugin.server import AllocIntentCache
+
+    api = apisrv.FakeApiServer()
+    server = SimpleNamespace(intents=AllocIntentCache())
+    w = apisrv.AllocIntentWatcher(api, "host-0-0-0", server,
+                                  poll_seconds=0.05)
+    assert w._use_watch
+    w.start()
+    try:
+        pod = {"metadata": {"name": "a", "namespace": "default",
+                            "annotations": {}}, "spec": {}}
+        api.upsert_pod(pod)  # Pending: field selector filters this out
+        alloc = AllocResult(
+            pod_key="default/a", node_name="host-0-0-0",
+            device_ids=["tpu-2"], coords=[TopologyCoord(0, 0, 0)],
+        )
+        api.bind_pod("default", "a", "host-0-0-0",
+                     {codec.ANNO_ALLOC: codec.encode_alloc(alloc)})
+        deadline = _time.monotonic() + 5
+        while (server.intents.snapshot().get("default/a") != ["tpu-2"]
+               and _time.monotonic() < deadline):
+            _time.sleep(0.01)
+        assert server.intents.snapshot()["default/a"] == ["tpu-2"]
+
+        api.delete_pod("default", "a")
+        deadline = _time.monotonic() + 5
+        while server.intents.snapshot() and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        assert server.intents.snapshot() == {}
+
+        t0 = _time.monotonic()
+        w.stop()
+        assert _time.monotonic() - t0 < 4, "stop() hung behind the fake watch"
+        w = None
+    finally:
+        if w is not None:
+            w.stop()
+    assert api._watch_queues == []  # subscription cleaned up

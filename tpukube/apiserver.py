@@ -33,6 +33,8 @@ from __future__ import annotations
 import json
 import logging
 import os
+import copy
+import queue
 import socket
 import ssl
 import threading
@@ -93,6 +95,13 @@ class FakeApiServer:
         # fake's stand-in for the real apiserver's 429): tests add keys
         # here to exercise the executor's requeue path
         self.pdb_blocked: set[str] = set()
+        # live watch subscriptions (watch_pods): each holds an event queue
+        self._watch_queues: list = []
+
+    def _notify(self, etype: str, pod: dict[str, Any]) -> None:
+        """Fan a pod event out to live watchers (call under self._lock)."""
+        for q in self._watch_queues:
+            q.put((etype, copy.deepcopy(pod)))
 
     # -- nodes -------------------------------------------------------------
     def patch_node_annotations(
@@ -127,11 +136,15 @@ class FakeApiServer:
         meta = pod["metadata"]
         key = f"{meta.get('namespace', 'default')}/{meta['name']}"
         with self._lock:
+            etype = "MODIFIED" if key in self._pods else "ADDED"
             self._pods[key] = pod
+            self._notify(etype, pod)
 
     def delete_pod(self, namespace: str, name: str) -> None:
         with self._lock:
-            self._pods.pop(f"{namespace}/{name}", None)
+            pod = self._pods.pop(f"{namespace}/{name}", None)
+            if pod is not None:
+                self._notify("DELETED", pod)
 
     def evict_pod(self, namespace: str, name: str) -> bool:
         """Graceful eviction: True once the pod is gone (or already was),
@@ -141,13 +154,65 @@ class FakeApiServer:
         with self._lock:
             if key in self.pdb_blocked:
                 return False
-            self._pods.pop(key, None)
+            pod = self._pods.pop(key, None)
+            if pod is not None:
+                self._notify("DELETED", pod)
             self.patch_log.append(("evict", key))
         return True
 
     def get_pod(self, namespace: str, name: str) -> Optional[dict[str, Any]]:
         with self._lock:
             return self._pods.get(f"{namespace}/{name}")
+
+    def watch_pods(self, node_name: Optional[str] = None,
+                   timeout_seconds: int = 300,
+                   handle_box: Optional[list] = None,
+                   resource_version: Optional[str] = None):
+        """The fake's watch half of the informer contract: yields
+        (event_type, pod) for every mutation after THIS CALL, honoring
+        the spec.nodeName field selector. Subscription happens eagerly
+        here — not at the generator's first next() — so no event can
+        slip between the caller's list resync and the iteration start
+        (the list->watch gap the informer pattern exists to close). The
+        handle placed in ``handle_box`` exposes close() (enqueues a
+        poison pill), so AllocIntentWatcher.stop() unblocks a quiet
+        watch exactly as it does the REST stream."""
+        q: queue.SimpleQueue = queue.SimpleQueue()
+
+        class _Handle:
+            def close(self) -> None:
+                q.put(None)
+
+        with self._lock:
+            self._watch_queues.append(q)
+        if handle_box is not None:
+            handle_box.append(_Handle())
+
+        def _events():
+            try:
+                deadline = time.monotonic() + timeout_seconds
+                while True:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return  # server timeout; caller reconnects
+                    try:
+                        ev = q.get(timeout=remaining)
+                    except queue.Empty:
+                        return
+                    if ev is None:
+                        return  # closed via the handle
+                    etype, pod = ev
+                    if node_name is not None:
+                        bound = (pod.get("spec") or {}).get("nodeName")
+                        if bound != node_name:
+                            continue
+                    yield etype, pod
+            finally:
+                with self._lock:
+                    if q in self._watch_queues:
+                        self._watch_queues.remove(q)
+
+        return _events()
 
     def bind_pod(
         self, namespace: str, name: str, node: str,
@@ -177,6 +242,7 @@ class FakeApiServer:
                 )
             spec["nodeName"] = node
             self.patch_log.append(("bind", key))
+            self._notify("MODIFIED", pod)
 
     def patch_pod_annotations(
         self, namespace: str, name: str, annotations: dict[str, Optional[str]]
@@ -195,6 +261,7 @@ class FakeApiServer:
                 else:
                     annos[k] = v
             self.patch_log.append(("pod", key))
+            self._notify("MODIFIED", pod)
 
     def list_pods(self, node_name: Optional[str] = None) -> list[dict[str, Any]]:
         with self._lock:
