@@ -961,6 +961,221 @@ def test_rebuild_extender_from_apiserver():
         assert len(res.assigned) == 4
 
 
+# -- pod-lifecycle release loop ----------------------------------------------
+
+def test_lifecycle_release_via_sim_harness():
+    """The sim's delete/complete paths run the SAME release loop a real
+    extender daemon runs — no manual extender.release side channel."""
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        p0 = c.make_pod("a", tpu=1)
+        p1 = c.make_pod("b", tpu=1)
+        c.schedule(p0)
+        c.schedule(p1)
+        assert c.extender.state.allocation("default/a") is not None
+
+        c.delete_pod("a")  # object gone -> released
+        assert c.extender.state.allocation("default/a") is None
+
+        c.complete_pod("b")  # phase Succeeded, object LINGERS -> released
+        assert c.extender.state.allocation("default/b") is None
+        assert "default/b" in c.pods  # the completed pod object remains
+        assert c.extender.state.utilization() == 0.0
+        assert c._lifecycle.released == 2
+        assert c._lifecycle.check_once() is False  # idempotent
+
+
+def test_lifecycle_watch_mode_releases_on_delete():
+    """Watch-mode loop against the fake apiserver: a bound pod's DELETED
+    event frees its chips with no poll and no manual release."""
+    import time
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        api = apisrv.FakeApiServer()
+        c.extender.binder = apisrv.pod_binder(api)
+        pod = c.make_pod("w0", tpu=1)
+        api.upsert_pod(pod)
+        c.schedule(pod)
+        assert c.extender.state.allocation("default/w0") is not None
+
+        loop = apisrv.PodLifecycleReleaseLoop(
+            c.extender, api, poll_seconds=0.05
+        )
+        assert loop._use_watch
+        loop.start()
+        try:
+            api.delete_pod("default", "w0")
+            deadline = time.monotonic() + 5
+            while (time.monotonic() < deadline
+                   and c.extender.state.allocation("default/w0")):
+                time.sleep(0.02)
+            assert c.extender.state.allocation("default/w0") is None
+            assert loop.released == 1
+        finally:
+            loop.stop()
+
+
+def test_lifecycle_resync_confirms_before_releasing():
+    """A list snapshot can predate a just-bound pod's creation; the resync
+    must GET-confirm absence before releasing, or it would free a LIVE
+    pod's chips out from under it."""
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        pod = c.make_pod("young", tpu=1)
+        c.schedule(pod)
+
+        class StaleListApi:
+            """List is stale (missing the pod); GET still finds it."""
+
+            def list_pods(self, node_name=None):
+                return []
+
+            def get_pod(self, namespace, name):
+                return c.pods.get(f"{namespace}/{name}")
+
+        loop = apisrv.PodLifecycleReleaseLoop(
+            c.extender, StaleListApi(), use_watch=False
+        )
+        assert loop.check_once() is False
+        assert c.extender.state.allocation("default/young") is not None
+
+        # once the pod is REALLY gone, the same resync releases it
+        del c.pods["default/young"]
+        assert loop.check_once() is True
+        assert c.extender.state.allocation("default/young") is None
+
+
+def test_lifecycle_watch_event_semantics():
+    """Event rules: DELETED releases; terminal phase releases; Running
+    MODIFIED and unknown pods do not."""
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        for name in ("a", "b", "c"):
+            c.schedule(c.make_pod(name, tpu=1))
+        loop = c._lifecycle
+
+        def pod_obj(name, phase=None):
+            obj = {"metadata": {"name": name, "namespace": "default"}}
+            if phase:
+                obj["status"] = {"phase": phase}
+            return obj
+
+        loop._apply_watch_event("MODIFIED", pod_obj("a", "Running"))
+        assert c.extender.state.allocation("default/a") is not None
+        loop._apply_watch_event("MODIFIED", pod_obj("a", "Failed"))
+        assert c.extender.state.allocation("default/a") is None
+        loop._apply_watch_event("DELETED", pod_obj("b"))
+        assert c.extender.state.allocation("default/b") is None
+        # a stranger pod's deletion is a no-op, not an error
+        loop._apply_watch_event("DELETED", pod_obj("stranger"))
+        assert loop.released == 2
+
+
+def test_lifecycle_uid_guard_spares_recreated_pod():
+    """Pod names recur (StatefulSet members). A stale lifecycle signal
+    about the OLD incarnation must not free the chips a recreated,
+    re-bound pod is holding — and a same-name pod with a different uid
+    proves the ledger's incarnation is gone (phantom-allocation cure)."""
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        pod = c.make_pod("web-0", tpu=1)
+        c.schedule(pod)
+        alloc = c.extender.state.allocation("default/web-0")
+        assert alloc is not None and alloc.uid == "uid-default-web-0"
+        loop = c._lifecycle
+
+        stale = {"metadata": {"name": "web-0", "namespace": "default",
+                              "uid": "uid-of-the-OLD-incarnation"}}
+        loop._apply_watch_event("DELETED", stale)
+        assert c.extender.state.allocation("default/web-0") is not None
+        loop._apply_watch_event(
+            "MODIFIED",
+            {"metadata": {"name": "web-0", "namespace": "default",
+                          "uid": "uid-of-the-OLD-incarnation"},
+             "status": {"phase": "Failed"}},
+        )
+        assert c.extender.state.allocation("default/web-0") is not None
+        assert loop.released == 0
+
+        # resync: the store now holds a RECREATED web-0 (different uid,
+        # not yet bound) — the old incarnation's ledger entry must go, or
+        # the newcomer's bind 409s forever
+        c.pods["default/web-0"]["metadata"]["uid"] = "uid-recreated"
+        assert loop.check_once() is True
+        assert c.extender.state.allocation("default/web-0") is None
+        assert loop.released == 1
+
+        # the matching-uid event releases normally
+        pod2 = c.make_pod("web-1", tpu=1)
+        c.schedule(pod2)
+        loop._apply_watch_event(
+            "DELETED",
+            {"metadata": {"name": "web-1", "namespace": "default",
+                          "uid": "uid-default-web-1"}},
+        )
+        assert c.extender.state.allocation("default/web-1") is None
+
+
+def test_rebuild_skips_dead_and_unbound_pods():
+    """The restart path must not re-import the leak: terminal-phase pods,
+    unbound alloc residue (bind partial failure), and node-mismatched
+    annotations are skipped; a gracefully-TERMINATING pod is restored
+    (its containers still hold the chips until it is really gone)."""
+    import copy as copymod
+
+    from tpukube.sched.extender import Extender
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        api = apisrv.FakeApiServer()
+        specs = {}
+        for name in ("live", "done", "terminating", "residue", "moved"):
+            pod = c.make_pod(name, tpu=1)
+            c.schedule(pod)
+            specs[name] = copymod.deepcopy(pod)
+        for obj in c.node_objects():
+            api.patch_node_annotations(
+                obj["metadata"]["name"], obj["metadata"]["annotations"]
+            )
+
+        specs["done"].setdefault("status", {})["phase"] = "Succeeded"
+        specs["terminating"]["metadata"]["deletionTimestamp"] = (
+            "2026-07-30T00:00:00Z"
+        )
+        del specs["residue"]["spec"]["nodeName"]  # Binding POST never landed
+        other = [n for n in c.nodes
+                 if n != specs["moved"]["spec"]["nodeName"]][0]
+        specs["moved"]["spec"]["nodeName"] = other
+        for pod in specs.values():
+            api.upsert_pod(pod)
+
+        fresh = Extender(cfg)
+        assert apisrv.rebuild_extender(fresh, api) == 2
+        assert fresh.state.allocation("default/live") is not None
+        assert fresh.state.allocation("default/terminating") is not None
+        for name in ("done", "residue", "moved"):
+            assert fresh.state.allocation(f"default/{name}") is None, name
+
+
 # -- watch channel -----------------------------------------------------------
 
 def test_rest_watch_pods_streams_events():

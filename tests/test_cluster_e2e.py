@@ -112,6 +112,9 @@ def test_full_cluster_lifecycle(tmp_path):
         )
         reconcile = apisrv.AllocReconcileLoop(ext, api, poll_seconds=999)
         evictions = apisrv.EvictionExecutor(ext, api, poll_seconds=999)
+        lifecycle = apisrv.PodLifecycleReleaseLoop(
+            ext, api, poll_seconds=999, use_watch=False
+        )
         assert apisrv.rebuild_extender(ext, api) == 0
         assert refresh.check_once() is True  # topology flows api -> cache
 
@@ -192,15 +195,31 @@ def test_full_cluster_lifecycle(tmp_path):
         assert health.check_once() and syncer.check_once()
         assert refresh.check_once() is True
         # all-or-nothing holds: a released gang member's chip stays
-        # reserved for a REPLACEMENT member, never for bystanders
+        # reserved for a REPLACEMENT member, never for bystanders. The
+        # release is the lifecycle loop observing the deletion — no
+        # manual release call anywhere in this cluster's day.
         api.delete_pod("default", "vip-3")
-        ext.handle("release", {"pod_key": "default/vip-3"})
+        assert lifecycle.check_once() is True
+        assert ext.state.allocation("default/vip-3") is None
         with pytest.raises(RuntimeError, match="unschedulable"):
             _schedule(ext, api, pod3)
         replacement = _pod_obj("vip-3b", tpu=1, priority=100, group=gang)
         api.upsert_pod(replacement)
         assert _schedule(ext, api, replacement) == "host-0-0-0"
         assert api.get_pod("default", "vip-3b")["spec"]["nodeName"]
+
+        # ---- the job finishes: terminal phases recycle the chips -------
+        # completed Job pods LINGER as objects (phase Succeeded); only the
+        # lifecycle loop's phase rule returns their chips, the gang
+        # dissolves with its last member, and the bystander finally fits
+        for name in ("vip-0", "vip-1", "vip-2", "vip-3b"):
+            obj = api.get_pod("default", name)
+            obj.setdefault("status", {})["phase"] = "Succeeded"
+            api.upsert_pod(obj)
+        assert lifecycle.check_once() is True
+        assert ext.gang.reservation("default", "vip") is None
+        assert ext.state.utilization() == 0.0
+        assert _schedule(ext, api, pod3) == "host-0-0-0"
 
         # the whole day replays deterministically from the trace
         from tpukube import trace as trace_mod

@@ -660,88 +660,35 @@ class NodeAnnotationSyncer(_PollLoop):
         return True
 
 
-class AllocIntentWatcher(_PollLoop):
-    """Feeds the extender's planned allocations to the device plugin.
-
-    Polls pods bound to this node; every ``tpu.qiniu.com/alloc`` annotation
-    becomes an intent in the plugin's :class:`~tpukube.plugin.server.
-    AllocIntentCache`, which GetPreferredAllocation serves back to the
-    kubelet — closing the loop the reference closes with its annotation
-    channel (SURVEY §4.3): the kubelet's id choice converges on the chips
-    the gang's contiguity score was computed for."""
+class _WatchLoop(_PollLoop):
+    """Informer-pattern scaffolding shared by the pod-watching loops:
+    list-resync at every (re)connect, then a watch FROM the list's
+    resourceVersion, with the poll loop as the no-watch fallback.
+    Subclasses implement ``_resync()`` (full list reconciliation,
+    returning ``(changed, resourceVersion)``) and
+    ``_apply_watch_event(etype, pod)``."""
 
     def __init__(
-        self, api, node_name: str, server, poll_seconds: float = 5.0,
-        use_watch: bool = True,
+        self, name: str, api, node_name: Optional[str],
+        poll_seconds: float, use_watch: bool,
     ) -> None:
-        super().__init__(poll_seconds, "tpukube-alloc-intents")
+        super().__init__(poll_seconds, name)
         self._api = api
         self._node = node_name
-        self._server = server
-        # watch mode (the informer pattern): intents land within ms of
-        # the bind instead of a poll interval later — steering would
-        # otherwise routinely lose the race against the kubelet's
-        # Allocate on a real cluster. Full list_pods resync on every
-        # (re)connect; the fake apiserver has no watch, so sim keeps
-        # polling.
         self._use_watch = use_watch and hasattr(api, "watch_pods")
         self._box_supported = True  # False after a handle_box TypeError
-        self.watch_events = 0  # processed watch events (tests/metrics)
 
-    @staticmethod
-    def _intent_of(pod: dict[str, Any]):
-        """(pod_key, device_ids) from a pod's alloc annotation, or None."""
-        meta = pod.get("metadata", {})
-        payload = (meta.get("annotations") or {}).get(codec.ANNO_ALLOC)
-        if not payload:
-            return None
-        try:
-            alloc = codec.decode_alloc(payload)
-        except codec.CodecError as e:
-            log.warning("pod %s: bad alloc annotation: %s",
-                        meta.get("name"), e)
-            return None
-        return alloc.pod_key, list(alloc.device_ids)
+    def _resync(self) -> tuple[bool, Optional[str]]:  # pragma: no cover
+        raise NotImplementedError
+
+    def _apply_watch_event(
+        self, etype: str, pod: dict[str, Any]
+    ) -> None:  # pragma: no cover - overridden
+        raise NotImplementedError
 
     def check_once(self) -> bool:
-        """One full resync; True if the intent set changed."""
+        """One full resync; True if anything changed."""
         return self._resync()[0]
-
-    def _resync(self) -> tuple[bool, Optional[str]]:
-        """Full list resync; returns (changed, resourceVersion) — the
-        version is the watch's safe starting point (None when the api
-        doesn't expose it)."""
-        if hasattr(self._api, "list_pods_with_rv"):
-            pods, rv = self._api.list_pods_with_rv(self._node)
-        else:
-            pods, rv = self._api.list_pods(self._node), None
-        intents: dict[str, list[str]] = {}
-        for pod in pods:
-            entry = self._intent_of(pod)
-            if entry is not None:
-                intents[entry[0]] = entry[1]
-        return self._server.intents.sync(intents), rv
-
-    def _apply_watch_event(self, etype: str, pod: dict[str, Any]) -> None:
-        if etype == "DELETED":
-            # the pod key needs no annotation decode (the final object's
-            # annotation may be corrupt; the intent must still die NOW,
-            # not at the next reconnect resync)
-            meta = pod.get("metadata") or {}
-            name = meta.get("name")
-            if name:
-                self.watch_events += 1
-                self._server.intents.remove(
-                    f"{meta.get('namespace', 'default')}/{name}"
-                )
-            return
-        entry = self._intent_of(pod)
-        if entry is None:
-            return
-        self.watch_events += 1
-        # offer, not put: a consumed intent must not be resurrected by
-        # the pod's later MODIFIED events / reconnect replays
-        self._server.intents.offer(entry[0], entry[1])
 
     def _run(self) -> None:
         if not self._use_watch:
@@ -751,8 +698,7 @@ class AllocIntentWatcher(_PollLoop):
             self._stream_box = box
             try:
                 # resync at every (re)connect, then watch FROM the list's
-                # resourceVersion — events in the list->watch gap are the
-                # exact bind-vs-Allocate race this channel exists to win
+                # resourceVersion — no event in the list->watch gap is lost
                 _, rv = self._resync()
                 try:
                     gen = self._api.watch_pods(
@@ -798,6 +744,217 @@ class AllocIntentWatcher(_PollLoop):
             except Exception:
                 pass
         super().stop()
+
+
+class AllocIntentWatcher(_WatchLoop):
+    """Feeds the extender's planned allocations to the device plugin.
+
+    Watches pods bound to this node; every ``tpu.qiniu.com/alloc``
+    annotation becomes an intent in the plugin's :class:`~tpukube.plugin.
+    server.AllocIntentCache`, which GetPreferredAllocation serves back to
+    the kubelet — closing the loop the reference closes with its annotation
+    channel (SURVEY §4.3): the kubelet's id choice converges on the chips
+    the gang's contiguity score was computed for."""
+
+    def __init__(
+        self, api, node_name: str, server, poll_seconds: float = 5.0,
+        use_watch: bool = True,
+    ) -> None:
+        # watch mode (the informer pattern): intents land within ms of
+        # the bind instead of a poll interval later — steering would
+        # otherwise routinely lose the race against the kubelet's
+        # Allocate on a real cluster. Both apiserver implementations
+        # (REST and fake) speak the watch protocol; poll mode remains
+        # for deterministic stepping (check_once) in tests/sim.
+        super().__init__("tpukube-alloc-intents", api, node_name,
+                         poll_seconds, use_watch)
+        self._server = server
+        self.watch_events = 0  # processed watch events (tests/metrics)
+
+    @staticmethod
+    def _intent_of(pod: dict[str, Any]):
+        """(pod_key, device_ids) from a pod's alloc annotation, or None."""
+        meta = pod.get("metadata", {})
+        payload = (meta.get("annotations") or {}).get(codec.ANNO_ALLOC)
+        if not payload:
+            return None
+        try:
+            alloc = codec.decode_alloc(payload)
+        except codec.CodecError as e:
+            log.warning("pod %s: bad alloc annotation: %s",
+                        meta.get("name"), e)
+            return None
+        return alloc.pod_key, list(alloc.device_ids)
+
+    def _resync(self) -> tuple[bool, Optional[str]]:
+        """Full list resync; returns (changed, resourceVersion) — the
+        version is the watch's safe starting point (None when the api
+        doesn't expose it)."""
+        if hasattr(self._api, "list_pods_with_rv"):
+            pods, rv = self._api.list_pods_with_rv(self._node)
+        else:
+            pods, rv = self._api.list_pods(self._node), None
+        intents: dict[str, list[str]] = {}
+        for pod in pods:
+            entry = self._intent_of(pod)
+            if entry is not None:
+                intents[entry[0]] = entry[1]
+        return self._server.intents.sync(intents), rv
+
+    def _apply_watch_event(self, etype: str, pod: dict[str, Any]) -> None:
+        if etype == "DELETED":
+            # the pod key needs no annotation decode (the final object's
+            # annotation may be corrupt; the intent must still die NOW,
+            # not at the next reconnect resync)
+            meta = pod.get("metadata") or {}
+            name = meta.get("name")
+            if name:
+                self.watch_events += 1
+                self._server.intents.remove(
+                    f"{meta.get('namespace', 'default')}/{name}"
+                )
+            return
+        entry = self._intent_of(pod)
+        if entry is None:
+            return
+        self.watch_events += 1
+        # offer, not put: a consumed intent must not be resurrected by
+        # the pod's later MODIFIED events / reconnect replays
+        self._server.intents.offer(entry[0], entry[1])
+
+
+# pod phases whose containers have stopped for good — their devices are
+# free even while the pod object lingers (phase is monotonic once terminal)
+TERMINAL_PHASES = frozenset({"Succeeded", "Failed"})
+
+
+def _pod_key_of(pod: dict[str, Any]) -> Optional[str]:
+    meta = pod.get("metadata") or {}
+    name = meta.get("name")
+    if not name:
+        return None
+    return f"{meta.get('namespace', 'default')}/{name}"
+
+
+class PodLifecycleReleaseLoop(_WatchLoop):
+    """The release effector for pod lifecycle — SURVEY §4.4's recovery loop
+    ("pods on dead device fail → controller reschedules") structurally
+    requires it, and so does every long-lived cluster.
+
+    kube-scheduler only talks to the extender about pods it is *placing*;
+    nothing in the webhook protocol ever says a placed pod finished.
+    Without this loop a completed or deleted pod's chips stay committed in
+    the ledger forever: utilization reads 100% while the hardware idles,
+    later gangs cannot fit, and preemption plans evict pods that no longer
+    exist. This loop watches pod lifecycle cluster-wide and turns each
+    ending into the extender's recorded ``release`` decision:
+
+      * ``DELETED``                  → release (object gone, devices freed)
+      * phase ``Succeeded``/``Failed`` → release (containers stopped; the
+        object lingers until a controller or operator deletes it, but the
+        kubelet has already returned the devices)
+
+    A pod carrying only a ``deletionTimestamp`` is NOT released: graceful
+    termination means its containers may still hold the chips — the same
+    conservative rule :class:`EvictionExecutor` applies before counting a
+    preemption victim as evicted.
+
+    The resync (every (re)connect; every poll in no-watch mode) closes
+    watch gaps from both directions: listed pods in a terminal phase are
+    released directly, and ledger allocations whose pod is absent from the
+    list are released only after a confirming GET — the GET, not the list,
+    is the authority, because the list snapshot may predate a just-bound
+    pod's creation (pods are always created before they are scheduled, so
+    a pod the GET still finds is alive, not leaked).
+
+    Gang note: only committed allocations are released here. A gang
+    member holding a pre-bind *reservation* (assigned, never bound) whose
+    pod vanishes is rolled back by the gang layer's own TTL — the
+    documented path for half-assembled gangs.
+    """
+
+    def __init__(
+        self, extender, api, poll_seconds: float = 5.0,
+        use_watch: bool = True,
+    ) -> None:
+        super().__init__("tpukube-pod-lifecycle", api, None,
+                         poll_seconds, use_watch)
+        self._extender = extender
+        self.released = 0  # lifecycle releases applied (tests/metrics)
+
+    def _release(self, pod_key: str, why: str, uid: str = "") -> bool:
+        alloc = self._extender.state.allocation(pod_key)
+        if alloc is None:
+            return False
+        if alloc.uid and uid and alloc.uid != uid:
+            # pod names recur (StatefulSet members): this signal is about
+            # a DIFFERENT incarnation than the ledger entry — a stale
+            # DELETED event or stale list entry must not free the chips a
+            # recreated, live pod is holding
+            log.info("lifecycle signal for %s ignored: uid %s is not the "
+                     "ledger's %s", pod_key, uid, alloc.uid)
+            return False
+        self._extender.handle("release", {"pod_key": pod_key})
+        self.released += 1
+        log.info("released %s (%s)", pod_key, why)
+        return True
+
+    def _apply_watch_event(self, etype: str, pod: dict[str, Any]) -> None:
+        key = _pod_key_of(pod)
+        if key is None:
+            return
+        uid = str((pod.get("metadata") or {}).get("uid") or "")
+        if etype == "DELETED":
+            self._release(key, "pod deleted", uid=uid)
+            return
+        phase = (pod.get("status") or {}).get("phase")
+        if phase in TERMINAL_PHASES:
+            self._release(key, f"phase {phase}", uid=uid)
+
+    def _resync(self) -> tuple[bool, Optional[str]]:
+        if hasattr(self._api, "list_pods_with_rv"):
+            pods, rv = self._api.list_pods_with_rv()
+        else:
+            pods, rv = self._api.list_pods(), None
+        present: dict[str, str] = {}  # key -> listed uid
+        changed = False
+        for pod in pods:
+            key = _pod_key_of(pod)
+            if key is None:
+                continue
+            uid = str((pod.get("metadata") or {}).get("uid") or "")
+            present[key] = uid
+            if (pod.get("status") or {}).get("phase") in TERMINAL_PHASES:
+                changed |= self._release(key, "terminal phase (resync)",
+                                         uid=uid)
+        for alloc in self._extender.state.allocations():
+            listed_uid = present.get(alloc.pod_key)
+            if listed_uid is not None:
+                if not (alloc.uid and listed_uid
+                        and alloc.uid != listed_uid):
+                    continue  # same (or indeterminate) incarnation — alive
+                # a same-name pod with a DIFFERENT uid: the allocation's
+                # incarnation is gone; holding its entry would 409 the
+                # newcomer's bind forever (phantom allocation)
+                changed |= self._release(alloc.pod_key,
+                                         "pod replaced (resync)")
+                continue
+            namespace, name = alloc.pod_key.split("/", 1)
+            try:
+                pod = self._api.get_pod(namespace, name)
+            except Exception as e:
+                log.warning("lifecycle confirm of %s failed, retrying: %s",
+                            alloc.pod_key, e)
+                continue
+            if pod is not None:
+                cur_uid = str((pod.get("metadata") or {}).get("uid") or "")
+                if not (alloc.uid and cur_uid and alloc.uid != cur_uid):
+                    continue  # created after the list snapshot — alive
+                changed |= self._release(alloc.pod_key,
+                                         "pod replaced (resync)")
+                continue
+            changed |= self._release(alloc.pod_key, "pod absent (resync)")
+        return changed, rv
 
 
 class NodeTopologyRefreshLoop(_PollLoop):
@@ -854,9 +1011,13 @@ def rebuild_extender(extender, api) -> int:
     """Reconstruct a restarted extender's ledger AND gang reservations
     from the apiserver (SURVEY §6 restart story, wired to the real
     channel): node topology annotations first — the ledger can only
-    commit onto known nodes — then every pod's alloc annotation. A node
-    whose annotation is malformed is skipped loudly; its pods then fail
-    to restore (also loudly) and the reconcile machinery takes over.
+    commit onto known nodes — then every *live, bound* pod's alloc
+    annotation. Lifecycle-filtered: terminal-phase pods, unbound pods
+    (bind partial-failure residue), and pods whose bound node contradicts
+    their annotation are skipped loudly — restoring any of them would
+    resurrect a dead or phantom allocation. A node whose annotation is
+    malformed is skipped loudly; its pods then fail to restore (also
+    loudly) and the reconcile machinery takes over.
     Returns the number of allocations restored."""
     for obj in api.list_nodes():
         meta = obj.get("metadata") or {}
@@ -873,10 +1034,53 @@ def rebuild_extender(extender, api) -> int:
         if out.get("error"):
             log.error("rebuild: node %s annotation rejected: %s",
                       name, out["error"])
-    pods = [
-        dict((p.get("metadata") or {}).get("annotations") or {})
-        for p in api.list_pods()
-    ]
+    pods = []
+    for p in api.list_pods():
+        meta = p.get("metadata") or {}
+        annos = dict(meta.get("annotations") or {})
+        payload = annos.get(codec.ANNO_ALLOC)
+        if not payload:
+            continue
+        key = _pod_key_of(p)
+        if key is None:
+            continue
+        phase = (p.get("status") or {}).get("phase")
+        if phase in TERMINAL_PHASES:
+            # the pod finished; its devices are free. Restoring it would
+            # re-import exactly the leak PodLifecycleReleaseLoop exists to
+            # close. (A pod with only a deletionTimestamp IS restored: its
+            # containers may still hold the chips through graceful
+            # termination, and the lifecycle loop releases it on DELETED.)
+            log.warning("rebuild: skipping %s (phase %s — chips are free)",
+                        key, phase)
+            continue
+        node_name = (p.get("spec") or {}).get("nodeName")
+        if not node_name:
+            # the bind effector's designed partial-failure residue: the
+            # annotation PATCH landed but the Binding POST failed, so the
+            # ledger was released and the scheduler retries. Restoring it
+            # would plant a phantom allocation that 409s every bind retry,
+            # pinning the pod Pending and leaking its chips.
+            log.warning("rebuild: skipping %s (alloc annotation on an "
+                        "unbound pod — bind partial-failure residue)", key)
+            continue
+        try:
+            planned = codec.decode_alloc(payload)
+        except codec.CodecError:
+            planned = None  # rebuild_from_pods logs the decode loudly
+        if planned is not None and planned.node_name != node_name:
+            log.warning("rebuild: skipping %s (alloc says node %s but the "
+                        "pod is bound to %s — stale annotation)",
+                        key, planned.node_name, node_name)
+            continue
+        pod_uid = str(meta.get("uid") or "")
+        if (planned is not None and planned.uid and pod_uid
+                and planned.uid != pod_uid):
+            log.warning("rebuild: skipping %s (alloc was for uid %s; the "
+                        "pod is a recreation with uid %s)",
+                        key, planned.uid, pod_uid)
+            continue
+        pods.append(annos)
     return extender.rebuild_from_pods(pods)
 
 

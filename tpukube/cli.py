@@ -324,6 +324,7 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
             AllocReconcileLoop,
             EvictionExecutor,
             NodeTopologyRefreshLoop,
+            PodLifecycleReleaseLoop,
             pod_binder,
             rebuild_extender,
         )
@@ -350,7 +351,10 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
         node_refresh = NodeTopologyRefreshLoop(
             extender, api, poll_seconds=cfg.health_poll_seconds
         )
-        loops = [reconcile, evictions, node_refresh]
+        # the release effector: completed/deleted pods' chips return to
+        # the ledger — without it every finished job leaks its chips
+        lifecycle = PodLifecycleReleaseLoop(extender, api)
+        loops = [reconcile, evictions, node_refresh, lifecycle]
         for loop in loops:
             loop.start()
     log.warning("extender serving on %s:%d (score_mode=%s)",

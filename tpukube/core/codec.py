@@ -181,18 +181,20 @@ def node_from_annotations(
 # -- allocation result -----------------------------------------------------
 
 def encode_alloc(alloc: AllocResult) -> str:
-    return json.dumps(
-        {
-            "v": SCHEMA_VERSION,
-            "pod": alloc.pod_key,
-            "node": alloc.node_name,
-            "devices": alloc.device_ids,
-            "coords": [c.as_list() for c in alloc.coords],
-            "env": alloc.env,
-            "priority": alloc.priority,
-        },
-        separators=(",", ":"),
-    )
+    obj = {
+        "v": SCHEMA_VERSION,
+        "pod": alloc.pod_key,
+        "node": alloc.node_name,
+        "devices": alloc.device_ids,
+        "coords": [c.as_list() for c in alloc.coords],
+        "env": alloc.env,
+        "priority": alloc.priority,
+    }
+    if alloc.uid:
+        # optional, not a schema bump: pre-UID decoders ignore it, and
+        # pre-UID payloads decode to uid="" (name-only semantics)
+        obj["uid"] = alloc.uid
+    return json.dumps(obj, separators=(",", ":"))
 
 
 def decode_alloc(payload: str) -> AllocResult:
@@ -209,6 +211,7 @@ def decode_alloc(payload: str) -> AllocResult:
             coords=[TopologyCoord.of(c) for c in obj.get("coords", [])],
             env=dict(obj.get("env", {})),
             priority=int(obj.get("priority", 0)),
+            uid=str(obj.get("uid", "")),
         )
     except CodecError:
         raise

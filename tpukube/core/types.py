@@ -271,6 +271,11 @@ class AllocResult:
     # pod priority, persisted in the annotation so a restarted extender
     # rebuilds preemption protection (not just occupancy)
     priority: int = 0
+    # pod UID at bind time ("" for pre-UID annotations). Pod names recur
+    # — controllers recreate StatefulSet members under the same name — so
+    # the UID is what lets the lifecycle release loop and the restart
+    # rebuild tell THIS incarnation's allocation from a stale one
+    uid: str = ""
 
     def chip_indices(self) -> list[int]:
         return [parse_device_id(d)[0] for d in self.device_ids]

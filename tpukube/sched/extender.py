@@ -793,6 +793,7 @@ class Extender:
                 coords=sorted(set(plan)),
                 env=env,
                 priority=pod.priority,
+                uid=uid or cached_uid or "",
             )
             self.state.commit(alloc)  # StateError on lost race
             if res is not None:
@@ -1023,6 +1024,7 @@ class Extender:
             coords=coords,
             env=alloc.env,
             priority=alloc.priority,
+            uid=alloc.uid,
         )
         try:
             self.state.commit(actual)

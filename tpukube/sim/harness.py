@@ -39,13 +39,14 @@ from tpukube.core.types import (
     TopologyCoord,
     canonical_link,
 )
-from tpukube.apiserver import EvictionExecutor
+from tpukube.apiserver import EvictionExecutor, PodLifecycleReleaseLoop
 from tpukube.sched.extender import Extender, make_app
 
 
 class _PodStoreApi:
-    """Adapter giving EvictionExecutor the apiserver ``evict_pod`` surface
-    over the harness's in-memory pod store (no PDBs in the sim)."""
+    """Adapter giving EvictionExecutor and PodLifecycleReleaseLoop the
+    apiserver surface over the harness's in-memory pod store (no PDBs in
+    the sim)."""
 
     def __init__(self, pods: dict[str, dict[str, Any]]) -> None:
         self._pods = pods
@@ -59,6 +60,13 @@ class _PodStoreApi:
 
     def get_pod(self, namespace: str, name: str) -> Optional[dict[str, Any]]:
         return self._pods.get(f"{namespace}/{name}")
+
+    def list_pods(self, node_name: Optional[str] = None) -> list[dict[str, Any]]:
+        return [
+            p for p in list(self._pods.values())
+            if node_name is None
+            or p.get("spec", {}).get("nodeName") == node_name
+        ]
 
 
 def _free_port() -> int:
@@ -156,9 +164,16 @@ class SimCluster:
                 )
         self.extender = Extender(self.config)
         self.pods: dict[str, dict[str, Any]] = {}  # key -> pod object
+        store_api = _PodStoreApi(self.pods)
         self._evictions = EvictionExecutor(
-            self.extender, _PodStoreApi(self.pods)
+            self.extender, store_api
         )  # drained inline by schedule(); not started as a thread
+        # same release loop a real extender daemon runs, stepped
+        # deterministically (delete_pod/complete_pod) instead of as a
+        # thread — the sim has no manual extender.release side channel
+        self._lifecycle = PodLifecycleReleaseLoop(
+            self.extender, store_api, use_watch=False
+        )
         self._node_obj_cache: dict[str, dict[str, Any]] = {}
         self._synced_objs: list[dict[str, Any]] = []  # see _extender_node_args
         self._port = _free_port()
@@ -374,9 +389,22 @@ class SimCluster:
         raise RuntimeError(f"bind error after {retries} cycles: {last_err}")
 
     def delete_pod(self, name: str, namespace: str = "default") -> None:
-        key = f"{namespace}/{name}"
-        self.pods.pop(key, None)
-        self.extender.release(key)
+        """Remove the pod object, then let the lifecycle release loop
+        observe the absence — the path a real cluster takes (DELETED
+        event → recorded release decision), not a manual release call."""
+        self.pods.pop(f"{namespace}/{name}", None)
+        self._lifecycle.check_once()
+
+    def complete_pod(self, name: str, namespace: str = "default",
+                     phase: str = "Succeeded") -> None:
+        """Mark a pod's containers finished (terminal phase). The object
+        lingers — exactly how a completed Job pod looks on a real cluster
+        — and the lifecycle loop frees its chips from the phase alone."""
+        pod = self.pods.get(f"{namespace}/{name}")
+        if pod is None:
+            raise KeyError(f"no pod {namespace}/{name}")
+        pod.setdefault("status", {})["phase"] = phase
+        self._lifecycle.check_once()
 
     # -- fault injection (SURVEY.md §6) -------------------------------------
     def inject_fault(self, node_name: str, chip_index: int,
