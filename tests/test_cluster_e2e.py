@@ -42,6 +42,21 @@ def _pod_obj(name, tpu, priority=0, group=None, namespace="default"):
     }
 
 
+def _wait_for(predicate, what, timeout=10.0):
+    """Bounded wait for a watch-thread effect (the capstone runs the
+    intent watcher and lifecycle loop in REAL watch mode — events apply
+    on their threads, so the test waits for the effect instead of
+    stepping check_once)."""
+    import time as _time
+
+    deadline = _time.monotonic() + timeout
+    while _time.monotonic() < deadline:
+        if predicate():
+            return
+        _time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
 def _schedule(ext, api, pod_obj):
     """One kube-scheduler cycle in nodeCacheCapable mode: names-only
     filter -> prioritize -> pick max -> bind (the extender's binder does
@@ -107,16 +122,23 @@ def test_full_cluster_lifecycle(tmp_path):
         ext.binder = apisrv.pod_binder(api)
         server.set_alloc_reporter(apisrv.alloc_divergence_reporter(api))
         refresh = apisrv.NodeTopologyRefreshLoop(ext, api, poll_seconds=999)
+        # WATCH mode for both pod-watching loops — the production
+        # configuration: intents land within ms of the bind, releases
+        # within ms of the deletion. poll_seconds=999 ensures every
+        # observed effect below came through the watch stream, never the
+        # poll fallback.
         intent_watch = apisrv.AllocIntentWatcher(
-            api, "host-0-0-0", server, poll_seconds=999, use_watch=False
+            api, "host-0-0-0", server, poll_seconds=999, use_watch=True
         )
         reconcile = apisrv.AllocReconcileLoop(ext, api, poll_seconds=999)
         evictions = apisrv.EvictionExecutor(ext, api, poll_seconds=999)
         lifecycle = apisrv.PodLifecycleReleaseLoop(
-            ext, api, poll_seconds=999, use_watch=False
+            ext, api, poll_seconds=999, use_watch=True, evictions=evictions
         )
         assert apisrv.rebuild_extender(ext, api) == 0
         assert refresh.check_once() is True  # topology flows api -> cache
+        intent_watch.start()
+        lifecycle.start()
 
         # ---- pod lifecycle: schedule -> steer -> allocate (§4.2-§4.3) --
         pod = _pod_obj("train-0", tpu=2)
@@ -129,7 +151,12 @@ def test_full_cluster_lifecycle(tmp_path):
             bound["metadata"]["annotations"][codec.ANNO_ALLOC]
         ).device_ids
 
-        assert intent_watch.check_once() is True  # plan reaches the agent
+        _wait_for(  # plan reaches the agent through the WATCH stream
+            lambda: sorted(
+                server.intents.snapshot().get("default/train-0") or []
+            ) == sorted(planned),
+            "train-0 intent via watch",
+        )
         devs = sorted(kubelet.wait_for_devices(server.resource_name, 4))
         steered = kubelet.preferred(server.resource_name, devs, 2)
         assert sorted(steered) == sorted(planned)  # kubelet follows plan
@@ -141,11 +168,14 @@ def test_full_cluster_lifecycle(tmp_path):
         pod2 = _pod_obj("train-1", tpu=1)
         api.upsert_pod(pod2)
         _schedule(ext, api, pod2)
-        assert intent_watch.check_once() is True
         planned2 = codec.decode_alloc(
             api.get_pod("default", "train-1")
             ["metadata"]["annotations"][codec.ANNO_ALLOC]
         ).device_ids
+        _wait_for(
+            lambda: "default/train-1" in server.intents.snapshot(),
+            "train-1 intent via watch",
+        )
         free = [d for d in devs if d not in steered and d not in planned2]
         kubelet.allocate(server.resource_name, [free[0]])  # ignores plan
         assert server.divergences == 1
@@ -167,13 +197,33 @@ def test_full_cluster_lifecycle(tmp_path):
         assert ext.state.allocation("default/train-1").device_ids == [free[0]]
 
         # ---- preemption: gang evicts via the Eviction subresource ------
+        # the first member's bind executes the plan, then FAILS retryably
+        # until the victims' pod objects are confirmed gone (the eviction
+        # executor's drain + confirm, exactly as the daemon loop runs it)
+        ext.evict_precheck = (
+            lambda pod_key: api.evict_pod(*pod_key.split("/", 1),
+                                          dry_run=True)
+        )
         gang = PodGroup("vip", min_member=4)
         victims_before = {p["metadata"]["name"] for p in api.list_pods()}
+        import time as _t
         for i in range(4):
             gp = _pod_obj(f"vip-{i}", tpu=1, priority=100, group=gang)
             api.upsert_pod(gp)
-            _schedule(ext, api, gp)
-            evictions.check_once()  # drain as the daemon loop would
+            for attempt in range(100):  # kube-scheduler's requeue
+                try:
+                    _schedule(ext, api, gp)
+                    break
+                except RuntimeError as e:
+                    if "victim" not in str(e):
+                        raise
+                    # drain the queue; confirmation arrives via the
+                    # lifecycle WATCH thread (DELETED events), so give
+                    # it a beat before the next cycle
+                    evictions.check_once()
+                    _t.sleep(0.01)
+            else:
+                raise AssertionError(f"vip-{i} never bound")
         remaining = {p["metadata"]["name"] for p in api.list_pods()}
         evicted = victims_before - remaining
         assert evicted == {"train-0", "train-1"}  # preempted via the api
@@ -196,11 +246,14 @@ def test_full_cluster_lifecycle(tmp_path):
         assert refresh.check_once() is True
         # all-or-nothing holds: a released gang member's chip stays
         # reserved for a REPLACEMENT member, never for bystanders. The
-        # release is the lifecycle loop observing the deletion — no
-        # manual release call anywhere in this cluster's day.
+        # release is the lifecycle loop observing the DELETED event on
+        # its watch stream — no manual release call anywhere in this
+        # cluster's day.
         api.delete_pod("default", "vip-3")
-        assert lifecycle.check_once() is True
-        assert ext.state.allocation("default/vip-3") is None
+        _wait_for(
+            lambda: ext.state.allocation("default/vip-3") is None,
+            "vip-3 release via watch",
+        )
         with pytest.raises(RuntimeError, match="unschedulable"):
             _schedule(ext, api, pod3)
         replacement = _pod_obj("vip-3b", tpu=1, priority=100, group=gang)
@@ -216,10 +269,15 @@ def test_full_cluster_lifecycle(tmp_path):
             obj = api.get_pod("default", name)
             obj.setdefault("status", {})["phase"] = "Succeeded"
             api.upsert_pod(obj)
-        assert lifecycle.check_once() is True
+        _wait_for(
+            lambda: ext.state.utilization() == 0.0,
+            "terminal-phase releases via watch",
+        )
         assert ext.gang.reservation("default", "vip") is None
-        assert ext.state.utilization() == 0.0
         assert _schedule(ext, api, pod3) == "host-0-0-0"
+
+        intent_watch.stop()
+        lifecycle.stop()
 
         # the whole day replays deterministically from the trace
         from tpukube import trace as trace_mod

@@ -113,3 +113,35 @@ def test_rbac_covers_bindings_and_evictions():
         if d["kind"] == "ClusterRoleBinding":
             for s in d["subjects"]:
                 assert s["name"] in sas
+
+
+def test_gang_job_example_projects_bind_time_env():
+    """deploy/gang-job-example.yaml is the user-facing contract for the
+    DCN gang env: every TPU_KUBE_GANG_* variable is projected from
+    exactly the annotation key the bind effector mints
+    (codec.GANG_ENV_TO_ANNO), and the pod-group annotations decode to a
+    valid gang spec."""
+    from tpukube.core import codec
+
+    (job,) = _docs("gang-job-example.yaml")
+    assert job["kind"] == "Job"
+    tmpl = job["spec"]["template"]
+
+    # gang identity annotations decode through the real codec
+    group = codec.pod_group_from_annotations(
+        tmpl["metadata"]["annotations"]
+    )
+    assert group is not None
+    assert group.min_member == job["spec"]["parallelism"]
+    assert group.allow_dcn is True
+
+    (container,) = tmpl["spec"]["containers"]
+    assert container["resources"]["requests"][CFG.resource_tpu]
+    projected = {}
+    for env in container["env"]:
+        path = env.get("valueFrom", {}).get("fieldRef", {}).get(
+            "fieldPath", ""
+        )
+        if path.startswith("metadata.annotations['tpu.qiniu.com/"):
+            projected[env["name"]] = path.split("'")[1]
+    assert projected == codec.GANG_ENV_TO_ANNO

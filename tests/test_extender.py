@@ -259,8 +259,12 @@ def test_restart_rebuild_preserves_gang_granularity(cluster):
         fresh.state.allocation(f"default/solo-{i}") is not None
         for i in range(8)
     ), "filter must only plan; victims keep chips until first bind"
-    # the first member's bind executes the plan
-    fresh.bind("vip-0", "default", "", feasible[0]["metadata"]["name"])
+    # the first member's bind executes the plan (and then waits for the
+    # victims' termination before any member may start)
+    from tpukube.sched.extender import ExtenderError
+
+    with pytest.raises(ExtenderError, match="finish terminating"):
+        fresh.bind("vip-0", "default", "", feasible[0]["metadata"]["name"])
     low_alive = [
         i for i in range(8)
         if fresh.state.allocation(f"default/lo-{i}") is not None
@@ -273,6 +277,11 @@ def test_restart_rebuild_preserves_gang_granularity(cluster):
         if fresh.state.allocation(f"default/solo-{i}") is None
     ]
     assert len(evicted_solos) == 4
+    # victims confirmed gone (the executor's job): the bind proceeds
+    for pk in list(fresh.pending_evictions):
+        fresh.handle("victim_gone", {"pod_key": pk})
+    fresh.bind("vip-0", "default", "", feasible[0]["metadata"]["name"])
+    assert fresh.state.allocation("default/vip-0") is not None
 
 
 def _vip_gang_pod(name: str, min_member: int = 4):
@@ -318,13 +327,21 @@ def test_unbound_preempting_gang_never_evicts(cluster):
 
 
 def test_preemption_executes_once_at_first_bind(cluster):
-    """Phase two: the FIRST member bind executes the eviction plan; later
-    member binds must not evict again."""
+    """Phase two: the FIRST member bind executes the eviction plan (then
+    waits for victim termination); later member binds must not evict
+    again. Until every victim is confirmed gone, NO member bind proceeds
+    — on a single-owner TPU runtime a gang pod started while its victim's
+    containers still hold the chips crash-loops through the whole grace
+    period."""
+    from tpukube.sched.extender import ExtenderError
+
     for i in range(16):
         cluster.schedule(cluster.make_pod(f"s-{i}", tpu=1, priority=5))
     ext = cluster.extender
     feasible, _ = ext.filter(_vip_gang_pod("vip-0"), cluster.node_objects())
-    ext.bind("vip-0", "default", "", feasible[0]["metadata"]["name"])
+    target = feasible[0]["metadata"]["name"]
+    with pytest.raises(ExtenderError, match="finish terminating"):
+        ext.bind("vip-0", "default", "", target)
     assert ext.preemptions == 4
     evicted = [
         i for i in range(16)
@@ -332,12 +349,29 @@ def test_preemption_executes_once_at_first_bind(cluster):
     ]
     assert len(evicted) == 4
     assert len(ext.pending_evictions) == 4
+    res = ext.gang.reservation("default", "vip")
+    assert len(ext.gang.terminating_victims_of(res)) == 4
+    # victims' chips stay masked from every placement while terminating
+    assert ext.gang.terminating_count() == 4
 
+    # a sibling member is gated exactly the same way
     feasible2, _ = ext.filter(_vip_gang_pod("vip-1"), cluster.node_objects())
     assert feasible2
-    ext.bind("vip-1", "default", "", feasible2[0]["metadata"]["name"])
+    with pytest.raises(ExtenderError, match="victim"):
+        ext.bind("vip-1", "default", "", feasible2[0]["metadata"]["name"])
     assert ext.preemptions == 4, "second bind must not re-execute the plan"
     assert len(ext.pending_evictions) == 4
+
+    # the executor confirms the victims gone: binds proceed, once each
+    for pk in list(ext.pending_evictions):
+        ext.handle("victim_gone", {"pod_key": pk})
+    assert ext.gang.terminating_count() == 0
+    ext.bind("vip-0", "default", "", target)
+    feasible3, _ = ext.filter(_vip_gang_pod("vip-1"), cluster.node_objects())
+    ext.bind("vip-1", "default", "", feasible3[0]["metadata"]["name"])
+    assert ext.preemptions == 4
+    assert ext.state.allocation("default/vip-0") is not None
+    assert ext.state.allocation("default/vip-1") is not None
 
 
 def test_failing_first_bind_leaves_victims_untouched(cluster):

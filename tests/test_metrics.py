@@ -132,6 +132,72 @@ def test_extender_metrics_export_reconcile_and_evictions():
         c.extender.pending_evictions.clear()
 
 
+def test_extender_metrics_export_round5_loops():
+    """VERDICT round-4 task 4: a dead release watch must be VISIBLE —
+    lifecycle releases, node refreshes, victim-termination gauge, and
+    eviction age all appear on /metrics when the daemon loops are
+    attached (exactly what cli.main_extender passes to make_app)."""
+    from tpukube.apiserver import (
+        EvictionExecutor, FakeApiServer, NodeTopologyRefreshLoop,
+        PodLifecycleReleaseLoop,
+    )
+    from tpukube.sched.extender import make_app
+    from tpukube.sim.harness import _AppThread, _free_port
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        api = FakeApiServer()
+        evictions = EvictionExecutor(c.extender, api, poll_seconds=999)
+        node_refresh = NodeTopologyRefreshLoop(c.extender, api,
+                                               poll_seconds=999)
+        lifecycle = PodLifecycleReleaseLoop(
+            c.extender, api, poll_seconds=999, use_watch=False,
+            evictions=evictions,
+        )
+        node_refresh.refreshed = 3
+        lifecycle.released = 9
+
+        port = _free_port()
+        app = _AppThread(
+            make_app(c.extender, evictions=evictions,
+                     node_refresh=node_refresh, lifecycle=lifecycle),
+            "127.0.0.1", port,
+        )
+        app.start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ) as r:
+                text = r.read().decode()
+        finally:
+            app.stop()
+        assert "tpukube_node_refreshes_total 3" in text
+        assert "tpukube_lifecycle_releases_total 9" in text
+        assert "tpukube_gang_victims_terminating 0" in text
+        assert "tpukube_eviction_oldest_age_seconds 0" in text
+
+
+def test_plugin_metrics_export_intent_watch(tmp_path):
+    """The intent watcher's watch-events counter reaches the node agent's
+    /metrics (a flat counter while pods bind = steering is dead)."""
+    from types import SimpleNamespace
+
+    cfg = load_config(env={
+        "TPUKUBE_DEVICE_PLUGIN_DIR": str(tmp_path),
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with TpuDeviceManager(cfg) as device, \
+            DevicePluginServer(cfg, device) as server:
+        text = render_plugin_metrics(
+            server, intent_watch=SimpleNamespace(watch_events=6)
+        )
+        assert "tpukube_plugin_intent_watch_events_total 6" in text
+
+
 def test_syncer_metrics_render():
     from types import SimpleNamespace
 

@@ -246,6 +246,44 @@ def test_probe_default_liveness_no_false_alarm(stub_so, monkeypatch):
         assert all(c.health.value == "Healthy" for c in ti.chips())
 
 
+def test_probe_liveness_does_not_leak_handles(stub_so):
+    """round-4 advisor low: every liveness probe dlopens RTLD_NOLOAD and
+    must dlclose the hit — a daemon polls this every few seconds, so a
+    leaked reference per poll grows libtpu's refcount without bound.
+    Observable (in a FRESH process — every in-process init retains one
+    reference by design): after N probes + shutdown, consuming our
+    check-open plus the one intentionally-retained init reference must
+    fully unmap the image; any leaked probe reference keeps it mapped."""
+    import sys
+
+    script = """
+import ctypes, sys
+stub = sys.argv[1]
+sys.path.insert(0, sys.argv[2])
+from tpukube.native import TpuInfo
+with TpuInfo("real", f"libtpu={stub}") as ti:
+    for _ in range(32):
+        assert ti.probe() is True
+def mapped():
+    return stub in open("/proc/self/maps").read()
+assert mapped(), "retained init handle should keep the image mapped"
+libdl = ctypes.CDLL(None)
+libdl.dlopen.restype = ctypes.c_void_p
+libdl.dlopen.argtypes = [ctypes.c_char_p, ctypes.c_int]
+libdl.dlclose.argtypes = [ctypes.c_void_p]
+h = libdl.dlopen(stub.encode(), 0x1 | 0x4)  # RTLD_LAZY | RTLD_NOLOAD
+assert h
+libdl.dlclose(ctypes.c_void_p(h))  # our check-open
+libdl.dlclose(ctypes.c_void_p(h))  # the retained init reference
+assert not mapped(), "probe() leaked dlopen handles"
+"""
+    repo_root = os.path.dirname(HERE)
+    subprocess.run(
+        [sys.executable, "-c", script, stub_so, repo_root],
+        check=True, capture_output=True, text=True,
+    )
+
+
 def test_probe_failure_shrinks_allocatable_via_listandwatch(
     stub_so, tmp_path, monkeypatch
 ):

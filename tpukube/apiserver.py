@@ -35,6 +35,7 @@ import logging
 import os
 import copy
 import queue
+from collections import deque
 import socket
 import ssl
 import threading
@@ -95,13 +96,30 @@ class FakeApiServer:
         # fake's stand-in for the real apiserver's 429): tests add keys
         # here to exercise the executor's requeue path
         self.pdb_blocked: set[str] = set()
+        # pod keys that terminate GRACEFULLY on eviction: the accepted
+        # eviction stamps deletionTimestamp and the object lingers until
+        # finish_termination() — the real apiserver's behavior, and the
+        # window the gang bind termination gate exists for
+        self.graceful: set[str] = set()
         # live watch subscriptions (watch_pods): each holds an event queue
         self._watch_queues: list = []
+        # the informer contract's versioning half: every pod mutation bumps
+        # the resourceVersion and lands in a bounded history, so a watch
+        # started FROM a list's version replays the events that raced into
+        # the list->watch gap instead of silently dropping them (exactly
+        # what the REST path's resourceVersion parameter does)
+        self._rv = 0
+        self._history: deque = deque(maxlen=4096)
 
     def _notify(self, etype: str, pod: dict[str, Any]) -> None:
-        """Fan a pod event out to live watchers (call under self._lock)."""
+        """Fan a pod event out to live watchers (call under self._lock).
+        Each watcher gets its OWN copy (a consumer mutating its event
+        must not corrupt siblings or the replay history)."""
+        self._rv += 1
+        snap = copy.deepcopy(pod)
+        self._history.append((self._rv, etype, snap))
         for q in self._watch_queues:
-            q.put((etype, copy.deepcopy(pod)))
+            q.put((etype, copy.deepcopy(snap)))
 
     # -- nodes -------------------------------------------------------------
     def patch_node_annotations(
@@ -146,19 +164,43 @@ class FakeApiServer:
             if pod is not None:
                 self._notify("DELETED", pod)
 
-    def evict_pod(self, namespace: str, name: str) -> bool:
-        """Graceful eviction: True once the pod is gone (or already was),
-        False when a PodDisruptionBudget blocks it — the same contract
-        RestApiServer derives from 2xx/404 vs 429."""
+    def evict_pod(
+        self, namespace: str, name: str, dry_run: bool = False
+    ) -> bool:
+        """Eviction-subresource semantics: True once the eviction is
+        accepted (or the pod is already gone), False when a
+        PodDisruptionBudget blocks it — the same contract RestApiServer
+        derives from 2xx/404 vs 429. ``dry_run`` only answers the PDB
+        question (the real API's dryRun=All). Keys in ``graceful`` get a
+        deletionTimestamp and linger until finish_termination(); others
+        delete instantly (grace 0)."""
         key = f"{namespace}/{name}"
         with self._lock:
             if key in self.pdb_blocked:
                 return False
-            pod = self._pods.pop(key, None)
-            if pod is not None:
-                self._notify("DELETED", pod)
+            if dry_run:
+                return True
+            if key in self.graceful:
+                pod = self._pods.get(key)
+                if pod is not None:
+                    pod["metadata"].setdefault(
+                        "deletionTimestamp", "2026-01-01T00:00:00Z"
+                    )
+                    self._notify("MODIFIED", pod)
+            else:
+                pod = self._pods.pop(key, None)
+                if pod is not None:
+                    self._notify("DELETED", pod)
             self.patch_log.append(("evict", key))
         return True
+
+    def finish_termination(self, namespace: str, name: str) -> None:
+        """A graceful pod's containers finally stopped: the object goes
+        away (kubelet finishing the eviction the subresource started)."""
+        with self._lock:
+            pod = self._pods.pop(f"{namespace}/{name}", None)
+            if pod is not None:
+                self._notify("DELETED", pod)
 
     def get_pod(self, namespace: str, name: str) -> Optional[dict[str, Any]]:
         with self._lock:
@@ -169,21 +211,31 @@ class FakeApiServer:
                    handle_box: Optional[list] = None,
                    resource_version: Optional[str] = None):
         """The fake's watch half of the informer contract: yields
-        (event_type, pod) for every mutation after THIS CALL, honoring
-        the spec.nodeName field selector. Subscription happens eagerly
-        here — not at the generator's first next() — so no event can
-        slip between the caller's list resync and the iteration start
-        (the list->watch gap the informer pattern exists to close). The
-        handle placed in ``handle_box`` exposes close() (enqueues a
-        poison pill), so AllocIntentWatcher.stop() unblocks a quiet
-        watch exactly as it does the REST stream."""
+        (event_type, pod) for every mutation after ``resource_version``
+        (a list_pods_with_rv result — events that raced into the
+        list->watch gap are REPLAYED from the bounded history, exactly
+        like the REST path's resourceVersion parameter) or, without a
+        version, after this call. Subscription and replay snapshot happen
+        atomically under the store lock — not at the generator's first
+        next() — so no event can slip between them. Honors the
+        spec.nodeName field selector. The handle placed in ``handle_box``
+        exposes close() (enqueues a poison pill), so a loop's stop()
+        unblocks a quiet watch exactly as it does the REST stream."""
         q: queue.SimpleQueue = queue.SimpleQueue()
 
         class _Handle:
             def close(self) -> None:
                 q.put(None)
 
+        try:
+            since = int(resource_version) if resource_version else None
+        except ValueError:
+            since = None
         with self._lock:
+            if since is not None:
+                for rv, etype, pod in self._history:
+                    if rv > since:
+                        q.put((etype, copy.deepcopy(pod)))
             self._watch_queues.append(q)
         if handle_box is not None:
             handle_box.append(_Handle())
@@ -271,6 +323,20 @@ class FakeApiServer:
                         or pod.get("spec", {}).get("nodeName") == node_name):
                     out.append(pod)
             return out
+
+    def list_pods_with_rv(
+        self, node_name: Optional[str] = None
+    ) -> tuple[list[dict[str, Any]], str]:
+        """(pods, resourceVersion) — list half of the informer contract
+        (mirrors RestApiServer): watch from the returned version and no
+        event between the list and the watch is lost."""
+        with self._lock:
+            out = [
+                pod for pod in self._pods.values()
+                if (node_name is None
+                    or pod.get("spec", {}).get("nodeName") == node_name)
+            ]
+            return out, str(self._rv)
 
 
 class RestApiServer:
@@ -554,17 +620,24 @@ class RestApiServer:
                     f"{bound_to!r}, not {node!r}", code=409,
                 ) from e
 
-    def evict_pod(self, namespace: str, name: str) -> bool:
+    def evict_pod(
+        self, namespace: str, name: str, dry_run: bool = False
+    ) -> bool:
         """POST the policy/v1 Eviction subresource — the polite way to
         delete a preemption victim, because it lets the apiserver enforce
-        PodDisruptionBudgets. Returns True once the pod is gone (2xx, or
-        404 = already deleted), False when a PDB blocks it right now
-        (HTTP 429: retry later, exactly what the executor's requeue does)."""
-        body = {
+        PodDisruptionBudgets. Returns True once the eviction is accepted
+        (2xx, or 404 = already deleted), False when a PDB blocks it right
+        now (HTTP 429: retry later, exactly what the executor's requeue
+        does). ``dry_run`` sends deleteOptions.dryRun=["All"] — the PDB
+        answer without starting a termination (the extender's preemption
+        precheck)."""
+        body: dict[str, Any] = {
             "apiVersion": "policy/v1",
             "kind": "Eviction",
             "metadata": {"name": name, "namespace": namespace},
         }
+        if dry_run:
+            body["deleteOptions"] = {"dryRun": ["All"]}
         try:
             self._request(
                 "POST",
@@ -875,12 +948,30 @@ class PodLifecycleReleaseLoop(_WatchLoop):
 
     def __init__(
         self, extender, api, poll_seconds: float = 5.0,
-        use_watch: bool = True,
+        use_watch: bool = True, evictions: Optional["EvictionExecutor"] = None,
     ) -> None:
         super().__init__("tpukube-pod-lifecycle", api, None,
                          poll_seconds, use_watch)
         self._extender = extender
+        # termination-detection unification: this loop already sees every
+        # pod DELETED event, so it confirms the eviction executor's
+        # in-flight terminations for free — while this loop's watch runs,
+        # the executor stretches its per-key GET poll to a 30s missed-
+        # event safety net (attach_watch_confirmer)
+        self._evictions = evictions
+        if evictions is not None:
+            evictions.attach_watch_confirmer(self)
         self.released = 0  # lifecycle releases applied (tests/metrics)
+
+    def watch_alive(self) -> bool:
+        """True while DELETED events are flowing through a live watch
+        thread (the executor's cue to defer its GET confirms here)."""
+        return (self._use_watch and self._thread is not None
+                and self._thread.is_alive())
+
+    def _confirm_eviction(self, pod_key: str) -> None:
+        if self._evictions is not None:
+            self._evictions.confirm_deleted(pod_key)
 
     def _release(self, pod_key: str, why: str, uid: str = "") -> bool:
         alloc = self._extender.state.allocation(pod_key)
@@ -905,6 +996,7 @@ class PodLifecycleReleaseLoop(_WatchLoop):
             return
         uid = str((pod.get("metadata") or {}).get("uid") or "")
         if etype == "DELETED":
+            self._confirm_eviction(key)
             self._release(key, "pod deleted", uid=uid)
             return
         phase = (pod.get("status") or {}).get("phase")
@@ -949,10 +1041,24 @@ class PodLifecycleReleaseLoop(_WatchLoop):
             if pod is not None:
                 cur_uid = str((pod.get("metadata") or {}).get("uid") or "")
                 if not (alloc.uid and cur_uid and alloc.uid != cur_uid):
-                    continue  # created after the list snapshot — alive
+                    # same (or indeterminate) incarnation — but a pod the
+                    # stale LIST missed may ALREADY be terminal: trust the
+                    # GET's phase, or the chips wait a full reconnect
+                    # interval for release
+                    phase = (pod.get("status") or {}).get("phase")
+                    if phase in TERMINAL_PHASES:
+                        changed |= self._release(
+                            alloc.pod_key, f"phase {phase} (resync confirm)",
+                            uid=cur_uid,
+                        )
+                    continue
                 changed |= self._release(alloc.pod_key,
                                          "pod replaced (resync)")
                 continue
+            # (executor-tracked eviction victims never reach this loop —
+            # their ledger entries were released before queueing; a
+            # DELETED event missed in a reconnect gap is recovered by the
+            # executor's own stretched GET net, WATCH_CONFIRM_GRACE_S)
             changed |= self._release(alloc.pod_key, "pod absent (resync)")
         return changed, rv
 
@@ -974,6 +1080,22 @@ class NodeTopologyRefreshLoop(_PollLoop):
         self._applied: dict[str, str] = {}  # name -> applied topo payload
         self._rejected: dict[str, str] = {}  # name -> rejected payload
         self.refreshed = 0  # applied annotation changes (tests/metrics)
+
+    def note_applied(self, name: str, payload: Optional[str]) -> None:
+        """Prime the loop with a topology payload some OTHER path already
+        dispatched (rebuild_extender at startup): without priming, the
+        first poll re-records an upsert_node decision for every node the
+        rebuild just applied — duplicate trace records and an inflated
+        ``refreshed`` counter on every restart."""
+        if payload is not None:
+            self._applied[name] = payload
+
+    def note_rejected(self, name: str, payload: Optional[str]) -> None:
+        """Same priming for a payload another path already dispatched and
+        saw REJECTED — the first poll must not re-record the identical
+        error decision."""
+        if payload is not None:
+            self._rejected[name] = payload
 
     def check_once(self) -> bool:
         """One poll; True if any node's topology changed."""
@@ -1007,7 +1129,7 @@ class NodeTopologyRefreshLoop(_PollLoop):
         return did
 
 
-def rebuild_extender(extender, api) -> int:
+def rebuild_extender(extender, api, refresh=None) -> int:
     """Reconstruct a restarted extender's ledger AND gang reservations
     from the apiserver (SURVEY §6 restart story, wired to the real
     channel): node topology annotations first — the ledger can only
@@ -1018,22 +1140,33 @@ def rebuild_extender(extender, api) -> int:
     resurrect a dead or phantom allocation. A node whose annotation is
     malformed is skipped loudly; its pods then fail to restore (also
     loudly) and the reconcile machinery takes over.
+    Pass the daemon's NodeTopologyRefreshLoop as ``refresh`` to prime it
+    with the payloads applied here — its first poll then dispatches
+    nothing the rebuild already did.
     Returns the number of allocations restored."""
     for obj in api.list_nodes():
         meta = obj.get("metadata") or {}
         name = meta.get("name")
         if not name:
             continue
+        annotations = dict(meta.get("annotations") or {})
         # recorded upsert_node decisions, not bare state mutation: a
         # names-mode capture that starts right after rebuild must replay
         # with the same node state the live extender had
         out = extender.handle(
-            "upsert_node",
-            {"name": name, "annotations": dict(meta.get("annotations") or {})},
+            "upsert_node", {"name": name, "annotations": annotations},
         )
         if out.get("error"):
             log.error("rebuild: node %s annotation rejected: %s",
                       name, out["error"])
+            if refresh is not None:
+                refresh.note_rejected(
+                    name, annotations.get(codec.ANNO_NODE_TOPOLOGY)
+                )
+        elif refresh is not None:
+            refresh.note_applied(
+                name, annotations.get(codec.ANNO_NODE_TOPOLOGY)
+            )
     pods = []
     for p in api.list_pods():
         meta = p.get("metadata") or {}
@@ -1093,10 +1226,13 @@ def pod_binder(api) -> Callable[[Any], None]:
 
     def bind(alloc) -> None:
         namespace, name = alloc.pod_key.split("/", 1)
-        api.bind_pod(
-            namespace, name, alloc.node_name,
-            {codec.ANNO_ALLOC: codec.encode_alloc(alloc)},
-        )
+        annotations = {codec.ANNO_ALLOC: codec.encode_alloc(alloc)}
+        # gang env ALSO rides as per-key annotations: the downward API
+        # projects each into its TPU_KUBE_GANG_* container env var
+        # (deploy/gang-job-example.yaml) — a JSON blob inside one env
+        # var would make the in-pod runtime parse annotations itself
+        annotations.update(codec.gang_env_annotations(alloc.env))
+        api.bind_pod(namespace, name, alloc.node_name, annotations)
 
     return bind
 
@@ -1198,15 +1334,92 @@ class EvictionExecutor(_PollLoop):
         # confirmed: a 2xx on the Eviction subresource only STARTS
         # graceful termination; the pod keeps its devices until its
         # containers actually stop, so "evicted" is only counted once the
-        # pod object is gone
+        # pod object is gone. Guarded by _state_lock: the lifecycle
+        # watch's confirm_deleted runs on its own thread.
         self._terminating: set[str] = set()
+        # keys whose eviction POST is IN FLIGHT right now: an instantly-
+        # deleted victim's DELETED event can reach the lifecycle watch
+        # (confirm_deleted) before drain() regains the lock to add the
+        # key to _terminating — without pre-registration that confirm
+        # would miss and the gang would wait out the 30s GET net
+        self._expecting: set[str] = set()
+        self._confirmed_early: set[str] = set()
+        self._state_lock = threading.Lock()
+        # pod key -> monotonic time of its FIRST drain attempt; feeds the
+        # oldest-age gauge operators alarm on (a PDB-wedged eviction is
+        # a capacity leak in progress)
+        self._pending_since: dict[str, float] = {}
+        # a live pod watch that calls confirm_deleted (the lifecycle
+        # loop): while it is running, the per-key GET confirm only covers
+        # keys the watch has had ample time to see — O(1) confirmation
+        # traffic instead of one GET per victim per poll
+        self._watch_confirmer = None
         self.evicted = 0   # pods confirmed gone (tests/metrics)
         self.blocked = 0   # PDB 429s requeued (tests/metrics)
         self.failures = 0  # transport/API errors requeued (tests/metrics)
 
+    # while a watch confirmer runs, GET-confirm only keys older than this
+    # (the watch delivers DELETED within ms; the stretched GET is the
+    # missed-event safety net, not the primary channel)
+    WATCH_CONFIRM_GRACE_S = 30.0
+
+    def attach_watch_confirmer(self, loop) -> None:
+        """Called by PodLifecycleReleaseLoop when wired with this
+        executor: its DELETED events become the primary termination
+        confirmation channel."""
+        self._watch_confirmer = loop
+
     def depth(self) -> int:
         """Evictions not yet confirmed done: queued + terminating."""
-        return len(self._extender.pending_evictions) + len(self._terminating)
+        with self._state_lock:
+            return (len(self._extender.pending_evictions)
+                    + len(self._terminating))
+
+    def oldest_age_seconds(self, now: Optional[float] = None) -> float:
+        """Age of the oldest unconfirmed eviction (0.0 when idle),
+        measured from its first drain attempt."""
+        with self._state_lock:
+            if not self._pending_since:
+                return 0.0
+            now = time.monotonic() if now is None else now
+            return max(0.0, now - min(self._pending_since.values()))
+
+    def _confirmed(self, pod_key: str) -> None:
+        """Bookkeeping for a victim whose pod object is gone (call with
+        _state_lock held for the set mutation done by callers); tells the
+        extender through the recorded ``victim_gone`` decision so gated
+        gang binds unblock deterministically."""
+        self.evicted += 1
+        self._pending_since.pop(pod_key, None)
+
+    def _notify_gone(self, pod_key: str) -> None:
+        handle = getattr(self._extender, "handle", None)
+        if handle is not None:
+            try:
+                handle("victim_gone", {"pod_key": pod_key})
+            except Exception:
+                log.exception("victim_gone dispatch for %s failed", pod_key)
+
+    def confirm_deleted(self, pod_key: str) -> bool:
+        """Out-of-band confirmation from the lifecycle watch: it saw the
+        pod's DELETED event, so the GET poll for this key is redundant
+        (and _confirm_terminated defers to this channel while the watch
+        runs — see WATCH_CONFIRM_GRACE_S). Returns True if the key was
+        being tracked (terminating, or its eviction POST in flight)."""
+        with self._state_lock:
+            if pod_key in self._terminating:
+                self._terminating.discard(pod_key)
+            elif pod_key in self._expecting:
+                # the DELETED event outran the eviction call's return:
+                # count it now; drain() sees _confirmed_early and will
+                # not track (or requeue) the already-gone pod
+                self._confirmed_early.add(pod_key)
+            else:
+                return False
+            self._confirmed(pod_key)
+        self._notify_gone(pod_key)
+        log.warning("evicted %s (confirmed by lifecycle watch)", pod_key)
+        return True
 
     def check_once(self) -> bool:
         """One poll; True if any pod was evicted."""
@@ -1228,21 +1441,35 @@ class EvictionExecutor(_PollLoop):
                     pod_key = q.popleft()
                 except IndexError:  # racing consumer emptied it
                     break
+                with self._state_lock:
+                    self._pending_since.setdefault(pod_key, time.monotonic())
+                    self._expecting.add(pod_key)
+                ok = None
+                err = None
                 try:
                     namespace, name = pod_key.split("/", 1)
                     ok = self._api.evict_pod(namespace, name)
                 except Exception as e:
+                    err = e
+                with self._state_lock:
+                    self._expecting.discard(pod_key)
+                    if pod_key in self._confirmed_early:
+                        # the watch confirmed the pod gone mid-call:
+                        # nothing left to track or requeue, whatever the
+                        # call's own outcome was
+                        self._confirmed_early.discard(pod_key)
+                        continue
+                    if ok:
+                        self._terminating.add(pod_key)
+                if err is not None:
                     # broad on purpose: ANY failure (transport timeout,
                     # junk response body, ...) must requeue, not drop —
                     # a lost key is a silent double-allocation
                     log.warning("eviction of %s failed, requeued: %s",
-                                pod_key, e)
+                                pod_key, err)
                     self.failures += 1
                     requeue.append(pod_key)
-                    continue
-                if ok:
-                    self._terminating.add(pod_key)
-                else:
+                elif not ok:
                     self.blocked += 1
                     requeue.append(pod_key)
                     log.warning("eviction of %s blocked by PDB, requeued",
@@ -1260,7 +1487,17 @@ class EvictionExecutor(_PollLoop):
         a StatefulSet member) — the original is gone and the newcomer is
         someone else's allocation, not our victim."""
         done = []
-        for pod_key in sorted(self._terminating):
+        watch_live = (self._watch_confirmer is not None
+                      and self._watch_confirmer.watch_alive())
+        now = time.monotonic()
+        with self._state_lock:
+            tracked = sorted(
+                pod_key for pod_key in self._terminating
+                if not watch_live
+                or (now - self._pending_since.get(pod_key, now)
+                    > self.WATCH_CONFIRM_GRACE_S)
+            )
+        for pod_key in tracked:
             namespace, name = pod_key.split("/", 1)
             try:
                 pod = self._api.get_pod(namespace, name)
@@ -1272,8 +1509,12 @@ class EvictionExecutor(_PollLoop):
                 (pod.get("metadata") or {}).get("deletionTimestamp")
             ):
                 continue  # graceful termination still running
-            self._terminating.discard(pod_key)
-            self.evicted += 1
+            with self._state_lock:
+                if pod_key not in self._terminating:
+                    continue  # confirm_deleted raced in and won
+                self._terminating.discard(pod_key)
+                self._confirmed(pod_key)
+            self._notify_gone(pod_key)
             done.append(pod_key)
             log.warning("evicted %s (extender preemption/rollback)", pod_key)
         return done

@@ -181,13 +181,6 @@ def main_plugin(argv: Optional[list[str]] = None) -> int:
         kubelet_watch = None
         if not args.no_register:
             kubelet_watch = KubeletSessionWatcher(server)
-        metrics = MetricsServer(
-            lambda: render_plugin_metrics(
-                server, health=watcher, kubelet_watch=kubelet_watch
-            ),
-            port=args.metrics_port,
-        )
-        metrics.start()
 
         # (initial annotation already emitted above, before the watcher
         # started; transitions re-emit through the watcher hook)
@@ -210,6 +203,14 @@ def main_plugin(argv: Optional[list[str]] = None) -> int:
                 poll_seconds=cfg.health_poll_seconds,
             )
             intent_watch.start()
+        metrics = MetricsServer(
+            lambda: render_plugin_metrics(
+                server, health=watcher, kubelet_watch=kubelet_watch,
+                intent_watch=intent_watch,
+            ),
+            port=args.metrics_port,
+        )
+        metrics.start()
 
         if kubelet_watch is not None:
             try:
@@ -317,7 +318,7 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
     port = args.port if args.port is not None else cfg.extender_port
     extender = Extender(cfg)
     loops = []
-    reconcile = evictions = None
+    reconcile = evictions = node_refresh = lifecycle = None
     api = _make_apiserver(args)
     if api is not None:
         from tpukube.apiserver import (
@@ -329,31 +330,43 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
             rebuild_extender,
         )
 
+        # nodeCacheCapable webhooks carry names only: without this loop,
+        # health/link faults would never reach the node cache (built
+        # before the rebuild so the rebuild can prime it)
+        node_refresh = NodeTopologyRefreshLoop(
+            extender, api, poll_seconds=cfg.health_poll_seconds
+        )
         # restart story (SURVEY §6): reconstruct the ledger + gang
         # reservations from node/pod annotations BEFORE serving — a
         # freshly-restarted extender otherwise re-plans chips that are
         # already running someone's containers
-        restored = rebuild_extender(extender, api)
+        restored = rebuild_extender(extender, api, refresh=node_refresh)
         if restored:
             log.warning("rebuilt %d allocation(s) from the apiserver",
                         restored)
         # with bindVerb delegated here, the extender must create the real
         # Binding — kube-scheduler won't
         extender.binder = pod_binder(api)
+
+        # PDB precheck (dry-run Eviction POST): a preemption plan with a
+        # PDB-blocked victim is refused before any irreversible eviction
+        def _precheck(pod_key: str):
+            namespace, name = pod_key.split("/", 1)
+            return api.evict_pod(namespace, name, dry_run=True)
+
+        extender.evict_precheck = _precheck
         reconcile = AllocReconcileLoop(
             extender, api, poll_seconds=cfg.health_poll_seconds
         )
         # the effector for preemption/rollback decisions: without it a
         # victim pod keeps running on chips the ledger shows free
         evictions = EvictionExecutor(extender, api)
-        # nodeCacheCapable webhooks carry names only: without this loop,
-        # health/link faults would never reach the node cache
-        node_refresh = NodeTopologyRefreshLoop(
-            extender, api, poll_seconds=cfg.health_poll_seconds
-        )
         # the release effector: completed/deleted pods' chips return to
-        # the ledger — without it every finished job leaks its chips
-        lifecycle = PodLifecycleReleaseLoop(extender, api)
+        # the ledger — without it every finished job leaks its chips.
+        # Its watch also confirms the executor's in-flight terminations
+        # (one DELETED event instead of a per-key GET poll).
+        lifecycle = PodLifecycleReleaseLoop(extender, api,
+                                            evictions=evictions)
         loops = [reconcile, evictions, node_refresh, lifecycle]
         for loop in loops:
             loop.start()
@@ -361,7 +374,9 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
                 host, port, cfg.score_mode)
     try:
         web.run_app(make_app(extender, reconcile=reconcile,
-                             evictions=evictions),
+                             evictions=evictions,
+                             node_refresh=node_refresh,
+                             lifecycle=lifecycle),
                     host=host, port=port,
                     print=None, handle_signals=True)
     finally:

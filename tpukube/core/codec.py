@@ -37,6 +37,28 @@ ANNO_POD_GROUP_MIN_MEMBER = ANNO_PREFIX + "pod-group-min-member"
 ANNO_POD_GROUP_SHAPE = ANNO_PREFIX + "pod-group-shape"
 ANNO_POD_GROUP_ALLOW_DCN = ANNO_PREFIX + "pod-group-allow-dcn"
 
+# Per-key projections of the bind-time gang env (the DCN coordination
+# contract TPU_KUBE_GANG_* — device/tpu.py ENV_GANG_*). The alloc
+# annotation carries the same env as one JSON blob, but the downward API
+# can only project a WHOLE annotation value into one env var — so the
+# bind effector also writes each gang env key as its own annotation, and
+# deploy/gang-job-example.yaml projects them 1:1 into container env.
+GANG_ENV_TO_ANNO = {
+    "TPU_KUBE_GANG_NUM_SLICES": ANNO_PREFIX + "gang-num-slices",
+    "TPU_KUBE_GANG_SLICES": ANNO_PREFIX + "gang-slices",
+    "TPU_KUBE_GANG_SLICE_INDEX": ANNO_PREFIX + "gang-slice-index",
+}
+
+
+def gang_env_annotations(env: dict[str, str]) -> dict[str, str]:
+    """The per-key annotation projection of an alloc's gang env ({} for
+    non-gang allocs — their pods get no gang annotations at all)."""
+    return {
+        anno: env[var]
+        for var, anno in GANG_ENV_TO_ANNO.items()
+        if var in env
+    }
+
 
 class CodecError(ValueError):
     pass

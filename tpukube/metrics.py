@@ -49,11 +49,14 @@ def _fmt(name: str, value: float, labels: Optional[dict[str, str]] = None) -> st
     return f"{name} {value:.6g}\n"
 
 
-def render_extender_metrics(extender, reconcile=None, evictions=None) -> str:
+def render_extender_metrics(extender, reconcile=None, evictions=None,
+                            node_refresh=None, lifecycle=None) -> str:
     """Prometheus text for an Extender (tpukube.sched.extender); pass the
-    daemon's AllocReconcileLoop / EvictionExecutor to export their
-    counters (the divergence/reconcile/eviction story operators alarm
-    on)."""
+    daemon's AllocReconcileLoop / EvictionExecutor /
+    NodeTopologyRefreshLoop / PodLifecycleReleaseLoop to export their
+    counters (the divergence/reconcile/eviction/release story operators
+    alarm on — a flat releases counter under churn means the release
+    watch is dead and chips are leaking)."""
     out: list[str] = []
     out.append("# TYPE tpu_chip_utilization_percent gauge\n")
     out.append(_fmt("tpu_chip_utilization_percent",
@@ -88,6 +91,12 @@ def render_extender_metrics(extender, reconcile=None, evictions=None) -> str:
                             quantile(vs, q),
                             {"handler": handler, "quantile": str(q)}))
 
+    # evicted-but-unconfirmed preemption victims: non-zero means gang
+    # binds are gated on graceful terminations in progress
+    out.append("# TYPE tpukube_gang_victims_terminating gauge\n")
+    out.append(_fmt("tpukube_gang_victims_terminating",
+                    extender.gang.terminating_count()))
+
     out.append("# TYPE tpukube_evictions_pending gauge\n")
     if evictions is not None:
         out.append(_fmt("tpukube_evictions_pending", evictions.depth()))
@@ -97,6 +106,11 @@ def render_extender_metrics(extender, reconcile=None, evictions=None) -> str:
         out.append(_fmt("tpukube_evictions_blocked_total", evictions.blocked))
         out.append("# TYPE tpukube_eviction_failures_total counter\n")
         out.append(_fmt("tpukube_eviction_failures_total", evictions.failures))
+        # a PDB-wedged eviction is a capacity leak in progress: alarm on
+        # age, not just depth
+        out.append("# TYPE tpukube_eviction_oldest_age_seconds gauge\n")
+        out.append(_fmt("tpukube_eviction_oldest_age_seconds",
+                        evictions.oldest_age_seconds()))
     else:
         # no executor (sim/dev): the queue depth is still the operator's
         # double-allocation early-warning
@@ -105,13 +119,24 @@ def render_extender_metrics(extender, reconcile=None, evictions=None) -> str:
     if reconcile is not None:
         out.append("# TYPE tpukube_reconciles_total counter\n")
         out.append(_fmt("tpukube_reconciles_total", reconcile.reconciled))
+    if node_refresh is not None:
+        out.append("# TYPE tpukube_node_refreshes_total counter\n")
+        out.append(_fmt("tpukube_node_refreshes_total",
+                        node_refresh.refreshed))
+    if lifecycle is not None:
+        out.append("# TYPE tpukube_lifecycle_releases_total counter\n")
+        out.append(_fmt("tpukube_lifecycle_releases_total",
+                        lifecycle.released))
     return "".join(out)
 
 
-def render_plugin_metrics(server, health=None, kubelet_watch=None) -> str:
+def render_plugin_metrics(server, health=None, kubelet_watch=None,
+                          intent_watch=None) -> str:
     """Prometheus text for a DevicePluginServer (tpukube.plugin.server);
-    pass the daemon's HealthWatcher / KubeletSessionWatcher to export
-    their transition counters."""
+    pass the daemon's HealthWatcher / KubeletSessionWatcher /
+    AllocIntentWatcher to export their transition counters (a flat
+    watch-events counter while pods bind means intent steering is dead
+    and the kubelet is choosing chips unguided)."""
     out: list[str] = []
     out.append("# TYPE tpukube_plugin_allocations_total counter\n")
     out.append(_fmt("tpukube_plugin_allocations_total", server.allocation_count))
@@ -143,6 +168,10 @@ def render_plugin_metrics(server, health=None, kubelet_watch=None) -> str:
         out.append("# TYPE tpukube_plugin_reregistrations_total counter\n")
         out.append(_fmt("tpukube_plugin_reregistrations_total",
                         kubelet_watch.reregistrations))
+    if intent_watch is not None:
+        out.append("# TYPE tpukube_plugin_intent_watch_events_total counter\n")
+        out.append(_fmt("tpukube_plugin_intent_watch_events_total",
+                        intent_watch.watch_events))
     return "".join(out)
 
 

@@ -726,6 +726,10 @@ int tpuinfo_probe(void) {
     void* h = dlopen(g_state.libtpu_path.c_str(), RTLD_LAZY | RTLD_NOLOAD);
     ok = (on_disk && h != nullptr && dlsym(h, "GetPjrtApi") != nullptr)
              ? 1 : 0;
+    /* NOLOAD still bumps the refcount on a hit: dlclose it, or a daemon's
+     * per-poll probes grow libtpu's refcount without bound (the image
+     * stays mapped via the retained init handle regardless) */
+    if (h != nullptr) dlclose(h);
     if (!ok) why = "libtpu no longer loadable/present";
   }
   for (auto& c : g_state.chips) c.healthy = ok;

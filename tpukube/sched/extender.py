@@ -120,6 +120,16 @@ class Extender:
         # decision lock (_handle_bind) so apiserver latency never stalls
         # filter/prioritize for the whole cluster.
         self.binder = None
+        # The PDB PRECHECK: a callable pod_key -> Optional[bool] (True =
+        # evictable now, False = a PodDisruptionBudget blocks it, None =
+        # cannot determine). cli wires a dry-run Eviction POST here.
+        # Consulted by _handle_bind BEFORE a gang's first bind executes
+        # its preemption plan: evictions are irreversible, so a plan with
+        # a PDB-blocked victim is refused loudly instead of half-executed
+        # (the reservation then TTLs out without costing anyone chips).
+        # Runs OUTSIDE the decision lock and is NOT part of the recorded
+        # decision — a refused precheck leaves no state to replay.
+        self.evict_precheck = None
         # pod_key -> (reservation, this-bind-committed-the-gang), written
         # by bind() when a binder is set, consumed by _handle_bind's
         # effector undo
@@ -322,19 +332,23 @@ class Extender:
         commit is certain to succeed: every minted id must be on a healthy
         chip and held by nobody — or by a declared victim about to be
         evicted. A failed pre-check raises WITHOUT touching the victims
-        (the reservation stays pending; a sick slice is the sweep's job)."""
+        (the reservation stays pending; a sick slice is the sweep's job).
+
+        Execution does NOT let this bind proceed: a 2xx Eviction only
+        starts graceful termination, and on a single-owner TPU runtime a
+        gang pod started while its victim's containers still hold the
+        chips crash-loops for the whole grace period. The victims are
+        registered as terminating (gating every member bind + masking
+        their chips) and this bind fails retryably; binds resume once the
+        eviction executor / lifecycle watch confirms the pod objects gone
+        (the recorded ``victim_gone`` decision). kube-scheduler's own
+        preemption waits for victim deletion the same way."""
         from tpukube.core.types import Health, parse_device_id
 
         victims = self.gang.peek_pending_victims(res)
         if not victims:
             return
-        victim_pods: set[str] = set()
-        for w in victims:
-            victim_pods.update(w.pod_keys)
-            if w.gang_key is not None:
-                vres = self.gang.reservation(*w.gang_key)
-                if vres is not None:
-                    victim_pods.update(vres.assigned)
+        victim_pods = self._victim_pod_keys(victims)
         holders = {
             did: a.pod_key
             for a in self.state.allocations()
@@ -355,20 +369,52 @@ class Extender:
                     "executed, scheduler will re-run the cycle"
                 )
         victims = self.gang.take_pending_victims(res)
-        evicted_pods = self._apply_victims(victims)
+        evicted_pods, held = self._apply_victims(victims)
         self.preemptions += evicted_pods
         log.warning(
             "gang %s/%s executes deferred preemption at first bind: "
             "%d workload(s) / %d pod(s) evicted",
             res.namespace, res.group.name, len(victims), evicted_pods,
         )
+        if held:
+            self.gang.register_terminating(res, held)
+            raise ExtenderError(
+                f"gang {res.namespace}/{res.group.name}: preemption "
+                f"executed; waiting for {len(held)} victim pod(s) to "
+                "finish terminating — scheduler will re-run the cycle"
+            )
 
-    def _apply_victims(self, victims) -> int:
+    def _victim_pod_keys(self, victims) -> set[str]:
+        """Every pod a victim-workload list would evict: the workloads'
+        own pods plus, for gang victims, their reservations' assigned
+        members. One definition shared by the PDB precheck and the
+        execution pre-validation — they must never test different sets."""
+        victim_pods: set[str] = set()
+        for w in victims:
+            victim_pods.update(w.pod_keys)
+            if w.gang_key is not None:
+                vres = self.gang.reservation(*w.gang_key)
+                if vres is not None:
+                    victim_pods.update(vres.assigned)
+        return victim_pods
+
+    def _apply_victims(self, victims) -> tuple[int, dict]:
         """Evict a victim set: gangs dissolve wholesale (once, even when a
         DCN-spanning gang appears as several per-slice workloads), plain
         pods release + queue for eviction. Victims that vanished between
-        plan and execution (released naturally) are skipped. Returns pods
-        evicted."""
+        plan and execution (released naturally) are skipped. Returns
+        (pods evicted, evicted pod -> (slice, coords still physically
+        held) — the termination gate's input)."""
+        held: dict[str, tuple[str, list[TopologyCoord]]] = {}
+
+        def note_held(pk: str) -> None:
+            alloc = self.state.allocation(pk)
+            if alloc is None:
+                return
+            sid = self.state.slice_of_node(alloc.node_name)
+            if sid is not None:
+                held[pk] = (sid, [TopologyCoord.of(c) for c in alloc.coords])
+
         evicted_pods = 0
         dissolved: set[tuple[str, str]] = set()
         for victim in victims:
@@ -376,13 +422,20 @@ class Extender:
                 if victim.gang_key in dissolved:
                     continue
                 dissolved.add(victim.gang_key)
+                vres = self.gang.reservation(*victim.gang_key)
+                if vres is not None:
+                    for pk in list(vres.assigned):
+                        note_held(pk)
                 evicted_pods += len(self.gang.dissolve(victim.gang_key))
             else:
                 for pk in victim.pod_keys:
+                    note_held(pk)
                     if self.state.release(pk) is not None:
                         self.pending_evictions.append(pk)
                         evicted_pods += 1
-        return evicted_pods
+                    else:
+                        held.pop(pk, None)  # vanished between plan and now
+        return evicted_pods, held
 
     def _plan_split_preemption(
         self, workloads: list[policy.Workload], total: int,
@@ -752,6 +805,16 @@ class Extender:
                 if not self.gang.assignable(res, count):
                     res = None  # overflow replica: normal placement
             if res is not None:
+                terminating = self.gang.terminating_victims_of(res)
+                if terminating:
+                    # preemption executed but victims still hold the chips:
+                    # no member may start until their pod objects are gone
+                    raise ExtenderError(
+                        f"{key}: gang waiting for {len(terminating)} "
+                        "preemption victim(s) to finish terminating; "
+                        "scheduler will re-run the cycle"
+                    )
+            if res is not None:
                 try:
                     plan = self.gang.plan_for_bind(res, pod, node_name)
                 except GangError as e:
@@ -891,6 +954,15 @@ class Extender:
                 with self._pending_lock:
                     self._pending.pop(pod_key, None)
                 response = None
+            elif kind == "victim_gone":
+                # an eviction victim's pod object is confirmed gone
+                # (EvictionExecutor GET-confirm, or the lifecycle watch's
+                # DELETED event): unmask its chips, unblock gated gangs.
+                # A recorded decision so captures replay deterministically
+                # — the gate's state changes only through the trace.
+                response = {
+                    "cleared": self.gang.on_victim_gone(body["pod_key"])
+                }
             elif kind == "reconcile":
                 response = {
                     "changed": self._reconcile_devices(
@@ -924,6 +996,16 @@ class Extender:
         wire response reports the failure to the scheduler for a retry."""
         name, ns, uid, node = kube.parse_binding_args(body)
         key = f"{ns}/{name}"
+        blocked = self._precheck_preemption(key)
+        if blocked:
+            # refused BEFORE any mutation, so nothing is recorded (same
+            # contract as schema errors): the plan stays pending and the
+            # reservation TTLs out if the PDB never lifts — no victim is
+            # half-evicted, no gang half-binds
+            return kube.binding_result(
+                f"{key}: preemption plan refused — PodDisruptionBudget "
+                f"blocks eviction of {sorted(blocked)[:3]}"
+            )
         alloc = None
         gang_info = None
         with self._decision_lock:
@@ -972,6 +1054,46 @@ class Extender:
                 self.binds_total -= 1  # the bind did not survive
             return kube.binding_result(f"{key}: apiserver bind failed: {e}")
         return response
+
+    def _precheck_preemption(self, pod_key: str) -> list[str]:
+        """PDB dry-run for the eviction plan a bind for ``pod_key`` would
+        execute: the victim pod keys a PodDisruptionBudget blocks right
+        now ([] = proceed). External I/O, so it runs in _handle_bind
+        OUTSIDE the decision lock; no precheck wired (or an errored
+        dry-run) means proceed — the executor's forever-retry then covers
+        the raced case exactly as before."""
+        if self.evict_precheck is None:
+            return []
+        with self._pending_lock:
+            entry = self._pending.get(pod_key)
+        if entry is None or entry[0].group is None:
+            return []
+        pod = entry[0]
+        res = self.gang.reservation(pod.namespace, pod.group.name)
+        if res is None:
+            return []
+        try:
+            ask = self.device_request(pod)
+        except ExtenderError:
+            return []  # bind() will surface the real error
+        # mirror bind()'s routing: an overflow replica of a full gang
+        # binds as a normal pod and executes no preemption — its bind
+        # must not be refused for a PDB that only blocks the gang's plan
+        if ask is None or not self.gang.assignable(res, ask[1]):
+            return []
+        victim_pods = self._victim_pod_keys(
+            self.gang.peek_pending_victims(res)
+        )
+        blocked = []
+        for vk in victim_pods:
+            try:
+                if self.evict_precheck(vk) is False:
+                    blocked.append(vk)
+            except Exception as e:
+                # cannot determine (old apiserver, transient error):
+                # proceed — refusing would wedge preemption on noise
+                log.warning("eviction precheck for %s failed: %s", vk, e)
+        return blocked
 
     def _reconcile_devices(self, pod_key: str, device_ids: list[str]) -> bool:
         """Fold the kubelet's ACTUAL device choice into the ledger when it
@@ -1186,10 +1308,11 @@ class Extender:
 # -- aiohttp application ----------------------------------------------------
 
 def make_app(
-    extender: Extender, reconcile=None, evictions=None
+    extender: Extender, reconcile=None, evictions=None,
+    node_refresh=None, lifecycle=None,
 ) -> web.Application:
-    """``reconcile``/``evictions`` are the daemon's AllocReconcileLoop /
-    EvictionExecutor, exported on /metrics when present."""
+    """``reconcile``/``evictions``/``node_refresh``/``lifecycle`` are the
+    daemon's loops, exported on /metrics when present."""
     app = web.Application()
 
     async def _json(request: web.Request) -> Any:
@@ -1221,7 +1344,8 @@ def make_app(
 
         return web.Response(
             text=render_extender_metrics(
-                extender, reconcile=reconcile, evictions=evictions
+                extender, reconcile=reconcile, evictions=evictions,
+                node_refresh=node_refresh, lifecycle=lifecycle,
             ),
             content_type="text/plain",
         )

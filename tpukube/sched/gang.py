@@ -90,6 +90,12 @@ class GangReservation:
     # unbound never evicts anyone. None once executed (or when the
     # reservation needed no preemption).
     pending_victims: Optional[list] = None
+    # Executed-but-unconfirmed victims: a 2xx on the Eviction subresource
+    # only STARTS graceful termination, so the victim physically holds its
+    # chips until its pod object is gone. Member binds are gated on this
+    # set being empty (extender.bind); the EvictionExecutor / lifecycle
+    # watch clears entries through the recorded ``victim_gone`` decision.
+    terminating_victims: set[str] = field(default_factory=set)
 
     def record_assignment(
         self, pod_key: str, slice_id: str, coords: list[TopologyCoord]
@@ -165,6 +171,15 @@ class GangManager:
         self._evictions: deque[str] = (
             eviction_sink if eviction_sink is not None else deque()
         )
+        # Evicted-but-still-terminating victims' chips: pod_key ->
+        # (slice_id, coords). These chips are ledger-free (the eviction
+        # released them) but PHYSICALLY held until the pod object is gone;
+        # reserved_coords masks them so no bystander binds onto a chip a
+        # terminating container still owns. Entries die on on_victim_gone
+        # — independent of the reservation, which may roll back first.
+        self._terminating_coords: dict[
+            str, tuple[str, frozenset[TopologyCoord]]
+        ] = {}
 
     # -- views -------------------------------------------------------------
     def reservation(self, namespace: str, group_name: str) -> Optional[GangReservation]:
@@ -189,6 +204,11 @@ class GangManager:
                         out |= res.unassigned_in(sid)
                 else:
                     out |= res.unassigned_in(slice_id)
+            # terminating victims' chips are ledger-free but physically
+            # held: mask them exactly like unbound reservations
+            for sid, coords in self._terminating_coords.values():
+                if slice_id is None or sid == slice_id:
+                    out |= coords
             return out
 
     # -- expiry / fault sweep ----------------------------------------------
@@ -209,7 +229,16 @@ class GangManager:
             for key, res in list(self._reservations.items()):
                 if res.committed:
                     continue
-                expired = now - res.created > self._ttl
+                # TTL-exempt while executed victims are still terminating:
+                # those evictions are irreversible, so rolling the
+                # reservation back would not un-evict anyone — it would
+                # only let the gang re-reserve the victims' (ledger-free,
+                # still physically held) chips and bind onto them, the
+                # exact overlap the termination gate closes. The eviction
+                # executor retries/confirms forever, so this state always
+                # resolves (or pages the operator via /metrics).
+                expired = (now - res.created > self._ttl
+                           and not res.terminating_victims)
                 sick = any(
                     coords & unhealthy.get(sid, set())
                     for sid, coords in res.slice_coords.items()
@@ -633,6 +662,52 @@ class GangManager:
             victims = res.pending_victims or []
             res.pending_victims = None
             return list(victims)
+
+    def register_terminating(
+        self, res: GangReservation,
+        held: dict[str, tuple[str, list[TopologyCoord]]],
+    ) -> None:
+        """Record executed evictions awaiting confirmed termination:
+        ``held`` maps each evicted pod to the (slice, coords) its
+        containers still physically hold. Gates the gang's member binds
+        AND masks the chips from every other placement until
+        on_victim_gone confirms the pod object is gone."""
+        with self._lock:
+            for pod_key, (sid, coords) in held.items():
+                res.terminating_victims.add(pod_key)
+                if coords:
+                    self._terminating_coords[pod_key] = (
+                        sid, frozenset(coords)
+                    )
+
+    def on_victim_gone(self, pod_key: str) -> bool:
+        """A terminating eviction victim's pod object is confirmed gone
+        (EvictionExecutor / lifecycle watch, via the recorded
+        ``victim_gone`` decision): unmask its chips and unblock any gang
+        waiting on it. Returns True if anything was tracking the pod."""
+        with self._lock:
+            hit = self._terminating_coords.pop(pod_key, None) is not None
+            for res in self._reservations.values():
+                if pod_key in res.terminating_victims:
+                    res.terminating_victims.discard(pod_key)
+                    hit = True
+                    if not res.terminating_victims:
+                        log.info(
+                            "gang %s/%s: all preemption victims terminated; "
+                            "member binds may proceed",
+                            res.namespace, res.group.name,
+                        )
+            return hit
+
+    def terminating_victims_of(self, res: GangReservation) -> set[str]:
+        """Victims whose termination still gates this gang's binds."""
+        with self._lock:
+            return set(res.terminating_victims)
+
+    def terminating_count(self) -> int:
+        """Evicted-but-unconfirmed victims cluster-wide (metrics)."""
+        with self._lock:
+            return len(self._terminating_coords)
 
     # -- per-node queries for the extender ----------------------------------
     @staticmethod

@@ -51,7 +51,11 @@ class _PodStoreApi:
     def __init__(self, pods: dict[str, dict[str, Any]]) -> None:
         self._pods = pods
 
-    def evict_pod(self, namespace: str, name: str) -> bool:
+    def evict_pod(
+        self, namespace: str, name: str, dry_run: bool = False
+    ) -> bool:
+        if dry_run:
+            return True  # no PDBs in the sim
         pod = self._pods.pop(f"{namespace}/{name}", None)
         if pod is not None:
             pod["metadata"].get("annotations", {}).pop(codec.ANNO_ALLOC, None)
@@ -172,7 +176,15 @@ class SimCluster:
         # deterministically (delete_pod/complete_pod) instead of as a
         # thread — the sim has no manual extender.release side channel
         self._lifecycle = PodLifecycleReleaseLoop(
-            self.extender, store_api, use_watch=False
+            self.extender, store_api, use_watch=False,
+            evictions=self._evictions,
+        )
+        # PDB precheck for preemption plans, same dry-run shape the real
+        # daemon wires (trivially true here: the sim has no PDBs)
+        self.extender.evict_precheck = (
+            lambda pod_key: store_api.evict_pod(
+                *pod_key.split("/", 1), dry_run=True
+            )
         )
         self._node_obj_cache: dict[str, dict[str, Any]] = {}
         self._synced_objs: list[dict[str, Any]] = []  # see _extender_node_args
@@ -347,10 +359,16 @@ class SimCluster:
     ) -> tuple[str, AllocResult]:
         """One scheduling cycle for one pod, with kube-scheduler's requeue
         semantics: a lost bind race (another pod took the chips between
-        filter and bind) re-runs the whole cycle. Raises on failure."""
-        self.drain_evictions()
+        filter and bind) re-runs the whole cycle. Raises on failure.
+
+        Evictions drain at the top of EVERY cycle, not just the first: a
+        gang's first bind now executes its preemption plan and fails
+        retryably until the victims are confirmed gone, so the retry path
+        must run the executor (as the real daemon's eviction loop would
+        concurrently) for the cycle to make progress."""
         last_err = ""
         for _ in range(retries):
+            self.drain_evictions()
             node_args, pending_objs = self._extender_node_args()
             args = {"Pod": pod, **node_args}
             fres = self._post("/filter", args)

@@ -35,9 +35,12 @@ from typing import Any, Callable, Iterable, Optional
 # upsert_node carries a node-annotation refresh applied outside any
 # webhook (apiserver.NodeTopologyRefreshLoop — nodeCacheCapable mode's
 # out-of-band topology channel), recorded so captures replay with the
-# same node state the live extender saw.
+# same node state the live extender saw; victim_gone carries an eviction
+# victim's confirmed deletion (EvictionExecutor / lifecycle watch) —
+# recorded because it unblocks gated gang binds, so replay must apply it
+# at the same point in the stream.
 KINDS = ("filter", "prioritize", "bind", "release", "reconcile",
-         "upsert_node")
+         "upsert_node", "victim_gone")
 
 
 @dataclass
