@@ -1,9 +1,12 @@
-"""North-star benchmark: BASELINE config 5 on the sim control plane.
+"""North-star benchmark: BASELINE config 5 plus the steady-state churn
+scenario (config 6) on the sim control plane.
 
-Delegates to tpukube.sim.scenarios.multi_tenant_northstar — the SAME code
-path the acceptance test (tests/test_config5.py shape) and `tpukube-sim 5`
-run — and prints one JSON line with the headline metric. vs_baseline is
-measured utilization over the BASELINE.json target (>= 95%).
+Delegates to tpukube.sim.scenarios — the SAME code paths the acceptance
+tests (tests/test_config5.py, tests/test_config6.py) and `tpukube-sim
+5|6` run — and prints one JSON line. Headline metric: config 5's cluster
+utilization vs the BASELINE.json >= 95% target; the line also carries
+the gang-commit p50 and the churn scenario's utilization-stability and
+re-schedule numbers (the release loop's workload).
 """
 
 from __future__ import annotations
@@ -18,6 +21,13 @@ def run() -> dict:
     t0 = time.perf_counter()
     result = scenarios.multi_tenant_northstar(None)
     result["sched_wall_s"] = round(time.perf_counter() - t0, 2)
+    c = scenarios.churn(None)
+    result["churn"] = {
+        k: c[k] for k in (
+            "util_min_after_refill_percent", "resched_p50_s",
+            "resched_p99_s", "waves", "wave_size", "lifecycle_releases",
+        )
+    }
     return result
 
 

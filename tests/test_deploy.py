@@ -145,3 +145,36 @@ def test_gang_job_example_projects_bind_time_env():
         if path.startswith("metadata.annotations['tpu.qiniu.com/"):
             projected[env["name"]] = path.split("'")[1]
     assert projected == codec.GANG_ENV_TO_ANNO
+
+
+def test_extender_channel_is_secure_by_default():
+    """VERDICT round-4 task 3: the scheduler->extender channel ships
+    mTLS — enableHTTPS with a client cert in scheduler-config, the
+    serving cert + client CA mounted and required in the Deployment."""
+    (sched,) = _docs("scheduler-config.yaml")
+    (ext,) = sched["extenders"]
+    assert ext["enableHTTPS"] is True
+    assert ext["urlPrefix"].startswith("https://")
+    tls = ext["tlsConfig"]
+    assert {"certFile", "keyFile", "caFile"} <= set(tls)
+
+    docs = _docs("extender-deployment.yaml")
+    (deploy,) = [d for d in docs if d["kind"] == "Deployment"]
+    (container,) = deploy["spec"]["template"]["spec"]["containers"]
+    args = container["args"]
+    assert any(a.startswith("--tls-cert=") for a in args)
+    assert any(a.startswith("--tls-key=") for a in args)
+    assert any(a.startswith("--tls-client-ca=") for a in args)
+    # kubelet probes cannot present client certs: with mTLS on the main
+    # port, probes MUST target the plain probe listener
+    assert "--probe-port=12346" in args
+    port_names = {p["name"]: p["containerPort"]
+                  for p in container["ports"]}
+    assert port_names == {"https": 12345, "probe": 12346}
+    for probe in ("readinessProbe", "livenessProbe"):
+        assert container[probe]["httpGet"]["port"] == "probe"
+        assert container[probe]["httpGet"].get("scheme", "HTTP") == "HTTP"
+    mounts = {m["name"] for m in container["volumeMounts"]}
+    assert "tpukube-extender-tls" in mounts
+    vols = {v["name"]: v for v in deploy["spec"]["template"]["spec"]["volumes"]}
+    assert vols["tpukube-extender-tls"]["secret"]["secretName"]

@@ -306,13 +306,61 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
     p = _base_parser("tpukube-extender", "scheduler extender HTTP daemon")
     p.add_argument("--host", default=None, help="override extender_host")
     p.add_argument("--port", type=int, default=None, help="override extender_port")
+    # The extender's surface mutates the ledger (/bind executes
+    # preemption!) and discloses placement (/state, /trace) — it must not
+    # serve anonymous callers. Two auth modes, pick per client fleet:
+    #   mTLS  (--tls-cert/--tls-key/--tls-client-ca): the TLS layer
+    #         rejects peers without a CA-signed client cert — what stock
+    #         kube-scheduler speaks (extender tlsConfig certFile/keyFile).
+    #   bearer (--auth-token-file): application-level token on every
+    #         route except /healthz and /metrics — for tpukubectl and
+    #         setups where the scheduler sits behind an injecting proxy.
+    p.add_argument("--tls-cert", default=None, metavar="PEM",
+                   help="serve HTTPS with this certificate chain")
+    p.add_argument("--tls-key", default=None, metavar="PEM",
+                   help="private key for --tls-cert")
+    p.add_argument("--tls-client-ca", default=None, metavar="PEM",
+                   help="require client certs signed by this CA (mTLS)")
+    p.add_argument("--auth-token-file", default=None, metavar="FILE",
+                   help="require 'Authorization: Bearer <token>' matching "
+                        "this file's content on all non-probe routes")
+    p.add_argument("--probe-port", type=int, default=0, metavar="PORT",
+                   help="serve /healthz and /metrics ONLY on this extra "
+                        "plain-HTTP port (required with --tls-client-ca: "
+                        "kubelet probes and Prometheus cannot present "
+                        "client certs; 0 = disabled)")
     _add_kube_api_args(p)
     args = p.parse_args(argv)
     cfg = _setup(args)
 
+    ssl_ctx = None
+    if args.tls_cert or args.tls_key:
+        import ssl
+
+        if not (args.tls_cert and args.tls_key):
+            p.error("--tls-cert and --tls-key must be given together")
+        ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ssl_ctx.load_cert_chain(args.tls_cert, args.tls_key)
+        if args.tls_client_ca:
+            ssl_ctx.load_verify_locations(args.tls_client_ca)
+            ssl_ctx.verify_mode = ssl.CERT_REQUIRED
+    elif args.tls_client_ca:
+        p.error("--tls-client-ca requires --tls-cert/--tls-key")
+    auth_token = None
+    if args.auth_token_file:
+        with open(args.auth_token_file) as f:
+            auth_token = f.read().strip()
+        if not auth_token:
+            p.error(f"--auth-token-file {args.auth_token_file} is empty")
+
     from aiohttp import web
 
-    from tpukube.sched.extender import Extender, make_app
+    from tpukube.sched.extender import (
+        Extender,
+        make_app,
+        make_probe_app,
+        run_probe_server,
+    )
 
     host = args.host or cfg.extender_host
     port = args.port if args.port is not None else cfg.extender_port
@@ -370,16 +418,44 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
         loops = [reconcile, evictions, node_refresh, lifecycle]
         for loop in loops:
             loop.start()
-    log.warning("extender serving on %s:%d (score_mode=%s)",
-                host, port, cfg.score_mode)
+    if ssl_ctx is None and auth_token is None:
+        log.warning(
+            "extender serving WITHOUT transport or bearer auth — anyone "
+            "reaching this port can bind pods and execute preemption; "
+            "use --tls-cert/--tls-key (+ --tls-client-ca for mTLS) or "
+            "--auth-token-file outside of dev/sim"
+        )
+    if args.tls_client_ca and not args.probe_port:
+        log.warning(
+            "mTLS without --probe-port: kubelet httpGet probes and "
+            "Prometheus scrapes cannot present client certificates and "
+            "will be rejected at the handshake — serve them with "
+            "--probe-port (the deploy/ manifests use 12346)"
+        )
+    stop_probe = None
+    if args.probe_port:
+        stop_probe = run_probe_server(
+            make_probe_app(extender, reconcile=reconcile,
+                           evictions=evictions, node_refresh=node_refresh,
+                           lifecycle=lifecycle),
+            host, args.probe_port,
+        )
+    log.warning("extender serving on %s:%d (score_mode=%s, tls=%s, "
+                "mtls=%s, bearer=%s, probe_port=%d)",
+                host, port, cfg.score_mode, ssl_ctx is not None,
+                bool(args.tls_client_ca), auth_token is not None,
+                args.probe_port)
     try:
         web.run_app(make_app(extender, reconcile=reconcile,
                              evictions=evictions,
                              node_refresh=node_refresh,
-                             lifecycle=lifecycle),
-                    host=host, port=port,
+                             lifecycle=lifecycle,
+                             auth_token=auth_token),
+                    host=host, port=port, ssl_context=ssl_ctx,
                     print=None, handle_signals=True)
     finally:
+        if stop_probe is not None:
+            stop_probe()
         for loop in loops:
             loop.stop()
     return 0
@@ -392,8 +468,10 @@ def main_sim(argv: Optional[list[str]] = None) -> int:
         "tpukube-sim",
         "run a BASELINE config scenario against the real control-plane stack",
     )
-    p.add_argument("scenario", type=int, choices=range(1, 6),
-                   help="BASELINE config number (1..5)")
+    p.add_argument("scenario", type=int, choices=range(1, 7),
+                   help="BASELINE config number (1..5), or 6 = the "
+                        "steady-state churn benchmark (completions -> "
+                        "release loop -> re-scheduling)")
     args = p.parse_args(argv)
     cfg = _setup(args)
 

@@ -219,10 +219,16 @@ class Extender:
             else:
                 self.gang.sweep()
             reserved = self._reserved_by_slice() if res is None else None
+            # one availability pass per webhook, not one coord scan per
+            # node (hot: 64-member gang x 32 nodes x 64 reserved coords)
+            gang_counts = (self.gang.node_availability(res)
+                           if res is not None else None)
             feasible, failed = [], {}
             for name in names:
                 if res is not None:
-                    reason = self.gang.node_feasibility(res, name)
+                    reason = self.gang.feasibility_from(
+                        gang_counts, res, name
+                    )
                 else:
                     reason = self._node_feasibility(name, resource, count, reserved)
                 if reason is None:
@@ -588,7 +594,9 @@ class Extender:
             if pod.group is not None and resource == RESOURCE_TPU:
                 res = self.gang.reservation(pod.namespace, pod.group.name)
                 if res is not None and self.gang.assignable(res, count):
-                    return {n: self.gang.node_score(res, n) for n in names}
+                    counts = self.gang.node_availability(res)
+                    return {n: self.gang.score_from(counts, n)
+                            for n in names}
                 if res is None:
                     return {n: 0 for n in names}
                 # overflow replica of a full gang: fall through to normal
@@ -1309,11 +1317,45 @@ class Extender:
 
 def make_app(
     extender: Extender, reconcile=None, evictions=None,
-    node_refresh=None, lifecycle=None,
+    node_refresh=None, lifecycle=None, auth_token: Optional[str] = None,
 ) -> web.Application:
     """``reconcile``/``evictions``/``node_refresh``/``lifecycle`` are the
-    daemon's loops, exported on /metrics when present."""
+    daemon's loops, exported on /metrics when present.
+
+    ``auth_token`` gates every route except /healthz and /metrics behind
+    ``Authorization: Bearer <token>``: /bind mutates the ledger, creates
+    Bindings, and executes preemption; /state and /trace disclose the
+    whole cluster's placement — none of that may answer an
+    unauthenticated request. (/healthz stays open for kubelet probes,
+    /metrics for Prometheus scrapes; both are read-only and
+    non-disclosing.) Transport security/mTLS is the TLS layer's job —
+    cli.main_extender builds the SSLContext; this is the
+    application-level check that also protects plain-HTTP dev setups and
+    defends in depth behind TLS."""
     app = web.Application()
+
+    if auth_token:
+        expected = f"Bearer {auth_token}".encode()
+
+        @web.middleware
+        async def bearer_auth(request: web.Request, handler):
+            if request.path in ("/healthz", "/metrics"):
+                return await handler(request)
+            got = request.headers.get("Authorization", "")
+            # constant-time compare on BYTES: the token is a credential,
+            # and the str overload raises on non-ASCII input (a crafted
+            # header must get a 401, not a 500)
+            import hmac
+            if not hmac.compare_digest(
+                got.encode("utf-8", "surrogateescape"), expected
+            ):
+                raise web.HTTPUnauthorized(
+                    text="missing or invalid bearer token",
+                    headers={"WWW-Authenticate": "Bearer"},
+                )
+            return await handler(request)
+
+        app.middlewares.append(bearer_auth)
 
     async def _json(request: web.Request) -> Any:
         try:
@@ -1336,20 +1378,6 @@ def make_app(
     prioritize_handler = _webhook("prioritize")
     bind_handler = _webhook("bind")
 
-    async def healthz(request: web.Request) -> web.Response:
-        return web.json_response({"ok": True, "nodes": extender.state.node_names()})
-
-    async def metrics(request: web.Request) -> web.Response:
-        from tpukube.metrics import render_extender_metrics
-
-        return web.Response(
-            text=render_extender_metrics(
-                extender, reconcile=reconcile, evictions=evictions,
-                node_refresh=node_refresh, lifecycle=lifecycle,
-            ),
-            content_type="text/plain",
-        )
-
     async def state_topology(request: web.Request) -> web.Response:
         return web.json_response(extender.topology_snapshot())
 
@@ -1371,10 +1399,86 @@ def make_app(
     app.router.add_post("/filter", filter_handler)
     app.router.add_post("/prioritize", prioritize_handler)
     app.router.add_post("/bind", bind_handler)
-    app.router.add_get("/healthz", healthz)
-    app.router.add_get("/metrics", metrics)
+    _add_probe_routes(app, extender, reconcile, evictions,
+                      node_refresh, lifecycle)
     app.router.add_get("/state/topology", state_topology)
     app.router.add_get("/state/allocs", state_allocs)
     app.router.add_get("/state/gangs", state_gangs)
     app.router.add_get("/trace", trace_handler)
     return app
+
+
+def _add_probe_routes(app, extender, reconcile=None, evictions=None,
+                      node_refresh=None, lifecycle=None) -> None:
+    async def healthz(request: web.Request) -> web.Response:
+        return web.json_response(
+            {"ok": True, "nodes": extender.state.node_names()}
+        )
+
+    async def metrics(request: web.Request) -> web.Response:
+        from tpukube.metrics import render_extender_metrics
+
+        return web.Response(
+            text=render_extender_metrics(
+                extender, reconcile=reconcile, evictions=evictions,
+                node_refresh=node_refresh, lifecycle=lifecycle,
+            ),
+            content_type="text/plain",
+        )
+
+    app.router.add_get("/healthz", healthz)
+    app.router.add_get("/metrics", metrics)
+
+
+def make_probe_app(extender, reconcile=None, evictions=None,
+                   node_refresh=None, lifecycle=None) -> web.Application:
+    """/healthz + /metrics ONLY — the mTLS deployment's second listener.
+
+    With --tls-client-ca, the main port rejects every peer without a
+    CA-signed client certificate at the handshake — which kubelet's
+    httpGet probes and Prometheus scrapes cannot present. This app
+    serves exactly the two read-only, non-disclosing routes over the
+    separate --probe-port so probes and scrapes work while /bind,
+    /state, and /trace stay behind mTLS."""
+    app = web.Application()
+    _add_probe_routes(app, extender, reconcile, evictions,
+                      node_refresh, lifecycle)
+    return app
+
+
+def run_probe_server(app: web.Application, host: str, port: int):
+    """Serve ``app`` from a daemon thread with its own event loop;
+    returns a stop() callable. The main serving loop belongs to
+    web.run_app — this is only for the auxiliary probe listener."""
+    import asyncio
+    import threading
+
+    loop_box: list = []
+    started = threading.Event()
+
+    def _run() -> None:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        loop_box.append(loop)
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, host, port)
+        loop.run_until_complete(site.start())
+        started.set()
+        try:
+            loop.run_forever()
+        finally:
+            loop.run_until_complete(runner.cleanup())
+            loop.close()
+
+    thread = threading.Thread(target=_run, daemon=True,
+                              name="tpukube-extender-probe")
+    thread.start()
+    if not started.wait(timeout=10):
+        raise RuntimeError(f"probe server failed to start on :{port}")
+
+    def stop() -> None:
+        loop_box[0].call_soon_threadsafe(loop_box[0].stop)
+        thread.join(timeout=5)
+
+    return stop

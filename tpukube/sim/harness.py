@@ -80,12 +80,16 @@ def _free_port() -> int:
 
 
 class _AppThread:
-    """Runs an aiohttp app in a background thread with its own loop."""
+    """Runs an aiohttp app in a background thread with its own loop.
+    ``ssl_context`` serves TLS — the same path cli.main_extender uses,
+    so the auth tests exercise the real serving configuration."""
 
-    def __init__(self, app: web.Application, host: str, port: int):
+    def __init__(self, app: web.Application, host: str, port: int,
+                 ssl_context=None):
         self._app = app
         self._host = host
         self._port = port
+        self._ssl = ssl_context
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
@@ -102,7 +106,8 @@ class _AppThread:
         asyncio.set_event_loop(self._loop)
         runner = web.AppRunner(self._app)
         self._loop.run_until_complete(runner.setup())
-        site = web.TCPSite(runner, self._host, self._port)
+        site = web.TCPSite(runner, self._host, self._port,
+                           ssl_context=self._ssl)
         self._loop.run_until_complete(site.start())
         self._started.set()
         try:
