@@ -1478,6 +1478,8 @@ def test_rest_watch_pods_streams_events():
         events = list(api.watch_pods("n1", timeout_seconds=30))
         list(api.watch_pods("n1", timeout_seconds=30,
                             resource_version="4 2"))
+        node_events = list(api.watch_nodes(timeout_seconds=30,
+                                           resource_version="7"))
     finally:
         httpd.shutdown()
     assert [(e, p["metadata"]["name"]) for e, p in events] == [
@@ -1488,6 +1490,11 @@ def test_rest_watch_pods_streams_events():
         "&fieldSelector=spec.nodeName%3Dn1"
     )
     assert paths[1].endswith("&resourceVersion=4%202")  # informer contract
+    # the node watch rides the same transport against /api/v1/nodes
+    assert len(node_events) == 3
+    assert paths[2] == (
+        "/api/v1/nodes?watch=1&timeoutSeconds=30&resourceVersion=7"
+    )
 
 
 def test_fake_watch_replays_list_to_watch_gap():
@@ -1828,6 +1835,58 @@ def test_node_refresh_loop_feeds_namescapable_cache():
     assert ext.trace is not None
     divergences = trace_mod.replay(ext.trace.events(), config=cfg)
     assert divergences == []
+
+
+def test_node_refresh_watch_mode_applies_fault_within_event():
+    """Watch-mode NodeTopologyRefreshLoop (the node informer): a health
+    re-annotation PATCHed onto the Node reaches the extender's cache via
+    the watch stream — including one landing in the list->watch gap —
+    without a single poll."""
+    import time as _time
+
+    from tpukube.core.config import load_config as _load
+    from tpukube.core.types import ChipInfo, Health, NodeInfo
+    from tpukube.sched.extender import Extender
+
+    cfg = _load(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    mesh = cfg.sim_mesh()
+    chips = [
+        ChipInfo(chip_id=f"c{i}", index=i, coord=c,
+                 hbm_bytes=cfg.hbm_bytes_per_chip, num_cores=2)
+        for i, c in enumerate(mesh.coords_of_host("host-0-0-0"))
+    ]
+    info = NodeInfo(name="host-0-0-0", chips=chips, slice_id=cfg.slice_id)
+    api = apisrv.FakeApiServer()
+    api.patch_node_annotations("host-0-0-0",
+                               codec.annotate_node(info, mesh))
+
+    ext = Extender(cfg)
+    loop = apisrv.NodeTopologyRefreshLoop(ext, api, poll_seconds=999)
+    assert loop._use_watch
+    loop.start()
+    try:
+        deadline = _time.monotonic() + 5
+        while ext.state.node("host-0-0-0") is None \
+                and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        view = ext.state.node("host-0-0-0")
+        assert view is not None  # initial resync applied the topology
+
+        # the node agent reports a chip fault; the WATCH delivers it
+        chips[0].health = Health.UNHEALTHY
+        api.patch_node_annotations("host-0-0-0",
+                                   codec.annotate_node(info, mesh))
+        deadline = _time.monotonic() + 5
+        while not ext.state.unhealthy_coords(cfg.slice_id) \
+                and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        assert ext.state.unhealthy_coords(cfg.slice_id)
+        assert loop.refreshed == 2
+    finally:
+        loop.stop()
 
 
 def test_rebuild_primes_refresh_loop():

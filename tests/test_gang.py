@@ -193,6 +193,43 @@ def test_committed_gang_teardown_frees_capacity():
         assert c.utilization() == 1.0
 
 
+def test_rollback_masks_member_chips_until_eviction_confirmed():
+    """A rolled-back member's containers may still be running through
+    graceful termination — exactly like a preemption victim. Its chips
+    must stay masked from every placement until the eviction executor
+    confirms the pod object gone (victim_gone), then free."""
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        "TPUKUBE_RESERVATION_TTL_SECONDS": "0.2",
+    })
+    with SimCluster(cfg) as c:
+        import time
+        group = PodGroup("doomed", min_member=4)
+        _, alloc = c.schedule(c.make_pod("d-0", tpu=1, group=group))
+        member_coord = TopologyCoord.of(alloc.coords[0])
+        sid = c.extender.state.slice_of_node(alloc.node_name)
+        time.sleep(0.3)
+        gang = c.extender.gang
+        assert ("default", "doomed") in gang.sweep()
+        # ledger shows the chip free, but the mask holds it
+        assert c.extender.state.allocation("default/d-0") is None
+        assert member_coord in gang.reserved_coords(sid)
+        assert gang.terminating_count() == 1
+        # a bystander wanting the whole node is infeasible while the
+        # rolled-back member terminates (3 free + 1 masked of 4)...
+        pod4 = c.make_pod("greedy", tpu=4)
+        fres = c.extender.handle("filter", {
+            "Pod": pod4, "Nodes": {"Items": c.node_objects()}})
+        assert fres["NodeNames"] == []
+        assert "gang reservations excluded" in str(fres["FailedNodes"])
+        # ...the executor confirms the eviction; the chip frees for real
+        assert c.drain_evictions() == ["default/d-0"]
+        assert gang.terminating_count() == 0
+        c.schedule(pod4)
+        assert c.utilization() == 1.0
+
+
 def test_rollback_queues_member_evictions():
     with SimCluster(_cfg(ttl="0.2")) as c:
         import time

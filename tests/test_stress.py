@@ -108,6 +108,103 @@ def test_concurrent_mixed_load_keeps_ledger_consistent():
         assert not gang_errs, gang_errs
 
 
+def test_concurrent_preemption_with_graceful_victims():
+    """The round-5 termination gate under racing schedulers: a full
+    cluster of low-priority solos, then a high-priority gang whose
+    members are driven by CONCURRENT scheduler threads while victims
+    terminate gracefully in the background. Whatever the interleaving:
+    no gang member may ever hold a chip while its victim's pod object
+    still exists, no chip double-allocates, and the gang lands whole."""
+    import time
+
+    from tpukube import apiserver as apisrv
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        api = apisrv.FakeApiServer()
+        for i in range(16):
+            pod = c.make_pod(f"s-{i}", tpu=1, priority=5)
+            c.schedule(pod)
+            api.upsert_pod(pod)
+            api.graceful.add(f"default/s-{i}")
+        ext = c.extender
+        ext.evict_precheck = (
+            lambda pk: api.evict_pod(*pk.split("/", 1), dry_run=True)
+        )
+        execu = apisrv.EvictionExecutor(ext, api, poll_seconds=999)
+        # schedule()'s internal drain must run THIS executor (graceful
+        # victims), not the pod store's instant-delete one — otherwise
+        # the gate never sees a termination window at all
+        c._evictions = execu
+
+        overlap_errs: list[str] = []
+        errs: list[str] = []
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def finisher():
+            """Plays the kubelets: terminating victims finish at random
+            times; the executor confirms and dispatches victim_gone."""
+            while not stop.is_set():
+                execu.drain()
+                for pod in api.list_pods():
+                    meta = pod["metadata"]
+                    if meta.get("deletionTimestamp"):
+                        # a gang member must not hold chips while ANY
+                        # victim object still exists
+                        gang_bound = [
+                            a.pod_key for a in ext.state.allocations()
+                            if a.pod_key.startswith("default/vip-")
+                        ]
+                        if gang_bound:
+                            with lock:
+                                overlap_errs.append(
+                                    f"{gang_bound} bound while "
+                                    f"{meta['name']} still terminating"
+                                )
+                        api.finish_termination(meta["namespace"],
+                                               meta["name"])
+                execu.drain()
+                time.sleep(0.002)
+
+        fin = threading.Thread(target=finisher)
+        fin.start()
+
+        gang = PodGroup("vip", min_member=8)
+
+        def sched(name):
+            try:
+                c.schedule(c.make_pod(name, tpu=1, priority=100,
+                                      group=gang), retries=200)
+            except RuntimeError as e:
+                with lock:
+                    errs.append(f"{name}: {e}")
+
+        threads = [threading.Thread(target=sched, args=(f"vip-{i}",))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stop.set()
+        fin.join()
+
+        assert not overlap_errs, overlap_errs[:3]
+        assert not errs, errs[:3]
+        res = ext.gang.reservation("default", "vip")
+        assert res is not None and res.committed
+        # no chip double-allocated
+        seen: dict[tuple, str] = {}
+        for a in ext.state.allocations():
+            for co in a.coords:
+                assert tuple(co) not in seen, (co, a.pod_key, seen)
+                seen[tuple(co)] = a.pod_key
+        assert ext.gang.terminating_count() == 0
+
+
 def test_restart_under_load_rebuilds_identical_state():
     """Kill-and-rebuild mid-scenario: the restarted extender must agree
     with the pods' annotations exactly (SURVEY.md §6 checkpoint/resume)."""

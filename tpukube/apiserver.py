@@ -110,6 +110,20 @@ class FakeApiServer:
         # what the REST path's resourceVersion parameter does)
         self._rv = 0
         self._history: deque = deque(maxlen=4096)
+        # node-watch half (NodeTopologyRefreshLoop's informer), same
+        # versioning contract as pods but its own stream
+        self._node_rv = 0
+        self._node_history: deque = deque(maxlen=4096)
+        self._node_watch_queues: list = []
+
+    def _notify_node(self, etype: str, name: str) -> None:
+        """Fan a Node event out to node watchers (under self._lock)."""
+        self._node_rv += 1
+        obj = {"metadata": {"name": name,
+                            "annotations": dict(self._nodes.get(name, {}))}}
+        self._node_history.append((self._node_rv, etype, obj))
+        for q in self._node_watch_queues:
+            q.put((etype, copy.deepcopy(obj)))
 
     def _notify(self, etype: str, pod: dict[str, Any]) -> None:
         """Fan a pod event out to live watchers (call under self._lock).
@@ -126,8 +140,10 @@ class FakeApiServer:
         self, name: str, annotations: dict[str, str]
     ) -> None:
         with self._lock:
+            etype = "MODIFIED" if name in self._nodes else "ADDED"
             self._nodes.setdefault(name, {}).update(annotations)
             self.patch_log.append(("node", name))
+            self._notify_node(etype, name)
 
     def get_node_annotations(self, name: str) -> dict[str, str]:
         with self._lock:
@@ -221,6 +237,24 @@ class FakeApiServer:
         spec.nodeName field selector. The handle placed in ``handle_box``
         exposes close() (enqueues a poison pill), so a loop's stop()
         unblocks a quiet watch exactly as it does the REST stream."""
+        def pod_filter(pod: dict[str, Any]) -> bool:
+            if node_name is None:
+                return True
+            return (pod.get("spec") or {}).get("nodeName") == node_name
+
+        return self._subscribe_watch(
+            self._watch_queues, self._history, resource_version,
+            handle_box, timeout_seconds, pod_filter,
+        )
+
+    def _subscribe_watch(self, queues: list, history: deque,
+                         resource_version: Optional[str],
+                         handle_box: Optional[list],
+                         timeout_seconds: int, keep) -> Any:
+        """Shared machinery of the pod and node watch halves: atomic
+        replay-from-history + subscription under the store lock, a
+        close() handle (poison pill), the server-timeout deadline, and
+        unsubscription when the generator ends."""
         q: queue.SimpleQueue = queue.SimpleQueue()
 
         class _Handle:
@@ -233,10 +267,10 @@ class FakeApiServer:
             since = None
         with self._lock:
             if since is not None:
-                for rv, etype, pod in self._history:
+                for rv, etype, obj in history:
                     if rv > since:
-                        q.put((etype, copy.deepcopy(pod)))
-            self._watch_queues.append(q)
+                        q.put((etype, copy.deepcopy(obj)))
+            queues.append(q)
         if handle_box is not None:
             handle_box.append(_Handle())
 
@@ -253,16 +287,13 @@ class FakeApiServer:
                         return
                     if ev is None:
                         return  # closed via the handle
-                    etype, pod = ev
-                    if node_name is not None:
-                        bound = (pod.get("spec") or {}).get("nodeName")
-                        if bound != node_name:
-                            continue
-                    yield etype, pod
+                    etype, obj = ev
+                    if keep(obj):
+                        yield etype, obj
             finally:
                 with self._lock:
-                    if q in self._watch_queues:
-                        self._watch_queues.remove(q)
+                    if q in queues:
+                        queues.remove(q)
 
         return _events()
 
@@ -337,6 +368,27 @@ class FakeApiServer:
                     or pod.get("spec", {}).get("nodeName") == node_name)
             ]
             return out, str(self._rv)
+
+    def list_nodes_with_rv(self) -> tuple[list[dict[str, Any]], str]:
+        """(nodes, resourceVersion) — the node informer's list half."""
+        with self._lock:
+            out = [
+                {"metadata": {"name": n, "annotations": dict(a)}}
+                for n, a in sorted(self._nodes.items())
+            ]
+            return out, str(self._node_rv)
+
+    def watch_nodes(self, node_name: Optional[str] = None,
+                    timeout_seconds: int = 300,
+                    handle_box: Optional[list] = None,
+                    resource_version: Optional[str] = None):
+        """Node-object watch, same informer contract as watch_pods
+        (``node_name`` accepted for signature symmetry; Node watches have
+        no field selector)."""
+        return self._subscribe_watch(
+            self._node_watch_queues, self._node_history, resource_version,
+            handle_box, timeout_seconds, lambda obj: True,
+        )
 
 
 class RestApiServer:
@@ -494,6 +546,27 @@ class RestApiServer:
             f"/api/v1/nodes?limit={self.LIST_PAGE_LIMIT}"
         )[0]
 
+    def list_nodes_with_rv(self) -> tuple[list[dict[str, Any]], str]:
+        """(nodes, resourceVersion) — the node informer's list half."""
+        return self._list_paginated(
+            f"/api/v1/nodes?limit={self.LIST_PAGE_LIMIT}"
+        )
+
+    def watch_nodes(self, node_name: Optional[str] = None,
+                    timeout_seconds: int = 300,
+                    handle_box: Optional[list] = None,
+                    resource_version: Optional[str] = None):
+        """Node-object watch stream (NodeTopologyRefreshLoop's informer
+        transport): a health re-annotation reaches a nodeCacheCapable
+        extender within milliseconds instead of a poll interval — the
+        §4.4 fault path's end-to-end latency. ``node_name`` accepted for
+        signature symmetry with watch_pods; Node watches have no field
+        selector."""
+        path = f"/api/v1/nodes?watch=1&timeoutSeconds={timeout_seconds}"
+        yield from self._watch_stream(
+            "nodes", path, timeout_seconds, handle_box, resource_version
+        )
+
     def watch_pods(self, node_name: Optional[str] = None,
                    timeout_seconds: int = 300,
                    handle_box: Optional[list] = None,
@@ -508,6 +581,16 @@ class RestApiServer:
         path = f"/api/v1/pods?watch=1&timeoutSeconds={timeout_seconds}"
         if node_name is not None:
             path += f"&fieldSelector=spec.nodeName%3D{node_name}"
+        yield from self._watch_stream(
+            "pods", path, timeout_seconds, handle_box, resource_version
+        )
+
+    def _watch_stream(self, what: str, path: str, timeout_seconds: int,
+                      handle_box: Optional[list],
+                      resource_version: Optional[str]):
+        """Shared transport of the pod and node watches: one chunked GET,
+        one {"type","object"} event per line, ending when the server
+        closes at timeoutSeconds."""
         if resource_version:
             # the informer contract: watching FROM the list's version
             # closes the list->watch gap (without it, a watch starts at
@@ -534,15 +617,16 @@ class RestApiServer:
                     try:
                         ev = json.loads(line)
                     except json.JSONDecodeError as e:
-                        log.warning("watch: unparsable event line: %s", e)
+                        log.warning("watch %s: unparsable event line: %s",
+                                    what, e)
                         continue
                     yield str(ev.get("type", "")), dict(ev.get("object") or {})
         except urllib.error.HTTPError as e:
             raise ApiServerError(
-                f"watch pods: HTTP {e.code}", code=e.code
+                f"watch {what}: HTTP {e.code}", code=e.code
             ) from e
         except urllib.error.URLError as e:
-            raise ApiServerError(f"watch pods: {e.reason}") from e
+            raise ApiServerError(f"watch {what}: {e.reason}") from e
 
     def get_pod(self, namespace: str, name: str) -> Optional[dict[str, Any]]:
         """One pod object, or None when it does not exist (404)."""
@@ -734,21 +818,25 @@ class NodeAnnotationSyncer(_PollLoop):
 
 
 class _WatchLoop(_PollLoop):
-    """Informer-pattern scaffolding shared by the pod-watching loops:
+    """Informer-pattern scaffolding shared by the watching loops:
     list-resync at every (re)connect, then a watch FROM the list's
     resourceVersion, with the poll loop as the no-watch fallback.
     Subclasses implement ``_resync()`` (full list reconciliation,
     returning ``(changed, resourceVersion)``) and
-    ``_apply_watch_event(etype, pod)``."""
+    ``_apply_watch_event(etype, obj)``; ``watch_method`` names the api's
+    stream ("watch_pods" for the pod loops, "watch_nodes" for the node
+    topology loop)."""
 
     def __init__(
         self, name: str, api, node_name: Optional[str],
         poll_seconds: float, use_watch: bool,
+        watch_method: str = "watch_pods",
     ) -> None:
         super().__init__(poll_seconds, name)
         self._api = api
         self._node = node_name
-        self._use_watch = use_watch and hasattr(api, "watch_pods")
+        self._watch_method = watch_method
+        self._use_watch = use_watch and hasattr(api, watch_method)
         self._box_supported = True  # False after a handle_box TypeError
 
     def _resync(self) -> tuple[bool, Optional[str]]:  # pragma: no cover
@@ -773,13 +861,14 @@ class _WatchLoop(_PollLoop):
                 # resync at every (re)connect, then watch FROM the list's
                 # resourceVersion — no event in the list->watch gap is lost
                 _, rv = self._resync()
+                watch = getattr(self._api, self._watch_method)
                 try:
-                    gen = self._api.watch_pods(
+                    gen = watch(
                         self._node, handle_box=box, resource_version=rv
                     )
                 except TypeError:  # test stubs without the full signature
                     self._box_supported = False
-                    gen = self._api.watch_pods(self._node)
+                    gen = watch(self._node)
                 for etype, pod in gen:
                     if self._stop.is_set():
                         return
@@ -1063,20 +1152,24 @@ class PodLifecycleReleaseLoop(_WatchLoop):
         return changed, rv
 
 
-class NodeTopologyRefreshLoop(_PollLoop):
+class NodeTopologyRefreshLoop(_WatchLoop):
     """Keeps a nodeCacheCapable extender's node cache fresh.
 
     With ``nodeCacheCapable: true``, kube-scheduler sends only NodeNames —
     the extender would never see node-annotation updates (health faults,
     link faults, share-mode changes) after its startup rebuild. This loop
-    polls the Node objects and applies CHANGED topology annotations as
-    recorded ``upsert_node`` decisions, so live captures still replay
-    deterministically against a fresh extender."""
+    watches the Node objects (informer pattern, poll fallback) and
+    applies CHANGED topology annotations as recorded ``upsert_node``
+    decisions, so live captures still replay deterministically against a
+    fresh extender. Watch mode closes the §4.4 fault path's last latency
+    gap: a node agent's health re-annotation reaches the scheduler's
+    cache within milliseconds instead of a poll interval later."""
 
-    def __init__(self, extender, api, poll_seconds: float = 5.0) -> None:
-        super().__init__(poll_seconds, "tpukube-node-refresh")
+    def __init__(self, extender, api, poll_seconds: float = 5.0,
+                 use_watch: bool = True) -> None:
+        super().__init__("tpukube-node-refresh", api, None, poll_seconds,
+                         use_watch, watch_method="watch_nodes")
         self._extender = extender
-        self._api = api
         self._applied: dict[str, str] = {}  # name -> applied topo payload
         self._rejected: dict[str, str] = {}  # name -> rejected payload
         self.refreshed = 0  # applied annotation changes (tests/metrics)
@@ -1097,36 +1190,54 @@ class NodeTopologyRefreshLoop(_PollLoop):
         if payload is not None:
             self._rejected[name] = payload
 
-    def check_once(self) -> bool:
-        """One poll; True if any node's topology changed."""
+    def _apply_node(self, obj: dict[str, Any]) -> bool:
+        """Dispatch one Node object's topology annotation if it changed;
+        True when applied."""
+        meta = obj.get("metadata") or {}
+        name = meta.get("name")
+        if not name:
+            return False
+        annotations = dict(meta.get("annotations") or {})
+        payload = annotations.get(codec.ANNO_NODE_TOPOLOGY)
+        if payload is None or payload == self._applied.get(name):
+            return False
+        if payload == self._rejected.get(name):
+            # a persistently-bad annotation must not re-record an
+            # identical error decision (trace spam) every poll;
+            # re-dispatch only when the payload changes
+            return False
+        out = self._extender.handle(
+            "upsert_node", {"name": name, "annotations": annotations}
+        )
+        if out.get("error"):
+            log.warning("node refresh for %s rejected: %s",
+                        name, out["error"])
+            self._rejected[name] = payload
+            return False
+        self._rejected.pop(name, None)
+        self._applied[name] = payload
+        self.refreshed += 1
+        return True
+
+    def _apply_watch_event(self, etype: str, obj: dict[str, Any]) -> None:
+        if etype == "DELETED":
+            # forget bookkeeping so a recreated same-name node re-applies
+            name = (obj.get("metadata") or {}).get("name")
+            if name:
+                self._applied.pop(name, None)
+                self._rejected.pop(name, None)
+            return
+        self._apply_node(obj)
+
+    def _resync(self) -> tuple[bool, Optional[str]]:
+        if hasattr(self._api, "list_nodes_with_rv"):
+            nodes, rv = self._api.list_nodes_with_rv()
+        else:
+            nodes, rv = self._api.list_nodes(), None
         did = False
-        for obj in self._api.list_nodes():
-            meta = obj.get("metadata") or {}
-            name = meta.get("name")
-            if not name:
-                continue
-            annotations = dict(meta.get("annotations") or {})
-            payload = annotations.get(codec.ANNO_NODE_TOPOLOGY)
-            if payload is None or payload == self._applied.get(name):
-                continue
-            if payload == self._rejected.get(name):
-                # a persistently-bad annotation must not re-record an
-                # identical error decision (trace spam) every poll;
-                # re-dispatch only when the payload changes
-                continue
-            out = self._extender.handle(
-                "upsert_node", {"name": name, "annotations": annotations}
-            )
-            if out.get("error"):
-                log.warning("node refresh for %s rejected: %s",
-                            name, out["error"])
-                self._rejected[name] = payload
-                continue
-            self._rejected.pop(name, None)
-            self._applied[name] = payload
-            self.refreshed += 1
-            did = True
-        return did
+        for obj in nodes:
+            did |= self._apply_node(obj)
+        return did, rv
 
 
 def rebuild_extender(extender, api, refresh=None) -> int:

@@ -486,12 +486,34 @@ def main_sim(argv: Optional[list[str]] = None) -> int:
 
 # -- tpukubectl --------------------------------------------------------------
 
-def _fetch(server: str, path: str) -> Any:
-    with urllib.request.urlopen(f"{server}{path}", timeout=10) as r:
+def _fetch(server: str, path: str, token: Optional[str] = None,
+           ssl_ctx=None) -> Any:
+    req = urllib.request.Request(f"{server}{path}")
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    with urllib.request.urlopen(req, timeout=10, context=ssl_ctx) as r:
         body = r.read()
     if path == "/metrics":
         return body.decode()
     return json.loads(body)
+
+
+def _ctl_ssl_context(args: argparse.Namespace):
+    """Client-side TLS for a secured extender (mirrors the server's two
+    modes): --cacert pins the serving cert, --cert/--key presents the
+    client certificate mTLS demands."""
+    if args.key and not args.cert:
+        raise SystemExit("--key requires --cert")
+    if not (args.cacert or args.cert):
+        return None
+    import ssl
+
+    ctx = ssl.create_default_context(cafile=args.cacert)
+    if args.cert:
+        if not args.key:
+            raise SystemExit("--cert requires --key")
+        ctx.load_cert_chain(args.cert, args.key)
+    return ctx
 
 
 def _render_topo(topo: dict[str, Any], out) -> None:
@@ -534,9 +556,18 @@ def main_ctl(argv: Optional[list[str]] = None) -> int:
         description="inspect a live tpukube extender / replay decision traces",
     )
     p.add_argument("--server", default="http://127.0.0.1:12345",
-                   help="extender base URL")
+                   help="extender base URL (https:// for a TLS extender)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="raw JSON output")
+    # the client half of the extender's auth modes (main_extender):
+    p.add_argument("--token-file", default=None, metavar="FILE",
+                   help="bearer token file for an --auth-token-file extender")
+    p.add_argument("--cacert", default=None, metavar="PEM",
+                   help="CA bundle pinning the extender's serving cert")
+    p.add_argument("--cert", default=None, metavar="PEM",
+                   help="client certificate for an mTLS extender")
+    p.add_argument("--key", default=None, metavar="PEM",
+                   help="private key for --cert")
     sub = p.add_subparsers(dest="cmd", required=True)
     sub.add_parser("topo", help="cluster topology + occupancy map")
     sub.add_parser("alloc", help="committed allocations")
@@ -562,12 +593,16 @@ def main_ctl(argv: Optional[list[str]] = None) -> int:
             print(d)
         return 1
 
+    token = None
+    if args.token_file:
+        with open(args.token_file) as f:
+            token = f.read().strip()
     data = _fetch(args.server, {
         "topo": "/state/topology",
         "alloc": "/state/allocs",
         "gangs": "/state/gangs",
         "metrics": "/metrics",
-    }[args.cmd])
+    }[args.cmd], token=token, ssl_ctx=_ctl_ssl_context(args))
     if args.cmd == "metrics":
         sys.stdout.write(data)
         return 0

@@ -258,14 +258,31 @@ class GangManager:
                     rolled.append(key)
         return rolled
 
+    def _evict_and_mask_locked(
+        self, pod_key: str,
+        entry: Optional[tuple[str, list[TopologyCoord]]],
+    ) -> None:
+        """The one way a gang layer eviction happens (rollback, dissolve,
+        restore-rollback): release the ledger, queue the eviction for the
+        executor, and mask the member's chips until the eviction is
+        CONFIRMED. The pod may already be Running on its node — releasing
+        the ledger alone would let another pod double-book those chips,
+        and a rolled-back member terminates gracefully just like a
+        preemption victim: a bystander bound onto its chip mid-grace
+        would crash-loop on a single-owner TPU runtime. ``entry`` is the
+        member's (slice, coords); None only when the coordinate space is
+        genuinely unknown (restore with an unresolvable node on a
+        multi-slice cluster) — then the mask is impossible and skipped."""
+        self._state.release(pod_key)
+        self._evictions.append(pod_key)
+        if entry is not None and entry[1]:
+            self._terminating_coords[pod_key] = (
+                entry[0], frozenset(entry[1])
+            )
+
     def _rollback_locked(self, res: GangReservation) -> None:
         for pod_key in list(res.assigned):
-            self._state.release(pod_key)
-            # The pod may already be Running on its node; releasing the
-            # ledger alone would let another pod double-book those chips.
-            # Queue the eviction for whoever owns pod lifecycle (the sim
-            # harness, or an apiserver writer on a real cluster).
-            self._evictions.append(pod_key)
+            self._evict_and_mask_locked(pod_key, res.assigned.get(pod_key))
         self._reservations.pop(res.key, None)
         self.rollbacks += 1
 
@@ -410,8 +427,8 @@ class GangManager:
                 return []
             evicted = []
             for pod_key in list(res.assigned):
-                self._state.release(pod_key)
-                self._evictions.append(pod_key)
+                self._evict_and_mask_locked(pod_key,
+                                            res.assigned.get(pod_key))
                 evicted.append(pod_key)
             log.warning(
                 "gang %s/%s dissolved by preemption (%d members evicted)",
@@ -441,19 +458,31 @@ class GangManager:
             if key in self._reservations or not allocs:
                 return self._reservations.get(key)
             chips_per_pod = max(1, len(allocs[0].coords))
+            member_slices: dict[str, str] = {}
 
             def rollback_all(why: str) -> None:
                 log.warning("gang %s/%s: %s — rolling back",
                             namespace, group.name, why)
                 for a in allocs:
-                    self._state.release(a.pod_key)
-                    self._evictions.append(a.pod_key)
+                    # restored members may be RUNNING: mask their chips
+                    # until the eviction confirms. Prefer the resolved
+                    # member_slices entry (it carries the single-slice
+                    # fallback for nodes whose view is gone); only a
+                    # multi-slice cluster with an unresolvable node
+                    # leaves the coordinate space unknown (mask skipped).
+                    sid = member_slices.get(a.pod_key)
+                    if sid is None:
+                        sid = self._state.slice_of_node(a.node_name)
+                    entry = (
+                        (sid, [TopologyCoord.of(c) for c in a.coords])
+                        if sid is not None else None
+                    )
+                    self._evict_and_mask_locked(a.pod_key, entry)
                 self.rollbacks += 1
 
             # the members' nodes know which ICI slice(s) the gang lives in;
             # with a node view gone, only an unambiguous (single-slice)
             # cluster lets us proceed — guessing would mix coord spaces
-            member_slices: dict[str, str] = {}
             for a in allocs:
                 sid = self._state.slice_of_node(a.node_name)
                 if sid is None:
