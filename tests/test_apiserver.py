@@ -1526,6 +1526,14 @@ def test_fake_watch_replays_list_to_watch_gap():
     box2[0].close()
     assert list(gen2) == []
 
+    # a version older than the bounded history answers 410 Gone (the
+    # real apiserver's contract) instead of silently skipping the
+    # evicted events — the informer's reconnect then resyncs fresh
+    api._history.popleft()  # evict the oldest retained event
+    with pytest.raises(apisrv.ApiServerError) as e:
+        api.watch_pods(resource_version="0", timeout_seconds=5)
+    assert e.value.code == 410
+
 
 def test_intent_watcher_watch_mode(tmp_path):
     """Watch-mode AllocIntentWatcher: intents land as events arrive (no

@@ -267,6 +267,16 @@ class FakeApiServer:
             since = None
         with self._lock:
             if since is not None:
+                if history and history[0][0] > since + 1:
+                    # events between `since` and the oldest retained entry
+                    # were evicted from the bounded history: replaying
+                    # would silently skip them. The real apiserver answers
+                    # 410 Gone; the informer's reconnect then resyncs with
+                    # a fresh list — same contract here.
+                    raise ApiServerError(
+                        f"resourceVersion {since} too old "
+                        f"(history starts at {history[0][0]})", code=410,
+                    )
                 for rv, etype, obj in history:
                     if rv > since:
                         q.put((etype, copy.deepcopy(obj)))

@@ -65,6 +65,13 @@ class NodeView:
     # path queries this per planned coord — a linear chip scan there was
     # round-2 weak #2
     _coord_index: dict[TopologyCoord, int] = field(default_factory=dict)
+    # occupancy version, bumped by add_ids/remove_ids: memoizes the
+    # derived free-chip list and free-share total, which every webhook
+    # recomputes per node (health changes arrive as NEW views via
+    # upsert_node, so version-only invalidation is sound)
+    _version: int = 0
+    _free_cache: Optional[tuple[int, list[ChipInfo]]] = None
+    _free_shares_cache: Optional[tuple[int, int]] = None
 
     @property
     def shares_per_chip(self) -> int:
@@ -84,6 +91,7 @@ class NodeView:
             ) from None
 
     def add_ids(self, ids) -> None:
+        self._version += 1
         for did in ids:
             i, frac = parse_device_id(did)
             self.used_ids.add(did)
@@ -92,6 +100,7 @@ class NodeView:
             self.share_counts[i] = self.share_counts.get(i, 0) + weight
 
     def remove_ids(self, ids) -> None:
+        self._version += 1
         for did in ids:
             if did not in self.used_ids:
                 continue
@@ -121,15 +130,26 @@ class NodeView:
         return self.shares_per_chip - self.used_share_count(chip.index)
 
     def total_free_shares(self) -> int:
-        return sum(self.free_shares(c) for c in self.info.chips)
+        cached = self._free_shares_cache
+        if cached is not None and cached[0] == self._version:
+            return cached[1]
+        total = sum(self.free_shares(c) for c in self.info.chips)
+        self._free_shares_cache = (self._version, total)
+        return total
 
     def free_chips(self) -> list[ChipInfo]:
-        """Chips with ALL shares free (placeable as whole units)."""
-        return [
+        """Chips with ALL shares free (placeable as whole units).
+        Shared memoized list — callers must not mutate it."""
+        cached = self._free_cache
+        if cached is not None and cached[0] == self._version:
+            return cached[1]
+        out = [
             c
             for c in self.info.chips
             if self.free_shares(c) == self.shares_per_chip
         ]
+        self._free_cache = (self._version, out)
+        return out
 
 
 @dataclass
