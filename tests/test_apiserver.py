@@ -772,6 +772,10 @@ def test_gang_bind_waits_for_graceful_victim_termination():
         # objects linger with deletionTimestamp, so binds stay gated
         execu.check_once()
         assert execu.evicted == 0 and execu.depth() == 4
+        # the operator can SEE why the gang is not binding
+        (snap,) = ext.gang_snapshot()
+        assert snap["victims_terminating"] == 4
+        assert snap["victims_pending"] == 0
         for pk in victims:
             ns, name = pk.split("/", 1)
             assert api.get_pod(ns, name)["metadata"]["deletionTimestamp"]
@@ -799,6 +803,99 @@ def test_gang_bind_waits_for_graceful_victim_termination():
         # replays deterministically
         from tpukube import trace as trace_mod
         assert trace_mod.replay(ext.trace.events(), config=cfg) == []
+
+
+def test_restart_mid_victim_termination_is_safe():
+    """Extender restart while preemption victims terminate: the rebuilt
+    ledger restores the still-terminating victims (their objects carry
+    only a deletionTimestamp — containers may still hold the chips), so
+    no placement can overlap them; the gang re-plans preemption from
+    scratch, re-executes against the already-terminating victims, and
+    binds only once their objects are confirmed gone."""
+    from tpukube.core.types import PodGroup
+    from tpukube.sched.extender import Extender
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        api = apisrv.FakeApiServer()
+        for obj in c.node_objects():
+            api.patch_node_annotations(obj["metadata"]["name"],
+                                       obj["metadata"]["annotations"])
+        for i in range(16):
+            pod = c.make_pod(f"s-{i}", tpu=1, priority=5)
+            c.schedule(pod)  # mutates pod: nodeName + alloc annotation
+            api.upsert_pod(pod)
+            api.graceful.add(f"default/s-{i}")
+        ext = c.extender
+        ext.evict_precheck = (
+            lambda pk: api.evict_pod(*pk.split("/", 1), dry_run=True)
+        )
+        execu = apisrv.EvictionExecutor(ext, api, poll_seconds=999)
+        group = PodGroup("vip", min_member=4)
+        _, fbody = _gang_schedule_body("vip-0", c.node_objects(), group)
+        fres = ext.handle("filter", fbody)
+        target = fres["NodeNames"][0]
+        bind_body = {"PodName": "vip-0", "PodNamespace": "default",
+                     "PodUID": "uid-vip-0", "Node": target}
+        bres = ext.handle("bind", bind_body)
+        assert "finish terminating" in bres["Error"]
+        execu.check_once()  # evictions accepted; victims now TERMINATING
+        victims = sorted(
+            f"{p['metadata']['namespace']}/{p['metadata']['name']}"
+            for p in api.list_pods()
+            if p["metadata"].get("deletionTimestamp")
+        )
+        assert len(victims) == 4
+
+        # ---- CRASH + RESTART ------------------------------------------
+        fresh = Extender(cfg)
+        fresh.evict_precheck = ext.evict_precheck
+        restored = apisrv.rebuild_extender(fresh, api)
+        # the terminating victims' ledger entries are RESTORED: their
+        # containers may still hold the chips, so nothing may bind there
+        assert {v for v in victims} <= {
+            a.pod_key for a in fresh.state.allocations()
+        }
+        assert restored == 16
+        # the uncommitted gang reservation died with the process, and no
+        # eviction queue survived — nothing is half-executed
+        assert fresh.gang.reservation("default", "vip") is None
+        assert not fresh.pending_evictions
+
+        # the gang's next cycle re-plans preemption; victims are already
+        # terminating, so re-eviction is an idempotent accept
+        execu2 = apisrv.EvictionExecutor(fresh, api, poll_seconds=999)
+        _, fbody2 = _gang_schedule_body("vip-0", c.node_objects(), group)
+        fres2 = fresh.handle("filter", fbody2)
+        assert fres2["NodeNames"], fres2.get("Error")
+        bres2 = fresh.handle("bind", {
+            "PodName": "vip-0", "PodNamespace": "default",
+            "PodUID": "uid-vip-0", "Node": fres2["NodeNames"][0],
+        })
+        assert "finish terminating" in bres2["Error"]
+        execu2.check_once()
+        bres2 = fresh.handle("bind", {
+            "PodName": "vip-0", "PodNamespace": "default",
+            "PodUID": "uid-vip-0", "Node": fres2["NodeNames"][0],
+        })
+        assert "victim" in bres2["Error"]  # still gated mid-grace
+
+        # terminations finish; the new executor confirms; the bind lands
+        for p in list(api.list_pods()):
+            if p["metadata"].get("deletionTimestamp"):
+                api.finish_termination(p["metadata"]["namespace"],
+                                      p["metadata"]["name"])
+        execu2.check_once()
+        fres3 = fresh.handle("filter", fbody2)
+        bres3 = fresh.handle("bind", {
+            "PodName": "vip-0", "PodNamespace": "default",
+            "PodUID": "uid-vip-0", "Node": fres3["NodeNames"][0],
+        })
+        assert not bres3.get("Error"), bres3
+        assert fresh.state.allocation("default/vip-0") is not None
 
 
 def test_pdb_blocked_victim_refuses_preemption_plan():

@@ -237,3 +237,31 @@ def test_mesh_from_alloc_env_builds_dcn_mesh():
 def test_shaped_allow_dcn_pod_group_rejected_at_construction():
     with pytest.raises(ValueError, match="incompatible"):
         PodGroup("bad", min_member=4, shape=(2, 2, 1), allow_dcn=True)
+
+
+def test_dcn_gang_env_projected_as_per_key_annotations():
+    """The user-facing DCN contract end to end: a 2-slice gang bound
+    through the real bind effector (pod_binder) leaves each member pod
+    carrying the per-key gang annotations deploy/gang-job-example.yaml
+    projects into TPU_KUBE_GANG_* container env — both slice indices
+    represented, every annotation agreeing with the alloc blob's env."""
+    from tpukube import apiserver as apisrv
+
+    with two_slices() as c:
+        api = apisrv.FakeApiServer()
+        c.extender.binder = apisrv.pod_binder(api)
+        group = PodGroup("dcn-train", min_member=20, allow_dcn=True)
+        for i in range(20):
+            pod = c.make_pod(f"t-{i}", tpu=1, priority=10, group=group)
+            api.upsert_pod(pod)
+            c.schedule(pod)
+        seen_idx = set()
+        for i in range(20):
+            annos = api.get_pod("default", f"t-{i}")["metadata"]["annotations"]
+            alloc_env = codec.decode_alloc(annos[codec.ANNO_ALLOC]).env
+            for var, anno in codec.GANG_ENV_TO_ANNO.items():
+                assert annos[anno] == alloc_env[var], (var, annos)
+            assert annos["tpu.qiniu.com/gang-num-slices"] == "2"
+            assert annos["tpu.qiniu.com/gang-slices"] == "slice-a,slice-b"
+            seen_idx.add(annos["tpu.qiniu.com/gang-slice-index"])
+        assert seen_idx == {"0", "1"}

@@ -624,9 +624,16 @@ def main_ctl(argv: Optional[list[str]] = None) -> int:
             state = "committed" if g["committed"] else "assembling"
             chips = sum(len(cs) for cs in g["slices"].values())
             where = "+".join(sorted(g["slices"]))
+            gate = ""
+            if g.get("victims_terminating"):
+                gate = (f" [waiting on {g['victims_terminating']} "
+                        f"terminating victim(s)]")
+            elif g.get("victims_pending"):
+                gate = (f" [{g['victims_pending']} preemption victim(s) "
+                        f"planned, not yet evicted]")
             print(f"{g['namespace']}/{g['group']:24s} {state:10s} "
                   f"{g['members_bound']}/{g['min_member']} bound "
-                  f"prio={g['priority']} chips={chips} in {where}")
+                  f"prio={g['priority']} chips={chips} in {where}{gate}")
     return 0
 
 

@@ -1273,6 +1273,15 @@ class Extender:
                 "committed": res.committed,
                 "priority": res.priority,
                 "spans_dcn": res.spans_dcn,
+                # why an assembling gang is not binding: victims planned
+                # (preemption not yet executed) or still terminating —
+                # both through the manager's locked accessors
+                "victims_pending": len(
+                    self.gang.peek_pending_victims(res)
+                ),
+                "victims_terminating": len(
+                    self.gang.terminating_victims_of(res)
+                ),
                 "slices": {
                     sid: [list(c) for c in sorted(coords)]
                     for sid, coords in sorted(res.slice_coords.items())

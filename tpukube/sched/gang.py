@@ -220,6 +220,13 @@ class GangManager:
         Returns the rolled-back group keys."""
         now = time.monotonic() if now is None else now
         rolled: list[tuple[str, str]] = []
+        with self._lock:
+            if all(r.committed for r in self._reservations.values()):
+                # nothing sweepable (TTL/health/link rollback applies
+                # only to UNCOMMITTED reservations, which the loop below
+                # would skip anyway) — and this runs on every non-gang
+                # filter, so skip the per-slice health/link snapshots
+                return rolled
         unhealthy: dict[str, set[TopologyCoord]] = {}
         broken: dict[str, set] = {}
         for sid in self._state.slice_ids():
