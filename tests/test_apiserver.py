@@ -805,6 +805,54 @@ def test_gang_bind_waits_for_graceful_victim_termination():
         assert trace_mod.replay(ext.trace.events(), config=cfg) == []
 
 
+def test_reconcile_loop_watch_mode_folds_report_on_event():
+    """Watch-mode AllocReconcileLoop: a kubelet divergence report
+    (alloc-actual annotation) is folded into the ledger by the MODIFIED
+    event that carries it — no LIST poll — and the clearing PATCH's own
+    follow-up event no-ops instead of looping."""
+    import time as _time
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        api = apisrv.FakeApiServer()
+        pod = c.make_pod("p0", tpu=1)
+        api.upsert_pod(pod)
+        c.extender.binder = apisrv.pod_binder(api)
+        _, alloc = c.schedule(pod)
+        view = c.extender.state.node(alloc.node_name)
+        free = [ch for ch in view.info.chips
+                if f"tpu-{ch.index}" not in view.used_ids]
+        actual_id = f"tpu-{free[0].index}"
+
+        loop = apisrv.AllocReconcileLoop(c.extender, api, poll_seconds=999)
+        assert loop._use_watch
+        loop.start()
+        try:
+            # the node agent reports what the kubelet REALLY allocated
+            api.patch_pod_annotations(
+                "default", "p0",
+                {apisrv.ANNO_ALLOC_ACTUAL:
+                 apisrv.encode_alloc_actual([actual_id])},
+            )
+            deadline = _time.monotonic() + 5
+            while loop.reconciled == 0 and _time.monotonic() < deadline:
+                _time.sleep(0.01)
+            assert loop.reconciled == 1
+            assert c.extender.state.allocation(
+                "default/p0").device_ids == [actual_id]
+            annos = api.get_pod("default", "p0")["metadata"]["annotations"]
+            assert apisrv.ANNO_ALLOC_ACTUAL not in annos  # report cleared
+            fixed = codec.decode_alloc(annos[codec.ANNO_ALLOC])
+            assert fixed.device_ids == [actual_id]
+            _time.sleep(0.1)  # the clearing PATCH's event must not loop
+            assert loop.reconciled == 1
+        finally:
+            loop.stop()
+
+
 def test_restart_mid_victim_termination_is_safe():
     """Extender restart while preemption victims terminate: the rebuilt
     ledger restores the still-terminating victims (their objects carry

@@ -135,10 +135,14 @@ def test_full_cluster_lifecycle(tmp_path):
         lifecycle = apisrv.PodLifecycleReleaseLoop(
             ext, api, poll_seconds=999, use_watch=True, evictions=evictions
         )
+        # the daemon's shape: ONE pod stream fanned to both pod loops
+        pod_informer = apisrv.PodInformer(
+            api, [lifecycle, reconcile], poll_seconds=999
+        )
         assert apisrv.rebuild_extender(ext, api) == 0
         assert refresh.check_once() is True  # topology flows api -> cache
         intent_watch.start()
-        lifecycle.start()
+        pod_informer.start()
 
         # ---- pod lifecycle: schedule -> steer -> allocate (§4.2-§4.3) --
         pod = _pod_obj("train-0", tpu=2)
@@ -179,22 +183,19 @@ def test_full_cluster_lifecycle(tmp_path):
         free = [d for d in devs if d not in steered and d not in planned2]
         kubelet.allocate(server.resource_name, [free[0]])  # ignores plan
         assert server.divergences == 1
-        # the reporter thread PATCHes alloc-actual; wait for it, then the
-        # reconcile loop folds reality into the ledger
-        import time as _time
-        deadline = _time.monotonic() + 5
-        while _time.monotonic() < deadline:
-            annos = api.get_pod("default", "train-1")["metadata"]["annotations"]
-            if apisrv.ANNO_ALLOC_ACTUAL in annos:
-                break
-            _time.sleep(0.02)
-        assert reconcile.check_once() is True
+        # the reporter thread PATCHes alloc-actual; the informer's WATCH
+        # delivers that MODIFIED event to the reconcile handler, which
+        # folds reality into the ledger — no poll anywhere
+        _wait_for(
+            lambda: reconcile.reconciled == 1,  # counts AFTER the ack
+            "divergence reconciled via watch",
+        )
+        assert ext.state.allocation("default/train-1").device_ids == [free[0]]
         fixed = codec.decode_alloc(
             api.get_pod("default", "train-1")
             ["metadata"]["annotations"][codec.ANNO_ALLOC]
         )
         assert fixed.device_ids == [free[0]]
-        assert ext.state.allocation("default/train-1").device_ids == [free[0]]
 
         # ---- preemption: gang evicts via the Eviction subresource ------
         # the first member's bind executes the plan, then FAILS retryably
@@ -277,7 +278,7 @@ def test_full_cluster_lifecycle(tmp_path):
         assert _schedule(ext, api, pod3) == "host-0-0-0"
 
         intent_watch.stop()
-        lifecycle.stop()
+        pod_informer.stop()
 
         # the whole day replays deterministically from the trace
         from tpukube import trace as trace_mod

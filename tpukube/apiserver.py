@@ -827,6 +827,14 @@ class NodeAnnotationSyncer(_PollLoop):
         return True
 
 
+class _ResyncNeeded(Exception):
+    """Raised by a _WatchLoop subclass's event/resync handler when it
+    left work unfinished (e.g. a failed ack PATCH): the loop closes the
+    stream, backs off one poll interval, and resyncs — restoring the
+    poll mode's convergence bound instead of waiting out the watch
+    stream's server timeout (~300s)."""
+
+
 class _WatchLoop(_PollLoop):
     """Informer-pattern scaffolding shared by the watching loops:
     list-resync at every (re)connect, then a watch FROM the list's
@@ -861,6 +869,23 @@ class _WatchLoop(_PollLoop):
         """One full resync; True if anything changed."""
         return self._resync()[0]
 
+    def _needs_resync(self) -> bool:
+        """Subclass hook, consulted after each watch-mode resync: True
+        when the resync left work unfinished (retry after one poll
+        interval instead of entering the watch)."""
+        return False
+
+    def _list_pods_rv(
+        self, node_name: Optional[str] = None
+    ) -> tuple[list[dict[str, Any]], Optional[str]]:
+        """The informer contract's list half, with the plain-list
+        fallback for apis without resourceVersions (one definition for
+        every pod loop — watching then starts at 'now', and the
+        periodic resync covers the gap)."""
+        if hasattr(self._api, "list_pods_with_rv"):
+            return self._api.list_pods_with_rv(node_name)
+        return self._api.list_pods(node_name), None
+
     def _run(self) -> None:
         if not self._use_watch:
             return super()._run()
@@ -871,6 +896,8 @@ class _WatchLoop(_PollLoop):
                 # resync at every (re)connect, then watch FROM the list's
                 # resourceVersion — no event in the list->watch gap is lost
                 _, rv = self._resync()
+                if self._needs_resync():
+                    raise _ResyncNeeded
                 watch = getattr(self._api, self._watch_method)
                 try:
                     gen = watch(
@@ -883,6 +910,10 @@ class _WatchLoop(_PollLoop):
                     if self._stop.is_set():
                         return
                     self._apply_watch_event(etype, pod)
+            except _ResyncNeeded:
+                # expected control flow, not a failure: back off one
+                # poll and resync (bounded retry for unfinished work)
+                log.info("%s: resync forced (pending retry)", self._name)
             except Exception:
                 if self._stop.is_set():
                     return  # stop() closed the stream under us
@@ -962,10 +993,7 @@ class AllocIntentWatcher(_WatchLoop):
         """Full list resync; returns (changed, resourceVersion) — the
         version is the watch's safe starting point (None when the api
         doesn't expose it)."""
-        if hasattr(self._api, "list_pods_with_rv"):
-            pods, rv = self._api.list_pods_with_rv(self._node)
-        else:
-            pods, rv = self._api.list_pods(self._node), None
+        pods, rv = self._list_pods_rv(self._node)
         intents: dict[str, list[str]] = {}
         for pod in pods:
             entry = self._intent_of(pod)
@@ -1064,9 +1092,11 @@ class PodLifecycleReleaseLoop(_WatchLoop):
 
     def watch_alive(self) -> bool:
         """True while DELETED events are flowing through a live watch
-        thread (the executor's cue to defer its GET confirms here)."""
-        return (self._use_watch and self._thread is not None
-                and self._thread.is_alive())
+        thread (the executor's cue to defer its GET confirms here) —
+        this loop's own, or the shared PodInformer driving it."""
+        host = getattr(self, "_host_loop", None) or self
+        return (host._use_watch and host._thread is not None
+                and host._thread.is_alive())
 
     def _confirm_eviction(self, pod_key: str) -> None:
         if self._evictions is not None:
@@ -1103,10 +1133,12 @@ class PodLifecycleReleaseLoop(_WatchLoop):
             self._release(key, f"phase {phase}", uid=uid)
 
     def _resync(self) -> tuple[bool, Optional[str]]:
-        if hasattr(self._api, "list_pods_with_rv"):
-            pods, rv = self._api.list_pods_with_rv()
-        else:
-            pods, rv = self._api.list_pods(), None
+        pods, rv = self._list_pods_rv()
+        return self._resync_from(pods), rv
+
+    def _resync_from(self, pods: list[dict[str, Any]]) -> bool:
+        """Reconcile against an already-fetched pod list (the shared
+        PodInformer fetches once for all its children)."""
         present: dict[str, str] = {}  # key -> listed uid
         changed = False
         for pod in pods:
@@ -1159,7 +1191,65 @@ class PodLifecycleReleaseLoop(_WatchLoop):
             # DELETED event missed in a reconnect gap is recovered by the
             # executor's own stretched GET net, WATCH_CONFIRM_GRACE_S)
             changed |= self._release(alloc.pod_key, "pod absent (resync)")
+        return changed
+
+
+class PodInformer(_WatchLoop):
+    """ONE cluster-wide pod list+watch fanned out to the extender's pod
+    loops (lifecycle release + alloc reconcile).
+
+    Each of those loops is a correct standalone informer, but running
+    both means two full paginated LISTs per reconnect and two concurrent
+    watch streams each carrying — and decoding — every pod mutation in
+    the cluster. The daemon runs this composite instead: one stream, one
+    list, events dispatched to every child's handler. Children are
+    constructed normally but never started; their counters/metrics stay
+    theirs."""
+
+    def __init__(self, api, children, poll_seconds: float = 5.0,
+                 use_watch: bool = True) -> None:
+        super().__init__("tpukube-pod-informer", api, None,
+                         poll_seconds, use_watch)
+        self._children = list(children)
+        for c in self._children:
+            # watch_alive() consumers (eviction confirmation deferral)
+            # must see THIS loop's thread as the live stream
+            c._host_loop = self
+
+    def _apply_watch_event(self, etype: str, pod: dict[str, Any]) -> None:
+        resync = False
+        for c in self._children:
+            try:
+                c._apply_watch_event(etype, pod)
+            except _ResyncNeeded:
+                resync = True  # finish fanning out, then force resync
+            except Exception:
+                # a standalone loop would hit _run's generic handler and
+                # reconnect+resync within one poll — a child under the
+                # informer must keep that retry bound, not wait out the
+                # watch stream's server timeout
+                log.exception("%s: %s handler failed on %s",
+                              self._name, c._name, etype)
+                resync = True
+        if resync:
+            raise _ResyncNeeded
+
+    def _resync(self) -> tuple[bool, Optional[str]]:
+        pods, rv = self._list_pods_rv()
+        changed = False
+        for c in self._children:
+            try:
+                changed |= c._resync_from(pods)
+            except Exception:
+                log.exception("%s: %s resync failed", self._name, c._name)
+                self._child_failed = True
         return changed, rv
+
+    def _needs_resync(self) -> bool:
+        flags = [c._needs_resync() for c in self._children]  # consume ALL
+        failed, self._child_failed = getattr(self, "_child_failed",
+                                             False), False
+        return failed or any(flags)
 
 
 class NodeTopologyRefreshLoop(_WatchLoop):
@@ -1380,57 +1470,92 @@ def alloc_divergence_reporter(api) -> Callable[[str, list[str], list[str]], None
     return report
 
 
-class AllocReconcileLoop(_PollLoop):
+class AllocReconcileLoop(_WatchLoop):
     """Extender-side half of the device-id loop: folds reported
     ``alloc-actual`` annotations into the ledger (via the extender's
     recorded ``reconcile`` decision) and rewrites the pod's ``alloc``
-    annotation to match reality, clearing the report."""
+    annotation to match reality, clearing the report. Watch-driven
+    (informer pattern, poll fallback) like the other pod loops: a
+    divergence report lands as the MODIFIED event that carries it,
+    instead of up to a poll interval later — and the extender stops
+    LISTing every pod every few seconds looking for a rare annotation
+    the apiserver cannot field-select on."""
 
     def __init__(
-        self, extender, api, poll_seconds: float = 5.0
+        self, extender, api, poll_seconds: float = 5.0,
+        use_watch: bool = True,
     ) -> None:
-        super().__init__(poll_seconds, "tpukube-alloc-reconcile")
+        super().__init__("tpukube-alloc-reconcile", api, None,
+                         poll_seconds, use_watch)
         self._extender = extender
-        self._api = api
+        # a failed ack PATCH left a folded-but-uncleared report: force a
+        # resync after one poll interval instead of waiting for the next
+        # event / the watch stream's server timeout
+        self._ack_retry = False
         self.reconciled = 0  # ledger amendments applied (tests/metrics)
 
-    def check_once(self) -> bool:
-        """One poll; True if any pod was reconciled. Divergence reports
-        are rare, but the apiserver cannot field-select on annotations, so
-        the poll lists all pods — in bounded limit/continue pages (see
-        RestApiServer.list_pods). A failing pod never blocks the batch."""
+    def _reconcile_pod(self, pod: dict[str, Any]) -> bool:
+        """Fold one pod's alloc-actual report, if it carries one; True
+        when the ledger was amended and the report cleared. A failing
+        pod never blocks the batch."""
+        meta = pod.get("metadata", {})
+        annos = meta.get("annotations") or {}
+        payload = annos.get(ANNO_ALLOC_ACTUAL)
+        if not payload:
+            return False
+        namespace = meta.get("namespace", "default")
+        name = meta["name"]
+        pod_key = f"{namespace}/{name}"
+        try:
+            actual = decode_alloc_actual(payload)
+        except codec.CodecError as e:
+            log.warning("pod %s: bad alloc-actual: %s", pod_key, e)
+            return False
+        self._extender.handle(
+            "reconcile", {"pod_key": pod_key, "devices": actual}
+        )
+        patch: dict[str, Optional[str]] = {ANNO_ALLOC_ACTUAL: None}
+        alloc = self._extender.state.allocation(pod_key)
+        if alloc is not None:
+            patch[codec.ANNO_ALLOC] = codec.encode_alloc(alloc)
+        try:
+            self._api.patch_pod_annotations(namespace, name, patch)
+        except ApiServerError as e:
+            # pod deleted mid-event, transient apiserver error: the
+            # reconcile above is idempotent; flag a forced resync so the
+            # retry comes within one poll interval, not at the watch
+            # stream's server timeout
+            log.warning("reconcile ack for %s failed: %s", pod_key, e)
+            self._ack_retry = True
+            return False
+        self.reconciled += 1
+        return True
+
+    def _apply_watch_event(self, etype: str, pod: dict[str, Any]) -> None:
+        if etype == "DELETED":
+            return  # a deleted pod's report is moot
+        # the clearing PATCH triggers one more MODIFIED event, which
+        # finds no alloc-actual and no-ops — no feedback loop
+        self._reconcile_pod(pod)
+        if self._ack_retry:
+            self._ack_retry = False
+            raise _ResyncNeeded
+
+    def _resync(self) -> tuple[bool, Optional[str]]:
+        pods, rv = self._list_pods_rv()
+        return self._resync_from(pods), rv
+
+    def _resync_from(self, pods: list[dict[str, Any]]) -> bool:
         did = False
-        for pod in self._api.list_pods():
-            meta = pod.get("metadata", {})
-            annos = meta.get("annotations") or {}
-            payload = annos.get(ANNO_ALLOC_ACTUAL)
-            if not payload:
-                continue
-            namespace = meta.get("namespace", "default")
-            name = meta["name"]
-            pod_key = f"{namespace}/{name}"
-            try:
-                actual = decode_alloc_actual(payload)
-            except codec.CodecError as e:
-                log.warning("pod %s: bad alloc-actual: %s", pod_key, e)
-                continue
-            self._extender.handle(
-                "reconcile", {"pod_key": pod_key, "devices": actual}
-            )
-            patch: dict[str, Optional[str]] = {ANNO_ALLOC_ACTUAL: None}
-            alloc = self._extender.state.allocation(pod_key)
-            if alloc is not None:
-                patch[codec.ANNO_ALLOC] = codec.encode_alloc(alloc)
-            try:
-                self._api.patch_pod_annotations(namespace, name, patch)
-            except ApiServerError as e:
-                # pod deleted mid-poll, transient apiserver error: the
-                # reconcile above is idempotent, the patch retries next poll
-                log.warning("reconcile ack for %s failed: %s", pod_key, e)
-                continue
-            self.reconciled += 1
-            did = True
+        for pod in pods:
+            did |= self._reconcile_pod(pod)
         return did
+
+    def _needs_resync(self) -> bool:
+        # consumed AFTER the whole resync list was processed — one pod's
+        # failing ack must not starve the batch
+        retry, self._ack_retry = self._ack_retry, False
+        return retry
 
 
 class EvictionExecutor(_PollLoop):

@@ -373,6 +373,7 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
             AllocReconcileLoop,
             EvictionExecutor,
             NodeTopologyRefreshLoop,
+            PodInformer,
             PodLifecycleReleaseLoop,
             pod_binder,
             rebuild_extender,
@@ -415,7 +416,11 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
         # (one DELETED event instead of a per-key GET poll).
         lifecycle = PodLifecycleReleaseLoop(extender, api,
                                             evictions=evictions)
-        loops = [reconcile, evictions, node_refresh, lifecycle]
+        # ONE pod stream for both pod loops: the informer lists and
+        # watches once, fanning events to lifecycle + reconcile
+        pod_informer = PodInformer(api, [lifecycle, reconcile],
+                                   poll_seconds=cfg.health_poll_seconds)
+        loops = [evictions, node_refresh, pod_informer]
         for loop in loops:
             loop.start()
     if ssl_ctx is None and auth_token is None:
