@@ -187,3 +187,22 @@ def test_extender_daemon_subprocess():
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_ctl_topo_multislice(tmp_path):
+    """tpukubectl topo renders ONE occupancy grid per ICI slice on a
+    multi-slice (DCN) cluster — coords are slice-local, so a merged
+    grid would overlay unrelated chips."""
+    from tpukube.core.mesh import MeshSpec
+
+    slices = {"slice-a": MeshSpec(dims=(2, 2, 1), host_block=(2, 2, 1)),
+              "slice-b": MeshSpec(dims=(2, 2, 1), host_block=(2, 2, 1))}
+    with SimCluster(load_config(env={}), slices=slices) as c:
+        c.schedule(c.make_pod("p0", tpu=2))
+        rc, out = _ctl(c, "topo")  # _ctl only needs .base_url
+        assert rc == 0
+        assert "mesh None" not in out  # slice headers carry the dims
+        assert "slice slice-a" in out
+        assert "slice slice-b" in out
+        assert out.count("z=0") == 2  # one grid per slice
+        assert "#" in out             # the allocation is drawn

@@ -221,3 +221,83 @@ def _run_fuzz(seed: int) -> None:
 @pytest.mark.parametrize("seed", SEEDS)
 def test_fuzz_lifecycle_invariants(seed):
     _run_fuzz(seed)
+
+
+def _vtpu_invariants(c: SimCluster, ctx: str) -> None:
+    """Share-granular oracle for the vTPU fuzz: per-chip used shares in
+    the ledger must equal the store-side count of fractional ids held by
+    bound, non-terminal pods."""
+    from tpukube.core.types import parse_device_id
+
+    state = c.extender.state
+    expect: dict[tuple[str, int], int] = {}
+    for key, pod in c.pods.items():
+        if (pod.get("status") or {}).get("phase") in ("Succeeded",
+                                                      "Failed"):
+            continue
+        if not (pod.get("spec") or {}).get("nodeName"):
+            continue
+        payload = (pod["metadata"].get("annotations") or {}).get(
+            codec.ANNO_ALLOC)
+        if not payload:
+            continue
+        alloc = codec.decode_alloc(payload)
+        for did in alloc.device_ids:
+            index, frac = parse_device_id(did)
+            # mirrors the ledger's weighting rule: a fractional id is 1
+            # share, a whole-chip id consumes the node's full share count
+            node_view = c.extender.state.node(alloc.node_name)
+            whole = (node_view.shares_per_chip
+                     if node_view is not None else 1)
+            weight = 1 if frac is not None else whole
+            k = (alloc.node_name, index)
+            expect[k] = expect.get(k, 0) + weight
+    for name in state.node_names():
+        view = state.node(name)
+        for chip in view.info.chips:
+            used = view.used_share_count(chip.index)
+            assert used == expect.get((name, chip.index), 0), (
+                f"{ctx}: {name} chip {chip.index} ledger says {used} "
+                f"shares, store says {expect.get((name, chip.index), 0)}"
+            )
+            assert used <= view.shares_per_chip, ctx
+
+
+@pytest.mark.parametrize("seed", [11, 2718, 314159])
+def test_fuzz_vtpu_share_accounting(seed):
+    """Random vTPU share churn: fractional pods arrive, complete, and
+    are deleted on a 2-shares-per-chip cluster; after every op the
+    ledger's per-chip share counts must equal the store-side truth (a
+    re-minted live share id or a leaked share fails here)."""
+    rng = random.Random(seed)
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        "TPUKUBE_SHARES_PER_CHIP": "2",
+    })
+    with SimCluster(cfg, vtpu_nodes={"host-0-0-0"}, vtpu_shares=2) as c:
+        live: list[str] = []
+        counter = 0
+        for step in range(100):
+            ctx = f"vtpu seed={seed} step={step}"
+            op = rng.choices(["add", "complete", "delete"],
+                             weights=[50, 25, 25])[0]
+            attempted = None  # only the failing ADD's pod is unwound
+            try:
+                if op == "add":
+                    name = attempted = f"v-{counter}"
+                    counter += 1
+                    c.schedule(c.make_pod(
+                        name, vtpu=rng.choice([1, 1, 2])))
+                    live.append(name)
+                elif op == "complete" and live:
+                    c.complete_pod(live.pop(rng.randrange(len(live))))
+                elif op == "delete" and live:
+                    c.delete_pod(live.pop(rng.randrange(len(live))))
+            except RuntimeError as e:
+                if not any(t in str(e) for t in EXPECTED_SCHED_FAILURES):
+                    raise AssertionError(
+                        f"{ctx}: internal scheduler error: {e}") from e
+                if attempted is not None:
+                    c.pods.pop(f"default/{attempted}", None)
+            _vtpu_invariants(c, ctx)

@@ -522,30 +522,41 @@ def _ctl_ssl_context(args: argparse.Namespace):
 
 
 def _render_topo(topo: dict[str, Any], out) -> None:
-    """ASCII mesh occupancy map: one grid per z-plane, one cell per chip."""
+    """ASCII mesh occupancy map: one grid per z-plane per ICI slice,
+    one cell per chip (coords are slice-local)."""
     glyph = {"free": ".", "allocated": "#", "reserved": "+", "unhealthy": "X"}
+    # mesh_dims is null on a multi-slice cluster (coords are slice-local;
+    # the per-slice headers below carry each slice's dims instead)
+    mesh = (f"mesh {topo['mesh_dims']}  "
+            if topo.get("mesh_dims") else "")
     print(
-        f"mesh {topo['mesh_dims']}  util {topo['utilization_percent']}%  "
+        f"{mesh}util {topo['utilization_percent']}%  "
         f"alloc {topo['chips_allocated']}/{topo['chips_total']}  "
         f"reserved {topo['chips_reserved_unbound']}  "
         f"unhealthy {topo['chips_unhealthy']}",
         file=out,
     )
-    if not topo["mesh_dims"]:
-        return
-    dx, dy, dz = topo["mesh_dims"]
-    grid = {}
-    for node in topo["nodes"]:
-        for chip in node["chips"]:
-            x, y, z = chip["coord"]
-            grid[(x, y, z)] = glyph.get(chip["status"], "?")
-    for z in range(dz):
-        print(f"z={z}  ({glyph['free']} free {glyph['allocated']} alloc "
-              f"{glyph['reserved']} reserved {glyph['unhealthy']} unhealthy)",
-              file=out)
-        for y in range(dy):
-            print("  " + " ".join(grid.get((x, y, z), " ")
-                                  for x in range(dx)), file=out)
+    slices = topo.get("slices") or []
+    multi = len(slices) > 1
+    for sl in slices:
+        dx, dy, dz = sl["mesh_dims"]
+        grid = {}
+        for node in topo["nodes"]:
+            if node["slice"] != sl["id"]:
+                continue
+            for chip in node["chips"]:
+                x, y, z = chip["coord"]
+                grid[(x, y, z)] = glyph.get(chip["status"], "?")
+        if multi:
+            print(f"slice {sl['id']}  {sl['mesh_dims']}  "
+                  f"util {sl['utilization_percent']}%", file=out)
+        for z in range(dz):
+            print(f"z={z}  ({glyph['free']} free {glyph['allocated']} alloc "
+                  f"{glyph['reserved']} reserved {glyph['unhealthy']} "
+                  f"unhealthy)", file=out)
+            for y in range(dy):
+                print("  " + " ".join(grid.get((x, y, z), " ")
+                                      for x in range(dx)), file=out)
     # nodes whose inventory rode the static generation table instead of
     # runtime introspection: their HBM/core facts are guesses
     fallback = [n["name"] for n in topo["nodes"]
