@@ -29,6 +29,18 @@ SEEDS = [7, 42, 99, 512, 1234, 4242, 31337, 99991, 424243, 999331]
 STEPS = 120
 
 
+def _handle_sched_failure(c: SimCluster, ctx: str, e: RuntimeError,
+                          attempted) -> None:
+    """Shared failure classification for every fuzz loop: legitimate
+    unschedulability unwinds the attempted (never-bound) pod from the
+    store; anything else is an internal scheduler error the fuzzer must
+    surface."""
+    if not any(t in str(e) for t in EXPECTED_SCHED_FAILURES):
+        raise AssertionError(f"{ctx}: internal scheduler error: {e}") from e
+    if attempted is not None:
+        c.pods.pop(f"default/{attempted}", None)
+
+
 def _invariants(c: SimCluster, ctx: str) -> None:
     state = c.extender.state
     gang = c.extender.gang
@@ -195,19 +207,7 @@ def _run_fuzz(seed: int) -> None:
                 elif op == "drain":
                     c.drain_evictions()
             except RuntimeError as e:
-                # unschedulable / lost-race budgets are legitimate under
-                # random load — anything ELSE (StateError, GangError,
-                # codec failures, HTTP 5xx) is a real regression the
-                # fuzzer exists to catch
-                if not any(t in str(e) for t in EXPECTED_SCHED_FAILURES):
-                    raise AssertionError(
-                        f"{ctx}: internal scheduler error: {e}"
-                    ) from e
-                # the pod object was created before scheduling; a pod
-                # that never bound would sit in the store forever (a
-                # real controller would GC it) — drop it
-                if attempted is not None:
-                    c.pods.pop(f"default/{attempted}", None)
+                _handle_sched_failure(c, ctx, e, attempted)
             # evicted pods (preemption/rollback) leave the store: drop
             # them from the live list so complete/delete target real pods
             live = [n for n in live if f"default/{n}" in c.pods]
@@ -295,9 +295,62 @@ def test_fuzz_vtpu_share_accounting(seed):
                 elif op == "delete" and live:
                     c.delete_pod(live.pop(rng.randrange(len(live))))
             except RuntimeError as e:
-                if not any(t in str(e) for t in EXPECTED_SCHED_FAILURES):
-                    raise AssertionError(
-                        f"{ctx}: internal scheduler error: {e}") from e
-                if attempted is not None:
-                    c.pods.pop(f"default/{attempted}", None)
+                _handle_sched_failure(c, ctx, e, attempted)
             _vtpu_invariants(c, ctx)
+
+
+@pytest.mark.parametrize("seed", [21, 777, 480000])
+def test_fuzz_dcn_gang_churn(seed):
+    """Random churn on a TWO-slice (DCN) cluster with gangs that may
+    split across slices: solos and allow-dcn gangs arrive, pods complete
+    and vanish, evictions drain — the same invariants hold after every
+    op, now spanning slice-local coordinate spaces."""
+    from tpukube.core.mesh import MeshSpec
+
+    rng = random.Random(seed)
+    slices = {"slice-a": MeshSpec(dims=(2, 2, 1), host_block=(2, 2, 1)),
+              "slice-b": MeshSpec(dims=(2, 2, 1), host_block=(2, 2, 1))}
+    cfg = load_config(env={"TPUKUBE_RESERVATION_TTL_SECONDS": "30"})
+    with SimCluster(cfg, slices=slices) as c:
+        live: list[str] = []
+        gangs = 0
+        counter = 0
+        for step in range(100):
+            ctx = f"dcn seed={seed} step={step}"
+            op = rng.choices(
+                ["solo", "gang", "complete", "delete", "drain"],
+                weights=[30, 12, 22, 14, 12],
+            )[0]
+            attempted = None
+            try:
+                if op == "solo":
+                    name = attempted = f"s-{counter}"
+                    counter += 1
+                    c.schedule(c.make_pod(name, tpu=1,
+                                          priority=rng.choice([0, 5])))
+                    live.append(name)
+                elif op == "gang":
+                    gangs += 1
+                    # 6 chips never fit one 4-chip slice: forces the
+                    # DCN split whenever the gang lands at all
+                    size = rng.choice([3, 6])
+                    group = PodGroup(f"g{gangs}", min_member=size,
+                                     allow_dcn=True)
+                    prio = rng.choice([10, 100])
+                    for i in range(size):
+                        name = attempted = f"g{gangs}-{i}"
+                        c.schedule(c.make_pod(name, tpu=1, group=group,
+                                              priority=prio))
+                        live.append(name)
+                elif op == "complete" and live:
+                    c.complete_pod(live.pop(rng.randrange(len(live))))
+                elif op == "delete" and live:
+                    c.delete_pod(live.pop(rng.randrange(len(live))))
+                elif op == "drain":
+                    c.drain_evictions()
+            except RuntimeError as e:
+                _handle_sched_failure(c, ctx, e, attempted)
+            live = [n for n in live if f"default/{n}" in c.pods]
+            _invariants(c, ctx)
+        c.drain_evictions()
+        _invariants(c, f"dcn seed={seed} final")
