@@ -956,10 +956,3 @@ class GangManager:
                     return True
         return False
 
-    def forget(self, namespace: str, group_name: str) -> None:
-        """Drop a committed gang's bookkeeping once its job is done (the
-        chips themselves free via per-pod release)."""
-        with self._lock:
-            res = self._reservations.get((namespace, group_name))
-            if res is not None and res.committed:
-                self._reservations.pop(res.key, None)
