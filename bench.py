@@ -5,8 +5,12 @@ Delegates to tpukube.sim.scenarios — the SAME code paths the acceptance
 tests (tests/test_config5.py, tests/test_config6.py) and `tpukube-sim
 5|6` run — and prints one JSON line. Headline metric: config 5's cluster
 utilization vs the BASELINE.json >= 95% target; the line also carries
-the gang-commit p50 and the churn scenario's utilization-stability and
-re-schedule numbers (the release loop's workload).
+the gang-commit p50, the churn scenario's utilization-stability and
+re-schedule numbers (the release loop's workload), and — new with the
+obs layer — a ``phases`` key with per-phase timeline stats (p50/p99/max
+ms per scheduling phase, from the run's own decision trace) so N-run
+spread can be attributed to a phase, not just observed. Every
+pre-existing key is unchanged.
 """
 
 from __future__ import annotations

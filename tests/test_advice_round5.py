@@ -1,0 +1,286 @@
+"""Regression tests for the round-5 advisor findings (ISSUE 1 satellites):
+terminating chips blocked from preemption, queued-victim DELETED
+confirmation, stream-connected watch liveness, and the restored
+no-chips-in-slice filter message."""
+
+import time as _time
+from collections import deque
+from types import SimpleNamespace
+
+from tpukube import apiserver as apisrv
+from tpukube.core import codec
+from tpukube.core.config import load_config
+from tpukube.core.mesh import MeshSpec
+from tpukube.core.types import AllocResult, PodGroup, TopologyCoord
+from tpukube.sched import kube
+from tpukube.sched.extender import Extender
+from tpukube.sched.gang import GangError
+from tpukube.sim import SimCluster
+
+
+def _mini_extender(dims="2,2,1", block="2,2,1"):
+    """Extender over one simulated node (no HTTP), node ingested."""
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": dims,
+        "TPUKUBE_SIM_HOST_BLOCK": block,
+    })
+    c = SimCluster(cfg)  # never started: only mints node objects
+    ext = Extender(cfg)
+    for obj in c.node_objects():
+        ext.state.upsert_node(obj["metadata"]["name"],
+                              obj["metadata"]["annotations"])
+    return ext, cfg
+
+
+def _gang_pod_obj(name, group, tpu=1, namespace="default", priority=100):
+    return {
+        "metadata": {
+            "name": name, "namespace": namespace, "uid": f"uid-{name}",
+            "annotations": dict(codec.pod_group_annotations(group)),
+        },
+        "spec": {
+            "priority": priority,
+            "containers": [{"name": "main", "resources": {
+                "requests": {"qiniu.com/tpu": str(tpu)},
+            }}],
+        },
+    }
+
+
+def test_preemption_plan_blocked_by_terminating_chips():
+    """ADVICE round 5 medium: after a rollback leaves evicted-but-still-
+    terminating members' chips ledger-free and reservation-free, a new
+    gang planning preemption must NOT open a box over them — those chips
+    cannot be freed by evicting anyone."""
+    ext, cfg = _mini_extender()  # 4 chips, one host
+    sid = cfg.slice_id
+    # two chips are physically held by terminating (already-evicted)
+    # members of a rolled-back gang: masked, but owned by no workload
+    ext.gang._terminating_coords["default/dead-0"] = (
+        sid, frozenset({TopologyCoord(0, 0, 0), TopologyCoord(1, 0, 0)})
+    )
+    # the other two chips run a cheap evictable pod
+    ext.state.commit(AllocResult(
+        pod_key="default/cheap", node_name="host-0-0-0",
+        device_ids=["tpu-2", "tpu-3"],
+        coords=[TopologyCoord(0, 1, 0), TopologyCoord(1, 1, 0)],
+        priority=0,
+    ))
+    group = PodGroup("vip", min_member=4)
+    body = {"Pod": _gang_pod_obj("vip-0", group),
+            "NodeNames": ["host-0-0-0"]}
+    # without the fix: the planner sees the 2 terminating chips as free,
+    # evicts only default/cheap, and reserves the whole mesh — binding
+    # members onto chips dying containers still hold. With it: no 4-chip
+    # box avoids the terminating chips, so preemption must fail loudly.
+    res = ext.handle("filter", body)
+    assert res["NodeNames"] == []
+    assert "no victim set opens" in res["Error"]
+    assert ext.gang.reservation("default", "vip") is None
+    assert not ext.pending_evictions
+    assert ext.state.allocation("default/cheap") is not None
+
+
+def test_reserve_exact_split_rejects_terminating_chips():
+    """The second half of the double-ownership window: even a plan made
+    elsewhere cannot reserve chips a terminating victim still holds."""
+    ext, cfg = _mini_extender()
+    sid = cfg.slice_id
+    ext.gang._terminating_coords["default/dead"] = (
+        sid, frozenset({TopologyCoord(0, 0, 0)})
+    )
+    group = PodGroup("g", min_member=2)
+    pod = kube.pod_from_k8s(_gang_pod_obj("g-0", group))
+    try:
+        ext.gang.reserve_exact_split(
+            pod, 1,
+            {sid: [TopologyCoord(0, 0, 0), TopologyCoord(1, 0, 0)]},
+        )
+        assert False, "reservation over terminating chips must clash"
+    except GangError as e:
+        assert "re-occupied" in str(e)
+    # the accessor the preemption planner's blocked set consumes
+    assert ext.gang.terminating_coords(sid) == {TopologyCoord(0, 0, 0)}
+    assert ext.gang.terminating_coords("other-slice") == set()
+
+
+def test_confirm_deleted_covers_queued_evictions():
+    """ADVICE round 5 low (apiserver:1649): a victim whose DELETED event
+    arrives while its key still sits queued on pending_evictions is
+    trackable — confirmed immediately (victim_gone fires), and the later
+    drain skips the moot eviction instead of re-tracking a deletion the
+    watch will never re-deliver (which gated gangs ~30s)."""
+    gone: list[str] = []
+
+    class ExtStub(SimpleNamespace):
+        def handle(self, kind, body):
+            assert kind == "victim_gone"
+            gone.append(body["pod_key"])
+            return {"cleared": True}
+
+    class RecordingApi:
+        def __init__(self):
+            self.evict_calls: list[str] = []
+
+        def evict_pod(self, namespace, name, dry_run=False):
+            self.evict_calls.append(f"{namespace}/{name}")
+            return True  # accepted (or 404: already gone)
+
+        def get_pod(self, namespace, name):
+            return None
+
+    api = RecordingApi()
+    ext = ExtStub(pending_evictions=deque(["default/v"]))
+    execu = apisrv.EvictionExecutor(ext, api, poll_seconds=999)
+    # the lifecycle watch sees DELETED before drain ever ran: the key
+    # leaves the queue IMMEDIATELY (a lingering marker would cancel a
+    # later legitimate eviction of a reused pod name, and depth would
+    # overcount an already-confirmed pod)
+    assert execu.confirm_deleted("default/v") is True
+    assert gone == ["default/v"]
+    assert execu.evicted == 1
+    assert list(ext.pending_evictions) == []
+    assert execu.depth() == 0
+    # drain has nothing to do: no POST, no tracking, no requeue
+    assert execu.drain() == []
+    assert api.evict_calls == []
+    assert execu.evicted == 1  # not double-counted
+    # a SAME-NAME victim queued later still gets its eviction POSTed —
+    # nothing stale cancels the new incarnation's eviction
+    ext.pending_evictions.append("default/v")
+    execu.drain()
+    assert api.evict_calls == ["default/v"]
+    # an unknown key is still untracked
+    assert execu.confirm_deleted("default/unknown") is False
+
+
+def test_drain_after_lost_confirm_race_does_not_leak_age_entry():
+    """The queued-victim confirm can lose the race to drain's popleft:
+    confirm_deleted's membership check passes, its remove() raises
+    ValueError, and its _confirmed() bookkeeping runs BEFORE drain
+    re-inserts the key into _pending_since. drain's confirmed-early
+    branch must then drop the age entry itself — an orphan would inflate
+    tpukube_eviction_oldest_age_seconds forever (a phantom PDB-wedged
+    eviction alarm) while depth reads 0."""
+
+    class RaceLostDeque(deque):
+        # popleft (drain, other thread) wins between confirm_deleted's
+        # membership check and its remove()
+        def remove(self, value):
+            raise ValueError(value)
+
+    class ExtStub(SimpleNamespace):
+        def handle(self, kind, body):
+            return {"cleared": True}
+
+    class Api:
+        def evict_pod(self, namespace, name, dry_run=False):
+            return True
+
+        def get_pod(self, namespace, name):
+            return None
+
+    ext = ExtStub(pending_evictions=RaceLostDeque(["default/v"]))
+    execu = apisrv.EvictionExecutor(ext, Api(), poll_seconds=999)
+    assert execu.confirm_deleted("default/v") is True  # ValueError path
+    execu.drain()  # popleft + POST; sees _confirmed_early
+    assert execu._pending_since == {}
+    assert execu.oldest_age_seconds() == 0.0
+    assert execu.depth() == 0
+
+
+def test_watch_alive_requires_connected_stream():
+    """ADVICE round 5 low (apiserver:1089): watch_alive() must require a
+    currently-connected stream, not merely a live thread — during
+    reconnect backoff the executor must GET-confirm immediately instead
+    of deferring 30s on the strength of a dead stream."""
+    api = apisrv.FakeApiServer()
+    ext = SimpleNamespace(
+        pending_evictions=deque(),
+        state=SimpleNamespace(allocation=lambda key: None,
+                              allocations=lambda: []),
+        handle=lambda kind, body: {"cleared": True},
+    )
+    loop = apisrv.PodLifecycleReleaseLoop(ext, api, poll_seconds=999)
+    assert loop._use_watch
+    assert not loop.watch_alive()          # not started: no stream
+    loop.start()
+    try:
+        deadline = _time.monotonic() + 5
+        while not loop.stream_connected() and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        assert loop.stream_connected()
+        assert loop.watch_alive()
+        status = loop.watch_status()
+        assert status["stream_connected"] is True
+        assert status["thread_alive"] is True
+        assert isinstance(status["last_event_ts"], float)
+        # simulate the reconnect-backoff window: thread alive, stream not
+        loop._stream_connected = False
+        assert loop._thread.is_alive()
+        assert not loop.watch_alive()
+        loop._stream_connected = True      # restore for clean shutdown
+    finally:
+        loop.stop()
+    assert not loop.watch_alive()
+
+
+def test_watch_alive_consults_informer_host_stream():
+    """Under a shared PodInformer, the child's watch_alive() follows the
+    INFORMER's stream state."""
+    api = apisrv.FakeApiServer()
+    ext = SimpleNamespace(
+        pending_evictions=deque(),
+        state=SimpleNamespace(allocation=lambda key: None,
+                              allocations=lambda: []),
+        handle=lambda kind, body: {"cleared": True},
+    )
+    lifecycle = apisrv.PodLifecycleReleaseLoop(ext, api, poll_seconds=999)
+    informer = apisrv.PodInformer(api, [lifecycle], poll_seconds=999)
+    assert not lifecycle.watch_alive()
+    informer.start()
+    try:
+        deadline = _time.monotonic() + 5
+        while (not informer.stream_connected()
+               and _time.monotonic() < deadline):
+            _time.sleep(0.01)
+        assert lifecycle.watch_alive()
+        informer._stream_connected = False
+        assert not lifecycle.watch_alive()
+        informer._stream_connected = True
+    finally:
+        informer.stop()
+    assert not lifecycle.watch_alive()
+
+
+def test_gang_filter_message_distinguishes_foreign_slice():
+    """ADVICE round 5 low (gang:781): a node whose ICI slice holds none
+    of the reservation's chips fails with the historical 'gang holds no
+    chips in this node's ICI slice', while an in-slice node that merely
+    hosts none of the reserved coords keeps the counted message."""
+    cfg = load_config(env={"TPUKUBE_SIM_HOST_BLOCK": "2,2,1"})
+    spec = MeshSpec(dims=(4, 2, 1), host_block=(2, 2, 1))
+    c = SimCluster(cfg, slices={"sa": spec, "sb": spec})
+    ext = Extender(cfg)
+    for obj in c.node_objects():
+        ext.state.upsert_node(obj["metadata"]["name"],
+                              obj["metadata"]["annotations"])
+    group = PodGroup("g", min_member=2)
+    pod = kube.pod_from_k8s(_gang_pod_obj("g-0", group, tpu=2, priority=0))
+    res = ext.gang.ensure_reservation(pod, 2)
+    assert res.slice_id == "sa"  # deterministic tie-break on slice id
+    counts = ext.gang.node_availability(res)
+    # foreign slice: the restored historical message
+    assert ext.gang.feasibility_from(counts, res, "sb-host-0-0-0") == \
+        "gang holds no chips in this node's ICI slice"
+    # in-slice node hosting none of the reserved coords: counted message
+    in_slice_empty = [
+        n for n in ("sa-host-0-0-0", "sa-host-1-0-0")
+        if n not in counts
+    ]
+    assert in_slice_empty, "expected one sa host outside the reserved box"
+    assert ext.gang.feasibility_from(counts, res, in_slice_empty[0]) == \
+        "gang slice has 0 unassigned chips here, pod needs 2"
+    # a hosting node with room: feasible
+    hosting = next(iter(counts))
+    assert ext.gang.feasibility_from(counts, res, hosting) is None

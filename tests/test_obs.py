@@ -1,0 +1,511 @@
+"""Unified observability layer (ISSUE 1 tentpole): metrics registry,
+per-pod timelines, /statusz introspection."""
+
+import json
+import urllib.error
+import urllib.request
+
+from tpukube.core.config import load_config
+from tpukube.core.types import PodGroup
+from tpukube.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    Summary,
+    escape_label_value,
+    format_sample,
+)
+from tpukube.sim import SimCluster
+
+
+# -- registry ----------------------------------------------------------------
+
+def test_registry_counter_gauge_labels_and_order():
+    reg = Registry()
+    c = reg.counter("reqs_total")
+    c.labels(code="200").inc()
+    c.labels(code="500").inc(2)
+    g = reg.gauge("depth")
+    g.set(3)
+    text = reg.render()
+    assert text == (
+        "# TYPE reqs_total counter\n"
+        'reqs_total{code="200"} 1\n'
+        'reqs_total{code="500"} 2\n'
+        "# TYPE depth gauge\n"
+        "depth 3\n"
+    )
+    # children render in creation order; counters refuse set()
+    try:
+        c.set(7)
+        assert False, "Counter.set must raise"
+    except TypeError:
+        pass
+
+
+def test_registry_label_escaping():
+    """Arbitrary runtime text in label values (inventory_source carries
+    PJRT error strings) must not corrupt the exposition format."""
+    line = format_sample("m", 1, {"source": 'table (err "quoted"\nline\\x)'})
+    assert line == 'm{source="table (err \\"quoted\\"\\nline\\\\x)"} 1\n'
+    assert escape_label_value('a"b\nc\\d') == 'a\\"b\\nc\\\\d'
+    # legacy import surface kept alive
+    from tpukube.metrics import _fmt
+
+    assert _fmt is format_sample
+
+
+def test_registry_duplicate_name_rejected():
+    reg = Registry()
+    reg.counter("x_total")
+    try:
+        reg.counter("x_total")
+        assert False, "duplicate family must raise"
+    except ValueError:
+        pass
+    # a histogram PAIRED with a summary of the same family is the one
+    # sanctioned overlap (the legacy gang series)
+    reg.summary("lat_seconds")
+    reg.histogram("lat_seconds", bucket_only=True)
+
+
+def test_histogram_bucket_boundaries():
+    """le is inclusive: an observation exactly on a boundary lands in
+    that bucket; the +Inf terminal bucket counts everything."""
+    h = Histogram("lat", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.01, 0.0100001, 0.1, 5.0):
+        h.observe(v)
+    assert h.bucket_counts([0.005, 0.01, 0.0100001, 0.1, 5.0]) == [2, 4, 4, 5]
+    text = h.render()
+    assert '# TYPE lat histogram' in text
+    assert 'lat_bucket{le="0.01"} 2\n' in text
+    assert 'lat_bucket{le="0.1"} 4\n' in text
+    assert 'lat_bucket{le="1"} 4\n' in text
+    assert 'lat_bucket{le="+Inf"} 5\n' in text
+    assert 'lat_count 5\n' in text
+    assert 'lat_sum 5.12' in text
+
+
+def test_histogram_buckets_are_monotonic_counters():
+    """_bucket series are Prometheus counters: cumulative since process
+    start, never a window snapshot. The extender's latency deques are
+    bounded (maxlen eviction) and gang rollback REMOVES a sample — a
+    bucket count derived from either would decrease between scrapes and
+    Prometheus would read the dip as a counter reset, garbaging every
+    rate()/histogram_quantile() over the series."""
+    from tpukube.sched.extender import Extender
+
+    h = Histogram("lat_seconds", buckets=(1.0,), bucket_only=True)
+    h.observe(0.5)
+    h.observe(0.5)
+    assert 'lat_seconds_bucket{le="1"} 2\n' in h.render()
+    # Histogram is observation-only by design: a pull callback over a
+    # sliding window cannot be monotonic
+    try:
+        Histogram("x", values_fn=lambda: [1.0])
+        assert False, "Histogram must not accept values_fn"
+    except TypeError:
+        pass
+
+    # the daemon wiring: undoing a gang commit removes the summary's
+    # windowed sample but the bucket counters keep theirs
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    ext = Extender(cfg)
+    ext.gang.commit_latencies.append(0.07)
+    ext.gang.commit_hist.observe(0.07)
+    from tpukube.metrics import render_extender_metrics
+
+    before = render_extender_metrics(ext)
+    assert 'gang_schedule_latency_seconds_bucket{le="+Inf"} 1\n' in before
+    ext.gang.commit_latencies.remove(0.07)  # what undo_commit does
+    after = render_extender_metrics(ext)
+    assert "gang_schedule_latency_seconds_count 0\n" in after
+    assert 'gang_schedule_latency_seconds_bucket{le="+Inf"} 1\n' in after
+
+
+def test_summary_quantiles_and_count_sum():
+    s = Summary("q_seconds", quantiles=(0.5, 0.99))
+    for v in (1.0, 2.0, 3.0):
+        s.observe(v)
+    text = s.render()
+    assert 'q_seconds{quantile="0.5"} 2\n' in text
+    assert 'q_seconds{quantile="0.99"} 3\n' in text
+    assert "q_seconds_count 3\n" in text
+    assert "q_seconds_sum 6\n" in text
+
+
+# -- byte-compat golden files ------------------------------------------------
+
+EXTENDER_GOLDEN = """\
+# TYPE tpu_chip_utilization_percent gauge
+tpu_chip_utilization_percent 0
+# TYPE gang_schedule_latency_seconds summary
+gang_schedule_latency_seconds{quantile="0.5"} 0.01
+gang_schedule_latency_seconds{quantile="0.9"} 0.3
+gang_schedule_latency_seconds{quantile="0.99"} 0.3
+gang_schedule_latency_seconds_count 2
+gang_schedule_latency_seconds_sum 0.31
+# TYPE tpukube_ici_links_down gauge
+tpukube_ici_links_down 0
+# TYPE tpukube_binds_total counter
+tpukube_binds_total 0
+# TYPE tpukube_gang_rollbacks_total counter
+tpukube_gang_rollbacks_total 0
+# TYPE tpukube_preemptions_total counter
+tpukube_preemptions_total 0
+# TYPE tpukube_webhook_latency_seconds summary
+tpukube_webhook_latency_seconds{handler="filter",quantile="0.5"} 0.001
+tpukube_webhook_latency_seconds{handler="filter",quantile="0.99"} 0.002
+tpukube_webhook_latency_seconds{handler="prioritize",quantile="0.5"} 0
+tpukube_webhook_latency_seconds{handler="prioritize",quantile="0.99"} 0
+tpukube_webhook_latency_seconds{handler="bind",quantile="0.5"} 0.5
+tpukube_webhook_latency_seconds{handler="bind",quantile="0.99"} 0.5
+# TYPE tpukube_gang_victims_terminating gauge
+tpukube_gang_victims_terminating 0
+# TYPE tpukube_evictions_pending gauge
+tpukube_evictions_pending 1
+"""
+
+
+def test_extender_metrics_byte_compat_golden():
+    """The registry refactor must render every legacy series
+    byte-identically (golden captured from the pre-registry renderer);
+    the histogram ``_bucket`` families are the only additions."""
+    from tpukube.metrics import render_extender_metrics
+    from tpukube.sched.extender import Extender
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    ext = Extender(cfg)
+    # through the daemon's real recording surfaces, so the windowed
+    # summaries AND the cumulative bucket counters both fill
+    ext._observe_latency("filter", 0.001)
+    ext._observe_latency("filter", 0.002)
+    ext._observe_latency("bind", 0.5)
+    for v in (0.01, 0.3):
+        ext.gang.commit_latencies.append(v)
+        ext.gang.commit_hist.observe(v)
+    ext.pending_evictions.append("default/x")
+    text = render_extender_metrics(ext)
+    legacy = "".join(
+        line for line in text.splitlines(keepends=True)
+        if "_bucket" not in line
+    )
+    assert legacy == EXTENDER_GOLDEN
+    # ...and the additions are real histogram series
+    assert 'gang_schedule_latency_seconds_bucket{le="0.01"} 1\n' in text
+    assert 'gang_schedule_latency_seconds_bucket{le="+Inf"} 2\n' in text
+    assert ('tpukube_webhook_latency_seconds_bucket'
+            '{handler="bind",le="0.5"} 1\n') in text
+    assert ('tpukube_webhook_latency_seconds_bucket'
+            '{handler="prioritize",le="+Inf"} 0\n') in text
+
+
+PLUGIN_GOLDEN = """\
+# TYPE tpukube_plugin_allocations_total counter
+tpukube_plugin_allocations_total 0
+# TYPE tpukube_plugin_devices gauge
+tpukube_plugin_devices{health="Healthy"} 4
+tpukube_plugin_devices{health="Unhealthy"} 0
+tpukube_plugin_resource_info{resource="qiniu.com/tpu"} 1
+# TYPE tpukube_plugin_inventory_source gauge
+tpukube_plugin_inventory_source{source="sim"} 1
+# TYPE tpukube_plugin_intent_depth gauge
+tpukube_plugin_intent_depth 0
+# TYPE tpukube_plugin_divergences_total counter
+tpukube_plugin_divergences_total 0
+"""
+
+
+def test_plugin_metrics_byte_compat_golden(tmp_path):
+    """Node-agent renderer: byte-identical to the pre-registry output,
+    including the quirk that resource_info rides without its own TYPE."""
+    from tpukube.device import TpuDeviceManager
+    from tpukube.metrics import render_plugin_metrics
+    from tpukube.plugin import DevicePluginServer
+
+    cfg = load_config(env={
+        "TPUKUBE_DEVICE_PLUGIN_DIR": str(tmp_path),
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with TpuDeviceManager(cfg) as device, \
+            DevicePluginServer(cfg, device) as server:
+        assert render_plugin_metrics(server) == PLUGIN_GOLDEN
+
+
+# -- per-pod timelines -------------------------------------------------------
+
+def _gang16_cluster_with_trace():
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    return SimCluster(cfg)
+
+
+def test_timeline_span_chain_for_16_pod_gang(tmp_path):
+    """Acceptance: a trace captured from the 16-pod gang config exports
+    valid Chrome trace-event JSON with one complete span chain
+    (filter -> gang_reserve -> bind -> allocate) per pod."""
+    from tpukube.obs import timeline
+
+    with _gang16_cluster_with_trace() as c:
+        group = PodGroup("llama-8b", min_member=16)
+        allocs = []
+        for i in range(16):
+            _, alloc = c.schedule(
+                c.make_pod(f"llama-8b-{i}", tpu=1, priority=10, group=group)
+            )
+            allocs.append(alloc)
+        # the node-agent leg: a real device-plugin Allocate per pod,
+        # span-sinked into the extender's trace
+        for alloc in allocs:
+            c.execute_allocation(alloc)
+        events = c.extender.trace.events()
+
+    chains = timeline.span_chains(events)
+    for i in range(16):
+        # one complete span chain per pod:
+        # filter -> gang_reserve -> bind -> allocate
+        chain = chains[f"default/llama-8b-{i}"]
+        assert "filter" in chain
+        assert "gang_reserve" in chain
+        assert "bind" in chain
+        assert chain.index("bind") > chain.index("filter")
+        assert chain.index("allocate") > chain.index("bind")
+        # the kubelet chose exactly the planned chips
+        assert "intent_match" in chain
+    # exactly one gang_commit span, on the quorum member's track
+    assert sum(chain.count("gang_commit")
+               for chain in chains.values()) == 1
+
+    # valid Chrome trace-event JSON (Perfetto's object format)
+    doc = timeline.chrome_trace(events)
+    blob = json.dumps(doc)
+    parsed = json.loads(blob)
+    assert isinstance(parsed["traceEvents"], list) and parsed["traceEvents"]
+    for ev in parsed["traceEvents"]:
+        assert ev["ph"] in ("X", "M")
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0 and ev["ts"] >= 0
+            assert isinstance(ev["name"], str)
+
+    # phase stats cover the chain phases (the bench line's new key); a
+    # pod's FIRST event has an undefined width — counted, but excluded
+    # from the percentiles (null when a phase was only ever first)
+    stats = timeline.phase_stats(events)
+    for phase in ("filter", "gang_reserve", "bind", "allocate"):
+        assert stats[phase]["count"] >= 1
+        p50 = stats[phase]["p50_ms"]
+        assert p50 is None or p50 >= 0
+    # bind/allocate always follow earlier events on the pod's track, so
+    # their widths are defined and must be real measurements
+    assert stats["bind"]["p50_ms"] is not None
+    assert stats["allocate"]["p50_ms"] is not None
+
+
+def test_timeline_cli_roundtrip(tmp_path, capsys):
+    """``tpukube obs timeline <trace.jsonl>`` writes loadable JSON."""
+    from tpukube import cli
+
+    trace_file = tmp_path / "trace.jsonl"
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        "TPUKUBE_TRACE_PATH": str(trace_file),
+    })
+    with SimCluster(cfg) as c:
+        c.schedule(c.make_pod("p", tpu=1))
+    out_file = tmp_path / "chrome.json"
+    rc = cli.main_obs(["timeline", str(trace_file), "-o", str(out_file)])
+    assert rc == 0
+    doc = json.loads(out_file.read_text())
+    names = {ev["name"] for ev in doc["traceEvents"] if ev["ph"] == "X"}
+    assert {"filter", "prioritize", "bind"} <= names
+    # stdout mode + --stats
+    rc = cli.main_obs(["timeline", str(trace_file), "--stats"])
+    assert rc == 0
+    captured = capsys.readouterr()
+    assert json.loads(captured.out)["traceEvents"]
+    assert "bind" in json.loads(captured.err)
+
+
+def test_span_events_do_not_break_replay(tmp_path):
+    """A capture with span annotations still replays clean — spans are
+    observability markers, not decisions."""
+    from tpukube import trace as trace_mod
+
+    trace_file = tmp_path / "trace.jsonl"
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        "TPUKUBE_TRACE_PATH": str(trace_file),
+    })
+    with SimCluster(cfg) as c:
+        group = PodGroup("g", min_member=4)
+        for i in range(4):
+            c.schedule(c.make_pod(f"g-{i}", tpu=1, group=group))
+    events = trace_mod.load(str(trace_file))
+    assert any(e["kind"] == "span" for e in events)
+    assert trace_mod.replay(events) == []
+
+
+# -- /statusz ----------------------------------------------------------------
+
+def test_extender_statusz_endpoint():
+    """/statusz on the extender app: ledger/gang summary, pending
+    evictions with ages, watch liveness with a LAST-EVENT timestamp
+    (connected stream, not just live thread), trace-ring stats."""
+    import time as _time
+
+    from tpukube.apiserver import (
+        EvictionExecutor,
+        FakeApiServer,
+        PodInformer,
+        PodLifecycleReleaseLoop,
+    )
+    from tpukube.sched.extender import make_app
+    from tpukube.sim.harness import _AppThread, _free_port
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        c.schedule(c.make_pod("p", tpu=2))
+        api = FakeApiServer()
+        # mirror the bound pod into the fake apiserver so the informer's
+        # startup resync finds the allocation's pod object alive
+        api.upsert_pod(c.pods["default/p"])
+        evictions = EvictionExecutor(c.extender, api, poll_seconds=999)
+        lifecycle = PodLifecycleReleaseLoop(
+            c.extender, api, poll_seconds=999, evictions=evictions,
+        )
+        informer = PodInformer(api, [lifecycle], poll_seconds=999)
+        informer.start()
+        try:
+            deadline = _time.monotonic() + 5
+            while (not informer.stream_connected()
+                   and _time.monotonic() < deadline):
+                _time.sleep(0.01)
+            port = _free_port()
+            app = _AppThread(
+                make_app(c.extender, evictions=evictions,
+                         lifecycle=lifecycle, informer=informer),
+                "127.0.0.1", port,
+            )
+            app.start()
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/statusz", timeout=5
+                ) as r:
+                    doc = json.loads(r.read())
+            finally:
+                app.stop()
+        finally:
+            informer.stop()
+
+    assert doc["component"] == "extender"
+    assert doc["ledger"]["allocations"] == 1
+    assert doc["ledger"]["utilization_percent"] == 50.0
+    assert doc["gangs"]["reservations"] == 0
+    assert doc["pending_evictions"]["depth"] == 0
+    assert doc["trace"]["enabled"] and doc["trace"]["last_seq"] >= 3
+    watch = doc["pod_watch"]
+    assert watch["configured"] and watch["mode"] == "watch"
+    assert watch["stream_connected"] is True
+    assert isinstance(watch["last_event_ts"], float)
+
+
+def test_extender_statusz_reports_pending_evictions_with_ages():
+    from tpukube.obs.statusz import extender_statusz
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        c.extender.pending_evictions.append("default/victim")
+        doc = extender_statusz(c.extender)
+        assert doc["pending_evictions"]["depth"] == 1
+        entry = doc["pending_evictions"]["entries"][0]
+        assert entry["pod"] == "default/victim"
+        assert entry["state"] == "queued"
+        c.extender.pending_evictions.clear()
+
+
+def test_plugin_statusz_endpoint(tmp_path):
+    """/statusz on the node agent's MetricsServer: devices, inventory
+    source, intents, watch liveness."""
+    from tpukube.device import TpuDeviceManager
+    from tpukube.metrics import MetricsServer
+    from tpukube.obs.statusz import plugin_statusz
+    from tpukube.plugin import DevicePluginServer
+
+    cfg = load_config(env={
+        "TPUKUBE_DEVICE_PLUGIN_DIR": str(tmp_path),
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with TpuDeviceManager(cfg) as device, \
+            DevicePluginServer(cfg, device) as server:
+        server.intents.put("default/p0", ["tpu-0"])
+        ms = MetricsServer(
+            lambda: "",
+            statusz=lambda: plugin_statusz(server, device=device),
+        )
+        ms.start()
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{ms.port}/statusz", timeout=5
+            ) as r:
+                doc = json.loads(r.read())
+        finally:
+            ms.stop()
+    assert doc["component"] == "plugin"
+    assert doc["resource"] == "qiniu.com/tpu"
+    assert doc["devices"] == {"healthy": 4, "unhealthy": 0}
+    assert doc["inventory_source"] == "sim"
+    assert doc["intents"] == {"depth": 1, "pending": ["default/p0"]}
+    assert doc["intent_watch"] == {"configured": False}
+
+
+def test_metrics_server_without_statusz_404s(tmp_path):
+    from tpukube.metrics import MetricsServer
+
+    ms = MetricsServer(lambda: "x 1\n")
+    ms.start()
+    try:
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{ms.port}/statusz", timeout=5
+            )
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        ms.stop()
+
+
+def test_bench_line_gains_phase_stats():
+    """Scenario 5 (the bench.py headline) now carries per-phase timeline
+    stats under the NEW ``phases`` key; every pre-existing key parses
+    unchanged."""
+    from tpukube.sim import scenarios
+
+    result = scenarios.run(5, None)
+    for key in ("metric", "value", "unit", "vs_baseline", "gang_p50_s",
+                "preemptions", "pods_placed"):
+        assert key in result
+    phases = result["phases"]
+    assert phases["filter"]["count"] > 0
+    assert phases["bind"]["count"] > 0
+    assert set(phases["bind"]) == {"count", "p50_ms", "p99_ms", "max_ms"}
+    json.dumps(result)  # still one JSON-able line

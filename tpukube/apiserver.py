@@ -856,6 +856,14 @@ class _WatchLoop(_PollLoop):
         self._watch_method = watch_method
         self._use_watch = use_watch and hasattr(api, watch_method)
         self._box_supported = True  # False after a handle_box TypeError
+        # Stream liveness, NOT thread liveness: a watch thread is alive
+        # through reconnect backoff and list-resync windows where DELETED
+        # events are silently missed (ADVICE round 5 low). True only
+        # between a successful (resync, stream open) and the stream's
+        # end/failure; last_event_time (wall clock) stamps the stream
+        # connect and every delivered event — exported on /statusz.
+        self._stream_connected = False
+        self.last_event_time: Optional[float] = None
 
     def _resync(self) -> tuple[bool, Optional[str]]:  # pragma: no cover
         raise NotImplementedError
@@ -874,6 +882,22 @@ class _WatchLoop(_PollLoop):
         when the resync left work unfinished (retry after one poll
         interval instead of entering the watch)."""
         return False
+
+    def stream_connected(self) -> bool:
+        """True while the watch stream is actually open and delivering —
+        NOT during reconnect backoff or a failed resync."""
+        return self._stream_connected
+
+    def watch_status(self) -> dict[str, Any]:
+        """Liveness document for /statusz."""
+        return {
+            "name": self._name,
+            "mode": "watch" if self._use_watch else "poll",
+            "thread_alive": (self._thread is not None
+                             and self._thread.is_alive()),
+            "stream_connected": self._stream_connected,
+            "last_event_ts": self.last_event_time,
+        }
 
     def _list_pods_rv(
         self, node_name: Optional[str] = None
@@ -906,10 +930,20 @@ class _WatchLoop(_PollLoop):
                 except TypeError:  # test stubs without the full signature
                     self._box_supported = False
                     gen = watch(self._node)
-                for etype, pod in gen:
-                    if self._stop.is_set():
-                        return
-                    self._apply_watch_event(etype, pod)
+                # connected from here until the stream ends or fails:
+                # the resync landed and the watch is (about to be) open —
+                # the REST transport dials on first iteration, which
+                # happens immediately below
+                self._stream_connected = True
+                self.last_event_time = time.time()
+                try:
+                    for etype, pod in gen:
+                        if self._stop.is_set():
+                            return
+                        self.last_event_time = time.time()
+                        self._apply_watch_event(etype, pod)
+                finally:
+                    self._stream_connected = False
             except _ResyncNeeded:
                 # expected control flow, not a failure: back off one
                 # poll and resync (bounded retry for unfinished work)
@@ -1091,12 +1125,16 @@ class PodLifecycleReleaseLoop(_WatchLoop):
         self.released = 0  # lifecycle releases applied (tests/metrics)
 
     def watch_alive(self) -> bool:
-        """True while DELETED events are flowing through a live watch
-        thread (the executor's cue to defer its GET confirms here) —
-        this loop's own, or the shared PodInformer driving it."""
+        """True while DELETED events can actually flow (the executor's
+        cue to defer its GET confirms here) — this loop's own stream, or
+        the shared PodInformer's. Requires a CURRENTLY-CONNECTED stream,
+        not merely a live thread: during reconnect backoff and list-
+        resync windows events are silently missed, and deferring the GET
+        net on a dead stream gates gang binds up to 30s per missed event
+        (ADVICE round 5 low)."""
         host = getattr(self, "_host_loop", None) or self
         return (host._use_watch and host._thread is not None
-                and host._thread.is_alive())
+                and host._thread.is_alive() and host.stream_connected())
 
     def _confirm_eviction(self, pod_key: str) -> None:
         if self._evictions is not None:
@@ -1630,6 +1668,31 @@ class EvictionExecutor(_PollLoop):
             now = time.monotonic() if now is None else now
             return max(0.0, now - min(self._pending_since.values()))
 
+    def pending_snapshot(
+        self, now: Optional[float] = None
+    ) -> list[dict[str, Any]]:
+        """Every unconfirmed eviction with its state and age (seconds
+        since first drain attempt; None before the first attempt) — the
+        /statusz rendering of the queue the depth gauge only counts."""
+        now = time.monotonic() if now is None else now
+        with self._state_lock:
+            out = []
+            for pod_key in list(self._extender.pending_evictions):
+                since = self._pending_since.get(pod_key)
+                out.append({
+                    "pod": pod_key, "state": "queued",
+                    "age_seconds": (round(now - since, 3)
+                                    if since is not None else None),
+                })
+            for pod_key in sorted(self._terminating):
+                since = self._pending_since.get(pod_key)
+                out.append({
+                    "pod": pod_key, "state": "terminating",
+                    "age_seconds": (round(now - since, 3)
+                                    if since is not None else None),
+                })
+            return out
+
     def _confirmed(self, pod_key: str) -> None:
         """Bookkeeping for a victim whose pod object is gone (call with
         _state_lock held for the set mutation done by callers); tells the
@@ -1651,7 +1714,9 @@ class EvictionExecutor(_PollLoop):
         pod's DELETED event, so the GET poll for this key is redundant
         (and _confirm_terminated defers to this channel while the watch
         runs — see WATCH_CONFIRM_GRACE_S). Returns True if the key was
-        being tracked (terminating, or its eviction POST in flight)."""
+        being tracked: terminating, its eviction POST in flight, or
+        still queued on pending_evictions awaiting its first drain."""
+        never_posted = False
         with self._state_lock:
             if pod_key in self._terminating:
                 self._terminating.discard(pod_key)
@@ -1660,11 +1725,35 @@ class EvictionExecutor(_PollLoop):
                 # count it now; drain() sees _confirmed_early and will
                 # not track (or requeue) the already-gone pod
                 self._confirmed_early.add(pod_key)
+            elif pod_key in self._extender.pending_evictions:
+                # queued but not yet drained: the victim is already
+                # gone, so the eviction POST is moot — drop the key from
+                # the queue NOW (a side marker would linger and cancel a
+                # later legitimate eviction of a reused pod name), and
+                # never track a deletion the watch has already delivered
+                # (re-tracking would gate the gang on the 30s GET net)
+                try:
+                    self._extender.pending_evictions.remove(pod_key)
+                    never_posted = True
+                except ValueError:
+                    # drain() popped it between the membership check and
+                    # the remove; its POST is about to fly — same
+                    # handling as the _expecting race above
+                    self._confirmed_early.add(pod_key)
             else:
                 return False
+            # ``evicted`` counts pods confirmed GONE (the gang-unblock
+            # event), not Eviction POSTs executed — a queued victim that
+            # exits on its own still resolves its eviction obligation
             self._confirmed(pod_key)
         self._notify_gone(pod_key)
-        log.warning("evicted %s (confirmed by lifecycle watch)", pod_key)
+        if never_posted:
+            # no Eviction POST ever flew: don't log an eviction that
+            # would have no apiserver audit record to correlate with
+            log.warning("victim %s gone before its eviction was posted "
+                        "(confirmed by lifecycle watch)", pod_key)
+        else:
+            log.warning("evicted %s (confirmed by lifecycle watch)", pod_key)
         return True
 
     def check_once(self) -> bool:
@@ -1702,7 +1791,13 @@ class EvictionExecutor(_PollLoop):
                     if pod_key in self._confirmed_early:
                         # the watch confirmed the pod gone mid-call:
                         # nothing left to track or requeue, whatever the
-                        # call's own outcome was
+                        # call's own outcome was. Drop the age entry too:
+                        # when confirm_deleted's queued-key remove lost
+                        # the race to our popleft, its _confirmed()
+                        # bookkeeping ran BEFORE our setdefault above —
+                        # without this pop the orphan entry inflates
+                        # oldest_age_seconds() forever
+                        self._pending_since.pop(pod_key, None)
                         self._confirmed_early.discard(pod_key)
                         continue
                     if ok:

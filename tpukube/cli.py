@@ -12,6 +12,9 @@ parsing; forks add inspection tooling. Here:
                     and print its metrics as one JSON line
   tpukubectl        inspect a live extender: topo / alloc / gangs /
                     metrics, and offline trace replay
+  tpukube-obs       offline observability tooling: `timeline` converts a
+                    JSONL decision trace to Chrome trace-event JSON
+                    (Perfetto-loadable per-pod scheduling timelines)
 
 All commands take ``--config <yaml>`` (same schema as TpuKubeConfig) and
 honor TPUKUBE_* env overrides, mirroring the reference's flag+config-file
@@ -203,12 +206,18 @@ def main_plugin(argv: Optional[list[str]] = None) -> int:
                 poll_seconds=cfg.health_poll_seconds,
             )
             intent_watch.start()
+        from tpukube.obs.statusz import plugin_statusz
+
         metrics = MetricsServer(
             lambda: render_plugin_metrics(
                 server, health=watcher, kubelet_watch=kubelet_watch,
                 intent_watch=intent_watch,
             ),
             port=args.metrics_port,
+            statusz=lambda: plugin_statusz(
+                server, device=device, health=watcher,
+                kubelet_watch=kubelet_watch, intent_watch=intent_watch,
+            ),
         )
         metrics.start()
 
@@ -367,6 +376,7 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
     extender = Extender(cfg)
     loops = []
     reconcile = evictions = node_refresh = lifecycle = None
+    pod_informer = None
     api = _make_apiserver(args)
     if api is not None:
         from tpukube.apiserver import (
@@ -455,7 +465,8 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
                              evictions=evictions,
                              node_refresh=node_refresh,
                              lifecycle=lifecycle,
-                             auth_token=auth_token),
+                             auth_token=auth_token,
+                             informer=pod_informer),
                     host=host, port=port, ssl_context=ssl_ctx,
                     print=None, handle_signals=True)
     finally:
@@ -486,6 +497,46 @@ def main_sim(argv: Optional[list[str]] = None) -> int:
     # with it, the user's topology/config drives the scenario
     result = scenarios.run(args.scenario, cfg if args.config else None)
     print(json.dumps(result))
+    return 0
+
+
+# -- tpukube-obs -------------------------------------------------------------
+
+def main_obs(argv: Optional[list[str]] = None) -> int:
+    """Offline observability tooling over captured decision traces
+    (``tpukube obs timeline <trace.jsonl>``): correlate a JSONL trace's
+    events into per-pod span chains and export Chrome trace-event JSON —
+    load the output in Perfetto (ui.perfetto.dev) or chrome://tracing to
+    see where each pod spent its time between filter and Allocate."""
+    p = argparse.ArgumentParser(
+        prog="tpukube-obs",
+        description="offline observability tooling (timeline export)",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    tp = sub.add_parser(
+        "timeline",
+        help="convert a JSONL decision trace (trace_path capture, or a "
+             "/trace dump) to Chrome trace-event JSON",
+    )
+    tp.add_argument("trace_file")
+    tp.add_argument("-o", "--out", default="-", metavar="FILE",
+                    help="output file ('-' = stdout)")
+    tp.add_argument("--stats", action="store_true",
+                    help="also print per-phase timing stats (JSON) to stderr")
+    args = p.parse_args(argv)
+
+    from tpukube import trace as trace_mod
+    from tpukube.obs import timeline
+
+    events = trace_mod.load(args.trace_file)
+    if args.out == "-":
+        timeline.dump_chrome_trace(events, sys.stdout)
+    else:
+        with open(args.out, "w") as f:
+            timeline.dump_chrome_trace(events, f)
+    if args.stats:
+        print(json.dumps(timeline.phase_stats(events), indent=2),
+              file=sys.stderr)
     return 0
 
 
@@ -659,6 +710,7 @@ if __name__ == "__main__":  # python -m tpukube.cli <tool> ...
         "extender": main_extender,
         "sim": main_sim,
         "ctl": main_ctl,
+        "obs": main_obs,
     }
     if len(sys.argv) < 2 or sys.argv[1] not in tools:
         print(f"usage: python -m tpukube.cli {{{'|'.join(tools)}}} ...",

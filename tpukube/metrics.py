@@ -2,16 +2,23 @@
 
 The reference lineage only has glog; BASELINE's north-star metrics demand
 more: cluster TPU-chip utilization % and the gang-schedule latency
-distribution. This module renders Prometheus text-format metrics without
-depending on prometheus_client (not in this environment), and provides a
-tiny threaded HTTP server for the node agent (the extender serves /metrics
-from its aiohttp app).
+distribution. The renderers here are thin builders over the
+``tpukube.obs.registry`` metrics registry (Counter/Gauge/Summary/
+Histogram with label sets) — no prometheus_client dependency — and a
+tiny threaded HTTP server for the node agent (the extender serves
+/metrics from its aiohttp app). Every legacy series name/label renders
+byte-identically to the pre-registry renderers (golden-file test in
+tests/test_obs.py); the registry additionally contributes histogram
+``_bucket`` series for the gang-commit and webhook latency
+distributions.
 
 Exported series (extender):
   tpu_chip_utilization_percent            — north star #1
   gang_schedule_latency_seconds{quantile} — north star #2 (+ _count/_sum)
+  gang_schedule_latency_seconds_bucket{le}          — histogram buckets
   tpukube_binds_total, tpukube_gang_rollbacks_total,
   tpukube_preemptions_total, tpukube_webhook_latency_seconds{handler,quantile}
+  tpukube_webhook_latency_seconds_bucket{handler,le}
 
 Exported series (node agent):
   tpukube_plugin_allocations_total, tpukube_plugin_devices{health}
@@ -19,189 +26,208 @@ Exported series (node agent):
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Iterable, Optional
+from typing import Any, Callable, Optional
+
+from tpukube.obs.registry import (
+    Registry,
+    escape_label_value as _esc,  # noqa: F401  (legacy import surface)
+    format_sample as _fmt,
+    quantile,
+)
+
+__all__ = [
+    "quantile", "MetricsServer", "build_extender_registry",
+    "build_plugin_registry", "build_syncer_registry",
+    "render_extender_metrics", "render_plugin_metrics",
+    "render_syncer_metrics",
+]
 
 
-def quantile(values: Iterable[float], q: float) -> float:
-    """Nearest-rank quantile; 0.0 on empty input."""
-    vs = sorted(values)
-    if not vs:
-        return 0.0
-    idx = min(len(vs) - 1, max(0, round(q * (len(vs) - 1))))
-    return vs[idx]
-
-
-def _esc(value: str) -> str:
-    """Prometheus text-format label-value escaping. Label values here can
-    carry arbitrary runtime text (e.g. inventory_source embeds PJRT error
-    messages); an unescaped quote or newline would corrupt the whole
-    scrape — on exactly the degraded nodes the metric exists to flag."""
-    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
-            .replace("\n", "\\n"))
-
-
-def _fmt(name: str, value: float, labels: Optional[dict[str, str]] = None) -> str:
-    if labels:
-        inner = ",".join(f'{k}="{_esc(v)}"' for k, v in sorted(labels.items()))
-        return f"{name}{{{inner}}} {value:.6g}\n"
-    return f"{name} {value:.6g}\n"
-
-
-def render_extender_metrics(extender, reconcile=None, evictions=None,
-                            node_refresh=None, lifecycle=None) -> str:
-    """Prometheus text for an Extender (tpukube.sched.extender); pass the
+def build_extender_registry(extender, reconcile=None, evictions=None,
+                            node_refresh=None, lifecycle=None) -> Registry:
+    """Registry for an Extender (tpukube.sched.extender); pass the
     daemon's AllocReconcileLoop / EvictionExecutor /
     NodeTopologyRefreshLoop / PodLifecycleReleaseLoop to export their
     counters (the divergence/reconcile/eviction/release story operators
     alarm on — a flat releases counter under churn means the release
     watch is dead and chips are leaking)."""
-    out: list[str] = []
-    out.append("# TYPE tpu_chip_utilization_percent gauge\n")
-    out.append(_fmt("tpu_chip_utilization_percent",
-                    100.0 * extender.state.utilization()))
+    reg = Registry()
+    # everything is pull-based (fn/values_fn against the live daemon
+    # objects): a registry built once and rendered per scrape — the
+    # natural long-lived usage — must never serve construction-time
+    # snapshots of the north-star series
+    reg.gauge("tpu_chip_utilization_percent",
+              fn=lambda: 100.0 * extender.state.utilization())
 
-    lats = list(extender.gang.commit_latencies)
-    out.append("# TYPE gang_schedule_latency_seconds summary\n")
-    for q in (0.5, 0.9, 0.99):
-        out.append(_fmt("gang_schedule_latency_seconds", quantile(lats, q),
-                        {"quantile": str(q)}))
-    out.append(_fmt("gang_schedule_latency_seconds_count", len(lats)))
-    out.append(_fmt("gang_schedule_latency_seconds_sum", sum(lats)))
+    reg.summary("gang_schedule_latency_seconds",
+                quantiles=(0.5, 0.9, 0.99),
+                values_fn=lambda: list(extender.gang.commit_latencies))
+    # the distribution the summary's fixed quantiles flatten: the gang
+    # manager's persistent histogram — monotonic cumulative bucket
+    # counters (observed at commit time, never a window snapshot), so
+    # Prometheus can rate()/aggregate them across scrapes and instances
+    reg.register(extender.gang.commit_hist)
 
-    out.append("# TYPE tpukube_ici_links_down gauge\n")
-    out.append(_fmt("tpukube_ici_links_down", sum(
+    reg.gauge("tpukube_ici_links_down", fn=lambda: sum(
         len(extender.state.broken_links(sid))
         for sid in extender.state.slice_ids()
-    )))
+    ))
 
-    out.append("# TYPE tpukube_binds_total counter\n")
-    out.append(_fmt("tpukube_binds_total", extender.binds_total))
-    out.append("# TYPE tpukube_gang_rollbacks_total counter\n")
-    out.append(_fmt("tpukube_gang_rollbacks_total", extender.gang.rollbacks))
-    out.append("# TYPE tpukube_preemptions_total counter\n")
-    out.append(_fmt("tpukube_preemptions_total", extender.preemptions))
+    reg.counter("tpukube_binds_total",
+                fn=lambda: extender.binds_total)
+    reg.counter("tpukube_gang_rollbacks_total",
+                fn=lambda: extender.gang.rollbacks)
+    reg.counter("tpukube_preemptions_total",
+                fn=lambda: extender.preemptions)
 
-    out.append("# TYPE tpukube_webhook_latency_seconds summary\n")
-    for handler, window in extender.latencies.items():
-        vs = list(window)
-        for q in (0.5, 0.99):
-            out.append(_fmt("tpukube_webhook_latency_seconds",
-                            quantile(vs, q),
-                            {"handler": handler, "quantile": str(q)}))
+    web = reg.summary("tpukube_webhook_latency_seconds",
+                      quantiles=(0.5, 0.99), emit_count_sum=False)
+    for handler in extender.latencies:
+        web.labels(_values_fn=(lambda h=handler: list(extender.latencies[h])),
+                   handler=handler)
+    # per-handler monotonic buckets, observed where the daemon records
+    # each sample (the extender's persistent histogram)
+    reg.register(extender.webhook_hist)
 
     # evicted-but-unconfirmed preemption victims: non-zero means gang
     # binds are gated on graceful terminations in progress
-    out.append("# TYPE tpukube_gang_victims_terminating gauge\n")
-    out.append(_fmt("tpukube_gang_victims_terminating",
-                    extender.gang.terminating_count()))
+    reg.gauge("tpukube_gang_victims_terminating",
+              fn=lambda: extender.gang.terminating_count())
 
-    out.append("# TYPE tpukube_evictions_pending gauge\n")
+    pending = reg.gauge("tpukube_evictions_pending")
     if evictions is not None:
-        out.append(_fmt("tpukube_evictions_pending", evictions.depth()))
-        out.append("# TYPE tpukube_evictions_total counter\n")
-        out.append(_fmt("tpukube_evictions_total", evictions.evicted))
-        out.append("# TYPE tpukube_evictions_blocked_total counter\n")
-        out.append(_fmt("tpukube_evictions_blocked_total", evictions.blocked))
-        out.append("# TYPE tpukube_eviction_failures_total counter\n")
-        out.append(_fmt("tpukube_eviction_failures_total", evictions.failures))
+        pending.set_function(lambda: evictions.depth())
+        reg.counter("tpukube_evictions_total",
+                    fn=lambda: evictions.evicted)
+        reg.counter("tpukube_evictions_blocked_total",
+                    fn=lambda: evictions.blocked)
+        reg.counter("tpukube_eviction_failures_total",
+                    fn=lambda: evictions.failures)
         # a PDB-wedged eviction is a capacity leak in progress: alarm on
         # age, not just depth
-        out.append("# TYPE tpukube_eviction_oldest_age_seconds gauge\n")
-        out.append(_fmt("tpukube_eviction_oldest_age_seconds",
-                        evictions.oldest_age_seconds()))
+        reg.gauge("tpukube_eviction_oldest_age_seconds",
+                  fn=lambda: evictions.oldest_age_seconds())
     else:
         # no executor (sim/dev): the queue depth is still the operator's
         # double-allocation early-warning
-        out.append(_fmt("tpukube_evictions_pending",
-                        len(extender.pending_evictions)))
+        pending.set_function(lambda: len(extender.pending_evictions))
     if reconcile is not None:
-        out.append("# TYPE tpukube_reconciles_total counter\n")
-        out.append(_fmt("tpukube_reconciles_total", reconcile.reconciled))
+        reg.counter("tpukube_reconciles_total",
+                    fn=lambda: reconcile.reconciled)
     if node_refresh is not None:
-        out.append("# TYPE tpukube_node_refreshes_total counter\n")
-        out.append(_fmt("tpukube_node_refreshes_total",
-                        node_refresh.refreshed))
+        reg.counter("tpukube_node_refreshes_total",
+                    fn=lambda: node_refresh.refreshed)
     if lifecycle is not None:
-        out.append("# TYPE tpukube_lifecycle_releases_total counter\n")
-        out.append(_fmt("tpukube_lifecycle_releases_total",
-                        lifecycle.released))
-    return "".join(out)
+        reg.counter("tpukube_lifecycle_releases_total",
+                    fn=lambda: lifecycle.released)
+    return reg
+
+
+def render_extender_metrics(extender, reconcile=None, evictions=None,
+                            node_refresh=None, lifecycle=None) -> str:
+    """Prometheus text for an Extender — see build_extender_registry."""
+    return build_extender_registry(
+        extender, reconcile=reconcile, evictions=evictions,
+        node_refresh=node_refresh, lifecycle=lifecycle,
+    ).render()
+
+
+def build_plugin_registry(server, health=None, kubelet_watch=None,
+                          intent_watch=None) -> Registry:
+    """Registry for a DevicePluginServer (tpukube.plugin.server); pass
+    the daemon's HealthWatcher / KubeletSessionWatcher /
+    AllocIntentWatcher to export their transition counters (a flat
+    watch-events counter while pods bind means intent steering is dead
+    and the kubelet is choosing chips unguided)."""
+    from tpukube.obs.statusz import device_health_counts
+
+    reg = Registry()
+    reg.counter("tpukube_plugin_allocations_total",
+                fn=lambda: server.allocation_count)
+    devices = reg.gauge("tpukube_plugin_devices")
+    devices.labels(health="Healthy").set_function(
+        lambda: device_health_counts(server._device)[0])
+    devices.labels(health="Unhealthy").set_function(
+        lambda: device_health_counts(server._device)[1])
+    info = reg.gauge("tpukube_plugin_resource_info", emit_type=False)
+    info.labels(resource=server.resource_name).set(1)
+    # operators alarm on table-fallback nodes: their HBM/core facts are
+    # static guesses, not runtime truth
+    reg.gauge("tpukube_plugin_inventory_source").labels(
+        source=server._device.inventory_source()
+    ).set(1)
+    reg.gauge("tpukube_plugin_intent_depth",
+              fn=lambda: server.intents.depth())
+    reg.counter("tpukube_plugin_divergences_total",
+                fn=lambda: server.divergences)
+    if health is not None:
+        reg.counter("tpukube_plugin_health_transitions_total",
+                    fn=lambda: health.transitions)
+    if kubelet_watch is not None:
+        reg.counter("tpukube_plugin_reregistrations_total",
+                    fn=lambda: kubelet_watch.reregistrations)
+    if intent_watch is not None:
+        reg.counter("tpukube_plugin_intent_watch_events_total",
+                    fn=lambda: intent_watch.watch_events)
+    return reg
 
 
 def render_plugin_metrics(server, health=None, kubelet_watch=None,
                           intent_watch=None) -> str:
-    """Prometheus text for a DevicePluginServer (tpukube.plugin.server);
-    pass the daemon's HealthWatcher / KubeletSessionWatcher /
-    AllocIntentWatcher to export their transition counters (a flat
-    watch-events counter while pods bind means intent steering is dead
-    and the kubelet is choosing chips unguided)."""
-    out: list[str] = []
-    out.append("# TYPE tpukube_plugin_allocations_total counter\n")
-    out.append(_fmt("tpukube_plugin_allocations_total", server.allocation_count))
-    out.append("# TYPE tpukube_plugin_devices gauge\n")
-    healthy = unhealthy = 0
-    for _, h in server._device.device_list():
-        if h.value == "Healthy":
-            healthy += 1
-        else:
-            unhealthy += 1
-    out.append(_fmt("tpukube_plugin_devices", healthy, {"health": "Healthy"}))
-    out.append(_fmt("tpukube_plugin_devices", unhealthy, {"health": "Unhealthy"}))
-    out.append(_fmt("tpukube_plugin_resource_info", 1,
-                    {"resource": server.resource_name}))
-    # operators alarm on table-fallback nodes: their HBM/core facts are
-    # static guesses, not runtime truth
-    out.append("# TYPE tpukube_plugin_inventory_source gauge\n")
-    out.append(_fmt("tpukube_plugin_inventory_source", 1,
-                    {"source": server._device.inventory_source()}))
-    out.append("# TYPE tpukube_plugin_intent_depth gauge\n")
-    out.append(_fmt("tpukube_plugin_intent_depth", server.intents.depth()))
-    out.append("# TYPE tpukube_plugin_divergences_total counter\n")
-    out.append(_fmt("tpukube_plugin_divergences_total", server.divergences))
-    if health is not None:
-        out.append("# TYPE tpukube_plugin_health_transitions_total counter\n")
-        out.append(_fmt("tpukube_plugin_health_transitions_total",
-                        health.transitions))
-    if kubelet_watch is not None:
-        out.append("# TYPE tpukube_plugin_reregistrations_total counter\n")
-        out.append(_fmt("tpukube_plugin_reregistrations_total",
-                        kubelet_watch.reregistrations))
-    if intent_watch is not None:
-        out.append("# TYPE tpukube_plugin_intent_watch_events_total counter\n")
-        out.append(_fmt("tpukube_plugin_intent_watch_events_total",
-                        intent_watch.watch_events))
-    return "".join(out)
+    """Prometheus text for a DevicePluginServer — see
+    build_plugin_registry."""
+    return build_plugin_registry(
+        server, health=health, kubelet_watch=kubelet_watch,
+        intent_watch=intent_watch,
+    ).render()
+
+
+def build_syncer_registry(syncer) -> Registry:
+    reg = Registry()
+    reg.counter("tpukube_syncer_syncs_total", fn=lambda: syncer.syncs)
+    return reg
 
 
 def render_syncer_metrics(syncer) -> str:
     """Prometheus text for a NodeAnnotationSyncer sidecar."""
-    return (
-        "# TYPE tpukube_syncer_syncs_total counter\n"
-        + _fmt("tpukube_syncer_syncs_total", syncer.syncs)
-    )
+    return build_syncer_registry(syncer).render()
 
 
 class MetricsServer:
-    """Minimal threaded /metrics HTTP server for the node agent."""
+    """Minimal threaded HTTP server for the node agent: /metrics always,
+    /statusz when a ``statusz`` document callback is wired (the node
+    agent passes tpukube.obs.statusz.plugin_statusz)."""
 
     def __init__(self, render: Callable[[], str], host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0,
+                 statusz: Optional[Callable[[], Any]] = None):
         render_fn = render
+        statusz_fn = statusz
 
         class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802  (http.server API)
-                if self.path != "/metrics":
-                    self.send_error(404)
-                    return
-                body = render_fn().encode()
+            def _reply(self, body: bytes, content_type: str) -> None:
                 self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802  (http.server API)
+                if self.path == "/metrics":
+                    self._reply(
+                        render_fn().encode(),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                elif self.path == "/statusz" and statusz_fn is not None:
+                    self._reply(
+                        json.dumps(statusz_fn(), sort_keys=True).encode(),
+                        "application/json",
+                    )
+                else:
+                    self.send_error(404)
 
             def log_message(self, *args):  # silence per-request stderr spam
                 pass

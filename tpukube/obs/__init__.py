@@ -1,0 +1,31 @@
+"""Unified observability layer (ISSUE 1 tentpole).
+
+Three subsystems, all control-plane-agnostic:
+
+  * :mod:`tpukube.obs.registry` — a small metrics registry
+    (Counter/Gauge/Summary/Histogram with label sets) rendering
+    Prometheus text format. ``tpukube.metrics``'s renderers are built on
+    it; every legacy series name/label renders byte-identically, plus
+    new histogram ``_bucket`` series for the gang and webhook latency
+    distributions.
+  * :mod:`tpukube.obs.timeline` — per-pod scheduling timelines:
+    correlates DecisionTrace events (webhook decisions + span
+    annotations) by pod key into span chains and exports Chrome
+    trace-event JSON (Perfetto-loadable) — ``tpukube-obs timeline``.
+  * :mod:`tpukube.obs.statusz` — /statusz JSON introspection documents
+    for the extender daemon and the node agent: ledger/reservation
+    summary, pending-eviction queue with ages, watch liveness with a
+    last-event timestamp, trace-ring stats, inventory source.
+"""
+
+from tpukube.obs.registry import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    Summary,
+    escape_label_value,
+    format_sample,
+    quantile,
+)

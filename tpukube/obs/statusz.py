@@ -1,0 +1,124 @@
+"""/statusz JSON introspection documents.
+
+/metrics answers "how much"; /statusz answers "what, exactly, right
+now": the ledger/reservation summary, the pending-eviction queue with
+per-key ages, watch liveness as a LAST-EVENT TIMESTAMP (a live thread in
+reconnect backoff is not a live stream — ADVICE round 5), trace-ring
+stats, and the node agent's inventory source. Served by the extender's
+aiohttp app and the node agent's MetricsServer.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+
+def device_health_counts(device) -> tuple[int, int]:
+    """(healthy, unhealthy) over a device manager's current device list —
+    the ONE classification both /metrics and /statusz report (a second
+    copy would let the two disagree the day the health enum grows)."""
+    healthy = unhealthy = 0
+    for _, h in device.device_list():
+        if h.value == "Healthy":
+            healthy += 1
+        else:
+            unhealthy += 1
+    return healthy, unhealthy
+
+
+def watch_status(loop) -> dict[str, Any]:
+    """One watch/poll loop's liveness document. ``loop`` is any
+    apiserver._WatchLoop (or a loop hosted by a PodInformer); None means
+    the daemon runs without that loop (sim/dev)."""
+    if loop is None:
+        return {"configured": False}
+    status = getattr(loop, "watch_status", None)
+    if status is not None:
+        return {"configured": True, **status()}
+    return {"configured": True, "name": getattr(loop, "_name", "?")}
+
+
+def extender_statusz(
+    extender, evictions=None, informer=None, node_refresh=None,
+    lifecycle=None, reconcile=None,
+) -> dict[str, Any]:
+    """The extender daemon's introspection document (served on /statusz
+    behind the same auth as /state — it discloses placement)."""
+    state = extender.state
+    gangs = extender.gang.snapshot()
+    now = time.monotonic()
+    if evictions is not None:
+        pending = evictions.pending_snapshot(now=now)
+        oldest = evictions.oldest_age_seconds(now=now)
+    else:
+        # no executor (sim/dev): the raw queue, ages unknown
+        pending = [
+            {"pod": k, "state": "queued", "age_seconds": None}
+            for k in list(extender.pending_evictions)
+        ]
+        oldest = None
+    out: dict[str, Any] = {
+        "component": "extender",
+        "time": time.time(),
+        "ledger": {
+            "nodes": len(state.node_names()),
+            "allocations": len(state.allocations()),
+            "utilization_percent": round(100.0 * state.utilization(), 2),
+        },
+        "gangs": {
+            "reservations": len(gangs),
+            "committed": sum(1 for r in gangs if r.committed),
+            "assembling": sum(1 for r in gangs if not r.committed),
+            "victims_terminating": extender.gang.terminating_count(),
+        },
+        "pending_evictions": {
+            "depth": len(pending),
+            "oldest_age_seconds": oldest,
+            "entries": pending,
+        },
+        # the pod stream feeding lifecycle releases + alloc reconciles:
+        # liveness means a CONNECTED stream with a last-event timestamp,
+        # not merely a live thread (reconnect backoff windows miss
+        # DELETED events silently)
+        "pod_watch": watch_status(informer if informer is not None
+                                  else lifecycle),
+        "node_watch": watch_status(node_refresh),
+        "trace": (extender.trace.stats() if extender.trace is not None
+                  else {"enabled": False}),
+    }
+    if lifecycle is not None:
+        out["lifecycle_releases"] = lifecycle.released
+    if reconcile is not None:
+        out["reconciles"] = reconcile.reconciled
+    return out
+
+
+def plugin_statusz(
+    server, device=None, health=None, kubelet_watch=None, intent_watch=None,
+) -> dict[str, Any]:
+    """The node agent's introspection document (served by its
+    MetricsServer on /statusz)."""
+    dev = device if device is not None else server._device
+    healthy, unhealthy = device_health_counts(dev)
+    out: dict[str, Any] = {
+        "component": "plugin",
+        "time": time.time(),
+        "resource": server.resource_name,
+        "devices": {"healthy": healthy, "unhealthy": unhealthy},
+        # table-fallback nodes run on static HBM/core guesses, not
+        # runtime truth — the first thing to check on a weird node
+        "inventory_source": dev.inventory_source(),
+        "allocations": server.allocation_count,
+        "divergences": server.divergences,
+        "intents": {
+            "depth": server.intents.depth(),
+            "pending": sorted(server.intents.snapshot()),
+        },
+        "intent_watch": watch_status(intent_watch),
+    }
+    if health is not None:
+        out["health_transitions"] = health.transitions
+    if kubelet_watch is not None:
+        out["kubelet_reregistrations"] = kubelet_watch.reregistrations
+    return out

@@ -185,6 +185,21 @@ class DevicePluginServer(stubs.DevicePluginServicer):
         # AllocIntentCache); fed by apiserver.AllocIntentWatcher
         self.intents = AllocIntentCache()
         self._alloc_reporter = None  # divergence callback (apiserver chan)
+        # observability span hook: called as span_sink(name, pod_key,
+        # **fields) on Allocate / intent-match, when an Allocate can be
+        # attributed to a pod. Wire a DecisionTrace.span here (the sim
+        # harness does) and the per-pod timeline gains the node-agent leg
+        # of the chain: filter -> gang_reserve -> bind -> allocate.
+        self.span_sink = None
+
+    def _span(self, name: str, pod_key: str, **fields) -> None:
+        if self.span_sink is None:
+            return
+        try:
+            self.span_sink(name, pod_key, **fields)
+        except Exception:
+            # observability must never fail an Allocate
+            log.exception("span sink failed for %s/%s", name, pod_key)
 
     def set_alloc_reporter(self, reporter) -> None:
         """Install the divergence report channel: called as
@@ -336,6 +351,14 @@ class DevicePluginServer(stubs.DevicePluginServicer):
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
             resp.container_responses.append(pb.ContainerAllocateResponse(envs=env))
             pod_key, planned, diverged = self.intents.consume(ids)
+            if pod_key is not None:
+                if not diverged:
+                    # the kubelet's choice matched the extender's plan
+                    # exactly — the steering loop closed as designed
+                    self._span("intent_match", pod_key,
+                               devices=sorted(ids))
+                self._span("allocate", pod_key,
+                           devices=sorted(ids), diverged=diverged)
             if diverged and planned is not None and pod_key is not None:
                 self.divergences += 1
                 log.warning(

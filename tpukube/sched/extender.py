@@ -39,6 +39,7 @@ from tpukube.core.types import (
     TopologyCoord,
     make_device_id,
 )
+from tpukube.obs.registry import Histogram
 from tpukube.sched import kube, policy, slicefit
 from tpukube.sched.gang import (
     GangError,
@@ -109,6 +110,14 @@ class Extender:
             "prioritize": deque(maxlen=self.LATENCY_WINDOW),
             "bind": deque(maxlen=self.LATENCY_WINDOW),
         }
+        # the same latencies as monotonic histogram buckets (counters,
+        # cumulative since start — the windowed deques feed only the
+        # quantile summaries); children pre-created so every handler's
+        # _bucket series renders from the first scrape
+        self.webhook_hist = Histogram("tpukube_webhook_latency_seconds",
+                                      bucket_only=True)
+        for handler in self.latencies:
+            self.webhook_hist.labels(handler=handler)
         self.preemptions = 0   # victims evicted for higher-priority gangs
         self.binds_total = 0   # successful binds (metrics counter)
         # The bind EFFECTOR: with bindVerb configured, kube-scheduler
@@ -216,6 +225,14 @@ class Extender:
                     # replica beyond min_member of a full gang: schedule it
                     # as a normal pod rather than wedging it Pending forever
                     res = None
+                if res is not None and self.trace is not None:
+                    # timeline span: this member attached to (or created)
+                    # the gang's slice reservation in this filter cycle
+                    self.trace.span(
+                        "gang_reserve", pod.key(),
+                        gang=f"{pod.namespace}/{pod.group.name}",
+                        chips=res.total_chips(), committed=res.committed,
+                    )
             else:
                 self.gang.sweep()
             reserved = self._reserved_by_slice() if res is None else None
@@ -238,7 +255,13 @@ class Extender:
                     failed[name] = reason
             return feasible, failed
         finally:
-            self.latencies["filter"].append(time.monotonic() - t0)
+            self._observe_latency("filter", time.monotonic() - t0)
+
+    def _observe_latency(self, handler: str, seconds: float) -> None:
+        """One webhook latency sample: into the bounded window (quantile
+        summaries) AND the cumulative histogram (_bucket counters)."""
+        self.latencies[handler].append(seconds)
+        self.webhook_hist.labels(handler=handler).observe(seconds)
 
     def _reserved_by_slice(self) -> dict[str, set[TopologyCoord]]:
         return {
@@ -278,10 +301,16 @@ class Extender:
         plan_slice = None
         best_rank = None
         for sid in slice_ids:
+            # blocked = unhealthy chips PLUS terminating victims' chips:
+            # the latter are ledger-free but physically held, and no
+            # eviction can free them sooner — a plan over them would
+            # reserve with zero victims and bind ungated onto chips a
+            # dying container still owns (ADVICE round 5 medium)
             cand = policy.find_preemption_plan(
                 [w for w in workloads if w.slice_id == sid],
                 self.state.slice_mesh(sid),
-                self.state.unhealthy_coords(sid),
+                self.state.unhealthy_coords(sid)
+                | self.gang.terminating_coords(sid),
                 total,
                 pod.group.shape,
                 pod.priority,
@@ -306,6 +335,12 @@ class Extender:
                         pod.namespace, pod.group.name, len(victims), total,
                         sorted(split),
                     )
+                    if self.trace is not None:
+                        self.trace.span(
+                            "preemption_plan", pod.key(),
+                            gang=f"{pod.namespace}/{pod.group.name}",
+                            victims=len(victims), slices=sorted(split),
+                        )
                     return self.gang.reserve_exact_split(
                         pod, count,
                         {sid: p.coords for sid, p in split.items()},
@@ -322,6 +357,14 @@ class Extender:
             pod.namespace, pod.group.name,
             plan.victim_count, plan.cost_priority_sum, total, plan_slice,
         )
+        if self.trace is not None:
+            self.trace.span(
+                "preemption_plan", pod.key(),
+                gang=f"{pod.namespace}/{pod.group.name}",
+                victims=plan.victim_count,
+                cost_priority_sum=plan.cost_priority_sum,
+                slices=[plan_slice],
+            )
         return self.gang.reserve_exact(
             pod, count, plan.coords, slice_id=plan_slice,
             pending_victims=plan.victims,
@@ -462,7 +505,10 @@ class Extender:
                 break
             mesh = self.state.slice_mesh(sid)
             in_slice = [w for w in workloads if w.slice_id == sid]
-            unhealthy = self.state.unhealthy_coords(sid)
+            # same blocked-set rule as the single-slice path: chips a
+            # terminating victim still physically holds are unopenable
+            unhealthy = (self.state.unhealthy_coords(sid)
+                         | self.gang.terminating_coords(sid))
             broken = self.state.broken_links(sid)
             max_vol = min(
                 remaining,
@@ -619,7 +665,7 @@ class Extender:
                 scores[name] = self._score_node(name, resource, count, sweeps, reserved)
             return scores
         finally:
-            self.latencies["prioritize"].append(time.monotonic() - t0)
+            self._observe_latency("prioritize", time.monotonic() - t0)
 
     def _score_node(
         self,
@@ -876,6 +922,14 @@ class Extender:
                     # reservation changed between plan and commit: undo
                     self.state.release(key)
                     raise ExtenderError(str(e)) from e
+                if committed_now and self.trace is not None:
+                    # timeline span: this bind assembled the quorum
+                    self.trace.span(
+                        "gang_commit", key,
+                        gang=f"{res.namespace}/{res.group.name}",
+                        members=len(res.assigned),
+                        latency_s=res.commit_latency,
+                    )
                 if self.binder is not None:
                     # _handle_bind's effector undo needs to know whether
                     # THIS bind committed the gang (keyed, since other
@@ -887,7 +941,7 @@ class Extender:
             log.info("bound %s -> %s %s", key, node_name, device_ids)
             return alloc
         finally:
-            self.latencies["bind"].append(time.monotonic() - t0)
+            self._observe_latency("bind", time.monotonic() - t0)
 
     def _mint_device_ids(
         self, view: NodeView, resource: str, plan: list[TopologyCoord]
@@ -1327,9 +1381,12 @@ class Extender:
 def make_app(
     extender: Extender, reconcile=None, evictions=None,
     node_refresh=None, lifecycle=None, auth_token: Optional[str] = None,
+    informer=None,
 ) -> web.Application:
     """``reconcile``/``evictions``/``node_refresh``/``lifecycle`` are the
-    daemon's loops, exported on /metrics when present.
+    daemon's loops, exported on /metrics when present; ``informer`` is
+    the shared PodInformer whose stream liveness /statusz reports (falls
+    back to ``lifecycle`` when the loops run standalone).
 
     ``auth_token`` gates every route except /healthz and /metrics behind
     ``Authorization: Bearer <token>``: /bind mutates the ledger, creates
@@ -1405,6 +1462,18 @@ def make_app(
             raise web.HTTPBadRequest(text="since must be an integer")
         return web.json_response(extender.trace.events(since_seq=since))
 
+    async def statusz_handler(request: web.Request) -> web.Response:
+        # behind the bearer middleware like /state and /trace: the
+        # pending-eviction queue and reservation summary disclose
+        # placement, so /statusz is NOT a probe route
+        from tpukube.obs.statusz import extender_statusz
+
+        return web.json_response(extender_statusz(
+            extender, evictions=evictions, informer=informer,
+            node_refresh=node_refresh, lifecycle=lifecycle,
+            reconcile=reconcile,
+        ))
+
     app.router.add_post("/filter", filter_handler)
     app.router.add_post("/prioritize", prioritize_handler)
     app.router.add_post("/bind", bind_handler)
@@ -1414,6 +1483,7 @@ def make_app(
     app.router.add_get("/state/allocs", state_allocs)
     app.router.add_get("/state/gangs", state_gangs)
     app.router.add_get("/trace", trace_handler)
+    app.router.add_get("/statusz", statusz_handler)
     return app
 
 

@@ -40,6 +40,7 @@ from tpukube.core.types import (
     PodInfo,
     TopologyCoord,
 )
+from tpukube.obs.registry import Histogram
 from tpukube.sched import slicefit
 from tpukube.sched.state import ClusterState, StateError
 
@@ -164,6 +165,11 @@ class GangManager:
         self._reservations: dict[tuple[str, str], GangReservation] = {}
         # reservation-created -> committed durations (north-star p50 feed)
         self.commit_latencies: deque[float] = deque(maxlen=self.LATENCY_WINDOW)
+        # same durations as monotonic histogram buckets (the _bucket
+        # series on /metrics are counters: cumulative since process
+        # start, never windowed — aggregatable across scrapes/instances)
+        self.commit_hist = Histogram("gang_schedule_latency_seconds",
+                                     bucket_only=True)
         self.rollbacks = 0  # TTL/fault rollbacks observed (metrics/tests)
         # Cluster-wide eviction bus, owned by the Extender (which also feeds
         # it preemption victims); gang rollback/dissolve appends rolled-back
@@ -648,6 +654,13 @@ class GangManager:
                     self._state.occupied_coords(slice_id)
                     - victim_held.get(slice_id, set())
                 ) | reserved
+                # terminating victims' chips are ledger-free (their
+                # eviction already released them) but physically held
+                # until the pod object is gone — a preemption-opened box
+                # overlapping them would bind members onto chips a dying
+                # container still owns, with zero victims to gate on
+                # (the RLock makes the locked accessor safe here)
+                occupied |= self.terminating_coords(slice_id)
                 clash = [c for c in coords if c in occupied]
                 if clash:
                     raise GangError(
@@ -745,6 +758,20 @@ class GangManager:
         with self._lock:
             return len(self._terminating_coords)
 
+    def terminating_coords(self, slice_id: str) -> set[TopologyCoord]:
+        """Chips of evicted-but-still-terminating victims in one slice.
+        They are ledger-free and reservation-free but PHYSICALLY held, so
+        the preemption planner must treat them exactly like unhealthy
+        chips: no eviction can free them any sooner, and a plan that
+        reserves them reopens the double-ownership window the
+        termination gate closes (ADVICE round 5 medium)."""
+        with self._lock:
+            out: set[TopologyCoord] = set()
+            for sid, coords in self._terminating_coords.values():
+                if sid == slice_id:
+                    out |= coords
+            return out
+
     # -- per-node queries for the extender ----------------------------------
     def _node_slice(
         self, res: GangReservation, node_name: str
@@ -780,13 +807,25 @@ class GangManager:
                         entry[0] += 1
         return {h: (a, t) for h, (a, t) in out.items()}
 
-    @staticmethod
     def feasibility_from(
-        counts: dict[str, tuple[int, int]], res: GangReservation,
+        self, counts: dict[str, tuple[int, int]], res: GangReservation,
         node_name: str,
     ) -> Optional[str]:
-        """node_feasibility answered from a node_availability snapshot."""
-        avail = counts.get(node_name, (0, 0))[0]
+        """node_feasibility answered from a node_availability snapshot.
+
+        A node absent from the snapshot hosts NONE of the reservation's
+        coords. When the node's whole ICI slice is outside the
+        reservation — the commonest infeasible case — report the
+        historical no-chips-in-slice reason instead of a misleading
+        '0 unassigned chips here' (ADVICE round 5 low); an in-slice node
+        that merely hosts none of the reserved chips keeps the counted
+        message."""
+        entry = counts.get(node_name)
+        if entry is None:
+            if self._node_slice(res, node_name) is None:
+                return "gang holds no chips in this node's ICI slice"
+            entry = (0, 0)
+        avail = entry[0]
         if avail < res.chips_per_pod:
             return (
                 f"gang slice has {avail} unassigned chips here, "
@@ -871,6 +910,7 @@ class GangManager:
                 res.committed = True
                 res.commit_latency = time.monotonic() - res.created
                 self.commit_latencies.append(res.commit_latency)
+                self.commit_hist.observe(res.commit_latency)
                 log.info(
                     "gang %s/%s COMMITTED: %d members in %.3fs",
                     res.namespace, res.group.name,
@@ -898,6 +938,10 @@ class GangManager:
                 self.commit_latencies.remove(res.commit_latency)
             except ValueError:
                 pass  # window overflow evicted it already
+            # commit_hist keeps its sample: _bucket series are monotonic
+            # counters and cannot un-count — one phantom observation on
+            # this rare apiserver-failure path beats a counter decrease
+            # (which Prometheus would read as a process restart)
             log.warning(
                 "gang %s/%s commit UNDONE (quorum bind failed at the "
                 "apiserver)", res.namespace, res.group.name,

@@ -503,6 +503,13 @@ class SimCluster:
             with FakeKubelet(td) as kubelet, \
                  TpuDeviceManager(cfg, host=alloc.node_name) as device, \
                  DevicePluginServer(cfg, device) as server:
+                # the node-agent leg of the per-pod timeline: feed the
+                # planned intent (the intent watcher's job on a real
+                # node) and record allocate/intent-match spans into the
+                # extender's decision trace
+                if self.extender.trace is not None:
+                    server.span_sink = self.extender.trace.span
+                server.intents.put(alloc.pod_key, list(alloc.device_ids))
                 server.register_with_kubelet()
                 kubelet.wait_for_devices(
                     server.resource_name, len(device.device_list())

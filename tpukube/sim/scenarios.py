@@ -165,7 +165,7 @@ def multi_tenant_northstar(config: TpuKubeConfig | None) -> dict[str, Any]:
                 break
         m = _metrics(c)
         util = m["tpu_chip_utilization_percent"]
-        return {
+        result = {
             "metric": "cluster_tpu_utilization_percent",
             "value": round(util, 2),
             "unit": "%",
@@ -175,6 +175,17 @@ def multi_tenant_northstar(config: TpuKubeConfig | None) -> dict[str, Any]:
             "preemptions": int(m["tpukube_preemptions_total"]),
             "pods_placed": int(m["tpukube_binds_total"]),
         }
+        # per-phase timeline stats (new key; every pre-existing key
+        # above is unchanged): where scheduling time went, phase by
+        # phase, from the run's own decision trace — the data BASELINE's
+        # N-run honesty policy needs to explain run-to-run spread
+        if c.extender.trace is not None:
+            from tpukube.obs import timeline
+
+            result["phases"] = timeline.phase_stats(
+                c.extender.trace.events()
+            )
+        return result
 
 
 def churn(config: TpuKubeConfig | None) -> dict[str, Any]:

@@ -42,6 +42,13 @@ from typing import Any, Callable, Iterable, Optional
 KINDS = ("filter", "prioritize", "bind", "release", "reconcile",
          "upsert_node", "victim_gone")
 
+# Annotation kinds: pure observability markers (tpukube.obs.timeline
+# span hooks — gang reserve, preemption plan, gang commit, plugin
+# Allocate/intent-match). They mutate NOTHING and replay skips them;
+# they exist so the per-pod timeline can show where time went between
+# the decision events.
+ANNOTATION_KINDS = ("span",)
+
 
 @dataclass
 class DecisionTrace:
@@ -60,7 +67,7 @@ class DecisionTrace:
             self._sink = open(self.path, "a", buffering=1)  # line-buffered
 
     def record(self, kind: str, request: Any, response: Any) -> dict:
-        assert kind in KINDS, kind
+        assert kind in KINDS or kind in ANNOTATION_KINDS, kind
         with self._lock:
             self._seq += 1
             ev = {
@@ -75,9 +82,31 @@ class DecisionTrace:
                 self._sink.write(json.dumps(ev, sort_keys=True) + "\n")
         return ev
 
+    def span(self, name: str, pod_key: str, **fields: Any) -> dict:
+        """Record one observability span marker attributed to a pod (the
+        timeline correlates these with the decision events by pod key).
+        ``fields`` must be JSON-able."""
+        request = {"name": name, "pod_key": pod_key}
+        request.update(fields)
+        return self.record("span", request, None)
+
     def events(self, since_seq: int = 0) -> list[dict]:
         with self._lock:
             return [e for e in self._events if e["seq"] > since_seq]
+
+    def stats(self) -> dict:
+        """Ring statistics for /statusz: occupancy, total recorded, and
+        how many events the bounded ring has already dropped (non-zero
+        means an incident capture should use a file sink)."""
+        with self._lock:
+            return {
+                "enabled": True,
+                "capacity": self.capacity,
+                "events": len(self._events),
+                "last_seq": self._seq,
+                "dropped": max(0, self._seq - len(self._events)),
+                "sink_path": self.path or None,
+            }
 
     def close(self) -> None:
         with self._lock:
@@ -149,6 +178,8 @@ def replay(
 
     for ev in events:
         kind, req = ev["kind"], ev["request"]
+        if kind in ANNOTATION_KINDS:
+            continue  # observability markers: nothing to re-dispatch
         if kind not in KINDS:  # newer trace format: report, don't crash
             divergences.append(Divergence(ev.get("seq", -1), kind, ev, None))
             if stop_on_divergence:
