@@ -19,6 +19,21 @@ import json
 import time
 
 
+def process_stats() -> dict:
+    """Control-plane process overhead for the bench line: peak RSS and
+    CPU time, so BENCH_*.json tracks scheduler cost across PRs, not
+    just scheduler speed."""
+    import resource
+
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    return {
+        # ru_maxrss is KiB on Linux
+        "peak_rss_bytes": int(ru.ru_maxrss) * 1024,
+        "cpu_user_s": round(ru.ru_utime, 2),
+        "cpu_system_s": round(ru.ru_stime, 2),
+    }
+
+
 def run() -> dict:
     from tpukube.sim import scenarios
 
@@ -30,8 +45,12 @@ def run() -> dict:
         k: c[k] for k in (
             "util_min_after_refill_percent", "resched_p50_s",
             "resched_p99_s", "waves", "wave_size", "lifecycle_releases",
-        )
+            # per-phase timeline stats for the churn run too: re-schedule
+            # spread attributed to a phase, not just observed
+            "phases",
+        ) if k in c
     }
+    result["process"] = process_stats()
     return result
 
 

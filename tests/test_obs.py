@@ -193,9 +193,14 @@ def test_extender_metrics_byte_compat_golden():
         ext.gang.commit_hist.observe(v)
     ext.pending_evictions.append("default/x")
     text = render_extender_metrics(ext)
+    # additions since the golden was captured: the _bucket histogram
+    # families (PR 1) and the event-journal counter (PR 2, which also
+    # opts into # HELP). Everything else must render byte-identically.
     legacy = "".join(
         line for line in text.splitlines(keepends=True)
         if "_bucket" not in line
+        and "tpukube_events_total" not in line
+        and not line.startswith("# HELP")
     )
     assert legacy == EXTENDER_GOLDEN
     # ...and the additions are real histogram series
@@ -509,3 +514,101 @@ def test_bench_line_gains_phase_stats():
     assert phases["bind"]["count"] > 0
     assert set(phases["bind"]) == {"count", "p50_ms", "p99_ms", "max_ms"}
     json.dumps(result)  # still one JSON-able line
+
+
+def test_registry_help_lines_opt_in():
+    """Satellite: # HELP is opt-in per family — new telemetry/event
+    series carry it, legacy families stay HELP-free (byte-compat
+    goldens above prove the latter)."""
+    from tpukube.obs.registry import Registry
+
+    reg = Registry()
+    reg.counter("helped_total", help_text='has "quotes" and\nnewline \\x')
+    reg.gauge("plain")
+    text = reg.render()
+    assert ("# HELP helped_total has \"quotes\" and\\nnewline \\\\x\n"
+            "# TYPE helped_total counter\n") in text
+    assert "# HELP plain" not in text
+    # bucket_only histograms HELP their actual family name
+    reg2 = Registry()
+    reg2.summary("lat_seconds")
+    reg2.histogram("lat_seconds", bucket_only=True, help_text="buckets")
+    assert "# HELP lat_seconds_bucket buckets\n" in reg2.render()
+
+
+def test_timeline_tolerates_span_only_pods(tmp_path):
+    """Satellite regression: a pod with span annotations but no
+    bind/filter decision events (crashed or still-pending) — plus junk
+    entries from a torn capture — must not break the timeline
+    exporter."""
+    import time as _time
+
+    from tpukube import cli
+    from tpukube.obs import timeline
+
+    now = _time.time()
+    events = [
+        # a normal pod with a full chain
+        {"seq": 1, "ts": now, "kind": "filter",
+         "request": {"Pod": {"metadata": {"name": "ok",
+                                          "namespace": "default"}}},
+         "response": {"NodeNames": ["n1"], "FailedNodes": {}}},
+        {"seq": 2, "ts": now + 0.01, "kind": "bind",
+         "request": {"PodName": "ok", "PodNamespace": "default"},
+         "response": {}},
+        # a crashed pod: spans only, no decisions ever recorded
+        {"seq": 3, "ts": now + 0.02, "kind": "span",
+         "request": {"name": "gang_reserve", "pod_key": "default/crashed",
+                     "gang": "default/g"}, "response": None},
+        {"seq": 4, "ts": now + 0.03, "kind": "span",
+         "request": {"name": "allocate", "pod_key": "default/crashed",
+                     "devices": ["tpu-0"]}, "response": None},
+        # junk a torn capture can contain
+        "not even a dict",
+        {"seq": 5, "kind": "span"},            # no ts
+        {"seq": 6, "ts": "corrupt", "kind": "bind"},  # non-numeric ts
+        {"seq": 7, "ts": now + 0.04, "kind": "span", "request": None},
+    ]
+    chains = timeline.span_chains(events)
+    assert chains["default/crashed"] == ["gang_reserve", "allocate"]
+    assert chains["default/ok"] == ["filter", "bind"]
+    doc = timeline.chrome_trace(events)
+    assert any(ev.get("name") == "allocate"
+               for ev in doc["traceEvents"])
+    stats = timeline.phase_stats(events)
+    assert stats["gang_reserve"]["count"] == 1
+    # the allocate slice's width is measurable (it follows the reserve)
+    assert stats["allocate"]["p50_ms"] is not None
+
+    # end to end through the CLI, including a torn final line
+    trace_file = tmp_path / "trace.jsonl"
+    with open(trace_file, "w") as f:
+        for ev in events:
+            if isinstance(ev, dict):
+                f.write(json.dumps(ev) + "\n")
+        f.write('{"seq": 8, "ts": 1.0, "kind": "bi')  # torn
+    out_file = tmp_path / "out.json"
+    rc = cli.main_obs(["timeline", str(trace_file), "-o", str(out_file)])
+    assert rc == 0
+    assert json.loads(out_file.read_text())["traceEvents"]
+
+
+def test_bench_process_stats_and_churn_phases():
+    """Satellite: the bench line's new ``process`` key (peak RSS, CPU
+    time) and the churn scenario's ``phases`` key."""
+    import bench
+    from tpukube.sim import scenarios
+
+    proc = bench.process_stats()
+    assert proc["peak_rss_bytes"] > 10 * 1024 * 1024
+    assert proc["cpu_user_s"] >= 0 and proc["cpu_system_s"] >= 0
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    result = scenarios.churn(cfg)
+    phases = result["phases"]
+    assert phases["bind"]["count"] > 0
+    assert set(phases["bind"]) == {"count", "p50_ms", "p99_ms", "max_ms"}
+    json.dumps(result)

@@ -1,0 +1,83 @@
+"""Prometheus text-format lint over both daemons' live /metrics
+(satellite of ISSUE 2): every future series addition must keep a TYPE
+line per family, unique series, and parseable label escaping — this
+scrapes the REAL endpoints, so a bad series fails here before any
+dashboard sees it."""
+
+import urllib.request
+
+from tpukube.core.config import load_config
+from tpukube.core.types import PodGroup
+from tpukube.obs.slo import parse_metrics, validate_exposition
+from tpukube.sim import SimCluster
+
+
+def _scrape(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.read().decode()
+
+
+def test_extender_metrics_endpoint_lints_clean():
+    """The extender's /metrics after real activity — binds, a gang, a
+    preemption, faults — must parse and lint clean."""
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        for i in range(4):
+            c.schedule(c.make_pod(f"low-{i}", tpu=2, priority=0))
+        group = PodGroup("g", min_member=4)
+        for i in range(4):
+            c.schedule(c.make_pod(f"g-{i}", tpu=2, priority=100,
+                                  group=group))
+        c.inject_fault("host-0-0-0", 0)
+        text = _scrape(f"{c.base_url}/metrics")
+    errors = validate_exposition(text)
+    assert errors == [], "\n".join(errors)
+    # and it is substantive: both histogram families + counters present
+    names = {s.name for s in parse_metrics(text)}
+    assert "gang_schedule_latency_seconds_bucket" in names
+    assert "tpukube_webhook_latency_seconds_bucket" in names
+    assert "tpukube_events_total" in names
+
+
+def test_node_agent_metrics_endpoint_lints_clean(tmp_path):
+    """The node agent's MetricsServer /metrics with the full
+    observability surface attached (telemetry sampler, journal, health
+    watcher) and label-hostile state (a fault, a weird intent key)."""
+    from tpukube.device import TpuDeviceManager
+    from tpukube.metrics import MetricsServer, render_plugin_metrics
+    from tpukube.obs.events import EventJournal
+    from tpukube.obs.health import HealthSampler
+    from tpukube.plugin import DevicePluginServer, HealthWatcher
+
+    cfg = load_config(env={
+        "TPUKUBE_DEVICE_PLUGIN_DIR": str(tmp_path),
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with TpuDeviceManager(cfg) as device, \
+            DevicePluginServer(cfg, device) as server:
+        journal = EventJournal()
+        server.events = journal
+        sampler = HealthSampler(device, journal=journal, poll_seconds=999)
+        watcher = HealthWatcher(device, server, poll_seconds=999)
+        sampler.check_once()
+        device.inject_fault(0)
+        sampler.check_once()
+        watcher.check_once()
+        ms = MetricsServer(lambda: render_plugin_metrics(
+            server, health=watcher, sampler=sampler, events=journal,
+        ))
+        ms.start()
+        try:
+            text = _scrape(f"http://127.0.0.1:{ms.port}/metrics")
+        finally:
+            ms.stop()
+    errors = validate_exposition(text)
+    assert errors == [], "\n".join(errors)
+    names = {s.name for s in parse_metrics(text)}
+    assert "tpukube_chip_healthy" in names
+    assert "tpukube_chip_ici_link_errors_total" in names
+    assert "tpukube_plugin_devices" in names

@@ -1,0 +1,253 @@
+"""SLO layer (ISSUE 2 tentpole): metrics parser, burn-rate math,
+exposition lint, and the prometheus-rules.yaml <-> registry contract."""
+
+import os
+
+import yaml
+
+from tpukube.core.config import load_config
+from tpukube.obs.registry import DEFAULT_BUCKETS
+from tpukube.obs.slo import (
+    DEFAULT_SLOS,
+    burn_rate,
+    evaluate,
+    histogram_totals,
+    parse_metrics,
+    referenced_metric_names,
+    validate_exposition,
+)
+
+DEPLOY = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "deploy"
+)
+
+HIST = """\
+# TYPE lat_seconds_bucket counter
+lat_seconds_bucket{le="0.25"} 90
+lat_seconds_bucket{le="2.5"} 99
+lat_seconds_bucket{le="+Inf"} 100
+"""
+
+
+# -- parser / math -----------------------------------------------------------
+
+def test_parse_metrics_labels_and_escapes():
+    samples = parse_metrics(
+        'm{source="table (err \\"quoted\\"\\nline\\\\x)"} 1\n'
+        "plain 2.5\n"
+    )
+    assert samples[0].label("source") == 'table (err "quoted"\nline\\x)'
+    assert samples[1].name == "plain" and samples[1].value == 2.5
+    try:
+        parse_metrics("not a metric line !!!\n")
+        assert False, "junk must raise"
+    except ValueError:
+        pass
+
+
+def test_histogram_totals_and_burn_rate():
+    samples = parse_metrics(HIST)
+    good, total = histogram_totals(samples, "lat_seconds", "2.5")
+    assert (good, total) == (99.0, 100.0)
+    # 1% errors on a 1% budget = burn 1.0
+    assert burn_rate(good, total, objective=0.99) == 1.0
+    # 10% errors on a 1% budget = burn 10
+    good, total = histogram_totals(samples, "lat_seconds", "0.25")
+    assert burn_rate(good, total, objective=0.99) == 10.0
+    # no traffic is not a burning SLO
+    assert burn_rate(0, 0, objective=0.99) is None
+
+
+def test_evaluate_single_snapshot_and_window_delta():
+    text = HIST.replace("lat_seconds", "gang_schedule_latency_seconds")
+    result = evaluate(text)
+    gang = result["gang-schedule-latency"]
+    assert gang["total"] == 100.0
+    assert gang["error_ratio"] == 0.01
+    assert gang["burn_rate"] == 1.0
+    assert gang["window"] == "lifetime"
+    assert gang["alerts"] == []  # burn 1.0 pages nobody
+
+    # a second snapshot where every NEW observation missed the bucket:
+    # windowed burn = 100% errors / 1% budget = 100 -> page + ticket
+    later = text.replace('le="2.5"} 99', 'le="2.5"} 99').replace(
+        'le="+Inf"} 100', 'le="+Inf"} 110'
+    )
+    result = evaluate(later, prev_text=text, window_seconds=60)
+    gang = result["gang-schedule-latency"]
+    assert gang["window"] == "60s"
+    assert gang["total"] == 10.0 and gang["good"] == 0.0
+    assert gang["burn_rate"] == 100.0
+    assert gang["alerts"] == ["page", "ticket"]
+
+
+def test_slo_thresholds_are_real_bucket_boundaries():
+    """A threshold_le that is not a rendered bucket boundary would make
+    histogram_totals silently count zero good events."""
+    boundaries = {f"{b:g}" for b in DEFAULT_BUCKETS}
+    for slo in DEFAULT_SLOS:
+        assert slo.threshold_le in boundaries, slo.name
+
+
+# -- exposition lint ---------------------------------------------------------
+
+def test_validate_exposition_accepts_real_pages():
+    assert validate_exposition(HIST) == []
+
+
+def test_validate_exposition_catches_violations():
+    assert any("duplicate series" in e for e in validate_exposition(
+        "# TYPE x counter\nx 1\nx 2\n"
+    ))
+    assert any("duplicate TYPE" in e for e in validate_exposition(
+        "# TYPE x counter\n# TYPE x counter\nx 1\n"
+    ))
+    assert any("after its samples" in e for e in validate_exposition(
+        "x 1\n# TYPE x counter\n"
+    ))
+    assert any("re-opened" in e for e in validate_exposition(
+        "# TYPE a counter\n# TYPE b counter\na 1\nb 1\na{l=\"2\"} 2\n"
+    ))
+    assert any("bad label syntax" in e for e in validate_exposition(
+        'm{key=unquoted} 1\n'
+    ))
+    assert any("le label" in e for e in validate_exposition(
+        "# TYPE h histogram\nh_bucket 1\n"
+    ))
+    assert any("quantile" in e for e in validate_exposition(
+        "# TYPE s summary\ns 1\n"
+    ))
+
+
+# -- prometheus-rules.yaml contract ------------------------------------------
+
+def _rendered_sample_names() -> set:
+    """Every sample name the two daemons' registries actually render,
+    with all optional loops/telemetry attached."""
+    from types import SimpleNamespace
+
+    from tpukube.device import TpuDeviceManager
+    from tpukube.metrics import (
+        render_extender_metrics,
+        render_plugin_metrics,
+    )
+    from tpukube.obs.events import EventJournal
+    from tpukube.obs.health import HealthSampler
+    from tpukube.plugin import DevicePluginServer
+    from tpukube.sched.extender import Extender
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    ext = Extender(cfg)
+    ext.events.emit("GangCommitted", obj="gang/x")
+    evictions = SimpleNamespace(
+        depth=lambda: 0, evicted=0, blocked=0, failures=0,
+        oldest_age_seconds=lambda now=None: 0.0,
+    )
+    reconcile = SimpleNamespace(reconciled=0)
+    node_refresh = SimpleNamespace(refreshed=0)
+    lifecycle = SimpleNamespace(released=0)
+    text = render_extender_metrics(
+        ext, reconcile=reconcile, evictions=evictions,
+        node_refresh=node_refresh, lifecycle=lifecycle,
+    )
+    names = {s.name for s in parse_metrics(text)}
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        node_cfg = load_config(env={
+            "TPUKUBE_DEVICE_PLUGIN_DIR": td,
+            "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+            "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        })
+        with TpuDeviceManager(node_cfg) as device, \
+                DevicePluginServer(node_cfg, device) as server:
+            journal = EventJournal()
+            sampler = HealthSampler(device, journal=journal,
+                                    poll_seconds=999)
+            sampler.check_once()
+            text = render_plugin_metrics(
+                server, sampler=sampler, events=journal,
+            )
+    names |= {s.name for s in parse_metrics(text)}
+    return names
+
+
+def test_prometheus_rules_reference_only_rendered_series():
+    """ISSUE 2 acceptance: every metric name in
+    deploy/prometheus-rules.yaml expressions must be a series the
+    registries actually render — a renamed series fails here instead of
+    silently blinding the alerts."""
+    with open(os.path.join(DEPLOY, "prometheus-rules.yaml")) as f:
+        (doc,) = list(yaml.safe_load_all(f))
+    assert doc["kind"] == "PrometheusRule"
+    rendered = _rendered_sample_names()
+    exprs = [
+        rule["expr"]
+        for group in doc["spec"]["groups"]
+        for rule in group["rules"]
+    ]
+    assert exprs, "rules file must define rules"
+    for expr in exprs:
+        for name in referenced_metric_names(expr):
+            assert name in rendered, (
+                f"rule references {name!r}, which no registry renders; "
+                f"expr: {expr}"
+            )
+    # the burn-rate rules encode the same thresholds DEFAULT_SLOS uses
+    text = str(exprs)
+    for slo in DEFAULT_SLOS:
+        assert f'le="{slo.threshold_le}"' in text, slo.name
+
+
+def test_slo_cli_snapshot_mode(tmp_path, capsys):
+    import json
+
+    from tpukube import cli
+
+    snap = tmp_path / "metrics.txt"
+    snap.write_text(
+        HIST.replace("lat_seconds", "gang_schedule_latency_seconds")
+    )
+    rc = cli.main_obs(["slo", "--snapshot", str(snap)])
+    assert rc == 0  # burn 1.0 does not page
+    out = json.loads(capsys.readouterr().out)
+    assert out["gang-schedule-latency"]["burn_rate"] == 1.0
+    # no bind traffic in the snapshot: burn is None, not a crash
+    assert out["bind-webhook-latency"]["burn_rate"] is None
+
+
+def test_slo_cli_live_scrape():
+    """`tpukube-obs slo --url` against a live extender /metrics — the
+    acceptance path scenario 7 exercises via the library."""
+    import io
+    import json
+    import sys
+
+    from tpukube import cli
+    from tpukube.core.types import PodGroup
+    from tpukube.sim import SimCluster
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        group = PodGroup("g", min_member=4)
+        for i in range(4):
+            c.schedule(c.make_pod(f"g-{i}", tpu=1, group=group))
+        buf = io.StringIO()
+        stdout, sys.stdout = sys.stdout, buf
+        try:
+            rc = cli.main_obs(["slo", "--url", f"{c.base_url}/metrics"])
+        finally:
+            sys.stdout = stdout
+    assert rc in (0, 1)  # 1 only if the sim run burned at page rate
+    out = json.loads(buf.getvalue())
+    gang = out["gang-schedule-latency"]
+    assert gang["total"] >= 1
+    assert gang["burn_rate"] is not None
+    assert gang["window"] == "lifetime"

@@ -1,0 +1,383 @@
+"""Fleet health telemetry + structured event journal (ISSUE 2 tentpole):
+sampler transitions, per-chip /metrics series, health-summary
+annotations, extender fleet rollup, event journal seams, CLI."""
+
+import json
+import urllib.request
+
+from tpukube.core import codec
+from tpukube.core.config import load_config
+from tpukube.core.types import Health, NodeInfo, PodGroup
+from tpukube.obs.events import EventJournal, filter_events
+from tpukube.obs.health import HealthSampler
+from tpukube.sim import SimCluster
+
+
+def _node_cfg(tmp_path):
+    return load_config(env={
+        "TPUKUBE_DEVICE_PLUGIN_DIR": str(tmp_path),
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+
+
+# -- sampler -----------------------------------------------------------------
+
+def test_sampler_detects_chip_and_link_transitions(tmp_path):
+    from tpukube.device import TpuDeviceManager
+
+    journal = EventJournal()
+    with TpuDeviceManager(_node_cfg(tmp_path)) as device:
+        sampler = HealthSampler(device, journal=journal, poll_seconds=999)
+        assert sampler.check_once() is False  # baseline, no flip
+        assert sampler.state_counts()["healthy"] == 4
+
+        device.inject_fault(0)
+        assert sampler.check_once() is True
+        assert sampler.state_counts()["unhealthy"] == 1
+
+        device.inject_link_fault((0, 0, 0), (1, 0, 0))
+        assert sampler.check_once() is True
+        # chip 0 stays unhealthy (dominates); chip 1 degrades
+        counts = sampler.state_counts()
+        assert counts["unhealthy"] == 1 and counts["degraded"] >= 1
+
+        device.inject_fault(0, healthy=True)
+        device.inject_link_fault((0, 0, 0), (1, 0, 0), up=True)
+        assert sampler.check_once() is True
+        assert sampler.state_counts() == {
+            "healthy": 4, "degraded": 0, "unhealthy": 0,
+        }
+
+    reasons = [e["reason"] for e in journal.events()]
+    assert "ChipUnhealthy" in reasons
+    assert "ChipRecovered" in reasons
+    assert "LinkFault" in reasons
+    assert "LinkRecovered" in reasons
+    # telemetry counters moved: the faulted link accumulated errors
+    status = sampler.telemetry_status()
+    assert status["samples"] == 4
+    errs = {c["device"]: c["ici_link_errors"] for c in status["chips"]}
+    assert errs["tpu-0"] >= 1 and errs["tpu-1"] >= 1
+
+
+def test_plugin_metrics_carry_per_chip_series(tmp_path):
+    from tpukube.device import TpuDeviceManager
+    from tpukube.metrics import render_plugin_metrics
+    from tpukube.obs.slo import validate_exposition
+    from tpukube.plugin import DevicePluginServer
+
+    cfg = _node_cfg(tmp_path)
+    with TpuDeviceManager(cfg) as device, \
+            DevicePluginServer(cfg, device) as server:
+        journal = EventJournal()
+        sampler = HealthSampler(device, journal=journal, poll_seconds=999)
+        sampler.check_once()
+        device.inject_fault(2)
+        sampler.check_once()
+        text = render_plugin_metrics(server, sampler=sampler,
+                                     events=journal)
+    # one series per chip for every telemetry family, HELP opt-in
+    assert '# HELP tpukube_chip_healthy ' in text
+    assert 'tpukube_chip_healthy{chip="tpu-0"} 1\n' in text
+    assert 'tpukube_chip_healthy{chip="tpu-2"} 0\n' in text
+    assert 'tpukube_chip_duty_cycle_percent{chip="tpu-1"}' in text
+    assert 'tpukube_chip_hbm_total_bytes{chip="tpu-3"}' in text
+    assert 'tpukube_chip_ici_link_errors_total{chip="tpu-0"} 0\n' in text
+    assert ('tpukube_chip_health_transitions_total{chip="tpu-2"} 1\n'
+            in text)
+    assert 'tpukube_node_chips{state="unhealthy"} 1\n' in text
+    assert 'tpukube_node_chips{state="healthy"} 3\n' in text
+    assert 'tpukube_events_total{reason="ChipUnhealthy"} 1\n' in text
+    # and the whole page still lints clean
+    assert validate_exposition(text) == []
+
+
+def test_plugin_statusz_telemetry_section(tmp_path):
+    from tpukube.device import TpuDeviceManager
+    from tpukube.obs.statusz import plugin_statusz
+    from tpukube.plugin import DevicePluginServer
+
+    cfg = _node_cfg(tmp_path)
+    with TpuDeviceManager(cfg) as device, \
+            DevicePluginServer(cfg, device) as server:
+        journal = EventJournal()
+        sampler = HealthSampler(device, journal=journal, poll_seconds=999)
+        sampler.check_once()
+        device.inject_fault(1)
+        sampler.check_once()
+        doc = plugin_statusz(server, device=device, sampler=sampler,
+                             events=journal)
+    telem = doc["telemetry"]
+    assert telem["samples"] == 2
+    assert telem["states"] == {"healthy": 3, "degraded": 0, "unhealthy": 1}
+    by_dev = {c["device"]: c for c in telem["chips"]}
+    assert by_dev["tpu-1"]["state"] == "unhealthy"
+    assert by_dev["tpu-0"]["duty_cycle_avg_percent"] > 0
+    assert doc["events"]["by_reason"] == {"ChipUnhealthy": 1}
+    json.dumps(doc)  # whole document must stay JSON-able
+
+
+# -- health-summary annotation + fleet rollup --------------------------------
+
+def test_health_summary_annotation_roundtrip():
+    from tpukube.core.types import ChipInfo, TopologyCoord, canonical_link
+
+    chips = [
+        ChipInfo("c0", 0, TopologyCoord(0, 0, 0), 1 << 30),
+        ChipInfo("c1", 1, TopologyCoord(1, 0, 0), 1 << 30),
+        ChipInfo("c2", 2, TopologyCoord(0, 1, 0), 1 << 30,
+                 health=Health.UNHEALTHY),
+    ]
+    node = NodeInfo(
+        name="host-0-0-0", chips=chips,
+        bad_links=[canonical_link((0, 0, 0), (1, 0, 0))],
+    )
+    summary = codec.health_summary(node)
+    assert summary["healthy"] == 0  # both healthy chips touch the link
+    assert summary["degraded"] == 2
+    assert summary["unhealthy"] == 1
+    assert summary["badLinks"] == 1
+    assert summary["chips"]["tpu-2"] == "unhealthy"
+    decoded = codec.decode_health_summary(
+        codec.encode_health_summary(summary)
+    )
+    assert decoded == summary
+    # annotate_node ships both annotations together
+    from tpukube.core.mesh import MeshSpec
+
+    mesh = MeshSpec(dims=(2, 2, 1), host_block=(2, 2, 1))
+    annos = codec.annotate_node(node, mesh)
+    assert codec.ANNO_NODE_TOPOLOGY in annos
+    assert codec.ANNO_HEALTH_SUMMARY in annos
+
+
+def test_extender_fleet_rollup_reflects_faults():
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    from tpukube.obs.statusz import extender_statusz
+
+    with SimCluster(cfg) as c:
+        c.schedule(c.make_pod("p", tpu=1))
+        doc = extender_statusz(c.extender)
+        assert doc["fleet"]["total"]["chips"] == 16
+        assert doc["fleet"]["total"]["healthy"] == 16
+        assert doc["fleet"]["degraded_slices"] == []
+
+        c.inject_fault("host-0-0-0", 0)
+        c.inject_link_fault((2, 0, 0), (3, 0, 0))
+        # push the refreshed annotations the way the syncer would
+        for obj in c.node_objects():
+            c.extender.handle("upsert_node", {
+                "name": obj["metadata"]["name"],
+                "annotations": obj["metadata"]["annotations"],
+            })
+        doc = extender_statusz(c.extender)
+        total = doc["fleet"]["total"]
+        assert total["unhealthy"] == 1
+        assert total["degraded"] == 2  # both endpoints of the link
+        assert total["healthy"] == 13
+        assert total["links_down"] == 1
+        assert doc["fleet"]["degraded_slices"] == ["slice-0"]
+
+
+# -- event journal -----------------------------------------------------------
+
+def test_event_journal_dedup_ring_and_filters(tmp_path):
+    sink = tmp_path / "events.jsonl"
+    j = EventJournal(capacity=4, path=str(sink))
+    for _ in range(3):
+        j.emit("ChipUnhealthy", obj="chip/tpu-0", message="went down",
+               type="Warning", node="host-0-0-0")
+    j.emit("GangCommitted", obj="gang/default/g", message="4 members")
+    evs = j.events()
+    assert len(evs) == 2  # deduped
+    assert evs[0]["count"] == 3
+    assert evs[0]["last_ts"] >= evs[0]["first_ts"]
+    # filters
+    assert [e["reason"] for e in j.events(reason="GangCommitted")] == [
+        "GangCommitted"
+    ]
+    assert j.events(node="host-0-0-0")[0]["reason"] == "ChipUnhealthy"
+    assert j.events(node="elsewhere") == []
+    assert j.counts_by_reason() == {
+        "ChipUnhealthy": 3, "GangCommitted": 1,
+    }
+    # ring bound: flood evicts the oldest and forgets its dedup key
+    for i in range(10):
+        j.emit("LinkFault", obj=f"chip/tpu-{i}", message="x")
+    assert len(j.events()) == 4
+    j.close()
+    # the sink kept every emission (count rides each line)
+    from tpukube.obs import events as events_mod
+
+    lines = events_mod.load(str(sink))
+    assert len(lines) == 14
+    assert filter_events(lines, reason="ChipUnhealthy")[-1]["count"] == 3
+
+
+def test_event_journal_disabled_is_noop():
+    j = EventJournal(capacity=0)
+    assert j.emit("X", obj="y") is None
+    assert j.events() == []
+    assert j.stats()["enabled"] is False
+
+
+def test_gang_lifecycle_emits_events():
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        group = PodGroup("g", min_member=4)
+        for i in range(4):
+            c.schedule(c.make_pod(f"g-{i}", tpu=1, group=group))
+        reasons = c.extender.events.counts_by_reason()
+        assert reasons.get("GangReserved") == 1
+        assert reasons.get("GangCommitted") == 1
+
+
+def test_preemption_emits_planned_executed_and_victims():
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        for i in range(4):
+            c.schedule(c.make_pod(f"low-{i}", tpu=1, priority=0))
+        group = PodGroup("big", min_member=4)
+        for i in range(4):
+            c.schedule(c.make_pod(f"big-{i}", tpu=1, priority=100,
+                                  group=group))
+        reasons = c.extender.events.counts_by_reason()
+        assert reasons.get("PreemptionPlanned", 0) >= 1
+        assert reasons.get("PreemptionExecuted", 0) >= 1
+        assert reasons.get("VictimEvicted", 0) == 4
+        assert reasons.get("VictimGone", 0) == 4
+        assert reasons.get("GangCommitted", 0) == 1
+
+
+def test_extender_events_endpoint_filters():
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        group = PodGroup("g", min_member=4)
+        for i in range(4):
+            c.schedule(c.make_pod(f"g-{i}", tpu=1, group=group))
+        with urllib.request.urlopen(
+            f"{c.base_url}/events?reason=GangCommitted", timeout=5
+        ) as r:
+            evs = json.loads(r.read())
+        assert len(evs) == 1
+        assert evs[0]["object"] == "gang/default/g"
+        with urllib.request.urlopen(
+            f"{c.base_url}/events?reason=NoSuchReason", timeout=5
+        ) as r:
+            assert json.loads(r.read()) == []
+        # /statusz carries the journal summary too
+        with urllib.request.urlopen(f"{c.base_url}/statusz",
+                                    timeout=5) as r:
+            doc = json.loads(r.read())
+        assert doc["events"]["enabled"] is True
+        assert doc["events"]["by_reason"]["GangReserved"] == 1
+        assert any(e["reason"] == "GangCommitted"
+                   for e in doc["events"]["recent"])
+
+
+def test_events_cli_filters(tmp_path, capsys):
+    from tpukube import cli
+
+    sink = tmp_path / "events.jsonl"
+    j = EventJournal(path=str(sink))
+    j.emit("ChipUnhealthy", obj="chip/tpu-0", message="down",
+           type="Warning", node="host-0-0-0")
+    j.emit("GangCommitted", obj="gang/default/g", message="ok")
+    j.emit("VictimEvicted", obj="pod/default/low-1", message="preempted",
+           node="host-1-0-0")
+    j.close()
+
+    rc = cli.main_obs(["events", str(sink), "--reason", "ChipUnhealthy"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "ChipUnhealthy" in out and "GangCommitted" not in out
+
+    rc = cli.main_obs(["events", str(sink), "--pod", "default/low-1",
+                       "--json"])
+    assert rc == 0
+    lines = [json.loads(line)
+             for line in capsys.readouterr().out.splitlines()]
+    assert len(lines) == 1 and lines[0]["reason"] == "VictimEvicted"
+
+    rc = cli.main_obs(["events", str(sink), "--node", "host-0-0-0"])
+    assert rc == 0
+    assert "ChipUnhealthy" in capsys.readouterr().out
+
+    # --since with a small value is relative to the newest event
+    rc = cli.main_obs(["events", str(sink), "--since", "3600"])
+    assert rc == 0
+    assert len(capsys.readouterr().out.splitlines()) == 3
+
+
+# -- the acceptance scenario -------------------------------------------------
+
+def test_fault_telemetry_scenario_end_to_end():
+    """ISSUE 2 acceptance: chip + link fault through the whole pipeline —
+    node-agent per-chip series, ChipUnhealthy then ChipRecovered in the
+    journal, extender fleet rollup reflecting the degraded slice, SLO
+    burn rates from a live scrape."""
+    from tpukube.sim import scenarios
+
+    r = scenarios.run(7, None)
+    assert r["transitions"] == {
+        "chip_fault": True, "link_fault": True, "recovery": True,
+    }
+    assert {"ChipUnhealthy", "ChipRecovered", "LinkFault",
+            "LinkRecovered"} <= set(r["event_reasons"])
+    assert r["chip_series_on_node_metrics"] >= 4 * 4  # 4 chips x families
+    assert r["fleet_degraded"]["unhealthy"] == 1
+    assert r["fleet_degraded"]["degraded"] == 2
+    assert r["fleet_degraded"]["links_down"] == 1
+    assert r["fleet_recovered"]["unhealthy"] == 0
+    assert r["fleet_recovered"]["degraded"] == 0
+    assert r["fleet_recovered"]["healthy"] == 16
+    for slo in r["slo"].values():
+        assert slo["total"] > 0
+        assert slo["burn_rate"] is not None
+    json.dumps(r)  # one JSON-able line for tpukube-sim 7
+
+
+def test_event_pod_filter_is_exact_not_substring():
+    """Review regression: --pod default/p1 must not leak default/p10's
+    events into the forensics."""
+    j = EventJournal()
+    j.emit("VictimEvicted", obj="pod/default/p1", message="a")
+    j.emit("VictimEvicted", obj="pod/default/p10", message="a")
+    j.emit("VictimGone", obj="pod/default/p1", message="b")
+    assert [e["object"] for e in j.events(pod="default/p1")] == [
+        "pod/default/p1", "pod/default/p1",
+    ]
+    assert [e["object"] for e in j.events(pod="default/p10")] == [
+        "pod/default/p10",
+    ]
+
+
+def test_event_sink_rotation_caps_file_size(tmp_path):
+    """Review follow-up: the event sink rotates at max_sink_bytes like
+    the trace sink — a flapping chip cannot fill the disk."""
+    import os
+
+    sink = tmp_path / "events.jsonl"
+    j = EventJournal(capacity=64, path=str(sink), max_sink_bytes=2048)
+    for i in range(100):
+        j.emit("LinkFault", obj=f"chip/tpu-{i}", message="flap")
+    j.close()
+    assert os.path.exists(f"{sink}.1")
+    assert os.path.getsize(sink) <= 2048 + 300
+    assert j.stats()["sink_rotations"] >= 1
+    from tpukube.obs import events as events_mod
+
+    assert events_mod.load(str(sink)), "live sink must still hold events"

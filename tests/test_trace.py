@@ -1,6 +1,7 @@
 """Decision trace + replay (SURVEY.md §6 tracing) and /state endpoints."""
 
 import json
+import threading
 import urllib.request
 
 import pytest
@@ -173,3 +174,70 @@ def test_trace_ring_bounded():
     evs = t.events()
     assert len(evs) == 4
     assert evs[-1]["seq"] == 10
+
+
+def test_trace_sink_rotation_caps_file_size(tmp_path):
+    """ISSUE 2 satellite: the JSONL sink rotates at max_sink_bytes
+    (one <path>.1 generation) instead of growing without bound."""
+    import os
+
+    path = tmp_path / "trace.jsonl"
+    t = trace_mod.DecisionTrace(capacity=16, path=str(path),
+                                max_sink_bytes=2048)
+    for i in range(200):
+        t.record("release", {"pod_key": f"ns/pod-{i:04d}"}, None)
+    t.close()
+    assert os.path.exists(f"{path}.1")
+    # both generations stay near the cap (one line of slack)
+    assert os.path.getsize(path) <= 2048 + 200
+    assert os.path.getsize(f"{path}.1") <= 2048 + 200
+    stats = t.stats()
+    assert stats["sink_rotations"] >= 1
+    # the LIVE file still loads and carries the newest events in order
+    evs = trace_mod.load(str(path))
+    assert evs, "post-rotation sink must hold events"
+    seqs = [e["seq"] for e in evs]
+    assert seqs == sorted(seqs)
+    assert seqs[-1] == 200
+
+
+def test_trace_sink_writes_stay_ordered_under_threads(tmp_path):
+    """Sink writes moved OUT of the ring lock's critical section: lines
+    must still land in seq order even with concurrent recorders."""
+    path = tmp_path / "trace.jsonl"
+    t = trace_mod.DecisionTrace(capacity=4096, path=str(path))
+    errs = []
+
+    def pound(start):
+        try:
+            for i in range(100):
+                t.record("release", {"pod_key": f"ns/p{start}-{i}"}, None)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=pound, args=(n,)) for n in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=30)
+    t.close()
+    assert not errs
+    seqs = [e["seq"] for e in trace_mod.load(str(path))]
+    assert len(seqs) == 400
+    assert seqs == sorted(seqs)
+
+
+def test_trace_load_skips_torn_final_line(tmp_path):
+    """A daemon that crashed mid-write leaves a torn last line; the
+    loader (and therefore tpukube-obs timeline and replay) must keep
+    the intact events."""
+    path = tmp_path / "trace.jsonl"
+    t = trace_mod.DecisionTrace(capacity=16, path=str(path))
+    for i in range(3):
+        t.record("release", {"pod_key": f"ns/p{i}"}, None)
+    t.close()
+    with open(path, "a") as f:
+        f.write('{"seq": 4, "kind": "rel')  # torn mid-write
+    evs = trace_mod.load(str(path))
+    assert [e["seq"] for e in evs] == [1, 2, 3]
+    assert trace_mod.replay(evs) == []

@@ -864,6 +864,13 @@ class _WatchLoop(_PollLoop):
         # connect and every delivered event — exported on /statusz.
         self._stream_connected = False
         self.last_event_time: Optional[float] = None
+        # optional EventJournal (obs/events.py), wired by the daemon
+        # main: a WatchReconnected event per stream re-establishment —
+        # frequent reconnects mean events are being missed in backoff
+        # windows, the first thing to check when releases lag
+        self.journal = None
+        self._connects = 0
+        self.reconnects = 0
 
     def _resync(self) -> tuple[bool, Optional[str]]:  # pragma: no cover
         raise NotImplementedError
@@ -897,6 +904,7 @@ class _WatchLoop(_PollLoop):
                              and self._thread.is_alive()),
             "stream_connected": self._stream_connected,
             "last_event_ts": self.last_event_time,
+            "reconnects": self.reconnects,
         }
 
     def _list_pods_rv(
@@ -936,6 +944,21 @@ class _WatchLoop(_PollLoop):
                 # happens immediately below
                 self._stream_connected = True
                 self.last_event_time = time.time()
+                self._connects += 1
+                if self._connects > 1:
+                    self.reconnects += 1
+                    if self.journal is not None:
+                        try:
+                            self.journal.emit(
+                                "WatchReconnected",
+                                obj=f"watch/{self._name}",
+                                message=f"stream re-established "
+                                        f"(reconnect #{self.reconnects}); "
+                                        f"resync covered the gap",
+                            )
+                        except Exception:
+                            log.exception("event emit failed: "
+                                          "WatchReconnected")
                 try:
                     for etype, pod in gen:
                         if self._stop.is_set():

@@ -178,12 +178,28 @@ def main_plugin(argv: Optional[list[str]] = None) -> int:
         # initial emit BEFORE the watcher starts: exactly one writer at a
         # time touches the annotation file
         write_annotation()
+        # node-local observability: structured event journal + per-chip
+        # telemetry sampler (obs/events.py, obs/health.py). The sampler
+        # emits ChipUnhealthy/ChipRecovered/LinkFault events; the
+        # annotation refresh (incl. the health summary the extender's
+        # fleet rollup reads) stays on the HealthWatcher's transition
+        # hook — one writer, no duplicate rewrites.
+        from tpukube.obs.events import EventJournal
+        from tpukube.obs.health import HealthSampler
+
+        journal = EventJournal(capacity=cfg.events_capacity,
+                               path=cfg.events_path or None,
+                               max_sink_bytes=cfg.events_sink_max_bytes)
+        server.events = journal
+        sampler = HealthSampler(device, journal=journal)
+        sampler.start()
         watcher = HealthWatcher(device, server,
                                 on_transition=write_annotation)
         watcher.start()
         kubelet_watch = None
         if not args.no_register:
             kubelet_watch = KubeletSessionWatcher(server)
+            kubelet_watch.events = journal
 
         # (initial annotation already emitted above, before the watcher
         # started; transitions re-emit through the watcher hook)
@@ -211,12 +227,14 @@ def main_plugin(argv: Optional[list[str]] = None) -> int:
         metrics = MetricsServer(
             lambda: render_plugin_metrics(
                 server, health=watcher, kubelet_watch=kubelet_watch,
-                intent_watch=intent_watch,
+                intent_watch=intent_watch, sampler=sampler,
+                events=journal,
             ),
             port=args.metrics_port,
             statusz=lambda: plugin_statusz(
                 server, device=device, health=watcher,
                 kubelet_watch=kubelet_watch, intent_watch=intent_watch,
+                sampler=sampler, events=journal,
             ),
         )
         metrics.start()
@@ -245,6 +263,8 @@ def main_plugin(argv: Optional[list[str]] = None) -> int:
             if kubelet_watch is not None:
                 kubelet_watch.stop()
             watcher.stop()
+            sampler.stop()
+            journal.close()
             metrics.stop()
             server.stop()
     return 0
@@ -430,6 +450,11 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
         # watches once, fanning events to lifecycle + reconcile
         pod_informer = PodInformer(api, [lifecycle, reconcile],
                                    poll_seconds=cfg.health_poll_seconds)
+        # watch-stream reconnects land in the event journal: frequent
+        # WatchReconnected events mean DELETED events are being missed
+        # in backoff windows — the first thing to check when releases lag
+        node_refresh.journal = extender.events
+        pod_informer.journal = extender.events
         loops = [evictions, node_refresh, pod_informer]
         for loop in loops:
             loop.start()
@@ -474,6 +499,10 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
             stop_probe()
         for loop in loops:
             loop.stop()
+        # drain the capture sinks so a post-mortem read sees every event
+        if extender.trace is not None:
+            extender.trace.close()
+        extender.events.close()
     return 0
 
 
@@ -484,10 +513,13 @@ def main_sim(argv: Optional[list[str]] = None) -> int:
         "tpukube-sim",
         "run a BASELINE config scenario against the real control-plane stack",
     )
-    p.add_argument("scenario", type=int, choices=range(1, 7),
-                   help="BASELINE config number (1..5), or 6 = the "
+    p.add_argument("scenario", type=int, choices=range(1, 8),
+                   help="BASELINE config number (1..5), 6 = the "
                         "steady-state churn benchmark (completions -> "
-                        "release loop -> re-scheduling)")
+                        "release loop -> re-scheduling), 7 = fault "
+                        "telemetry (chip + ICI link faults through the "
+                        "telemetry pipeline: events, per-chip metrics, "
+                        "fleet rollup, SLO scrape)")
     args = p.parse_args(argv)
     cfg = _setup(args)
 
@@ -503,14 +535,17 @@ def main_sim(argv: Optional[list[str]] = None) -> int:
 # -- tpukube-obs -------------------------------------------------------------
 
 def main_obs(argv: Optional[list[str]] = None) -> int:
-    """Offline observability tooling over captured decision traces
-    (``tpukube obs timeline <trace.jsonl>``): correlate a JSONL trace's
-    events into per-pod span chains and export Chrome trace-event JSON —
-    load the output in Perfetto (ui.perfetto.dev) or chrome://tracing to
-    see where each pod spent its time between filter and Allocate."""
+    """Offline observability tooling: ``timeline`` converts a JSONL
+    decision trace to Chrome trace-event JSON (Perfetto-loadable
+    per-pod scheduling timelines); ``events`` queries a structured
+    event-journal capture (events_path sink, or an /events dump saved
+    one JSON object per line) with pod/node/reason/since filters;
+    ``slo`` evaluates the burn-rate SLOs against a live /metrics
+    endpoint or a captured snapshot."""
     p = argparse.ArgumentParser(
         prog="tpukube-obs",
-        description="offline observability tooling (timeline export)",
+        description="offline observability tooling "
+                    "(timeline / events / slo)",
     )
     sub = p.add_subparsers(dest="cmd", required=True)
     tp = sub.add_parser(
@@ -523,21 +558,100 @@ def main_obs(argv: Optional[list[str]] = None) -> int:
                     help="output file ('-' = stdout)")
     tp.add_argument("--stats", action="store_true",
                     help="also print per-phase timing stats (JSON) to stderr")
+
+    ep = sub.add_parser(
+        "events",
+        help="query a JSONL event-journal capture (events_path sink)",
+    )
+    ep.add_argument("events_file")
+    ep.add_argument("--pod", default=None, help="filter by pod key")
+    ep.add_argument("--node", default=None, help="filter by node name")
+    ep.add_argument("--reason", default=None,
+                    help="filter by reason (e.g. ChipUnhealthy)")
+    ep.add_argument("--since", type=float, default=None, metavar="T",
+                    help="absolute unix timestamp, or (values < 1e9) "
+                         "seconds before the newest event in the capture")
+    ep.add_argument("--json", action="store_true", dest="as_json",
+                    help="one JSON object per event instead of text lines")
+
+    sp = sub.add_parser(
+        "slo",
+        help="evaluate the latency SLOs (burn rates) from /metrics",
+    )
+    src = sp.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", default=None,
+                     help="live /metrics endpoint to scrape")
+    src.add_argument("--snapshot", default=None, metavar="FILE",
+                     help="captured /metrics text to evaluate offline")
+    sp.add_argument("--window", type=float, default=0.0, metavar="SECONDS",
+                    help="with --url: scrape twice this far apart and "
+                         "report the windowed burn rate (0 = single "
+                         "scrape, lifetime burn)")
     args = p.parse_args(argv)
 
-    from tpukube import trace as trace_mod
-    from tpukube.obs import timeline
+    if args.cmd == "timeline":
+        from tpukube import trace as trace_mod
+        from tpukube.obs import timeline
 
-    events = trace_mod.load(args.trace_file)
-    if args.out == "-":
-        timeline.dump_chrome_trace(events, sys.stdout)
+        events = trace_mod.load(args.trace_file)
+        if args.out == "-":
+            timeline.dump_chrome_trace(events, sys.stdout)
+        else:
+            with open(args.out, "w") as f:
+                timeline.dump_chrome_trace(events, f)
+        if args.stats:
+            print(json.dumps(timeline.phase_stats(events), indent=2),
+                  file=sys.stderr)
+        return 0
+
+    if args.cmd == "events":
+        from tpukube.obs import events as events_mod
+
+        evs = events_mod.load(args.events_file)
+        since = args.since
+        if since is not None and since < 1e9:
+            newest = max(
+                (float(e.get("last_ts", 0)) for e in evs
+                 if isinstance(e, dict)), default=0.0,
+            )
+            since = newest - since
+        evs = events_mod.filter_events(
+            evs, reason=args.reason, pod=args.pod, node=args.node,
+            since=since,
+        )
+        for ev in evs:
+            if args.as_json:
+                print(json.dumps(ev, sort_keys=True))
+            else:
+                print(events_mod.format_event(ev))
+        return 0
+
+    # slo
+    import time as time_mod
+
+    from tpukube.obs import slo as slo_mod
+
+    def scrape(url: str) -> str:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.read().decode()
+
+    if args.snapshot:
+        with open(args.snapshot) as f:
+            text = f.read()
+        result = slo_mod.evaluate(text)
+    elif args.window > 0:
+        first = scrape(args.url)
+        time_mod.sleep(args.window)
+        second = scrape(args.url)
+        result = slo_mod.evaluate(second, prev_text=first,
+                                  window_seconds=args.window)
     else:
-        with open(args.out, "w") as f:
-            timeline.dump_chrome_trace(events, f)
-    if args.stats:
-        print(json.dumps(timeline.phase_stats(events), indent=2),
-              file=sys.stderr)
-    return 0
+        result = slo_mod.evaluate(scrape(args.url))
+    print(json.dumps(result, indent=2, sort_keys=True))
+    # exit non-zero when any SLO is burning at page rate, so the
+    # command composes into scripts/CI gates
+    burning = any(("page" in v["alerts"]) for v in result.values())
+    return 1 if burning else 0
 
 
 # -- tpukubectl --------------------------------------------------------------

@@ -36,6 +36,11 @@ ANNO_POD_GROUP = ANNO_PREFIX + "pod-group"
 ANNO_POD_GROUP_MIN_MEMBER = ANNO_PREFIX + "pod-group-min-member"
 ANNO_POD_GROUP_SHAPE = ANNO_PREFIX + "pod-group-shape"
 ANNO_POD_GROUP_ALLOW_DCN = ANNO_PREFIX + "pod-group-allow-dcn"
+# Compact per-node health summary (obs/health.py telemetry): refreshed
+# alongside node-topology on every health/link transition so the
+# extender can roll up fleet health per ICI slice without re-walking
+# every chip entry of every annotation.
+ANNO_HEALTH_SUMMARY = ANNO_PREFIX + "health-summary"
 
 # Per-key projections of the bind-time gang env (the DCN coordination
 # contract TPU_KUBE_GANG_* — device/tpu.py ENV_GANG_*). The alloc
@@ -182,7 +187,61 @@ def decode_node_topology(payload: str) -> tuple[NodeInfo, MeshSpec]:
 
 
 def annotate_node(node: NodeInfo, mesh: MeshSpec) -> dict[str, str]:
-    return {ANNO_NODE_TOPOLOGY: encode_node_topology(node, mesh)}
+    return {
+        ANNO_NODE_TOPOLOGY: encode_node_topology(node, mesh),
+        ANNO_HEALTH_SUMMARY: encode_health_summary(health_summary(node)),
+    }
+
+
+# -- per-node health summary -------------------------------------------------
+
+def chip_health_states(node: NodeInfo) -> dict[str, str]:
+    """device id -> "healthy" | "degraded" | "unhealthy" for a node's
+    whole chips. Degraded = the chip itself is up but touches a downed
+    ICI link (its gang traffic rides a reduced path) — the state the
+    fleet rollup and the telemetry sampler must agree on, so it is
+    defined exactly once, here."""
+    bad_ends = {c for link in node.bad_links for c in link}
+    out: dict[str, str] = {}
+    for chip in node.chips:
+        if chip.health is not Health.HEALTHY:
+            out[chip.device_id()] = "unhealthy"
+        elif chip.coord in bad_ends:
+            out[chip.device_id()] = "degraded"
+        else:
+            out[chip.device_id()] = "healthy"
+    return out
+
+
+def health_summary(node: NodeInfo) -> dict:
+    """The compact summary document the node agent pushes upstream."""
+    states = chip_health_states(node)
+    return {
+        "v": SCHEMA_VERSION,
+        "node": node.name,
+        "slice": node.slice_id,
+        "healthy": sum(1 for s in states.values() if s == "healthy"),
+        "degraded": sum(1 for s in states.values() if s == "degraded"),
+        "unhealthy": sum(1 for s in states.values() if s == "unhealthy"),
+        "badLinks": len(node.bad_links),
+        "chips": states,
+    }
+
+
+def encode_health_summary(summary: dict) -> str:
+    return json.dumps(summary, separators=(",", ":"), sort_keys=True)
+
+
+def decode_health_summary(payload: str) -> dict:
+    try:
+        obj = json.loads(payload)
+    except json.JSONDecodeError as e:
+        raise CodecError(f"health-summary: bad JSON: {e}") from e
+    _check_version(obj, "health-summary")
+    for key in ("healthy", "degraded", "unhealthy"):
+        if not isinstance(obj.get(key), int):
+            raise CodecError(f"health-summary: missing/bad count {key!r}")
+    return obj
 
 
 def node_from_annotations(

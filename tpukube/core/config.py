@@ -46,6 +46,18 @@ class TpuKubeConfig:
     # where post-mortem replay depth matters more than extender RSS.
     trace_capacity: int = 4096
     trace_path: str = ""
+    # JSONL sink size cap: at the cap the file rotates once to
+    # <trace_path>.1 (0 = unlimited). Default 256 MiB — an incident
+    # capture left on overnight must not fill the node's disk.
+    trace_sink_max_bytes: int = 256 * 1024**2
+    # structured event journal (obs/events.py): bounded ring of typed
+    # "why did that happen" events (GangCommitted, ChipUnhealthy, ...)
+    # served on /statusz + /events; events_path streams them to JSONL
+    # for `tpukube-obs events`, size-capped like the trace sink.
+    # events_capacity 0 disables the journal.
+    events_capacity: int = 4096
+    events_path: str = ""
+    events_sink_max_bytes: int = 64 * 1024**2
 
     # Which ICI slice this node belongs to (multi-slice clusters name
     # their pod slices; coords are slice-local — SURVEY.md §3 ICI/DCN note)
@@ -156,4 +168,10 @@ def load_config(
             )
     if not cfg.slice_id:
         raise ValueError("slice_id must be non-empty")
+    if (cfg.trace_sink_max_bytes < 0 or cfg.events_capacity < 0
+            or cfg.events_sink_max_bytes < 0):
+        raise ValueError(
+            "trace_sink_max_bytes, events_capacity, and "
+            "events_sink_max_bytes must be >= 0"
+        )
     return cfg

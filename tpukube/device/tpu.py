@@ -31,6 +31,7 @@ from tpukube.core.types import (
     ChipInfo,
     Health,
     NodeInfo,
+    TopologyCoord,
     VtpuShare,
     canonical_link,
     make_device_id,
@@ -80,6 +81,10 @@ class TpuDeviceManager:
         self._config = config
         self._lock = threading.Lock()
         self._host = host or "host-0-0-0"
+        # telemetry state (telemetry_snapshot): sample tick for the sim
+        # synthesis + per-chip cumulative ICI link-error counters
+        self._telemetry_ticks = 0
+        self._link_error_counts: dict[int, int] = {}
         if config.backend == "sim":
             origin = None
             if config.sim_host_origin:
@@ -367,6 +372,58 @@ class TpuDeviceManager:
             assert best is not None
             chosen.append(best)
         return chosen
+
+    # -- telemetry ---------------------------------------------------------
+    def telemetry_snapshot(self) -> list:
+        """One per-chip telemetry sample set (obs.health.ChipTelemetry):
+        health, HBM occupancy, duty cycle, and a cumulative ICI
+        link-error counter. The sim backend SYNTHESIZES occupancy/duty
+        deterministically from (tick, chip index) — enough signal for
+        the sampler's rolling windows and the /metrics series to be
+        exercised end to end; the real backend reports zeros there
+        (libtpu exposes no public per-chip utilization counters) while
+        health and link errors stay truthful. Link errors accumulate
+        one count per poll per downed link endpoint on the chip — a
+        counter shaped like a real lane-error counter, so the
+        Prometheus rate() alert on it behaves identically on sim and
+        real clusters."""
+        from tpukube.obs.health import ChipTelemetry
+
+        chips = self.chips()
+        bad_ends: dict[TopologyCoord, int] = {}
+        for a, b in self._ti.link_faults():
+            for end in (TopologyCoord.of(a), TopologyCoord.of(b)):
+                bad_ends[end] = bad_ends.get(end, 0) + 1
+        sim = self._config.backend == "sim"
+        out: list[ChipTelemetry] = []
+        with self._lock:
+            self._telemetry_ticks += 1
+            tick = self._telemetry_ticks
+            for c in chips:
+                down = bad_ends.get(c.coord, 0)
+                if down:
+                    self._link_error_counts[c.index] = (
+                        self._link_error_counts.get(c.index, 0) + down
+                    )
+                if sim and c.health is Health.HEALTHY:
+                    duty = 55.0 + (tick * 7 + c.index * 13) % 40
+                    hbm_used = c.hbm_bytes * (
+                        35 + (tick * 3 + c.index * 5) % 50
+                    ) // 100
+                else:
+                    duty, hbm_used = 0.0, 0
+                out.append(ChipTelemetry(
+                    device_id=c.device_id(),
+                    index=c.index,
+                    coord=c.coord,
+                    health=c.health,
+                    hbm_total_bytes=c.hbm_bytes,
+                    hbm_used_bytes=hbm_used,
+                    duty_cycle_percent=duty,
+                    ici_link_errors=self._link_error_counts.get(c.index, 0),
+                    links_down=down,
+                ))
+        return out
 
     # -- health / faults ---------------------------------------------------
     def inject_fault(self, chip_index: int, healthy: bool = False) -> None:

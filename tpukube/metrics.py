@@ -22,6 +22,14 @@ Exported series (extender):
 
 Exported series (node agent):
   tpukube_plugin_allocations_total, tpukube_plugin_devices{health}
+  tpukube_chip_healthy{chip}, tpukube_chip_duty_cycle_percent{chip},
+  tpukube_chip_hbm_used_bytes{chip}, tpukube_chip_hbm_total_bytes{chip},
+  tpukube_chip_ici_link_errors_total{chip},
+  tpukube_chip_health_transitions_total{chip}, tpukube_node_chips{state}
+  (telemetry sampler — obs/health.py)
+
+Both daemons additionally export tpukube_events_total{reason} when an
+event journal (obs/events.py) is attached.
 """
 
 from __future__ import annotations
@@ -123,6 +131,9 @@ def build_extender_registry(extender, reconcile=None, evictions=None,
     if lifecycle is not None:
         reg.counter("tpukube_lifecycle_releases_total",
                     fn=lambda: lifecycle.released)
+    events = getattr(extender, "events", None)
+    if events is not None:
+        _add_events_counter(reg, events)
     return reg
 
 
@@ -136,12 +147,17 @@ def render_extender_metrics(extender, reconcile=None, evictions=None,
 
 
 def build_plugin_registry(server, health=None, kubelet_watch=None,
-                          intent_watch=None) -> Registry:
+                          intent_watch=None, sampler=None,
+                          events=None) -> Registry:
     """Registry for a DevicePluginServer (tpukube.plugin.server); pass
     the daemon's HealthWatcher / KubeletSessionWatcher /
     AllocIntentWatcher to export their transition counters (a flat
     watch-events counter while pods bind means intent steering is dead
-    and the kubelet is choosing chips unguided)."""
+    and the kubelet is choosing chips unguided). ``sampler`` is the
+    telemetry HealthSampler (obs/health.py): per-chip health / HBM /
+    duty-cycle gauges and ICI-link-error counters, one series per chip.
+    The telemetry families are NEW and opt into ``# HELP`` text; every
+    legacy family stays byte-identical (no HELP)."""
     from tpukube.obs.statusz import device_health_counts
 
     reg = Registry()
@@ -172,16 +188,96 @@ def build_plugin_registry(server, health=None, kubelet_watch=None,
     if intent_watch is not None:
         reg.counter("tpukube_plugin_intent_watch_events_total",
                     fn=lambda: intent_watch.watch_events)
+    if sampler is not None:
+        _add_telemetry_metrics(reg, sampler)
+    if events is not None:
+        _add_events_counter(reg, events)
     return reg
 
 
+def _add_events_counter(reg: Registry, events) -> None:
+    counter = reg.counter(
+        "tpukube_events_total",
+        help_text="Structured journal events by reason "
+                  "(GangCommitted, ChipUnhealthy, ...).")
+    # children for every reason seen so far; later reasons appear on
+    # the next render (renderers rebuild per scrape)
+    for reason in sorted(events.counts_by_reason()):
+        counter.labels(reason=reason).set_function(
+            lambda r=reason: events.counts_by_reason().get(r, 0))
+
+
+def _add_telemetry_metrics(reg: Registry, sampler) -> None:
+    """Per-chip telemetry families (pull-based over the sampler's latest
+    samples; children exist for every chip the sampler has seen)."""
+    healthy = reg.gauge(
+        "tpukube_chip_healthy",
+        help_text="1 while the chip serves traffic, 0 after a health "
+                  "fault (per-chip ListAndWatch health).")
+    duty = reg.gauge(
+        "tpukube_chip_duty_cycle_percent",
+        help_text="Instantaneous TensorCore duty cycle per chip "
+                  "(synthesized on the sim backend).")
+    hbm_used = reg.gauge(
+        "tpukube_chip_hbm_used_bytes",
+        help_text="HBM bytes in use per chip (synthesized on the sim "
+                  "backend).")
+    hbm_total = reg.gauge(
+        "tpukube_chip_hbm_total_bytes",
+        help_text="HBM capacity per chip.")
+    link_errs = reg.counter(
+        "tpukube_chip_ici_link_errors_total",
+        help_text="Cumulative ICI link-error count per chip; a non-zero "
+                  "rate means the chip is riding a degraded link.")
+    flips = reg.counter(
+        "tpukube_chip_health_transitions_total",
+        help_text="Health-state transitions observed per chip "
+                  "(healthy/degraded/unhealthy flips).")
+
+    def field(did: str, attr: str, default: float = 0.0):
+        def get() -> float:
+            t = sampler.sample(did)
+            return float(getattr(t, attr)) if t is not None else default
+        return get
+
+    for t in sampler.latest():
+        did = t.device_id
+        healthy.labels(chip=did).set_function(
+            lambda d=did: 1.0 if (
+                (s := sampler.sample(d)) is not None
+                and s.state != "unhealthy"
+            ) else 0.0
+        )
+        duty.labels(chip=did).set_function(
+            field(did, "duty_cycle_percent"))
+        hbm_used.labels(chip=did).set_function(field(did, "hbm_used_bytes"))
+        hbm_total.labels(chip=did).set_function(
+            field(did, "hbm_total_bytes"))
+        link_errs.labels(chip=did).set_function(
+            field(did, "ici_link_errors"))
+        flips.labels(chip=did).set_function(
+            lambda d=did: sampler.transition_count(d))
+    chips = reg.gauge(
+        "tpukube_node_chips",
+        help_text="This node's chips by health state (healthy / "
+                  "degraded = up but on a downed ICI link / unhealthy).")
+    for state in ("healthy", "degraded", "unhealthy"):
+        chips.labels(state=state).set_function(
+            lambda s=state: sampler.state_counts().get(s, 0))
+    reg.counter(
+        "tpukube_telemetry_samples_total",
+        fn=lambda: sampler.samples,
+        help_text="Telemetry polls taken by the node agent's sampler.")
+
+
 def render_plugin_metrics(server, health=None, kubelet_watch=None,
-                          intent_watch=None) -> str:
+                          intent_watch=None, sampler=None,
+                          events=None) -> str:
     """Prometheus text for a DevicePluginServer — see
     build_plugin_registry."""
     return build_plugin_registry(
         server, health=health, kubelet_watch=kubelet_watch,
-        intent_watch=intent_watch,
+        intent_watch=intent_watch, sampler=sampler, events=events,
     ).render()
 
 

@@ -1,13 +1,13 @@
-"""Unified observability layer (ISSUE 1 tentpole).
+"""Unified observability layer.
 
-Three subsystems, all control-plane-agnostic:
+Six subsystems, all control-plane-agnostic:
 
   * :mod:`tpukube.obs.registry` — a small metrics registry
-    (Counter/Gauge/Summary/Histogram with label sets) rendering
-    Prometheus text format. ``tpukube.metrics``'s renderers are built on
-    it; every legacy series name/label renders byte-identically, plus
-    new histogram ``_bucket`` series for the gang and webhook latency
-    distributions.
+    (Counter/Gauge/Summary/Histogram with label sets, opt-in ``# HELP``)
+    rendering Prometheus text format. ``tpukube.metrics``'s renderers
+    are built on it; every legacy series name/label renders
+    byte-identically, plus histogram ``_bucket`` series for the gang
+    and webhook latency distributions.
   * :mod:`tpukube.obs.timeline` — per-pod scheduling timelines:
     correlates DecisionTrace events (webhook decisions + span
     annotations) by pod key into span chains and exports Chrome
@@ -15,7 +15,21 @@ Three subsystems, all control-plane-agnostic:
   * :mod:`tpukube.obs.statusz` — /statusz JSON introspection documents
     for the extender daemon and the node agent: ledger/reservation
     summary, pending-eviction queue with ages, watch liveness with a
-    last-event timestamp, trace-ring stats, inventory source.
+    last-event timestamp, trace-ring stats, inventory source, fleet
+    health rollup per ICI slice.
+  * :mod:`tpukube.obs.health` — per-chip fleet telemetry: the node
+    agent's sampler loop over the device layer's
+    health/HBM/duty-cycle/ICI-link-error counters, rolling windows,
+    health-state transitions, per-chip /metrics series, and the
+    compact health summary the node annotation carries upstream.
+  * :mod:`tpukube.obs.events` — the structured "why did that happen"
+    journal: typed, deduplicated events (GangCommitted, ChipUnhealthy,
+    PreemptionPlanned, ...) in a bounded ring + JSONL sink, queryable
+    via /statusz, /events, and ``tpukube-obs events``.
+  * :mod:`tpukube.obs.slo` — SLO definitions over the latency
+    histograms with multi-window burn-rate math (``tpukube-obs slo``,
+    deploy/prometheus-rules.yaml), plus the exposition-format parser
+    and lint the tier-1 format test runs over both daemons.
 """
 
 from tpukube.obs.registry import (  # noqa: F401

@@ -49,6 +49,12 @@ def escape_label_value(value) -> str:
             .replace("\n", "\\n"))
 
 
+def escape_help_text(text: str) -> str:
+    """# HELP docstring escaping (backslash and newline only, per the
+    exposition-format spec — quotes are legal in HELP)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def format_sample(name: str, value: float,
                   labels: Optional[dict[str, str]] = None) -> str:
     """One exposition line, identical to the legacy ``_fmt``."""
@@ -70,13 +76,20 @@ class Metric:
     ``emit_type=False`` suppresses the TYPE line (legacy quirk:
     ``tpukube_plugin_resource_info`` rides under the previous family's
     header; byte-compat keeps it that way).
+
+    ``help_text`` opts a family into a ``# HELP`` line before its TYPE.
+    Off by default: the pre-registry renderers never emitted HELP and
+    the byte-identical goldens must survive; new telemetry/event series
+    pass it explicitly.
     """
 
     kind = "untyped"
 
-    def __init__(self, name: str, emit_type: bool = True):
+    def __init__(self, name: str, emit_type: bool = True,
+                 help_text: Optional[str] = None):
         self.name = name
         self.emit_type = emit_type
+        self.help_text = help_text
         self._lock = threading.Lock()
 
     def samples(self) -> list[tuple[str, Optional[dict[str, str]], float]]:
@@ -84,6 +97,9 @@ class Metric:
 
     def render(self) -> str:
         out = []
+        if self.help_text:
+            out.append(f"# HELP {self.name} "
+                       f"{escape_help_text(self.help_text)}\n")
         if self.emit_type:
             out.append(f"# TYPE {self.name} {self.kind}\n")
         for name, labels, value in self.samples():
@@ -126,8 +142,8 @@ class _LabeledMetric(Metric):
     in creation order."""
 
     def __init__(self, name: str, fn: Optional[Callable[[], float]] = None,
-                 emit_type: bool = True):
-        super().__init__(name, emit_type=emit_type)
+                 emit_type: bool = True, help_text: Optional[str] = None):
+        super().__init__(name, emit_type=emit_type, help_text=help_text)
         self._self_child = _ValueChild(fn)
         # label-tuple -> child, insertion-ordered (emission order)
         self._children: dict[tuple[tuple[str, str], ...], _ValueChild] = {}
@@ -205,8 +221,8 @@ class _DistMetric(Metric):
 
     def __init__(self, name: str,
                  values_fn: Optional[Callable[[], Iterable[float]]] = None,
-                 emit_type: bool = True):
-        super().__init__(name, emit_type=emit_type)
+                 emit_type: bool = True, help_text: Optional[str] = None):
+        super().__init__(name, emit_type=emit_type, help_text=help_text)
         self._self_child = _DistChild(values_fn)
         self._has_unlabeled = values_fn is not None
         self._children: dict[tuple[tuple[str, str], ...], _DistChild] = {}
@@ -244,8 +260,10 @@ class Summary(_DistMetric):
     def __init__(self, name: str,
                  quantiles: Sequence[float] = (0.5, 0.9, 0.99),
                  values_fn: Optional[Callable[[], Iterable[float]]] = None,
-                 emit_count_sum: bool = True, emit_type: bool = True):
-        super().__init__(name, values_fn=values_fn, emit_type=emit_type)
+                 emit_count_sum: bool = True, emit_type: bool = True,
+                 help_text: Optional[str] = None):
+        super().__init__(name, values_fn=values_fn, emit_type=emit_type,
+                         help_text=help_text)
         self.quantiles = tuple(quantiles)
         self.emit_count_sum = emit_count_sum
 
@@ -318,8 +336,9 @@ class Histogram(Metric):
 
     def __init__(self, name: str,
                  buckets: Sequence[float] = DEFAULT_BUCKETS,
-                 bucket_only: bool = False, emit_type: bool = True):
-        super().__init__(name, emit_type=emit_type)
+                 bucket_only: bool = False, emit_type: bool = True,
+                 help_text: Optional[str] = None):
+        super().__init__(name, emit_type=emit_type, help_text=help_text)
         bs = sorted(float(b) for b in buckets)
         if not bs or bs[-1] != float("inf"):
             bs.append(float("inf"))
@@ -353,11 +372,16 @@ class Histogram(Metric):
 
     def render(self) -> str:
         out = []
+        # bucket_only: the family proper is already TYPEd (legacy
+        # summary); the bucket series get their own counter family
+        # header, so HELP must name that family too
+        family = f"{self.name}_bucket" if self.bucket_only else self.name
+        if self.help_text:
+            out.append(f"# HELP {family} "
+                       f"{escape_help_text(self.help_text)}\n")
         if self.emit_type:
             if self.bucket_only:
-                # the family proper is already TYPEd (legacy summary);
-                # the bucket series get their own counter family header
-                out.append(f"# TYPE {self.name}_bucket counter\n")
+                out.append(f"# TYPE {family} counter\n")
             else:
                 out.append(f"# TYPE {self.name} {self.kind}\n")
         for name, labels, value in self.samples():

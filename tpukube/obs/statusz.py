@@ -39,6 +39,64 @@ def watch_status(loop) -> dict[str, Any]:
     return {"configured": True, "name": getattr(loop, "_name", "?")}
 
 
+def fleet_health(extender) -> dict[str, Any]:
+    """Fleet health rolled up per ICI slice: healthy / degraded /
+    unhealthy chips (from the node agents' health-summary annotations,
+    falling back to the topology annotation's chip health for agents
+    that predate the summary) plus the terminating-victim chip count —
+    healthy hardware a dying container still physically owns, the third
+    state an operator sizing spare capacity must see. ``degraded``
+    means the chip is up but touches a downed ICI link
+    (codec.chip_health_states — the ONE classification the sampler,
+    the annotation, and this rollup share)."""
+    from tpukube.core import codec
+
+    state, gang = extender.state, extender.gang
+    slices: dict[str, dict[str, Any]] = {}
+    for sid in state.slice_ids():
+        slices[sid] = {
+            "nodes": 0,
+            "chips": 0,
+            "healthy": 0,
+            "degraded": 0,
+            "unhealthy": 0,
+            # separate dimension, not a fourth chip state: terminating
+            # victims' chips are healthy but unplaceable until confirmed
+            "terminating": len(gang.terminating_coords(sid)),
+            "links_down": len(state.broken_links(sid)),
+        }
+    for name in state.node_names():
+        view = state.node(name)
+        if view is None:
+            continue
+        s = slices.get(view.info.slice_id)
+        if s is None:
+            continue
+        s["nodes"] += 1
+        s["chips"] += len(view.info.chips)
+        summary = view.health_summary
+        if summary is not None:
+            for key in ("healthy", "degraded", "unhealthy"):
+                s[key] += int(summary.get(key, 0))
+        else:
+            for st in codec.chip_health_states(view.info).values():
+                s[st] += 1
+    totals = {
+        k: sum(s[k] for s in slices.values())
+        for k in ("nodes", "chips", "healthy", "degraded", "unhealthy",
+                  "terminating", "links_down")
+    }
+    return {
+        "slices": slices,
+        "total": totals,
+        "degraded_slices": sorted(
+            sid for sid, s in slices.items()
+            if s["degraded"] or s["unhealthy"] or s["terminating"]
+            or s["links_down"]
+        ),
+    }
+
+
 def extender_statusz(
     extender, evictions=None, informer=None, node_refresh=None,
     lifecycle=None, reconcile=None,
@@ -86,7 +144,17 @@ def extender_statusz(
         "node_watch": watch_status(node_refresh),
         "trace": (extender.trace.stats() if extender.trace is not None
                   else {"enabled": False}),
+        "fleet": fleet_health(extender),
     }
+    events = getattr(extender, "events", None)
+    if events is not None:
+        out["events"] = {
+            **events.stats(),
+            "by_reason": events.counts_by_reason(),
+            "recent": events.events(limit=20),
+        }
+    else:
+        out["events"] = {"enabled": False}
     if lifecycle is not None:
         out["lifecycle_releases"] = lifecycle.released
     if reconcile is not None:
@@ -96,9 +164,12 @@ def extender_statusz(
 
 def plugin_statusz(
     server, device=None, health=None, kubelet_watch=None, intent_watch=None,
+    sampler=None, events=None,
 ) -> dict[str, Any]:
     """The node agent's introspection document (served by its
-    MetricsServer on /statusz)."""
+    MetricsServer on /statusz). ``sampler`` is the telemetry
+    HealthSampler (per-chip states + rolling windows); ``events`` the
+    node-local EventJournal."""
     dev = device if device is not None else server._device
     healthy, unhealthy = device_health_counts(dev)
     out: dict[str, Any] = {
@@ -121,4 +192,12 @@ def plugin_statusz(
         out["health_transitions"] = health.transitions
     if kubelet_watch is not None:
         out["kubelet_reregistrations"] = kubelet_watch.reregistrations
+    if sampler is not None:
+        out["telemetry"] = sampler.telemetry_status()
+    if events is not None:
+        out["events"] = {
+            **events.stats(),
+            "by_reason": events.counts_by_reason(),
+            "recent": events.events(limit=20),
+        }
     return out

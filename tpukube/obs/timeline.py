@@ -90,7 +90,12 @@ def correlate(events: Iterable[dict]) -> dict[str, list[dict]]:
     scoped events group under :data:`CLUSTER_TRACK`."""
     tracks: dict[str, list[dict]] = {}
     for ev in events:
-        if not isinstance(ev, dict) or "ts" not in ev:
+        # tolerate partial events: a crashed or still-pending pod's
+        # track may hold only span annotations (no bind/filter), and a
+        # torn capture may carry junk — neither must break the exporter
+        if not isinstance(ev, dict):
+            continue
+        if not isinstance(ev.get("ts"), (int, float)):
             continue
         key = event_pod_key(ev) or CLUSTER_TRACK
         tracks.setdefault(key, []).append(ev)

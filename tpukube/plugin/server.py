@@ -191,6 +191,22 @@ class DevicePluginServer(stubs.DevicePluginServicer):
         # harness does) and the per-pod timeline gains the node-agent leg
         # of the chain: filter -> gang_reserve -> bind -> allocate.
         self.span_sink = None
+        # structured event journal (obs/events.py), wired by the daemon
+        # main; the same seams as the span hooks emit typed events here
+        self.events = None
+
+    def _emit_event(self, reason: str, obj: str, message: str,
+                    warning: bool = True) -> None:
+        if self.events is None:
+            return
+        try:
+            self.events.emit(
+                reason, obj=obj, message=message,
+                type="Warning" if warning else "Normal",
+                node=self._device.host,
+            )
+        except Exception:
+            log.exception("event emit failed: %s %s", reason, obj)
 
     def _span(self, name: str, pod_key: str, **fields) -> None:
         if self.span_sink is None:
@@ -365,6 +381,11 @@ class DevicePluginServer(stubs.DevicePluginServicer):
                     "kubelet allocated %s but %s was planned %s — reporting",
                     sorted(ids), pod_key, sorted(planned),
                 )
+                self._emit_event(
+                    "AllocDiverged", f"pod/{pod_key}",
+                    f"kubelet allocated {sorted(ids)} but the plan was "
+                    f"{sorted(planned)}; reporting for reconcile",
+                )
                 if self._alloc_reporter is not None:
                     # off the kubelet's pod-start critical path: the report
                     # is an apiserver PATCH that may block seconds
@@ -496,6 +517,7 @@ class KubeletSessionWatcher:
         self._kubelet_ident = self._ident()
         self._needs_register = False
         self.reregistrations = 0  # metrics/tests
+        self.events = None  # optional EventJournal (daemon main wires it)
 
     def _ident(self) -> Optional[tuple[int, int, int]]:
         try:
@@ -557,6 +579,16 @@ class KubeletSessionWatcher:
         self._kubelet_ident = ident
         self._needs_register = False
         self.reregistrations += 1
+        if self.events is not None:
+            try:
+                self.events.emit(
+                    "KubeletReregistered",
+                    obj=f"node/{self._server._device.host}",
+                    message="kubelet restarted; plugin re-registered",
+                    node=self._server._device.host,
+                )
+            except Exception:
+                log.exception("event emit failed: KubeletReregistered")
         return True
 
     def _run(self) -> None:

@@ -81,8 +81,20 @@ class Extender:
             trace = DecisionTrace(
                 capacity=config.trace_capacity,
                 path=config.trace_path or None,
+                max_sink_bytes=config.trace_sink_max_bytes,
             )
         self.trace = trace
+        # structured event journal (obs/events.py): the "why did that
+        # happen" channel, fed by the gang manager and the preemption /
+        # bind paths here, served on /statusz + /events and the
+        # tpukube_events_total counter. capacity 0 disables.
+        from tpukube.obs.events import EventJournal
+
+        self.events = EventJournal(
+            capacity=config.events_capacity,
+            path=config.events_path or None,
+            max_sink_bytes=config.events_sink_max_bytes,
+        )
         # Cluster-wide eviction bus: pods whose chips were taken back
         # (gang rollback/dissolve, preemption) and must be deleted by the
         # pod-lifecycle owner (sim harness / apiserver writer).
@@ -91,6 +103,7 @@ class Extender:
             self.state,
             ttl_seconds=config.reservation_ttl_seconds,
             eviction_sink=self.pending_evictions,
+            events=self.events,
         )
         # Pods seen at filter time, so /bind (which only carries names) can
         # recover the request: key -> (pod, uid, seen_monotonic).
@@ -143,6 +156,17 @@ class Extender:
         # by bind() when a binder is set, consumed by _handle_bind's
         # effector undo
         self._bind_gang_info: dict[str, tuple[Any, bool]] = {}
+
+    def _emit_event(self, reason: str, obj: str, message: str,
+                    warning: bool = True) -> None:
+        """Journal an event; never let observability fail a webhook."""
+        try:
+            self.events.emit(
+                reason, obj=obj, message=message,
+                type="Warning" if warning else "Normal",
+            )
+        except Exception:
+            log.exception("event emit failed: %s %s", reason, obj)
 
     def _remember(self, pod: PodInfo) -> None:
         now = time.monotonic()
@@ -341,6 +365,13 @@ class Extender:
                             gang=f"{pod.namespace}/{pod.group.name}",
                             victims=len(victims), slices=sorted(split),
                         )
+                    self._emit_event(
+                        "PreemptionPlanned",
+                        f"gang/{pod.namespace}/{pod.group.name}",
+                        f"{len(victims)} victim workload(s) planned for a "
+                        f"DCN-split {total}-chip reservation "
+                        f"(deferred to first bind)",
+                    )
                     return self.gang.reserve_exact_split(
                         pod, count,
                         {sid: p.coords for sid, p in split.items()},
@@ -365,6 +396,13 @@ class Extender:
                 cost_priority_sum=plan.cost_priority_sum,
                 slices=[plan_slice],
             )
+        self._emit_event(
+            "PreemptionPlanned",
+            f"gang/{pod.namespace}/{pod.group.name}",
+            f"{plan.victim_count} victim workload(s), priority sum "
+            f"{plan.cost_priority_sum}, for a {total}-chip slice in "
+            f"{plan_slice} (deferred to first bind)",
+        )
         return self.gang.reserve_exact(
             pod, count, plan.coords, slice_id=plan_slice,
             pending_victims=plan.victims,
@@ -425,6 +463,12 @@ class Extender:
             "%d workload(s) / %d pod(s) evicted",
             res.namespace, res.group.name, len(victims), evicted_pods,
         )
+        self._emit_event(
+            "PreemptionExecuted",
+            f"gang/{res.namespace}/{res.group.name}",
+            f"{len(victims)} workload(s) / {evicted_pods} pod(s) evicted "
+            f"at the gang's first bind",
+        )
         if held:
             self.gang.register_terminating(res, held)
             raise ExtenderError(
@@ -482,6 +526,11 @@ class Extender:
                     if self.state.release(pk) is not None:
                         self.pending_evictions.append(pk)
                         evicted_pods += 1
+                        self._emit_event(
+                            "VictimEvicted", f"pod/{pk}",
+                            "released and queued for eviction "
+                            "(preempted by a higher-priority gang)",
+                        )
                     else:
                         held.pop(pk, None)  # vanished between plan and now
         return evicted_pods, held
@@ -1103,6 +1152,11 @@ class Extender:
             # claim it is. Preemption evictions already executed stand:
             # the victims were released either way.
             log.error("bind effector for %s failed: %s", key, e)
+            self._emit_event(
+                "BindFailed", f"pod/{key}",
+                f"apiserver bind failed after a successful ledger "
+                f"commit; undone for retry: {e}",
+            )
             with self._decision_lock:
                 # undo atomically w.r.t. other binds (which also hold the
                 # decision lock): a sibling member interleaving between
@@ -1462,6 +1516,22 @@ def make_app(
             raise web.HTTPBadRequest(text="since must be an integer")
         return web.json_response(extender.trace.events(since_seq=since))
 
+    async def events_handler(request: web.Request) -> web.Response:
+        # behind the bearer middleware: events name pods/gangs/victims
+        q = request.query
+        since: Any = None
+        if q.get("since"):
+            try:
+                since = float(q["since"])
+            except ValueError:
+                raise web.HTTPBadRequest(text="since must be a unix ts")
+        return web.json_response(extender.events.events(
+            reason=q.get("reason") or None,
+            pod=q.get("pod") or None,
+            node=q.get("node") or None,
+            since=since,
+        ))
+
     async def statusz_handler(request: web.Request) -> web.Response:
         # behind the bearer middleware like /state and /trace: the
         # pending-eviction queue and reservation summary disclose
@@ -1483,6 +1553,7 @@ def make_app(
     app.router.add_get("/state/allocs", state_allocs)
     app.router.add_get("/state/gangs", state_gangs)
     app.router.add_get("/trace", trace_handler)
+    app.router.add_get("/events", events_handler)
     app.router.add_get("/statusz", statusz_handler)
     return app
 

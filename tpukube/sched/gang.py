@@ -158,9 +158,12 @@ class GangManager:
     LATENCY_WINDOW = 4096
 
     def __init__(self, state: ClusterState, ttl_seconds: float = 30.0,
-                 eviction_sink: Optional[deque] = None):
+                 eviction_sink: Optional[deque] = None, events=None):
         self._state = state
         self._ttl = ttl_seconds
+        # structured event journal (obs/events.py), shared with the
+        # owning Extender; None = no journal (standalone/unit tests)
+        self._events = events
         self._lock = threading.RLock()
         self._reservations: dict[tuple[str, str], GangReservation] = {}
         # reservation-created -> committed durations (north-star p50 feed)
@@ -186,6 +189,21 @@ class GangManager:
         self._terminating_coords: dict[
             str, tuple[str, frozenset[TopologyCoord]]
         ] = {}
+
+    def _emit(self, reason: str, res_key: tuple[str, str], message: str,
+              warning: bool = False) -> None:
+        """One journal event about a gang (no-op without a journal;
+        never raises into the scheduling path)."""
+        if self._events is None:
+            return
+        try:
+            self._events.emit(
+                reason, obj=f"gang/{res_key[0]}/{res_key[1]}",
+                message=message,
+                type="Warning" if warning else "Normal",
+            )
+        except Exception:
+            log.exception("event emit failed: %s %s", reason, res_key)
 
     # -- views -------------------------------------------------------------
     def reservation(self, namespace: str, group_name: str) -> Optional[GangReservation]:
@@ -268,6 +286,7 @@ class GangManager:
                     )
                     log.warning("gang %s/%s rollback: %s", key[0], key[1], why)
                     self._rollback_locked(res)
+                    self._emit("GangRollback", key, why, warning=True)
                     rolled.append(key)
         return rolled
 
@@ -385,6 +404,11 @@ class GangManager:
                 "gang %s/%s reserved %d chips over %d slice(s)",
                 key[0], key[1], res.total_chips(), len(slice_coords),
             )
+            self._emit(
+                "GangReserved", key,
+                f"{res.total_chips()} chips over "
+                f"{len(slice_coords)} slice(s)",
+            )
             return res
 
     def _plan_dcn_split(
@@ -447,6 +471,11 @@ class GangManager:
                 "gang %s/%s dissolved by preemption (%d members evicted)",
                 key[0], key[1], len(evicted),
             )
+            self._emit(
+                "GangDissolved", key,
+                f"preempted; {len(evicted)} member(s) evicted",
+                warning=True,
+            )
             return evicted
 
     def restore(
@@ -476,6 +505,7 @@ class GangManager:
             def rollback_all(why: str) -> None:
                 log.warning("gang %s/%s: %s — rolling back",
                             namespace, group.name, why)
+                self._emit("GangRollback", key, why, warning=True)
                 for a in allocs:
                     # restored members may be RUNNING: mask their chips
                     # until the eviction confirms. Prefer the resolved
@@ -691,6 +721,12 @@ class GangManager:
                 key[0], key[1], res.total_chips(), len(parts),
                 len(pending_victims or ()),
             )
+            self._emit(
+                "GangReserved", key,
+                f"{res.total_chips()} chips over {len(parts)} slice(s) "
+                f"via preemption "
+                f"({len(pending_victims or ())} victim(s) pending)",
+            )
             return res
 
     def peek_pending_victims(self, res: GangReservation) -> list:
@@ -736,6 +772,15 @@ class GangManager:
         waiting on it. Returns True if anything was tracking the pod."""
         with self._lock:
             hit = self._terminating_coords.pop(pod_key, None) is not None
+            if hit and self._events is not None:
+                try:
+                    self._events.emit(
+                        "VictimGone", obj=f"pod/{pod_key}",
+                        message="eviction victim's pod object confirmed "
+                                "gone; its chips are placeable again",
+                    )
+                except Exception:
+                    log.exception("event emit failed: VictimGone %s", pod_key)
             for res in self._reservations.values():
                 if pod_key in res.terminating_victims:
                     res.terminating_victims.discard(pod_key)
@@ -915,6 +960,11 @@ class GangManager:
                     "gang %s/%s COMMITTED: %d members in %.3fs",
                     res.namespace, res.group.name,
                     len(res.assigned), res.commit_latency,
+                )
+                self._emit(
+                    "GangCommitted", res.key,
+                    f"{len(res.assigned)} members in "
+                    f"{res.commit_latency:.3f}s",
                 )
                 return True
         return False

@@ -59,6 +59,10 @@ class NodeView:
     # skips re-decoding when a webhook carries the identical string (hot:
     # every /filter and /prioritize re-sends every node's annotations)
     raw_payload: str = ""
+    # decoded tpu.qiniu.com/health-summary annotation (obs telemetry),
+    # None when the node agent predates it; the /statusz fleet rollup
+    # prefers these counts and falls back to chip health otherwise
+    health_summary: Optional[dict] = None
 
     # coord -> chip index, built on first use (views are re-created per
     # decoded annotation, never re-pointed at different chips); the bind
@@ -246,7 +250,18 @@ class ClusterState:
             for chip in info.chips:
                 sl.host_by_coord[chip.coord] = name
             self._hosts_cache.pop(info.slice_id, None)
-            view = NodeView(info=info, raw_payload=payload)
+            summary = None
+            raw_summary = annotations.get(codec.ANNO_HEALTH_SUMMARY)
+            if raw_summary:
+                try:
+                    summary = codec.decode_health_summary(raw_summary)
+                except codec.CodecError as e:
+                    # a malformed summary must not reject the topology —
+                    # the rollup simply falls back to chip health
+                    log.warning("node %s: undecodable health summary: %s",
+                                name, e)
+            view = NodeView(info=info, raw_payload=payload,
+                            health_summary=summary)
             if prev is not None:
                 view.used_ids = prev.used_ids
                 view.share_counts = prev.share_counts

@@ -219,6 +219,12 @@ class SimCluster:
         if self._http is not None:
             self._http.stop()
             self._http = None
+        # sink writes drain on a background thread (trace.JsonlSink);
+        # closing here is what makes "read the capture after the with
+        # block" deterministic for tests and scenario code
+        if self.extender.trace is not None:
+            self.extender.trace.close()
+        self.extender.events.close()
 
     def __enter__(self) -> "SimCluster":
         self.start()

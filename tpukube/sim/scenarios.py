@@ -9,6 +9,7 @@ operator-facing ones — same shapes, metrics out instead of asserts.
 
 from __future__ import annotations
 
+import json
 import time
 import urllib.request
 from typing import Any
@@ -26,6 +27,7 @@ def run(scenario: int, config: TpuKubeConfig | None = None) -> dict[str, Any]:
         4: gang_16,
         5: multi_tenant_northstar,
         6: churn,
+        7: fault_telemetry,
     }[scenario]
     t0 = time.perf_counter()
     result = fn(config)
@@ -236,7 +238,7 @@ def churn(config: TpuKubeConfig | None) -> dict[str, Any]:
 
         recovered = util_samples[1::2]  # post-refill samples
         resched.sort()
-        return {
+        result = {
             "metric": "churn",
             "value": round(100 * min(recovered), 2),
             "unit": "% min utilization after refill",
@@ -248,4 +250,162 @@ def churn(config: TpuKubeConfig | None) -> dict[str, Any]:
             "resched_p50_s": round(resched[len(resched) // 2], 5),
             "resched_p99_s": round(resched[int(len(resched) * 0.99)], 5),
             "lifecycle_releases": c._lifecycle.released - released0,
+        }
+        # per-phase timeline stats, same key scenario 5 carries: under
+        # churn the interesting spread is release -> replacement-bind,
+        # and attributing it needs the per-phase view (BENCH tracking)
+        if c.extender.trace is not None:
+            from tpukube.obs import timeline
+
+            result["phases"] = timeline.phase_stats(
+                c.extender.trace.events()
+            )
+        return result
+
+
+def fault_telemetry(config: TpuKubeConfig | None) -> dict[str, Any]:
+    """Scenario 7: chip + ICI-link faults driven through the WHOLE
+    telemetry pipeline — the first scenario to exercise
+    ``inject_fault``/``inject_link_fault`` on a real node-agent stack:
+
+      device layer fault -> HealthSampler transition -> ChipUnhealthy /
+      LinkFault journal events + per-chip /metrics series -> node
+      re-annotation (health summary) -> extender fleet rollup on
+      /statusz -> SLO burn rates from a live /metrics scrape.
+    """
+    import os
+    import tempfile
+
+    from tpukube.core.config import load_config as _load
+    from tpukube.device import TpuDeviceManager
+    from tpukube.metrics import MetricsServer, render_plugin_metrics
+    from tpukube.obs import events as events_mod
+    from tpukube.obs import slo as slo_mod
+    from tpukube.obs.events import EventJournal
+    from tpukube.obs.health import HealthSampler
+    from tpukube.obs.statusz import plugin_statusz
+    from tpukube.plugin import DevicePluginServer
+
+    cfg = config or load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+
+    def fetch(url: str) -> str:
+        with urllib.request.urlopen(url, timeout=5) as r:
+            return r.read().decode()
+
+    link = ((0, 0, 0), (0, 1, 0))  # intra-host link on host-0-0-0
+    with SimCluster(cfg) as c:
+        # load the control plane so the SLO histograms hold samples
+        group = PodGroup("telemetry-gang", min_member=4)
+        for i in range(4):
+            c.schedule(c.make_pod(f"tg-{i}", tpu=1, priority=10,
+                                  group=group))
+        for i in range(4):
+            c.schedule(c.make_pod(f"bg-{i}", tpu=1))
+
+        with tempfile.TemporaryDirectory() as td:
+            node_cfg = _load(env={
+                "TPUKUBE_DEVICE_PLUGIN_DIR": td,
+                "TPUKUBE_SIM_MESH_DIMS": ",".join(
+                    str(d) for d in cfg.sim_mesh_dims),
+                "TPUKUBE_SIM_HOST_BLOCK": ",".join(
+                    str(d) for d in cfg.sim_host_block),
+            })
+            journal_path = os.path.join(td, "events.jsonl")
+            journal = EventJournal(path=journal_path)
+            with TpuDeviceManager(node_cfg, host="host-0-0-0") as device, \
+                    DevicePluginServer(node_cfg, device) as server:
+                server.events = journal
+                sampler = HealthSampler(device, journal=journal,
+                                        poll_seconds=999)
+                ms = MetricsServer(
+                    lambda: render_plugin_metrics(
+                        server, sampler=sampler, events=journal),
+                    statusz=lambda: plugin_statusz(
+                        server, device=device, sampler=sampler,
+                        events=journal),
+                )
+                ms.start()
+                try:
+                    sampler.check_once()  # baseline sighting
+
+                    def push_upstream() -> None:
+                        # the syncer's job, stepped deterministically:
+                        # apply the node's refreshed annotations (incl.
+                        # the health summary) through the recorded
+                        # upsert_node decision
+                        for obj in c.node_objects():
+                            if obj["metadata"]["name"] == "host-0-0-0":
+                                c.extender.handle("upsert_node", {
+                                    "name": "host-0-0-0",
+                                    "annotations":
+                                        obj["metadata"]["annotations"],
+                                })
+
+                    # chip fault + link fault, node-agent side and
+                    # scheduler side (as the health watch + syncer would)
+                    device.inject_fault(1)
+                    chip_flip = sampler.check_once()
+                    device.inject_link_fault(*link)
+                    link_flip = sampler.check_once()
+                    c.inject_fault("host-0-0-0", 1)
+                    c.inject_link_fault(*link)
+                    push_upstream()
+
+                    degraded_metrics = fetch(
+                        f"http://127.0.0.1:{ms.port}/metrics")
+                    degraded_statusz = json.loads(
+                        fetch(f"{c.base_url}/statusz"))
+
+                    # recovery
+                    device.inject_fault(1, healthy=True)
+                    device.inject_link_fault(*link, up=True)
+                    recovered = sampler.check_once()
+                    c.inject_fault("host-0-0-0", 1, healthy=True)
+                    c.inject_link_fault(*link, up=True)
+                    push_upstream()
+                    recovered_statusz = json.loads(
+                        fetch(f"{c.base_url}/statusz"))
+
+                    slo_eval = slo_mod.evaluate(
+                        fetch(f"{c.base_url}/metrics"))
+                finally:
+                    ms.stop()
+            journal.close()
+            event_reasons = [
+                e["reason"] for e in events_mod.load(journal_path)
+            ]
+
+        chip_series = sum(
+            1 for line in degraded_metrics.splitlines()
+            if line.startswith("tpukube_chip_")
+        )
+        fleet_degraded = degraded_statusz["fleet"]["total"]
+        fleet_recovered = recovered_statusz["fleet"]["total"]
+        return {
+            "metric": "fault_telemetry",
+            "transitions": {
+                "chip_fault": chip_flip,
+                "link_fault": link_flip,
+                "recovery": recovered,
+            },
+            "event_reasons": sorted(set(event_reasons)),
+            "chip_series_on_node_metrics": chip_series,
+            "fleet_degraded": {
+                k: fleet_degraded[k]
+                for k in ("healthy", "degraded", "unhealthy", "links_down")
+            },
+            "fleet_recovered": {
+                k: fleet_recovered[k]
+                for k in ("healthy", "degraded", "unhealthy", "links_down")
+            },
+            "slo": {
+                name: {
+                    "burn_rate": entry["burn_rate"],
+                    "total": entry["total"],
+                }
+                for name, entry in slo_eval.items()
+            },
         }

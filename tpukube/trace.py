@@ -22,6 +22,8 @@ from __future__ import annotations
 
 import io
 import json
+import logging
+import os
 import threading
 import time
 from collections import deque
@@ -50,21 +52,129 @@ KINDS = ("filter", "prioritize", "bind", "release", "reconcile",
 ANNOTATION_KINDS = ("span",)
 
 
+class JsonlSink:
+    """Size-capped JSONL file sink with a dedicated drain thread —
+    shared by :class:`DecisionTrace` and the event journal
+    (``tpukube.obs.events``).
+
+    ``write()`` only enqueues (a deque append + condition notify): the
+    file I/O happens on the sink's own daemon thread, so a stalled disk
+    can never block an emitter — and emitters call from inside the gang
+    manager's lock and the extender's decision paths, where one blocked
+    write syscall would freeze every concurrent webhook. Lines are
+    written in enqueue order (single drain thread). ``max_bytes`` caps
+    the file: at the cap it rotates once to ``<path>.1`` (replacing the
+    previous rotation) so incident captures on a long-lived daemon
+    cannot fill the disk. ``close()`` drains what is queued, then joins
+    the thread — call it before reading the file for a complete view.
+    """
+
+    def __init__(self, path: str, max_bytes: int = 0) -> None:
+        self.path = path
+        self.max_bytes = max_bytes
+        self._cond = threading.Condition()
+        self._pending: deque[str] = deque()
+        self._closed = False
+        self._file: Optional[io.TextIOBase] = open(path, "a", buffering=1)
+        try:
+            self._bytes = os.path.getsize(path)
+        except OSError:
+            self._bytes = 0
+        self._rotations = 0
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="tpukube-jsonl-sink",
+        )
+        self._thread.start()
+
+    def write(self, line: str) -> None:
+        """Enqueue one line (non-blocking; dropped after close)."""
+        with self._cond:
+            if self._closed:
+                return
+            self._pending.append(line)
+            self._cond.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending and not self._closed:
+                    self._cond.wait()
+                lines = list(self._pending)
+                self._pending.clear()
+                closing = self._closed
+            try:
+                self._write_out(lines)
+            except Exception:
+                # a sink failure must never kill the drain thread while
+                # the daemon keeps emitting
+                logging.getLogger("tpukube.trace").exception(
+                    "JSONL sink write failed (%s)", self.path
+                )
+            if closing:
+                return
+
+    def _write_out(self, lines: list[str]) -> None:
+        f = self._file
+        if f is None:
+            return
+        for line in lines:
+            if (self.max_bytes > 0 and self._bytes > 0
+                    and self._bytes + len(line) > self.max_bytes):
+                f.close()
+                try:
+                    os.replace(self.path, f"{self.path}.1")
+                except OSError:
+                    pass  # worst case we truncate in place below
+                f = self._file = open(self.path, "w", buffering=1)
+                with self._cond:
+                    self._bytes = 0
+                    self._rotations += 1
+            f.write(line)
+            with self._cond:
+                self._bytes += len(line)
+
+    def stats(self) -> tuple[int, int]:
+        """(bytes in the live file, rotations so far)."""
+        with self._cond:
+            return self._bytes, self._rotations
+
+    def close(self) -> None:
+        """Flush the queue, stop the drain thread, close the file.
+        Idempotent."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify()
+        self._thread.join(timeout=10.0)
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
 @dataclass
 class DecisionTrace:
-    """Bounded ring of decision events, with an optional JSONL file sink."""
+    """Bounded ring of decision events, with an optional JSONL file sink.
+
+    The sink is a :class:`JsonlSink`: recording only ENQUEUES the
+    serialized line (under the ring lock, preserving seq order); the
+    file write happens on the sink's drain thread, so disk latency
+    never reaches the decision path. ``max_sink_bytes`` caps the sink
+    file with one ``<path>.1`` rotation generation.
+    """
 
     capacity: int = 65536
     path: Optional[str] = None
+    max_sink_bytes: int = 0  # 0 = unlimited
     _events: deque = field(init=False)
     _lock: threading.Lock = field(init=False, default_factory=threading.Lock)
     _seq: int = field(init=False, default=0)
-    _sink: Optional[io.TextIOBase] = field(init=False, default=None)
+    _sink: Optional[JsonlSink] = field(init=False, default=None)
 
     def __post_init__(self) -> None:
         self._events = deque(maxlen=self.capacity)
         if self.path:
-            self._sink = open(self.path, "a", buffering=1)  # line-buffered
+            self._sink = JsonlSink(self.path, max_bytes=self.max_sink_bytes)
 
     def record(self, kind: str, request: Any, response: Any) -> dict:
         assert kind in KINDS or kind in ANNOTATION_KINDS, kind
@@ -79,6 +189,7 @@ class DecisionTrace:
             }
             self._events.append(ev)
             if self._sink is not None:
+                # enqueue under the ring lock so sink order IS seq order
                 self._sink.write(json.dumps(ev, sort_keys=True) + "\n")
         return ev
 
@@ -98,6 +209,9 @@ class DecisionTrace:
         """Ring statistics for /statusz: occupancy, total recorded, and
         how many events the bounded ring has already dropped (non-zero
         means an incident capture should use a file sink)."""
+        sink_bytes, rotations = (
+            self._sink.stats() if self._sink is not None else (None, 0)
+        )
         with self._lock:
             return {
                 "enabled": True,
@@ -106,23 +220,35 @@ class DecisionTrace:
                 "last_seq": self._seq,
                 "dropped": max(0, self._seq - len(self._events)),
                 "sink_path": self.path or None,
+                "sink_bytes": sink_bytes,
+                "sink_rotations": rotations,
             }
 
     def close(self) -> None:
-        with self._lock:
-            if self._sink is not None:
-                self._sink.close()
-                self._sink = None
+        if self._sink is not None:
+            self._sink.close()
 
 
 def load(path: str) -> list[dict]:
-    """Read a JSONL trace file back into an event list."""
+    """Read a JSONL trace file back into an event list. Undecodable
+    lines are skipped (counted in a log warning): a daemon that crashed
+    mid-write leaves a torn final line, and the capture's other ten
+    thousand events are exactly what the incident investigation needs."""
     out: list[dict] = []
+    bad = 0
     with open(path) as f:
         for line in f:
             line = line.strip()
-            if line:
+            if not line:
+                continue
+            try:
                 out.append(json.loads(line))
+            except json.JSONDecodeError:
+                bad += 1
+    if bad:
+        logging.getLogger("tpukube.trace").warning(
+            "%s: skipped %d undecodable line(s)", path, bad
+        )
     return out
 
 
@@ -163,9 +289,11 @@ def replay(
         from dataclasses import replace as _dc_replace
 
         cfg = config or load_config(env={})
-        # replay must not record (or append to the live sink!) — the
-        # replayed extender is a scratch instance, not a daemon
-        extender = Extender(_dc_replace(cfg, trace_capacity=0, trace_path=""))
+        # replay must not record (or append to the live trace/event
+        # sinks!) — the replayed extender is a scratch instance
+        extender = Extender(_dc_replace(
+            cfg, trace_capacity=0, trace_path="", events_path="",
+        ))
     divergences: list[Divergence] = []
 
     def _check(ev: dict, replayed: Any) -> bool:
