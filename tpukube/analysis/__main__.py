@@ -1,0 +1,6 @@
+"""``python -m tpukube.analysis`` — the uninstalled-checkout spelling
+of the ``tpukube-lint`` console script (tools/check.sh uses it)."""
+
+from tpukube.analysis.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,211 @@
+"""tpukube-lint core: findings, waiver pragmas, the source-file model,
+and the pass runner.
+
+Every pass is a function ``check(sf: SourceFile) -> list[Finding]``;
+``run_all`` walks the requested paths, runs every (or a selected subset
+of) pass, applies waivers, and appends the ``bare-waiver`` findings for
+malformed pragmas. Passes scope themselves by path suffix (e.g.
+lock-discipline only fires on ``sched/gang.py`` / ``sched/extender.py``
+/ ``sched/state.py``), which is also what makes them testable against
+fixture trees: a snippet written to ``<tmp>/sched/gang.py`` is in scope.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable, Iterable, Optional
+
+#: every rule a waiver may name (bare-waiver itself is not waivable)
+ALL_RULES: tuple[str, ...] = (
+    "lock-discipline",
+    "lock-order",
+    "shared-state",
+    "name-consistency",
+    "exception-hygiene",
+    "bare-waiver",
+)
+
+WAIVER_RE = re.compile(
+    r"#\s*tpukube:\s*allow\(\s*"
+    r"(?P<rules>[a-z][a-z0-9-]*(?:\s*,\s*[a-z][a-z0-9-]*)*)\s*\)"
+    r"\s*(?P<why>.*)$"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint violation, anchored to a source line."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+
+@dataclass(frozen=True)
+class Waiver:
+    line: int
+    rules: tuple[str, ...]
+    justification: str
+
+
+class SourceFile:
+    """One parsed source file: AST + the waiver pragmas in its comments."""
+
+    def __init__(self, path, text: Optional[str] = None,
+                 rel: Optional[str] = None):
+        self.path = Path(path)
+        self.rel = rel if rel is not None else str(path)
+        self.text = self.path.read_text() if text is None else text
+        self.tree = ast.parse(self.text, filename=str(path))
+        self.waivers: dict[int, Waiver] = {}
+        for tok in tokenize.generate_tokens(io.StringIO(self.text).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = WAIVER_RE.search(tok.string)
+            if m is None:
+                continue
+            rules = tuple(r.strip() for r in m.group("rules").split(","))
+            self.waivers[tok.start[0]] = Waiver(
+                tok.start[0], rules, m.group("why").strip()
+            )
+
+    def in_scope(self, suffixes: Iterable[str]) -> bool:
+        posix = self.path.as_posix()
+        return any(posix.endswith(s) for s in suffixes)
+
+    def waiver_for(self, rule: str, line: int) -> Optional[Waiver]:
+        """The waiver covering a finding at ``line``: same line, or a
+        waiver comment on the line directly above (long statements)."""
+        for ln in (line, line - 1):
+            w = self.waivers.get(ln)
+            if w is not None and rule in w.rules:
+                return w
+        return None
+
+
+def _passes() -> dict[str, Callable[[SourceFile], list[Finding]]]:
+    # imported lazily: the pass modules import from base
+    from tpukube.analysis import consistency, hygiene, locks
+
+    return {
+        "lock-discipline": locks.check_lock_discipline,
+        "lock-order": locks.check_lock_order,
+        "shared-state": locks.check_shared_state,
+        "name-consistency": consistency.check_names,
+        "exception-hygiene": hygiene.check_exceptions,
+    }
+
+
+def iter_source_files(
+    paths: Iterable,
+) -> tuple[list[SourceFile], list[Finding]]:
+    """Every lintable .py under the given files/directories, plus a
+    ``parse-error`` finding per file that cannot be tokenized/parsed —
+    an unparseable file (mid-edit, conflict markers) must surface as a
+    pointed finding, not crash the whole lint run. Generated protobuf
+    modules are excluded (not ours to discipline)."""
+    out: list[SourceFile] = []
+    errors: list[Finding] = []
+    for p in paths:
+        p = Path(p)
+        files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
+        for f in files:
+            if f.name.endswith("_pb2.py"):
+                continue
+            rel = os.path.relpath(f)
+            try:
+                out.append(SourceFile(f, rel=rel))
+            except (SyntaxError, ValueError, UnicodeDecodeError,
+                    tokenize.TokenError) as e:
+                line = getattr(e, "lineno", None) or 1
+                errors.append(Finding(
+                    "parse-error", rel, line,
+                    f"file does not parse, no pass can check it: {e}",
+                ))
+    return out, errors
+
+
+def find_rules_file(paths: Iterable) -> Optional[Path]:
+    """Locate deploy/prometheus-rules.yaml relative to the linted tree
+    (the deploy/ directory is the package directory's sibling)."""
+    for p in paths:
+        p = Path(p).resolve()
+        for base in (p if p.is_dir() else p.parent, p.parent):
+            cand = base / "deploy" / "prometheus-rules.yaml"
+            if cand.exists():
+                return cand
+    return None
+
+
+def waiver_findings(sf: SourceFile) -> list[Finding]:
+    """The waiver mechanism's own lint: a waiver must carry a trailing
+    justification and may only name known rules."""
+    out = []
+    for w in sf.waivers.values():
+        if not w.justification:
+            out.append(Finding(
+                "bare-waiver", sf.rel, w.line,
+                f"waiver for ({', '.join(w.rules)}) carries no "
+                f"justification — say why the rule does not apply here",
+            ))
+        for rule in w.rules:
+            if rule not in ALL_RULES:
+                out.append(Finding(
+                    "bare-waiver", sf.rel, w.line,
+                    f"waiver names unknown rule {rule!r} "
+                    f"(known: {', '.join(ALL_RULES[:-1])})",
+                ))
+    return out
+
+
+def apply_waivers(sf: SourceFile,
+                  findings: Iterable[Finding]) -> list[Finding]:
+    """Drop findings covered by a waiver pragma. bare-waiver findings
+    are never waivable — a malformed pragma cannot excuse itself."""
+    return [
+        f for f in findings
+        if f.rule == "bare-waiver" or sf.waiver_for(f.rule, f.line) is None
+    ]
+
+
+def run_all(paths: Iterable, rules: Optional[Iterable[str]] = None,
+            rules_file=None) -> list[Finding]:
+    """Run the selected passes (default: all) over ``paths`` plus the
+    prometheus-rules cross-check, returning unwaived findings sorted by
+    (path, line). ``rules_file`` overrides the deploy/ auto-discovery
+    (which simply finds nothing on an isolated fixture tree)."""
+    selected = set(rules) if rules is not None else set(ALL_RULES)
+    unknown = selected - set(ALL_RULES)
+    if unknown:
+        raise ValueError(f"unknown rule(s): {', '.join(sorted(unknown))}")
+    passes = {k: v for k, v in _passes().items() if k in selected}
+    sources, findings = iter_source_files(paths)
+    for sf in sources:
+        per_file: list[Finding] = []
+        for check in passes.values():
+            per_file.extend(check(sf))
+        if "bare-waiver" in selected:
+            per_file.extend(waiver_findings(sf))
+        findings.extend(apply_waivers(sf, per_file))
+    if "name-consistency" in selected:
+        from tpukube.analysis import consistency
+
+        if rules_file is None:
+            rules_file = find_rules_file(paths)
+        if rules_file:
+            findings.extend(consistency.check_rules_file(rules_file))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

@@ -1,0 +1,127 @@
+"""name-consistency: every event reason, metric series name, and
+``deploy/prometheus-rules.yaml`` metric reference must resolve against
+the DECLARED registries — ``tpukube.obs.events.REASONS`` and
+``tpukube.obs.registry.DECLARED_SERIES``.
+
+This extends the exposition-time promlint (tests/test_promlint.py, which
+scrapes live /metrics) to the SOURCE level: a typo'd ``emit("GangComited")``
+or a renamed series fails lint before any process runs, and a rules-file
+expression referencing a series nobody renders fails before the alert
+silently goes blind. Only string LITERALS are checked — forwarding
+wrappers passing a ``reason`` variable are the call sites' problem, and
+the call sites are literals.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Optional
+
+from tpukube.analysis.base import Finding, SourceFile
+
+#: call names whose first str-literal arg (or reason=) is an event reason
+EMIT_CALLS = {"emit", "_emit", "_emit_event"}
+
+#: registry builder methods / metric constructors whose first
+#: str-literal arg is a series family name
+METRIC_CALLS = {
+    "counter", "gauge", "summary", "histogram",
+    "Counter", "Gauge", "Summary", "Histogram",
+}
+
+#: suffixes a TYPE'd family implies (rules expressions reference these)
+DERIVED_SUFFIXES = ("_bucket", "_count", "_sum")
+
+
+def _call_name(call: ast.Call) -> Optional[str]:
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def _literal_arg(call: ast.Call, kwarg: str) -> Optional[str]:
+    for kw in call.keywords:
+        if kw.arg == kwarg and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+def check_names(sf: SourceFile) -> list[Finding]:
+    from tpukube.obs.events import REASONS
+    from tpukube.obs.registry import DECLARED_SERIES
+
+    findings: list[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name in EMIT_CALLS:
+            reason = _literal_arg(node, "reason")
+            if reason is not None and reason not in REASONS:
+                findings.append(Finding(
+                    "name-consistency", sf.rel, node.lineno,
+                    f"event reason {reason!r} is not declared in "
+                    f"tpukube.obs.events.REASONS — add it there (and to "
+                    f"the journal docstring) or fix the typo",
+                ))
+        elif name in METRIC_CALLS:
+            series = _literal_arg(node, "name")
+            if series is not None and series not in DECLARED_SERIES:
+                findings.append(Finding(
+                    "name-consistency", sf.rel, node.lineno,
+                    f"metric series {series!r} is not declared in "
+                    f"tpukube.obs.registry.DECLARED_SERIES — declare it "
+                    f"(dashboards and prometheus-rules key off the "
+                    f"registry) or fix the typo",
+                ))
+    return findings
+
+
+def check_rules_file(path) -> list[Finding]:
+    """Every metric name a prometheus-rules.yaml expression reads must
+    be a declared series (or a declared family's _bucket/_count/_sum).
+    Recording-rule names (containing ':') are skipped by the shared
+    PromQL name extractor in tpukube.obs.slo."""
+    import yaml
+
+    from tpukube.obs.registry import DECLARED_SERIES
+    from tpukube.obs.slo import referenced_metric_names
+
+    path = Path(path)
+    text = path.read_text()
+    findings: list[Finding] = []
+    for doc in yaml.safe_load_all(text):
+        if not isinstance(doc, dict):
+            continue
+        for group in (doc.get("spec") or {}).get("groups", ()):
+            for rule in group.get("rules", ()):
+                expr = rule.get("expr", "")
+                for name in sorted(referenced_metric_names(expr)):
+                    base = name
+                    for suffix in DERIVED_SUFFIXES:
+                        if name.endswith(suffix) \
+                                and name[: -len(suffix)] in DECLARED_SERIES:
+                            base = name[: -len(suffix)]
+                            break
+                    if base in DECLARED_SERIES:
+                        continue
+                    # anchor to the first textual occurrence for a
+                    # clickable location
+                    idx = text.find(name)
+                    line = text.count("\n", 0, idx) + 1 if idx >= 0 else 1
+                    findings.append(Finding(
+                        "name-consistency", str(path), line,
+                        f"rule {rule.get('record') or rule.get('alert')!r}"
+                        f" references series {name!r}, which is not in "
+                        f"tpukube.obs.registry.DECLARED_SERIES — no "
+                        f"registry renders it, so the rule reads nothing",
+                    ))
+    return findings

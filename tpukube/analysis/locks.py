@@ -1,0 +1,347 @@
+"""Lock passes: discipline (no blocking I/O under scheduling locks),
+order (acquisitions against the declared partial order), and
+shared-state (registry-declared attributes touched under their lock).
+
+All three are LEXICAL analyses: a ``with self._lock:`` region covers
+the statements (and nested defs) textually inside it. Cross-function
+flows — handle() holding the decision lock while bind() runs — are the
+dynamic detector's job (``tpukube.analysis.lockgraph``); these passes
+catch what is visible in one function body, which is where the bug
+class historically entered.
+
+The codebase convention the passes understand: a method named
+``*_locked`` is documented as called with its class's lock already held
+and is exempt from shared-state checking (its CALLERS are checked for
+holding the lock around the call's siblings instead).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from tpukube.analysis.base import Finding, SourceFile
+
+# -- lock-discipline ---------------------------------------------------------
+
+#: the scheduling-critical modules whose locks serialize every webhook
+DISCIPLINE_SCOPE = (
+    "sched/gang.py", "sched/extender.py", "sched/state.py",
+)
+
+#: the scheduling locks themselves (self.<name>)
+SCHED_LOCKS = {"_lock", "_decision_lock", "_pending_lock"}
+
+#: method names that block on I/O regardless of receiver: file/socket
+#: writes and flushes, socket traffic, HTTP round-trips, time.sleep.
+#: The JSONL capture sinks are covered by write/flush — JsonlSink.write
+#: only enqueues, but calling ANY .write under a scheduling lock is
+#: banned so a refactor swapping the sink for a raw file fails lint.
+BLOCKING_METHODS = {
+    "write", "flush", "send", "sendall", "recv", "connect", "fsync",
+    "request", "getresponse", "urlopen", "sleep",
+}
+
+#: bare-name calls that block (stdout IS a file)
+BLOCKING_NAMES = {"open", "print"}
+
+#: receiver-qualified calls: subprocess spawns, requests HTTP
+BLOCKING_QUALIFIED = {
+    "subprocess": {"run", "Popen", "call", "check_call", "check_output"},
+    "requests": {"get", "post", "put", "delete", "head", "patch"},
+    "socket": {"create_connection"},
+    "os": {"replace", "rename", "unlink", "system"},
+}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _blocking_desc(call: ast.Call) -> Optional[str]:
+    fn = call.func
+    if isinstance(fn, ast.Name) and fn.id in BLOCKING_NAMES:
+        return f"{fn.id}()"
+    if isinstance(fn, ast.Attribute):
+        recv = fn.value
+        if isinstance(recv, ast.Name):
+            qualified = BLOCKING_QUALIFIED.get(recv.id)
+            if qualified and fn.attr in qualified:
+                return f"{recv.id}.{fn.attr}()"
+        if fn.attr in BLOCKING_METHODS:
+            return f".{fn.attr}()"
+    return None
+
+
+def check_lock_discipline(sf: SourceFile) -> list[Finding]:
+    """Flag blocking operations lexically inside ``with self._lock`` /
+    ``_decision_lock`` / ``_pending_lock`` regions of the scheduling
+    modules: one stalled write syscall there freezes every concurrent
+    webhook (the emitters-only-enqueue invariant)."""
+    if not sf.in_scope(DISCIPLINE_SCOPE):
+        return []
+    findings: list[Finding] = []
+
+    class V(ast.NodeVisitor):
+        def __init__(self) -> None:
+            self.held: list[str] = []
+
+        def _visit_with(self, node) -> None:
+            # runtime order for `with A, B:`: A's expr, acquire A, B's
+            # expr (under A), acquire B — so each item's context expr is
+            # checked under the locks of the items before it
+            acquired = 0
+            for item in node.items:
+                self.visit(item.context_expr)
+                a = _self_attr(item.context_expr)
+                if a in SCHED_LOCKS:
+                    self.held.append(a)
+                    acquired += 1
+            for stmt in node.body:
+                self.visit(stmt)
+            del self.held[len(self.held) - acquired:]
+
+        visit_With = _visit_with
+        visit_AsyncWith = _visit_with
+
+        def visit_Call(self, node: ast.Call) -> None:
+            if self.held:
+                desc = _blocking_desc(node)
+                if desc is not None:
+                    findings.append(Finding(
+                        "lock-discipline", sf.rel, node.lineno,
+                        f"blocking call {desc} inside `with "
+                        f"self.{self.held[-1]}` — scheduling locks may "
+                        f"only guard memory; enqueue and do the I/O "
+                        f"outside the lock",
+                    ))
+            self.generic_visit(node)
+
+    V().visit(sf.tree)
+    return findings
+
+
+# -- lock-order --------------------------------------------------------------
+
+#: the declared partial order (smaller level = acquired first /
+#: outermost): decision -> pending -> gang -> ledger. Acquiring a
+#: SMALLER level while holding a larger one is an inversion.
+LOCK_LEVELS = {"decision": 0, "pending": 1, "gang": 2, "ledger": 3}
+
+#: (path suffix, class) -> {self lock attr: (name, level)}
+ORDERED_LOCKS = {
+    ("sched/extender.py", "Extender"): {
+        "_decision_lock": ("decision", 0),
+        "_pending_lock": ("pending", 1),
+    },
+    ("sched/gang.py", "GangManager"): {"_lock": ("gang", 2)},
+    ("sched/state.py", "ClusterState"): {"_lock": ("ledger", 3)},
+}
+
+#: (path suffix, class) -> {self.<root>.<method>() call root: lock it
+#: acquires}. Calls through these attributes take the mapped lock.
+CALL_ROOTS = {
+    ("sched/extender.py", "Extender"): {
+        "gang": ("gang", 2), "state": ("ledger", 3),
+    },
+    ("sched/gang.py", "GangManager"): {"_state": ("ledger", 3)},
+}
+
+#: (path suffix, class) -> {self.<method>() that re-enters a lock}
+SELF_METHODS = {
+    ("sched/extender.py", "Extender"): {
+        "handle": ("decision", 0), "release": ("decision", 0),
+    },
+}
+
+
+def _class_configs(sf: SourceFile, table: dict) -> dict[str, dict]:
+    out = {}
+    for (suffix, cls), cfg in table.items():
+        if sf.in_scope((suffix,)):
+            out[cls] = cfg
+    return out
+
+
+def check_lock_order(sf: SourceFile) -> list[Finding]:
+    """Flag statically visible inversions of the declared lock order
+    within the scheduling classes: a nested ``with`` on a lower-level
+    lock, or a call through an attribute known to take one."""
+    lock_cfg = _class_configs(sf, ORDERED_LOCKS)
+    if not lock_cfg:
+        return []
+    root_cfg = _class_configs(sf, CALL_ROOTS)
+    meth_cfg = _class_configs(sf, SELF_METHODS)
+    findings: list[Finding] = []
+
+    for cls_node in sf.tree.body:
+        if not isinstance(cls_node, ast.ClassDef):
+            continue
+        locks = lock_cfg.get(cls_node.name)
+        if locks is None:
+            continue
+        roots = root_cfg.get(cls_node.name, {})
+        methods = meth_cfg.get(cls_node.name, {})
+
+        class V(ast.NodeVisitor):
+            def __init__(self) -> None:
+                # held (attr, name, level), acquisition order
+                self.held: list[tuple[str, str, int]] = []
+
+            def _flag(self, lineno: int, name: str, level: int,
+                      how: str) -> None:
+                attr, hname, hlevel = max(self.held, key=lambda h: h[2])
+                if level < hlevel:
+                    findings.append(Finding(
+                        "lock-order", sf.rel, lineno,
+                        f"{how} acquires the {name} lock (level "
+                        f"{level}) while holding the {hname} lock "
+                        f"(level {hlevel}); the declared order is "
+                        f"decision -> pending -> gang -> ledger",
+                    ))
+
+            def _visit_with(self, node) -> None:
+                # items acquire left to right: each is checked (and then
+                # held) against the ones before it, so a single-statement
+                # `with self._pending_lock, self._decision_lock:` is the
+                # same inversion as the nested spelling
+                acquired = 0
+                for item in node.items:
+                    self.visit(item.context_expr)
+                    attr = _self_attr(item.context_expr)
+                    entry = locks.get(attr) if attr else None
+                    if entry is None:
+                        continue
+                    name, level = entry
+                    already = any(h[0] == attr for h in self.held)
+                    if self.held and not already:
+                        self._flag(node.lineno, name, level,
+                                   f"`with self.{attr}`")
+                    self.held.append((attr, name, level))
+                    acquired += 1
+                for stmt in node.body:
+                    self.visit(stmt)
+                del self.held[len(self.held) - acquired:]
+
+            visit_With = _visit_with
+            visit_AsyncWith = _visit_with
+
+            def visit_Call(self, node: ast.Call) -> None:
+                if self.held and isinstance(node.func, ast.Attribute):
+                    fn = node.func
+                    # self.<root>.<method>(...)
+                    root = _self_attr(fn.value)
+                    if root is not None and root in roots:
+                        name, level = roots[root]
+                        self._flag(node.lineno, name, level,
+                                   f"call self.{root}.{fn.attr}()")
+                    # self.<method>(...)
+                    if _self_attr(fn) is not None and fn.attr in methods:
+                        name, level = methods[fn.attr]
+                        self._flag(node.lineno, name, level,
+                                   f"call self.{fn.attr}()")
+                self.generic_visit(node)
+
+        V().visit(cls_node)
+    return findings
+
+
+# -- shared-state ------------------------------------------------------------
+
+#: The guarded-attribute registry, seeded from the classes whose state
+#: is mutated from threading.Thread targets (webhook loop, watchers,
+#: eviction/lifecycle loops, sink drains): (path suffix, class) ->
+#: {attribute: the self lock that must be held to touch it}. Growing a
+#: class a new cross-thread structure means declaring it here — the
+#: lint then enforces the locking everywhere the attribute appears.
+GUARDED_ATTRS = {
+    ("sched/state.py", "ClusterState"): {
+        "_nodes": "_lock", "_slices": "_lock", "_allocs": "_lock",
+        "_hosts_cache": "_lock",
+    },
+    ("sched/gang.py", "GangManager"): {
+        "_reservations": "_lock", "_terminating_coords": "_lock",
+    },
+    ("sched/extender.py", "Extender"): {
+        "_pending": "_pending_lock",
+        "_bind_gang_info": "_decision_lock",
+    },
+    ("obs/events.py", "EventJournal"): {
+        "_ring": "_lock", "_live": "_lock", "_by_reason": "_lock",
+        "_seq": "_lock", "_total": "_lock",
+    },
+    ("obs/health.py", "HealthSampler"): {
+        "_latest": "_lock", "_states": "_lock", "_windows": "_lock",
+        "_transition_counts": "_lock",
+    },
+    ("plugin/server.py", "AllocIntentCache"): {
+        "_intents": "_lock", "_satisfied": "_lock",
+    },
+    ("plugin/server.py", "DevicePluginServer"): {
+        "_watch_queues": "_watch_lock",
+    },
+}
+
+
+def check_shared_state(sf: SourceFile,
+                       registry: Optional[dict] = None) -> list[Finding]:
+    """Every read/write of a registry-declared attribute must sit
+    lexically inside ``with self.<declared lock>``. ``__init__`` (no
+    concurrency yet) and ``*_locked`` helpers (documented as called
+    under the lock) are exempt."""
+    table = registry if registry is not None else GUARDED_ATTRS
+    cfg = _class_configs(sf, table)
+    if not cfg:
+        return []
+    findings: list[Finding] = []
+
+    for cls_node in sf.tree.body:
+        if not isinstance(cls_node, ast.ClassDef):
+            continue
+        guarded = cfg.get(cls_node.name)
+        if guarded is None:
+            continue
+        for fn in cls_node.body:
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if fn.name == "__init__" or fn.name.endswith("_locked"):
+                continue
+
+            class V(ast.NodeVisitor):
+                def __init__(self) -> None:
+                    self.held: list[str] = []
+
+                def _visit_with(self, node) -> None:
+                    acquired = 0
+                    for item in node.items:
+                        self.visit(item.context_expr)
+                        a = _self_attr(item.context_expr)
+                        if a in set(guarded.values()):
+                            self.held.append(a)
+                            acquired += 1
+                    for stmt in node.body:
+                        self.visit(stmt)
+                    del self.held[len(self.held) - acquired:]
+
+                visit_With = _visit_with
+                visit_AsyncWith = _visit_with
+
+                def visit_Attribute(self, node: ast.Attribute) -> None:
+                    attr = _self_attr(node)
+                    lock = guarded.get(attr) if attr else None
+                    if lock is not None and lock not in self.held:
+                        findings.append(Finding(
+                            "shared-state", sf.rel, node.lineno,
+                            f"self.{attr} touched outside `with "
+                            f"self.{lock}` — declared guarded in the "
+                            f"shared-state registry "
+                            f"(analysis/locks.py GUARDED_ATTRS)",
+                        ))
+                    self.generic_visit(node)
+
+            V().visit(fn)
+    return findings

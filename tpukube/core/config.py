@@ -58,6 +58,13 @@ class TpuKubeConfig:
     events_capacity: int = 4096
     events_path: str = ""
     events_sink_max_bytes: int = 64 * 1024**2
+    # dynamic lock-order detector (tpukube.analysis.lockgraph): when
+    # true, threading.Lock/RLock created by tpukube code are wrapped to
+    # record acquisition-order edges; tpukube-sim attaches the resulting
+    # lock graph (edges + deadlock cycles) to its result JSON. Off by
+    # default: nothing is patched and lock creation is untouched —
+    # tests/test_lint.py asserts the zero-overhead default.
+    lock_monitor: bool = False
 
     # Which ICI slice this node belongs to (multi-slice clusters name
     # their pod slices; coords are slice-local — SURVEY.md §3 ICI/DCN note)

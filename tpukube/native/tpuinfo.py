@@ -220,6 +220,7 @@ class TpuInfo:
         # best-effort on GC (explicit close() remains the contract).
         try:
             self.close()
+        # tpukube: allow(exception-hygiene) GC-time best effort: logging machinery may already be finalized at interpreter shutdown
         except Exception:
             pass
 

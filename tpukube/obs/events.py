@@ -8,7 +8,10 @@ rollback, preemption plan/execute, chip and ICI-link health
 transitions, watch reconnects, kubelet divergences), held in a bounded
 ring and optionally streamed to a JSONL sink for `tpukube-obs events`.
 
-Reasons in use (emitters may add more; consumers filter by string):
+Reasons in use are DECLARED in ``REASONS`` below — tpukube-lint's
+name-consistency pass checks every source-level ``emit(...)`` literal
+against it, so adding a reason means adding it to the enum (a typo'd
+reason fails lint instead of silently fragmenting the journal):
 
   GangReserved, GangCommitted, GangRollback, GangDissolved,
   PreemptionPlanned, PreemptionExecuted, VictimEvicted, VictimGone,
@@ -35,6 +38,29 @@ from typing import Any, Iterable, Optional
 # event severities, K8s-style
 NORMAL = "Normal"
 WARNING = "Warning"
+
+#: The declared reason enum. Every emit() call in the tree must use one
+#: of these (enforced source-level by tpukube-lint name-consistency;
+#: consumers — /events filters, tpukube-obs events, the
+#: tpukube_events_total{reason} counter — key off these strings).
+REASONS: tuple[str, ...] = (
+    "AllocDiverged",
+    "BindFailed",
+    "ChipRecovered",
+    "ChipUnhealthy",
+    "GangCommitted",
+    "GangDissolved",
+    "GangReserved",
+    "GangRollback",
+    "KubeletReregistered",
+    "LinkFault",
+    "LinkRecovered",
+    "PreemptionExecuted",
+    "PreemptionPlanned",
+    "VictimEvicted",
+    "VictimGone",
+    "WatchReconnected",
+)
 
 
 class EventJournal:

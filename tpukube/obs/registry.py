@@ -31,6 +31,55 @@ DEFAULT_BUCKETS: tuple[float, ...] = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
 
+#: The declared series registry: every metric family any daemon's
+#: builders may construct. tpukube-lint's name-consistency pass checks
+#: source-level constructor calls (reg.counter/gauge/summary/histogram,
+#: Histogram(...)) AND every metric name deploy/prometheus-rules.yaml
+#: expressions reference against this set — a renamed or typo'd series
+#: fails lint before any dashboard or alert silently goes blind.
+#: Summary/histogram families imply their _bucket/_count/_sum children.
+DECLARED_SERIES: frozenset[str] = frozenset({
+    # extender (tpukube.metrics.build_extender_registry)
+    "tpu_chip_utilization_percent",
+    "gang_schedule_latency_seconds",
+    "tpukube_ici_links_down",
+    "tpukube_binds_total",
+    "tpukube_gang_rollbacks_total",
+    "tpukube_preemptions_total",
+    "tpukube_webhook_latency_seconds",
+    "tpukube_gang_victims_terminating",
+    "tpukube_evictions_pending",
+    "tpukube_evictions_total",
+    "tpukube_evictions_blocked_total",
+    "tpukube_eviction_failures_total",
+    "tpukube_eviction_oldest_age_seconds",
+    "tpukube_reconciles_total",
+    "tpukube_node_refreshes_total",
+    "tpukube_lifecycle_releases_total",
+    # both daemons (event journal)
+    "tpukube_events_total",
+    # node agent (tpukube.metrics.build_plugin_registry)
+    "tpukube_plugin_allocations_total",
+    "tpukube_plugin_devices",
+    "tpukube_plugin_resource_info",
+    "tpukube_plugin_inventory_source",
+    "tpukube_plugin_intent_depth",
+    "tpukube_plugin_divergences_total",
+    "tpukube_plugin_health_transitions_total",
+    "tpukube_plugin_reregistrations_total",
+    "tpukube_plugin_intent_watch_events_total",
+    "tpukube_chip_healthy",
+    "tpukube_chip_duty_cycle_percent",
+    "tpukube_chip_hbm_used_bytes",
+    "tpukube_chip_hbm_total_bytes",
+    "tpukube_chip_ici_link_errors_total",
+    "tpukube_chip_health_transitions_total",
+    "tpukube_node_chips",
+    "tpukube_telemetry_samples_total",
+    # annotation syncer sidecar
+    "tpukube_syncer_syncs_total",
+})
+
 
 def quantile(values: Iterable[float], q: float) -> float:
     """Nearest-rank quantile; 0.0 on empty input."""

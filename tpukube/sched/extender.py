@@ -983,6 +983,7 @@ class Extender:
                     # _handle_bind's effector undo needs to know whether
                     # THIS bind committed the gang (keyed, since other
                     # binds may interleave once the decision lock drops)
+                    # tpukube: allow(shared-state) bind() is only entered through _handle_bind, which already holds the decision lock around this whole call
                     self._bind_gang_info[key] = (res, committed_now)
             with self._pending_lock:
                 self._pending.pop(key, None)

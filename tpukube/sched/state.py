@@ -328,10 +328,11 @@ class ClusterState:
         with self._lock:
             return sorted(self._nodes)
 
-    def _slice_views(self, slice_id: Optional[str]) -> list[NodeView]:
+    def _slice_views_locked(self, slice_id: Optional[str]) -> list[NodeView]:
         """Node views of one slice — or of the WHOLE cluster only when it is
         single-slice (mixing coord sets across slices would be meaningless;
-        raise instead)."""
+        raise instead). Callers hold ``self._lock`` (the ``_locked``
+        naming is the contract tpukube-lint's shared-state pass keys on)."""
         if slice_id is None and len(self._slices) > 1:
             raise StateError(
                 "coord sets are slice-local; pass slice_id on a "
@@ -347,7 +348,7 @@ class ClusterState:
         used shares, plus unhealthy chips."""
         with self._lock:
             out: set[TopologyCoord] = set()
-            for view in self._slice_views(slice_id):
+            for view in self._slice_views_locked(slice_id):
                 for chip in view.info.chips:
                     if (
                         chip.health is not Health.HEALTHY
@@ -360,7 +361,7 @@ class ClusterState:
         with self._lock:
             return {
                 chip.coord
-                for view in self._slice_views(slice_id)
+                for view in self._slice_views_locked(slice_id)
                 for chip in view.info.chips
                 if chip.health is not Health.HEALTHY
             }
@@ -371,7 +372,7 @@ class ClusterState:
         with self._lock:
             return {
                 link
-                for view in self._slice_views(slice_id)
+                for view in self._slice_views_locked(slice_id)
                 for link in view.info.bad_links
             }
 
@@ -380,7 +381,7 @@ class ClusterState:
         the gang layer's bin-pack signal for slice choice."""
         with self._lock:
             total = used = 0
-            for view in self._slice_views(slice_id):
+            for view in self._slice_views_locked(slice_id):
                 n = view.shares_per_chip
                 for chip in view.info.chips:
                     if chip.health is Health.HEALTHY:

@@ -141,6 +141,31 @@ class SimCluster:
         self.config = config or load_config(env={})
         if slices is not None and mesh is not None:
             raise ValueError("pass either mesh or slices, not both")
+        # the dynamic lock-order detector must be live BEFORE the
+        # extender (and its gang/ledger locks) is constructed below;
+        # install is ref-counted, so a cluster inside an outer
+        # lockgraph.monitor() shares that monitor. A constructor that
+        # fails later must unwind the install (stop() never runs for a
+        # half-built cluster) — a leaked patch would silently wrap every
+        # tpukube lock for the rest of the process.
+        self.lock_monitor = None
+        self._lock_monitor_installed = False
+        if self.config.lock_monitor:
+            from tpukube.analysis import lockgraph
+
+            self.lock_monitor = lockgraph.install()
+            self._lock_monitor_installed = True
+        try:
+            self._init_cluster(mesh, vtpu_nodes, vtpu_shares, slices)
+        except BaseException:
+            if self._lock_monitor_installed:
+                from tpukube.analysis import lockgraph
+
+                lockgraph.uninstall()
+                self._lock_monitor_installed = False
+            raise
+
+    def _init_cluster(self, mesh, vtpu_nodes, vtpu_shares, slices) -> None:
         self._prefixed = slices is not None
         if slices is None:
             slices = {self.config.slice_id: mesh or self.config.sim_mesh()}
@@ -208,23 +233,45 @@ class SimCluster:
         return f"http://127.0.0.1:{self._port}"
 
     def start(self) -> None:
-        self._http = _AppThread(make_app(self.extender), "127.0.0.1", self._port)
-        self._http.start()
+        try:
+            self._http = _AppThread(make_app(self.extender), "127.0.0.1",
+                                    self._port)
+            self._http.start()
+        except BaseException:
+            # __enter__ raising means __exit__/stop() never runs: the
+            # process-wide threading patch must not outlive the failed
+            # startup (same unwind as the constructor's failure path)
+            if self._lock_monitor_installed:
+                from tpukube.analysis import lockgraph
+
+                lockgraph.uninstall()
+                self._lock_monitor_installed = False
+            raise
 
     def stop(self) -> None:
-        conn = getattr(self._tls, "conn", None)
-        if conn is not None:
-            conn.close()
-            self._tls.conn = None
-        if self._http is not None:
-            self._http.stop()
-            self._http = None
-        # sink writes drain on a background thread (trace.JsonlSink);
-        # closing here is what makes "read the capture after the with
-        # block" deterministic for tests and scenario code
-        if self.extender.trace is not None:
-            self.extender.trace.close()
-        self.extender.events.close()
+        try:
+            conn = getattr(self._tls, "conn", None)
+            if conn is not None:
+                conn.close()
+                self._tls.conn = None
+            if self._http is not None:
+                self._http.stop()
+                self._http = None
+            # sink writes drain on a background thread (trace.JsonlSink);
+            # closing here is what makes "read the capture after the with
+            # block" deterministic for tests and scenario code
+            if self.extender.trace is not None:
+                self.extender.trace.close()
+            self.extender.events.close()
+        finally:
+            # the process-wide threading patch must unwind even when a
+            # sink close raises (full disk) — same hazard the
+            # constructor's failure path unwinds
+            if self._lock_monitor_installed:
+                from tpukube.analysis import lockgraph
+
+                lockgraph.uninstall()
+                self._lock_monitor_installed = False
 
     def __enter__(self) -> "SimCluster":
         self.start()

@@ -75,7 +75,12 @@ class JsonlSink:
         self._cond = threading.Condition()
         self._pending: deque[str] = deque()
         self._closed = False
-        self._file: Optional[io.TextIOBase] = open(path, "a", buffering=1)
+        # explicit utf-8: event/trace text embeds arbitrary runtime
+        # strings (PJRT errors); a C-locale node must not drop a whole
+        # drain batch to UnicodeEncodeError
+        self._file: Optional[io.TextIOBase] = open(
+            path, "a", buffering=1, encoding="utf-8"
+        )
         try:
             self._bytes = os.path.getsize(path)
         except OSError:
@@ -118,20 +123,25 @@ class JsonlSink:
         if f is None:
             return
         for line in lines:
+            # count ENCODED bytes: the cap guards disk, and multi-byte
+            # text counted as characters would overshoot max_bytes 4x
+            # (it is also what the getsize() seed above measures)
+            nbytes = len(line.encode("utf-8"))
             if (self.max_bytes > 0 and self._bytes > 0
-                    and self._bytes + len(line) > self.max_bytes):
+                    and self._bytes + nbytes > self.max_bytes):
                 f.close()
                 try:
                     os.replace(self.path, f"{self.path}.1")
                 except OSError:
                     pass  # worst case we truncate in place below
-                f = self._file = open(self.path, "w", buffering=1)
+                f = self._file = open(self.path, "w", buffering=1,
+                                      encoding="utf-8")
                 with self._cond:
                     self._bytes = 0
                     self._rotations += 1
             f.write(line)
             with self._cond:
-                self._bytes += len(line)
+                self._bytes += nbytes
 
     def stats(self) -> tuple[int, int]:
         """(bytes in the live file, rotations so far)."""
@@ -167,7 +177,12 @@ class DecisionTrace:
     path: Optional[str] = None
     max_sink_bytes: int = 0  # 0 = unlimited
     _events: deque = field(init=False)
-    _lock: threading.Lock = field(init=False, default_factory=threading.Lock)
+    # default_factory resolves threading.Lock at INSTANCE creation (the
+    # lambda), not at class definition: the dynamic lock-order monitor
+    # (tpukube.analysis.lockgraph) patches the module attribute, and a
+    # factory captured at import time would silently escape it
+    _lock: threading.Lock = field(init=False,
+                                  default_factory=lambda: threading.Lock())
     _seq: int = field(init=False, default=0)
     _sink: Optional[JsonlSink] = field(init=False, default=None)
 
@@ -236,7 +251,7 @@ def load(path: str) -> list[dict]:
     thousand events are exactly what the incident investigation needs."""
     out: list[dict] = []
     bad = 0
-    with open(path) as f:
+    with open(path, encoding="utf-8") as f:
         for line in f:
             line = line.strip()
             if not line:
@@ -317,7 +332,7 @@ def replay(
         # scratch extender has tracing disabled, so nothing re-records)
         try:
             replayed = extender.handle(kind, req)
-        except Exception as e:  # a recorded request must re-dispatch cleanly
+        except Exception as e:  # tpukube: allow(exception-hygiene) the replay error IS the output — it lands in the divergence report the caller prints
             replayed = {"replayError": f"{type(e).__name__}: {e}"}
         if kind == "release":
             continue  # releases have no response to compare
