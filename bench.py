@@ -76,7 +76,26 @@ def run() -> dict:
     }
     result["process"] = process_stats()
     result["lint"] = lint_stats()
+    result["chaos"] = chaos_stats()
     return result
+
+
+def chaos_stats() -> dict:
+    """Chaos-scenario cost tracking (ISSUE 4): wall time of the seeded
+    apiserver-chaos run (scenario 8) and of the crash-recovery run
+    (scenario 9), plus the recovery latency proper (extender crash ->
+    ledger converged). Tracked per PR like the scheduler numbers so a
+    regression in retry/rebuild cost shows up in BENCH_*.json."""
+    from tpukube.sim import scenarios
+
+    s8 = scenarios.run(8)
+    s9 = scenarios.run(9)
+    return {
+        "scenario8_wall_s": s8["wall_s"],
+        "scenario8_faults_injected": s8["faults"]["injected"],
+        "scenario9_wall_s": s9["wall_s"],
+        "recovery_s": s9["recovery_s"],
+    }
 
 
 if __name__ == "__main__":

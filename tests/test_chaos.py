@@ -1,0 +1,676 @@
+"""ISSUE 4 acceptance: chaos harness, degraded mode, informer backoff,
+plugin registration retry, rebuild edge cases, and scenarios 8/9.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+
+import pytest
+
+from tpukube.apiserver import (
+    AllocIntentWatcher,
+    ApiServerError,
+    FakeApiServer,
+    transient_api_error,
+)
+from tpukube.chaos import (
+    ChaosApiServer,
+    ChaosSimCluster,
+    ChaosSpec,
+    FaultSchedule,
+    converge,
+    leaked_reservations,
+    ledger_divergence,
+)
+from tpukube.core import codec, retry
+from tpukube.core.config import load_config
+from tpukube.core.types import PodGroup
+from tpukube.sched.extender import Extender
+from tpukube.sim.harness import SimCluster
+
+
+def small_cfg(**extra):
+    env = {
+        "TPUKUBE_SIM_MESH_DIMS": "4,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    }
+    env.update(extra)
+    return load_config(env=env)
+
+
+# -- fault schedule ----------------------------------------------------------
+
+def test_fault_schedule_is_deterministic():
+    spec = ChaosSpec(error_rate=0.3, timeout_rate=0.2, torn_rate=0.1)
+
+    def draw_sequence(seed):
+        s = FaultSchedule(seed, spec)
+        return [s.draw_unary("op", mutating=True) for _ in range(50)]
+
+    assert draw_sequence(7) == draw_sequence(7)
+    assert draw_sequence(7) != draw_sequence(8)
+
+
+def test_fault_schedule_budget_and_stop():
+    s = FaultSchedule(1, ChaosSpec(error_rate=1.0), budget=2)
+    kinds = [s.draw_unary("op", mutating=False) for _ in range(5)]
+    assert kinds[:2] == ["error", "error"]
+    assert kinds[2:] == [None, None, None]  # budget exhausted
+    assert s.injected() == 2
+
+    s2 = FaultSchedule(1, ChaosSpec(error_rate=1.0))
+    assert s2.draw_unary("op", mutating=False) == "error"
+    s2.stop()
+    assert s2.draw_unary("op", mutating=False) is None
+    s2.resume()
+    assert s2.draw_unary("op", mutating=False) == "error"
+    assert s2.report()["by_kind"] == {"error": 2}
+
+
+def test_torn_only_applies_to_mutating_ops():
+    s = FaultSchedule(1, ChaosSpec(torn_rate=1.0))
+    assert s.draw_unary("get_pod", mutating=False) is None
+    assert s.draw_unary("patch_pod_annotations", mutating=True) == "torn"
+
+
+# -- chaos api proxy ---------------------------------------------------------
+
+def _pod(name, annotations=None, node=None):
+    pod = {
+        "metadata": {"name": name, "namespace": "default",
+                     "uid": f"uid-{name}",
+                     "annotations": dict(annotations or {})},
+        "spec": {},
+    }
+    if node:
+        pod["spec"]["nodeName"] = node
+    return pod
+
+
+def test_chaos_injects_503_and_timeout():
+    api = ChaosApiServer(
+        FakeApiServer(),
+        FaultSchedule(1, ChaosSpec(error_rate=1.0), budget=1),
+    )
+    with pytest.raises(ApiServerError) as e:
+        api.get_pod("default", "x")
+    assert e.value.code == 503
+    assert api.get_pod("default", "x") is None  # budget spent: clean
+
+    api2 = ChaosApiServer(
+        FakeApiServer(),
+        FaultSchedule(1, ChaosSpec(timeout_rate=1.0), budget=1),
+    )
+    with pytest.raises(ApiServerError) as e:
+        api2.get_pod("default", "x")
+    assert e.value.code is None  # transport-shaped
+    assert transient_api_error(e.value)
+
+
+def test_chaos_torn_write_applies_then_raises():
+    inner = FakeApiServer()
+    inner.upsert_pod(_pod("p"))
+    api = ChaosApiServer(
+        inner, FaultSchedule(3, ChaosSpec(torn_rate=1.0), budget=1)
+    )
+    with pytest.raises(ApiServerError) as e:
+        api.patch_pod_annotations("default", "p", {"k": "v"})
+    assert "torn" in str(e.value)
+    # ...but the write LANDED: the retrying caller must tolerate that
+    assert inner.get_pod("default", "p")["metadata"]["annotations"][
+        "k"] == "v"
+    # the retry (budget spent) re-applies harmlessly
+    api.patch_pod_annotations("default", "p", {"k": "v"})
+
+
+def test_chaos_watch_gone_and_event_fates():
+    inner = FakeApiServer()
+    api = ChaosApiServer(
+        inner, FaultSchedule(5, ChaosSpec(gone_rate=1.0), budget=1)
+    )
+    with pytest.raises(ApiServerError) as e:
+        api.watch_pods(timeout_seconds=1)
+    assert e.value.code == 410
+
+    # drop: the first event vanishes; the stream then heals
+    inner2 = FakeApiServer()
+    api2 = ChaosApiServer(
+        inner2, FaultSchedule(5, ChaosSpec(drop_event_rate=1.0), budget=1)
+    )
+    box: list = []
+    gen = api2.watch_pods(timeout_seconds=5, handle_box=box)
+    inner2.upsert_pod(_pod("a"))
+    inner2.upsert_pod(_pod("b"))
+    etype, obj = next(gen)
+    assert obj["metadata"]["name"] == "b"  # "a" was dropped
+
+    # dup: the first event arrives twice
+    inner3 = FakeApiServer()
+    api3 = ChaosApiServer(
+        inner3, FaultSchedule(5, ChaosSpec(dup_event_rate=1.0), budget=1)
+    )
+    gen3 = api3.watch_pods(timeout_seconds=5, handle_box=[])
+    inner3.upsert_pod(_pod("a"))
+    first = next(gen3)
+    second = next(gen3)
+    assert first[1]["metadata"]["name"] == "a"
+    assert second[1]["metadata"]["name"] == "a"
+
+
+# -- informer reconnect backoff (satellite: 410 resync) ----------------------
+
+class _StubServer:
+    def __init__(self) -> None:
+        from tpukube.plugin.server import AllocIntentCache
+
+        self.intents = AllocIntentCache()
+
+
+def test_watch_loop_backoff_grows_on_consecutive_failures():
+    """A persistently-failing watch (410 storm, down apiserver) must
+    back off with capped exponential growth, not a fixed cadence."""
+
+    class Always410:
+        def list_pods_with_rv(self, node_name=None):
+            return [], "0"
+
+        def watch_pods(self, node_name=None, handle_box=None,
+                       resource_version=None):
+            raise ApiServerError("resourceVersion too old", code=410)
+
+    loop = AllocIntentWatcher(Always410(), "n0", _StubServer(),
+                              poll_seconds=1.0, use_watch=True)
+    loop._reconnect_backoff = retry.Backoff(base=1.0, cap=16.0, jitter=0.0)
+    delays: list[float] = []
+
+    real_is_set = loop._stop.is_set
+
+    def fake_wait(delay):
+        delays.append(delay)
+        if len(delays) >= 5:
+            loop._stop.set()
+        return real_is_set()
+
+    loop._stop.wait = fake_wait  # run _run inline, deterministically
+    loop._run()
+    assert delays == [1.0, 2.0, 4.0, 8.0, 16.0]
+    assert loop.watch_status()["reconnect_failures"] == 5
+
+
+def test_watch_loop_410_resync_covers_the_gap():
+    """Regression for the list->watch resync gap: a 410 Gone on
+    subscribe must lead to a fresh list whose content is applied —
+    intents created during the outage are not lost."""
+    inner = FakeApiServer()
+    from tpukube.core.types import AllocResult, TopologyCoord
+
+    payload = codec.encode_alloc(AllocResult(
+        pod_key="default/p0", node_name="n0", device_ids=["tpu-0"],
+        coords=[TopologyCoord(0, 0, 0)], env={}, priority=0, uid="u0",
+    ))
+    inner.upsert_pod(_pod("p0", annotations={codec.ANNO_ALLOC: payload},
+                          node="n0"))
+    api = ChaosApiServer(
+        inner, FaultSchedule(5, ChaosSpec(gone_rate=1.0), budget=1)
+    )
+    server = _StubServer()
+    loop = AllocIntentWatcher(api, "n0", server, poll_seconds=0.01,
+                              use_watch=True)
+    loop._reconnect_backoff = retry.Backoff(base=0.01, cap=0.05,
+                                            jitter=0.0)
+    loop.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            # the post-410 reconnect landed and the outage-era intent
+            # was resynced from the fresh list
+            if (server.intents.snapshot().get("default/p0") == ["tpu-0"]
+                    and loop.stream_connected()):
+                break
+            time.sleep(0.01)
+        assert server.intents.snapshot().get("default/p0") == ["tpu-0"]
+        assert loop.stream_connected()
+        # a delivered watch event is the liveness proof that resets the
+        # reconnect backoff (an idle dial alone must not)
+        payload2 = codec.encode_alloc(AllocResult(
+            pod_key="default/p1", node_name="n0", device_ids=["tpu-1"],
+            coords=[TopologyCoord(1, 0, 0)], env={}, priority=0, uid="u1",
+        ))
+        inner.upsert_pod(_pod("p1", annotations={codec.ANNO_ALLOC: payload2},
+                              node="n0"))
+        while time.monotonic() < deadline:
+            if (server.intents.snapshot().get("default/p1") == ["tpu-1"]
+                    and loop._reconnect_backoff.failures == 0):
+                break
+            time.sleep(0.01)
+        assert server.intents.snapshot().get("default/p1") == ["tpu-1"]
+        assert loop._reconnect_backoff.failures == 0  # healthy again
+    finally:
+        loop.stop()
+
+
+# -- plugin registration retry (satellite) -----------------------------------
+
+class _FakePluginServer:
+    """Just enough DevicePluginServer surface for the session watcher."""
+
+    class _Device:
+        host = "n0"
+
+    def __init__(self, tmp_path, fail_times: int) -> None:
+        self.config = load_config(env={
+            "TPUKUBE_DEVICE_PLUGIN_DIR": str(tmp_path),
+        })
+        # both sockets "exist" as plain files
+        for name in ("kubelet.sock", "tpukube.sock"):
+            with open(os.path.join(str(tmp_path), name), "w") as f:
+                f.write("")
+        self.socket_path = os.path.join(str(tmp_path), "tpukube.sock")
+        self._device = self._Device()
+        self._fail_times = fail_times
+        self.register_calls = 0
+        self.restarts = 0
+
+    def restart(self):
+        self.restarts += 1
+
+    def register_with_kubelet(self):
+        self.register_calls += 1
+        if self.register_calls <= self._fail_times:
+            raise ConnectionError("kubelet not serving yet")
+
+
+def test_registration_retries_with_backoff_then_emits(tmp_path):
+    from tpukube.obs.events import EventJournal
+    from tpukube.plugin.server import KubeletSessionWatcher
+
+    server = _FakePluginServer(tmp_path, fail_times=2)
+    sleeps: list[float] = []
+    retrier = retry.Retrier(
+        retry.RetryPolicy(max_attempts=5, base_delay=0.05, max_delay=1.0,
+                          jitter=0.5, deadline=0),
+        name="kubelet-register", sleep=sleeps.append,
+        rng=random.Random(3),
+    )
+    watcher = KubeletSessionWatcher(server, poll_seconds=999,
+                                    retrier=retrier)
+    watcher.events = EventJournal(capacity=16)
+    watcher.mark_unregistered()  # the initial-registration-failed path
+
+    assert watcher.check_once() is True
+    assert server.register_calls == 3  # 2 failures + the success
+    assert len(sleeps) == 2
+    # jittered exponential: within (1-jitter)*ideal .. ideal
+    assert 0.025 <= sleeps[0] <= 0.05
+    assert 0.05 <= sleeps[1] <= 0.1
+    assert watcher.reregistrations == 1
+    evs = watcher.events.events(reason="KubeletReregistered")
+    assert len(evs) == 1
+    assert "recovered" in evs[0]["message"]
+    assert "attempt 3" in evs[0]["message"]
+    assert retrier.stats.retries == 2
+
+
+def test_registration_gives_up_after_max_attempts(tmp_path):
+    from tpukube.plugin.server import KubeletSessionWatcher
+
+    server = _FakePluginServer(tmp_path, fail_times=99)
+    retrier = retry.Retrier(
+        retry.RetryPolicy(max_attempts=3, base_delay=0.001, jitter=0.0,
+                          deadline=0),
+        name="kubelet-register", sleep=lambda s: None,
+    )
+    watcher = KubeletSessionWatcher(server, poll_seconds=999,
+                                    retrier=retrier)
+    watcher.mark_unregistered()
+    with pytest.raises(ConnectionError):
+        watcher.check_once()
+    assert server.register_calls == 3  # max attempts, not a tight loop
+    assert watcher.reregistrations == 0
+    # the flag survives, so the NEXT poll retries again
+    assert watcher._needs_register is True
+
+
+def test_default_watcher_retrier_comes_from_config(tmp_path):
+    from tpukube.plugin.server import KubeletSessionWatcher
+
+    server = _FakePluginServer(tmp_path, fail_times=0)
+    watcher = KubeletSessionWatcher(server, poll_seconds=999)
+    assert watcher.retrier.policy.max_attempts == \
+        server.config.retry_max_attempts
+
+
+# -- degraded mode -----------------------------------------------------------
+
+def _filter_body(cluster, pod):
+    return {"Pod": pod, "Nodes": {"Items": cluster.node_objects()}}
+
+
+def test_degraded_mode_fails_filter_and_bind_safe():
+    cfg = small_cfg()
+    with SimCluster(cfg) as c:
+        # healthy: filter works
+        pod = c.make_pod("p0", tpu=1)
+        ext = c.extender
+        out = ext.handle("filter", _filter_body(c, pod))
+        assert out["NodeNames"] and not out["Error"]
+
+        reason_box = ["apiserver circuit open"]
+        ext.degraded_gate = lambda: reason_box[0]
+        trace_len = len(ext.trace.events())
+        pod2 = c.make_pod("p1", tpu=1, priority=10,
+                          group=PodGroup("g", min_member=2))
+        out = ext.handle("filter", _filter_body(c, pod2))
+        assert "degraded mode" in out["Error"]
+        assert out["NodeNames"] == []
+        # fail SAFE: no reservation was created, nothing recorded
+        assert ext.gang.reservation("default", "g") is None
+        assert len(ext.trace.events()) == trace_len
+        bout = ext.handle("bind", {
+            "PodName": "p1", "PodNamespace": "default", "PodUID": "u",
+            "Node": "host-0-0-0",
+        })
+        assert "degraded mode" in bout["Error"]
+        assert ext.events.counts_by_reason().get("DegradedMode", 0) >= 2
+
+        # circuit closes -> normal service resumes, no restart needed
+        reason_box[0] = None
+        out = ext.handle("filter", _filter_body(c, pod))
+        assert out["NodeNames"] and not out["Error"]
+
+
+def test_degraded_gauge_and_retry_series_render():
+    from tpukube.metrics import render_extender_metrics
+
+    cfg = small_cfg()
+    ext = Extender(cfg)
+    text = render_extender_metrics(ext)
+    assert "tpukube_degraded_mode" not in text  # nothing wired: legacy
+    assert "tpukube_retry_attempts_total" not in text
+
+    ext.api_retrier = retry.Retrier(retry.RetryPolicy(), name="apiserver")
+    ext.api_circuit = retry.CircuitBreaker(
+        failure_threshold=3, reset_seconds=5, name="apiserver")
+    ext.degraded_gate = lambda: "apiserver circuit open"
+    text = render_extender_metrics(ext)
+    assert "tpukube_degraded_mode 1\n" in text
+    assert 'tpukube_retry_attempts_total{op="apiserver"} 0' in text
+    assert 'tpukube_circuit_state{circuit="apiserver"} 0' in text
+    assert 'tpukube_circuit_opens_total{circuit="apiserver"} 0' in text
+
+
+def test_plugin_registry_renders_registration_retrier(tmp_path):
+    from tpukube.metrics import render_plugin_metrics
+    from tpukube.plugin.server import KubeletSessionWatcher
+
+    server = _FakePluginServer(tmp_path, fail_times=0)
+    watcher = KubeletSessionWatcher(server, poll_seconds=999)
+
+    class _SrvForMetrics:
+        allocation_count = 0
+        divergences = 0
+        resource_name = "qiniu.com/tpu"
+        intents = server  # unused paths below avoid it
+
+    # the real render needs a full DevicePluginServer; assert through
+    # the shared helper instead
+    from tpukube.metrics import _add_retry_metrics
+    from tpukube.obs.registry import Registry
+
+    reg = Registry()
+    _add_retry_metrics(reg, retriers=[watcher.retrier])
+    text = reg.render()
+    assert 'tpukube_retry_attempts_total{op="kubelet-register"} 0' in text
+
+
+# -- RestApiServer through the unified layer ---------------------------------
+
+def _rest_server(**kw):
+    from tpukube.apiserver import RestApiServer
+
+    return RestApiServer(base_url="http://127.0.0.1:1", token="t", **kw)
+
+
+def test_rest_requests_retry_transient_errors(monkeypatch):
+    api = _rest_server(retrier=retry.Retrier(
+        retry.RetryPolicy(max_attempts=4, base_delay=0.001, jitter=0.0,
+                          deadline=0),
+        name="apiserver", retryable=transient_api_error,
+        sleep=lambda s: None,
+    ))
+    calls = []
+
+    def flaky(method, path, body=None, content_type=""):
+        calls.append(method)
+        if len(calls) < 3:
+            raise ApiServerError("injected 503", code=503)
+        return {"metadata": {"annotations": {"a": "1"}}}
+
+    monkeypatch.setattr(api, "_request_once", flaky)
+    assert api.get_node_annotations("n") == {"a": "1"}
+    assert len(calls) == 3
+
+
+def test_rest_requests_do_not_retry_logical_answers(monkeypatch):
+    api = _rest_server(retrier=retry.Retrier(
+        retry.RetryPolicy(max_attempts=4, base_delay=0.001, jitter=0.0,
+                          deadline=0),
+        name="apiserver", retryable=transient_api_error,
+        sleep=lambda s: None,
+    ))
+    calls = []
+
+    def not_found(method, path, body=None, content_type=""):
+        calls.append(method)
+        raise ApiServerError("nope", code=404)
+
+    monkeypatch.setattr(api, "_request_once", not_found)
+    assert api.get_pod("default", "x") is None  # 404 -> None, 1 call
+    assert len(calls) == 1
+
+
+def test_rest_circuit_opens_and_fails_fast(monkeypatch):
+    circuit = retry.CircuitBreaker(failure_threshold=2, reset_seconds=60,
+                                   name="apiserver")
+    api = _rest_server(circuit=circuit)
+    calls = []
+
+    def down(method, path, body=None, content_type=""):
+        calls.append(method)
+        raise ApiServerError("conn refused")
+
+    monkeypatch.setattr(api, "_request_once", down)
+    for _ in range(2):
+        with pytest.raises(ApiServerError):
+            api.get_node_annotations("n")
+    assert circuit.state() == retry.OPEN
+    with pytest.raises(ApiServerError) as e:
+        api.get_node_annotations("n")
+    assert "circuit" in str(e.value)
+    assert len(calls) == 2  # the fast-fail never dialed
+
+
+# -- eviction GET-confirms through the retrier -------------------------------
+
+def test_eviction_confirm_retries_through_policy():
+    cfg = small_cfg()
+    schedule_ = FaultSchedule(11, ChaosSpec(), budget=0)  # quiet chaos
+    with ChaosSimCluster(cfg, schedule_) as c:
+        assert c._evictions.retrier is c.confirm_retrier
+        c.schedule(c.make_pod("victim", tpu=1))
+        c.extender.handle("release", {"pod_key": "default/victim"})
+        c.extender.pending_evictions.append("default/victim")
+        # storm ONLY the confirm path: every get_pod 503s a few times
+        schedule_.resume(ChaosSpec(error_rate=0.5))
+        schedule_.budget = None
+        done: list[str] = []
+        for _ in range(20):
+            done += c.drain_evictions()
+            if done:
+                break
+        assert done == ["default/victim"]
+        assert c.confirm_retrier.stats.attempts >= 1
+
+
+# -- rebuild_from_pods edge cases (satellite) --------------------------------
+
+def _fresh_from(cluster, annotations_list):
+    fresh = Extender(cluster.config)
+    for obj in cluster.node_objects():
+        fresh.state.upsert_node(
+            obj["metadata"]["name"], obj["metadata"]["annotations"]
+        )
+    return fresh, fresh.rebuild_from_pods(annotations_list)
+
+
+def test_rebuild_malformed_gang_annotation_on_one_member():
+    """One member's undecodable pod-group annotation must not abort the
+    rebuild, and must not leave the OTHER members individually
+    evictable: they either restore under one reservation or die
+    together (all-or-nothing preserved either way)."""
+    cfg = small_cfg()
+    with SimCluster(cfg) as c:
+        group = PodGroup("g", min_member=4)
+        for i in range(4):
+            c.schedule(c.make_pod(f"g-{i}", tpu=1, priority=10,
+                                  group=group))
+        annos = {
+            k: dict(p["metadata"]["annotations"])
+            for k, p in c.pods.items()
+        }
+        annos["default/g-0"][codec.ANNO_POD_GROUP_MIN_MEMBER] = "banana"
+        fresh, restored = _fresh_from(c, list(annos.values()))
+        assert restored == 4  # the LEDGER always restores fully
+        intact = {f"default/g-{i}" for i in range(1, 4)}
+        res = fresh.gang.reservation("default", "g")
+        if res is not None:
+            # all intact members live inside the one reservation
+            assert intact <= set(res.assigned)
+        else:
+            # ...or the whole remnant was rolled back together
+            assert all(fresh.state.allocation(k) is None for k in intact)
+            assert intact <= set(fresh.pending_evictions)
+
+
+def test_rebuild_partial_gang_missing_member_pod():
+    """A member pod missing at restart (annotation never listed): the
+    survivors restore as ONE uncommitted reservation whose re-derived
+    slice still covers a full-size box — the late member can complete
+    the gang instead of the survivors becoming strays."""
+    cfg = small_cfg()
+    with SimCluster(cfg) as c:
+        group = PodGroup("g", min_member=4)
+        for i in range(4):
+            c.schedule(c.make_pod(f"g-{i}", tpu=1, priority=10,
+                                  group=group))
+        annos = [
+            dict(p["metadata"]["annotations"])
+            for k, p in c.pods.items() if k != "default/g-3"
+        ]
+        fresh, restored = _fresh_from(c, annos)
+        assert restored == 3
+        res = fresh.gang.reservation("default", "g")
+        assert res is not None and not res.committed
+        assert len(res.assigned) == 3
+        # the reservation holds a full-size pool (4 chips) so the gang
+        # can still complete
+        assert res.total_chips() == 4
+
+
+def test_rebuild_mid_commit_preserves_all_or_nothing_death():
+    """Restart mid-gang-commit (2 of 4 members bound; the others'
+    reservations existed only in the dead extender's memory). After
+    rebuild + completion, a preemption that needs the gang's chips
+    must dissolve the WHOLE gang — no member may die alone."""
+    cfg = small_cfg()
+    with SimCluster(cfg) as c:
+        group = PodGroup("g", min_member=4)
+        for i in range(2):
+            c.schedule(c.make_pod(f"g-{i}", tpu=1, priority=10,
+                                  group=group))
+        c.crash_extender()
+        restored = c.restart_extender()
+        assert restored == 2
+        res = c.extender.gang.reservation("default", "g")
+        assert res is not None and not res.committed
+        assert len(res.assigned) == 2
+
+        # the remaining members complete the gang after the restart
+        for i in range(2, 4):
+            c.schedule(c.make_pod(f"g-{i}", tpu=1, priority=10,
+                                  group=group))
+        res = c.extender.gang.reservation("default", "g")
+        assert res is not None and res.committed
+
+        # a mesh-wide prio-100 gang preempts: the restored gang dies
+        # WHOLE — every member released and queued, none survives
+        vip = PodGroup("vip", min_member=8)
+        for i in range(8):
+            c.schedule(c.make_pod(f"vip-{i}", tpu=1, priority=100,
+                                  group=vip))
+        assert c.extender.gang.reservation("default", "g") is None
+        for i in range(4):
+            assert c.extender.state.allocation(f"default/g-{i}") is None
+            assert f"default/g-{i}" not in c.pods  # evicted, not stray
+        assert ledger_divergence(c) == []
+
+
+# -- scenarios 8 / 9 ---------------------------------------------------------
+
+def test_scenario8_apiserver_chaos_acceptance():
+    from tpukube.sim import scenarios
+
+    result = scenarios.run(8)
+    assert result["scenario"] == 8
+    assert result["leaked_reservations"] == 0
+    assert result["ledger_divergence"] == 0
+    assert result["evictions_pending"] == 0
+    assert result["gang_committed"] is True
+    assert result["faults"]["injected"] > 0
+    assert result["circuit"]["opens"] >= 1
+    assert result["degraded_refusals"] >= 1
+    assert result["blackout_refused"] is True
+    assert result["retry"]["bind_retries"] >= 1
+
+
+def test_scenario8_is_deterministic_for_a_seed():
+    from tpukube.sim import scenarios
+
+    a = scenarios.run(8)
+    b = scenarios.run(8)
+    assert a["faults"] == b["faults"]
+    assert a["preemptions"] == b["preemptions"]
+
+
+def test_scenario9_crash_recovery_acceptance():
+    from tpukube.sim import scenarios
+
+    result = scenarios.run(9)
+    assert result["scenario"] == 9
+    assert result["restored"] == 4
+    assert result["partial_gang_restored"] is True
+    assert result["gang_committed"] is True
+    assert result["leaked_reservations"] == 0
+    assert result["ledger_divergence"] == 0
+    assert result["agent_restart_allocate_ok"] is True
+    assert result["recovery_s"] < 30.0
+
+
+def test_chaos_off_keeps_sim_cluster_behavior_identical():
+    """chaos_seed unset + circuits disabled = byte-identical legacy
+    behavior: a quiet FaultSchedule injects nothing and the plain
+    SimCluster path runs no chaos code at all."""
+    cfg = small_cfg()
+    assert cfg.chaos_seed == 0
+    quiet = FaultSchedule(0, ChaosSpec())
+    with ChaosSimCluster(cfg, quiet) as c:
+        c.schedule(c.make_pod("p", tpu=1))
+        assert quiet.injected() == 0
+        assert c.circuit.opens == 0
+        assert ledger_divergence(c) == []
+        assert leaked_reservations(c) == []
+        assert converge(c) >= 1

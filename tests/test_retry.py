@@ -1,0 +1,373 @@
+"""core/retry.py — the unified retry/backoff/circuit layer (ISSUE 4).
+
+Everything here is deterministic: clocks, sleeps, and RNGs are
+injected, so the policy math and the breaker's state machine are
+asserted exactly, not statistically.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from tpukube.core import retry
+from tpukube.core.config import load_config
+from tpukube.obs.events import EventJournal
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def make_retrier(policy, **kw):
+    sleeps: list[float] = []
+    clock = kw.pop("clock", FakeClock())
+    r = retry.Retrier(
+        policy, name=kw.pop("name", "test"),
+        sleep=sleeps.append, clock=clock,
+        rng=kw.pop("rng", random.Random(7)), **kw,
+    )
+    return r, sleeps, clock
+
+
+# -- policy math -------------------------------------------------------------
+
+def test_delay_is_exponential_and_capped():
+    p = retry.RetryPolicy(base_delay=0.1, max_delay=1.0, jitter=0.0)
+    rng = random.Random(0)
+    assert p.delay(1, rng) == pytest.approx(0.1)
+    assert p.delay(2, rng) == pytest.approx(0.2)
+    assert p.delay(3, rng) == pytest.approx(0.4)
+    assert p.delay(10, rng) == pytest.approx(1.0)  # capped
+
+
+def test_delay_jitter_only_shrinks_and_is_seeded():
+    p = retry.RetryPolicy(base_delay=1.0, max_delay=1.0, jitter=0.5)
+    a = [p.delay(1, random.Random(42)) for _ in range(3)]
+    b = [p.delay(1, random.Random(42)) for _ in range(3)]
+    assert a == b  # same seed, same jitter
+    for d in a:
+        assert 0.5 <= d <= 1.0  # full-jitter shrinks, never grows
+
+
+def test_backoff_sequence_grows_and_resets():
+    b = retry.Backoff(base=1.0, cap=8.0, jitter=0.0)
+    assert [b.next() for _ in range(5)] == [1.0, 2.0, 4.0, 8.0, 8.0]
+    assert b.failures == 5
+    b.reset()
+    assert b.failures == 0
+    assert b.next() == 1.0
+
+
+# -- Retrier -----------------------------------------------------------------
+
+def test_retrier_retries_then_succeeds():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("boom")
+        return "ok"
+
+    r, sleeps, _ = make_retrier(
+        retry.RetryPolicy(max_attempts=5, base_delay=0.1, jitter=0.0,
+                          deadline=0)
+    )
+    assert r.call(flaky) == "ok"
+    assert len(calls) == 3
+    assert sleeps == [pytest.approx(0.1), pytest.approx(0.2)]
+    assert r.last_attempts == 3
+    assert r.stats.attempts == 3
+    assert r.stats.retries == 2
+    assert r.stats.exhausted == 0
+
+
+def test_retrier_exhausts_max_attempts_and_journals():
+    journal = EventJournal(capacity=16)
+    r, sleeps, _ = make_retrier(
+        retry.RetryPolicy(max_attempts=3, base_delay=0.1, jitter=0.0,
+                          deadline=0),
+        journal=journal,
+    )
+    with pytest.raises(OSError):
+        r.call(lambda: (_ for _ in ()).throw(OSError("down")))
+    assert len(sleeps) == 2  # 3 attempts = 2 sleeps
+    assert r.stats.exhausted == 1
+    evs = journal.events(reason="RetryExhausted")
+    assert len(evs) == 1 and "3 attempt" in evs[0]["message"]
+
+
+def test_retrier_honors_overall_deadline():
+    clock = FakeClock()
+    r, sleeps, clock = make_retrier(
+        retry.RetryPolicy(max_attempts=100, base_delay=1.0, max_delay=1.0,
+                          jitter=0.0, deadline=2.5),
+        clock=clock,
+    )
+
+    def failing():
+        clock.advance(1.0)  # each attempt burns a second
+        raise OSError("slow failure")
+
+    with pytest.raises(OSError):
+        r.call(failing)
+    # attempt 1 (t=1) + sleep 1 -> attempt 2 (t=2): next sleep would
+    # land past the 2.5s deadline, so it gives up at 2 attempts
+    assert r.last_attempts == 2
+    assert r.stats.exhausted == 1
+
+
+def test_retrier_does_not_retry_non_retryable():
+    r, sleeps, _ = make_retrier(retry.RetryPolicy(max_attempts=5))
+    with pytest.raises(KeyError):
+        r.call(lambda: (_ for _ in ()).throw(KeyError("logic bug")))
+    assert sleeps == []
+    assert r.stats.exhausted == 0  # a logic error is not "exhausted"
+
+
+def test_retrier_custom_classifier():
+    r, sleeps, _ = make_retrier(
+        retry.RetryPolicy(max_attempts=5, base_delay=0.01, jitter=0.0,
+                          deadline=0),
+        retryable=lambda e: isinstance(e, ValueError),
+    )
+    with pytest.raises(OSError):
+        r.call(lambda: (_ for _ in ()).throw(OSError("not retryable here")))
+    assert sleeps == []
+
+
+# -- CircuitBreaker ----------------------------------------------------------
+
+def make_breaker(threshold=3, reset=10.0, probes=1, journal=None):
+    clock = FakeClock()
+    cb = retry.CircuitBreaker(
+        failure_threshold=threshold, reset_seconds=reset,
+        name="t", half_open_probes=probes, clock=clock, journal=journal,
+    )
+    return cb, clock
+
+
+def test_breaker_opens_after_consecutive_failures():
+    journal = EventJournal(capacity=16)
+    cb, clock = make_breaker(threshold=3, journal=journal)
+    for _ in range(2):
+        cb.on_failure()
+    assert cb.state() == retry.CLOSED
+    cb.on_success()  # success resets the consecutive count
+    for _ in range(2):
+        cb.on_failure()
+    assert cb.state() == retry.CLOSED
+    cb.on_failure()
+    assert cb.state() == retry.OPEN
+    assert cb.opens == 1
+    assert cb.is_open()
+    with pytest.raises(retry.CircuitOpenError):
+        cb.before_call()
+    assert journal.events(reason="CircuitOpen")
+
+
+def test_breaker_half_open_probe_closes_on_success():
+    journal = EventJournal(capacity=16)
+    cb, clock = make_breaker(threshold=1, reset=10.0, journal=journal)
+    cb.on_failure()
+    assert cb.state() == retry.OPEN
+    clock.advance(10.0)
+    assert cb.state() == retry.HALF_OPEN
+    assert not cb.is_open()  # half-open admits a probe: not refusing
+    cb.before_call()  # the probe is admitted
+    with pytest.raises(retry.CircuitOpenError):
+        cb.before_call()  # probe budget (1) exhausted
+    cb.on_success()
+    assert cb.state() == retry.CLOSED
+    assert journal.events(reason="CircuitClosed")
+
+
+def test_breaker_half_open_probe_failure_reopens():
+    cb, clock = make_breaker(threshold=1, reset=10.0)
+    cb.on_failure()
+    clock.advance(10.0)
+    cb.before_call()  # probe
+    cb.on_failure()   # probe failed
+    assert cb.state() == retry.OPEN
+    assert cb.opens == 2
+    clock.advance(5.0)
+    with pytest.raises(retry.CircuitOpenError):
+        cb.before_call()  # fresh reset window, still open
+
+
+def test_breaker_disabled_at_zero_threshold():
+    cb, _ = make_breaker(threshold=0)
+    for _ in range(100):
+        cb.on_failure()
+    assert cb.state() == retry.CLOSED
+    cb.before_call()  # never refuses
+    assert cb.opens == 0
+    assert not cb.enabled
+
+
+def test_breaker_state_codes():
+    cb, clock = make_breaker(threshold=1, reset=1.0)
+    assert cb.state_code() == 0
+    cb.on_failure()
+    assert cb.state_code() == 2
+    clock.advance(1.0)
+    assert cb.state_code() == 1
+
+
+def test_retrier_with_circuit_fails_fast_once_open():
+    cb, _ = make_breaker(threshold=2, reset=10.0)
+    r, sleeps, _ = make_retrier(
+        retry.RetryPolicy(max_attempts=10, base_delay=0.01, jitter=0.0,
+                          deadline=0),
+        circuit=cb,
+    )
+    calls = []
+
+    def failing():
+        calls.append(1)
+        raise OSError("down")
+
+    with pytest.raises(retry.CircuitOpenError):
+        r.call(failing)
+    # two real attempts tripped the breaker; the third admission was
+    # refused without touching the target — no 10-attempt hammering
+    assert len(calls) == 2
+    assert cb.opens == 1
+
+
+def test_retrier_non_retryable_answers_do_not_trip_circuit():
+    """A dependency that ANSWERS (409 conflicts, 404s) is healthy: a
+    streak of logical errors must never open the circuit and push the
+    extender into degraded mode."""
+    cb, _ = make_breaker(threshold=2)
+    r, _, _ = make_retrier(
+        retry.RetryPolicy(max_attempts=5, base_delay=0.01, jitter=0.0,
+                          deadline=0),
+        retryable=lambda e: isinstance(e, OSError),
+        circuit=cb,
+    )
+    for _ in range(5):
+        with pytest.raises(ValueError):
+            r.call(lambda: (_ for _ in ()).throw(ValueError("409-shaped")))
+    assert cb.state() == retry.CLOSED
+    assert cb.opens == 0
+
+
+def test_aborted_probe_releases_the_half_open_slot():
+    """An interrupted probe (BaseException) must not wedge the breaker
+    half-open with its budget consumed forever."""
+    cb, clock = make_breaker(threshold=1, reset=10.0)
+    cb.on_failure()
+    clock.advance(10.0)
+    with pytest.raises(KeyboardInterrupt):
+        cb.call(lambda: (_ for _ in ()).throw(KeyboardInterrupt()))
+    assert cb.state() == retry.HALF_OPEN
+    cb.before_call()  # the slot was released: a new probe is admitted
+    cb.on_success()
+    assert cb.state() == retry.CLOSED
+
+
+def test_retrier_aborted_probe_releases_the_slot():
+    cb, clock = make_breaker(threshold=1, reset=10.0)
+    r, _, _ = make_retrier(retry.RetryPolicy(max_attempts=3), circuit=cb)
+    cb.on_failure()
+    clock.advance(10.0)
+    with pytest.raises(KeyboardInterrupt):
+        r.call(lambda: (_ for _ in ()).throw(KeyboardInterrupt()))
+    assert cb.state() == retry.HALF_OPEN
+    cb.before_call()  # admitted: no leaked probe slot
+
+
+def test_breaker_call_wrapper_counts_outcomes():
+    cb, _ = make_breaker(threshold=2)
+    assert cb.call(lambda: "fine") == "fine"
+    with pytest.raises(OSError):
+        cb.call(lambda: (_ for _ in ()).throw(OSError("x")))
+    with pytest.raises(OSError):
+        cb.call(lambda: (_ for _ in ()).throw(OSError("x")))
+    assert cb.state() == retry.OPEN
+
+
+# -- config knobs ------------------------------------------------------------
+
+def test_policy_from_config_defaults():
+    cfg = load_config(env={})
+    p = retry.policy_from_config(cfg)
+    assert p.max_attempts == 5
+    assert p.base_delay == pytest.approx(0.1)
+    assert p.max_delay == pytest.approx(5.0)
+    assert p.jitter == pytest.approx(0.5)
+    assert p.deadline == pytest.approx(30.0)
+    # circuits ship DISABLED: chaos off by default
+    assert cfg.circuit_failure_threshold == 0
+    assert cfg.chaos_seed == 0
+
+
+def test_config_retry_knobs_load_and_coerce():
+    cfg = load_config(env={
+        "TPUKUBE_RETRY_MAX_ATTEMPTS": "7",
+        "TPUKUBE_RETRY_BASE_DELAY_SECONDS": "0.25",
+        "TPUKUBE_RETRY_JITTER": "0.1",
+        "TPUKUBE_RETRY_ATTEMPT_TIMEOUT_SECONDS": "2.5",
+        "TPUKUBE_CIRCUIT_FAILURE_THRESHOLD": "4",
+        "TPUKUBE_CIRCUIT_RESET_SECONDS": "12",
+        "TPUKUBE_CHAOS_SEED": "99",
+    })
+    assert cfg.retry_max_attempts == 7
+    assert cfg.retry_base_delay_seconds == pytest.approx(0.25)
+    assert cfg.retry_jitter == pytest.approx(0.1)
+    assert cfg.circuit_failure_threshold == 4
+    assert cfg.circuit_reset_seconds == pytest.approx(12.0)
+    assert cfg.chaos_seed == 99
+    p = retry.policy_from_config(cfg)
+    assert p.attempt_timeout == pytest.approx(2.5)
+
+
+def test_attempt_timeout_caps_rest_transport_timeout():
+    """The per-attempt deadline actually reaches the transport: a hung
+    attempt burns at most attempt_timeout of the overall deadline."""
+    from tpukube.apiserver import RestApiServer
+
+    cfg = load_config(env={
+        "TPUKUBE_RETRY_ATTEMPT_TIMEOUT_SECONDS": "2.5",
+    })
+    api = RestApiServer(
+        base_url="http://127.0.0.1:1", token="t",
+        retrier=retry.Retrier(retry.policy_from_config(cfg),
+                              name="apiserver"),
+    )
+    assert api._timeout == pytest.approx(2.5)
+    # 0 = keep the transport default
+    api2 = RestApiServer(
+        base_url="http://127.0.0.1:1", token="t",
+        retrier=retry.Retrier(retry.RetryPolicy(), name="apiserver"),
+    )
+    assert api2._timeout == pytest.approx(10.0)
+
+
+@pytest.mark.parametrize("env", [
+    {"TPUKUBE_RETRY_MAX_ATTEMPTS": "0"},
+    {"TPUKUBE_RETRY_BASE_DELAY_SECONDS": "0"},
+    {"TPUKUBE_RETRY_MAX_DELAY_SECONDS": "-1"},
+    {"TPUKUBE_RETRY_MAX_DELAY_SECONDS": "0.01"},  # < base_delay
+    {"TPUKUBE_RETRY_JITTER": "1.0"},
+    {"TPUKUBE_RETRY_JITTER": "-0.1"},
+    {"TPUKUBE_RETRY_DEADLINE_SECONDS": "-5"},
+    {"TPUKUBE_RETRY_ATTEMPT_TIMEOUT_SECONDS": "-1"},
+    {"TPUKUBE_CIRCUIT_FAILURE_THRESHOLD": "-1"},
+    {"TPUKUBE_CIRCUIT_RESET_SECONDS": "0"},
+    {"TPUKUBE_CIRCUIT_HALF_OPEN_PROBES": "0"},
+    {"TPUKUBE_CHAOS_SEED": "-1"},
+])
+def test_config_rejects_bad_retry_knobs(env):
+    with pytest.raises(ValueError):
+        load_config(env=env)

@@ -20,6 +20,16 @@ JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --deselect tests/test_workload.py::test_loss_decreases_under_training
 
 echo
+echo "== chaos smoke (scenarios 8-9: seeded apiserver chaos + crash"
+echo "   recovery; zero leaked reservations / zero ledger divergence) =="
+# fixed seed so the fault sequence — and therefore the pass — is
+# reproducible; the scenarios raise (non-zero exit) on any invariant
+# violation
+JAX_PLATFORMS=cpu TPUKUBE_CHAOS_SEED=1337 \
+  python -m tpukube.cli sim 8 > /dev/null
+JAX_PLATFORMS=cpu python -m tpukube.cli sim 9 > /dev/null
+
+echo
 echo "== native asan (libtpuinfo self-test under ASan/UBSan) =="
 if command -v g++ >/dev/null 2>&1; then
   make -C tpukube/native asan

@@ -45,7 +45,7 @@ import urllib.parse
 import urllib.request
 from typing import Any, Callable, Optional
 
-from tpukube.core import codec
+from tpukube.core import codec, retry
 
 log = logging.getLogger("tpukube.apiserver")
 
@@ -66,6 +66,16 @@ class ApiServerError(RuntimeError):
     def __init__(self, message: str, code: Optional[int] = None) -> None:
         super().__init__(message)
         self.code = code
+
+
+def transient_api_error(exc: BaseException) -> bool:
+    """The retry classifier every apiserver seam shares: transport
+    errors (no HTTP code) and 5xx are transient; everything else —
+    404, 409, 410, 429 — is a real answer the caller must handle, and
+    retrying it would only mask the logic error."""
+    if isinstance(exc, ApiServerError):
+        return exc.code is None or exc.code >= 500
+    return isinstance(exc, (OSError, ConnectionError))
 
 
 def encode_alloc_actual(device_ids: list[str]) -> str:
@@ -418,7 +428,17 @@ class RestApiServer:
         token_path: Optional[str] = None,
         ca_path: Optional[str] = None,
         timeout: float = 10.0,
+        retrier: Optional[retry.Retrier] = None,
+        circuit: Optional[retry.CircuitBreaker] = None,
     ) -> None:
+        """``retrier``/``circuit`` route every unary request through
+        the unified policy (core/retry.py): transient failures
+        (transport errors, 5xx) retry with jittered backoff and feed
+        the breaker; while the breaker is open, requests fail fast as
+        ApiServerError instead of stacking timeouts. Both default None
+        — the legacy single-attempt behavior. Watch STREAMS are not
+        retried here; the informer loops own reconnects (with their
+        own capped backoff)."""
         if base_url is None:
             host = os.environ.get("KUBERNETES_SERVICE_HOST")
             port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
@@ -444,6 +464,14 @@ class RestApiServer:
             )
         else:
             self._ssl = None
+        self.retrier = retrier
+        self.circuit = circuit
+        if retrier is not None and retrier.policy.attempt_timeout > 0:
+            # the policy's per-attempt deadline caps the transport
+            # timeout — a retried request must not spend its whole
+            # overall deadline waiting out one hung attempt
+            self._timeout = min(self._timeout,
+                                retrier.policy.attempt_timeout)
 
     def _authed_request(
         self, method: str, path: str, data: Optional[bytes] = None,
@@ -460,7 +488,7 @@ class RestApiServer:
             self._base + path, data=data, headers=headers, method=method
         )
 
-    def _request(
+    def _request_once(
         self, method: str, path: str, body: Optional[dict] = None,
         content_type: str = "application/merge-patch+json",
     ) -> Any:
@@ -483,6 +511,55 @@ class RestApiServer:
         except urllib.error.URLError as e:
             raise ApiServerError(f"{method} {path}: {e.reason}") from e
         return json.loads(payload) if payload else None
+
+    def _request(
+        self, method: str, path: str, body: Optional[dict] = None,
+        content_type: str = "application/merge-patch+json",
+    ) -> Any:
+        """One unary request through the unified retry/circuit layer
+        (when wired): each attempt consults the breaker, transient
+        outcomes feed it, and an open circuit fails fast. Retrying a
+        lost-response write is safe by the surface's own contract —
+        merge-patches re-apply, bind_pod treats already-bound-to-us as
+        success, evict_pod treats 404 as done."""
+        if self.retrier is None and self.circuit is None:
+            return self._request_once(method, path, body, content_type)
+
+        def attempt() -> Any:
+            if self.circuit is not None:
+                self.circuit.before_call()  # CircuitOpenError when open
+            try:
+                out = self._request_once(method, path, body, content_type)
+            except retry.CircuitOpenError:
+                raise
+            except Exception as e:
+                if self.circuit is not None:
+                    if transient_api_error(e):
+                        self.circuit.on_failure()
+                    else:
+                        # the server ANSWERED (404/409/429/...): the
+                        # channel is healthy, only the request lost
+                        self.circuit.on_success()
+                raise
+            except BaseException:
+                # interrupted, not answered: release any half-open
+                # probe slot so the breaker cannot wedge half-open
+                if self.circuit is not None:
+                    self.circuit.abort_probe()
+                raise
+            if self.circuit is not None:
+                self.circuit.on_success()
+            return out
+
+        try:
+            if self.retrier is not None:
+                return self.retrier.call(attempt)
+            return attempt()
+        except retry.CircuitOpenError as e:
+            # preserve the surface's error contract: callers catch
+            # ApiServerError; a fast-failed request is a transport-
+            # level failure with no HTTP code
+            raise ApiServerError(f"{method} {path}: {e}") from e
 
     # -- interface ---------------------------------------------------------
     def patch_node_annotations(
@@ -871,6 +948,14 @@ class _WatchLoop(_PollLoop):
         self.journal = None
         self._connects = 0
         self.reconnects = 0
+        # reconnect pacing: one poll interval after the FIRST failure,
+        # then jittered exponential growth up to 16x — a down apiserver
+        # (or a 410-Gone storm) must not be hammered at a fixed cadence
+        # by every informer in the fleet at once. Reset the moment a
+        # stream actually (re)connects.
+        self._reconnect_backoff = retry.Backoff(
+            base=poll_seconds, cap=poll_seconds * 16, jitter=0.5,
+        )
 
     def _resync(self) -> tuple[bool, Optional[str]]:  # pragma: no cover
         raise NotImplementedError
@@ -905,6 +990,10 @@ class _WatchLoop(_PollLoop):
             "stream_connected": self._stream_connected,
             "last_event_ts": self.last_event_time,
             "reconnects": self.reconnects,
+            # consecutive reconnect failures driving the current
+            # backoff (0 while healthy): non-zero here plus a stale
+            # last_event_ts is "the apiserver is down", not "quiet"
+            "reconnect_failures": self._reconnect_backoff.failures,
         }
 
     def _list_pods_rv(
@@ -924,6 +1013,8 @@ class _WatchLoop(_PollLoop):
         while not self._stop.is_set():
             box: list = []
             self._stream_box = box
+            delay = self._poll
+            stream_t0: Optional[float] = None
             try:
                 # resync at every (re)connect, then watch FROM the list's
                 # resourceVersion — no event in the list->watch gap is lost
@@ -941,9 +1032,15 @@ class _WatchLoop(_PollLoop):
                 # connected from here until the stream ends or fails:
                 # the resync landed and the watch is (about to be) open —
                 # the REST transport dials on first iteration, which
-                # happens immediately below
+                # happens immediately below. The reconnect backoff does
+                # NOT reset here: the REST generator is lazy, so nothing
+                # has actually dialed yet — a dial that fails every lap
+                # must keep escalating. Reset happens on demonstrated
+                # liveness: a delivered event, a clean stream end, or a
+                # stream that survived at least one poll interval.
                 self._stream_connected = True
                 self.last_event_time = time.time()
+                stream_t0 = time.monotonic()
                 self._connects += 1
                 if self._connects > 1:
                     self.reconnects += 1
@@ -964,9 +1061,14 @@ class _WatchLoop(_PollLoop):
                         if self._stop.is_set():
                             return
                         self.last_event_time = time.time()
+                        if self._reconnect_backoff.failures:
+                            # the stream is demonstrably delivering
+                            self._reconnect_backoff.reset()
                         self._apply_watch_event(etype, pod)
                 finally:
                     self._stream_connected = False
+                # clean end at the server timeout: the dial worked
+                self._reconnect_backoff.reset()
             except _ResyncNeeded:
                 # expected control flow, not a failure: back off one
                 # poll and resync (bounded retry for unfinished work)
@@ -974,8 +1076,23 @@ class _WatchLoop(_PollLoop):
             except Exception:
                 if self._stop.is_set():
                     return  # stop() closed the stream under us
-                log.exception("%s watch failed; reconnecting", self._name)
-            self._stop.wait(self._poll)  # backoff, then reconnect
+                # consecutive failures (down apiserver, 410 storms)
+                # escalate the reconnect delay instead of replaying a
+                # fixed-cadence hammer; the list-resync at the next
+                # (re)connect covers every event missed meanwhile
+                if (stream_t0 is not None
+                        and time.monotonic() - stream_t0 > self._poll):
+                    # an idle-but-open stream that lived at least a poll
+                    # interval before dying is a FRESH outage, not a
+                    # continuation of a dial-failure streak
+                    self._reconnect_backoff.reset()
+                delay = self._reconnect_backoff.next()
+                log.exception(
+                    "%s watch failed (consecutive failure %d); "
+                    "reconnecting in %.1fs", self._name,
+                    self._reconnect_backoff.failures, delay,
+                )
+            self._stop.wait(delay)  # backoff, then reconnect
 
     def stop(self) -> None:
         self._stop.set()
@@ -1663,6 +1780,11 @@ class EvictionExecutor(_PollLoop):
         # keys the watch has had ample time to see — O(1) confirmation
         # traffic instead of one GET per victim per poll
         self._watch_confirmer = None
+        # optional core/retry.Retrier for the per-key GET confirms: a
+        # transient apiserver blip then retries within this poll
+        # instead of gating a gang bind a whole extra interval. None
+        # (the default) keeps the poll-cadence-only legacy behavior.
+        self.retrier: Optional[retry.Retrier] = None
         self.evicted = 0   # pods confirmed gone (tests/metrics)
         self.blocked = 0   # PDB 429s requeued (tests/metrics)
         self.failures = 0  # transport/API errors requeued (tests/metrics)
@@ -1867,7 +1989,12 @@ class EvictionExecutor(_PollLoop):
         for pod_key in tracked:
             namespace, name = pod_key.split("/", 1)
             try:
-                pod = self._api.get_pod(namespace, name)
+                if self.retrier is not None:
+                    pod = self.retrier.call(
+                        lambda ns=namespace, n=name: self._api.get_pod(ns, n)
+                    )
+                else:
+                    pod = self._api.get_pod(namespace, name)
             except Exception as e:
                 log.warning("eviction confirm of %s failed, retrying: %s",
                             pod_key, e)

@@ -1,0 +1,37 @@
+"""Control-plane chaos harness (ISSUE 4 tentpole).
+
+Deterministic, seeded fault injection for the apiserver and
+plugin/kubelet seams: API errors (injected 503s, transport timeouts,
+410 Gone resyncs), dropped/duplicated watch events, torn annotation
+patches (the write lands, the response is lost), slow responses, and
+process "crashes" (extender teardown + cold restart mid-gang-commit,
+via the chaos cluster's crash/restart helpers).
+
+The schedule draws every fault decision from one seeded RNG in call
+order, so a scenario replays the same fault sequence for the same seed
+— chaos runs are regression tests, not dice rolls. Scenarios 8 and 9
+(`tpukube-sim 8|9`) drive this end to end and assert the recovery
+invariants: zero leaked gang reservations and zero ledger/apiserver
+divergence after the dust settles.
+"""
+
+from tpukube.chaos.api import ChaosApiServer
+from tpukube.chaos.cluster import (
+    ChaosSimCluster,
+    converge,
+    leaked_reservations,
+    ledger_divergence,
+    transient_api_error,
+)
+from tpukube.chaos.schedule import ChaosSpec, FaultSchedule
+
+__all__ = [
+    "ChaosApiServer",
+    "ChaosSimCluster",
+    "ChaosSpec",
+    "FaultSchedule",
+    "converge",
+    "leaked_reservations",
+    "ledger_divergence",
+    "transient_api_error",
+]

@@ -66,18 +66,46 @@ def _add_kube_api_args(p: argparse.ArgumentParser) -> None:
                    help="apiserver CA bundle (default: serviceaccount ca.crt)")
 
 
-def _make_apiserver(args: argparse.Namespace):
+def _make_apiserver(args: argparse.Namespace,
+                    cfg: Optional[TpuKubeConfig] = None, journal=None):
     """RestApiServer from flags / in-cluster env, or None when no
-    apiserver is reachable-by-configuration (sim/dev runs)."""
+    apiserver is reachable-by-configuration (sim/dev runs).
+
+    With ``cfg``, every unary request runs under the unified retry
+    policy (retry_* knobs) and — when circuit_failure_threshold > 0 —
+    behind a circuit breaker; ``journal`` receives the
+    RetryExhausted/CircuitOpen/CircuitClosed events. The built
+    Retrier/CircuitBreaker ride on the returned server as
+    ``api.retrier`` / ``api.circuit`` for metrics and degraded-mode
+    wiring."""
     if args.kube_api == "off":
         return None
-    from tpukube.apiserver import ApiServerError, RestApiServer
+    from tpukube.apiserver import (
+        ApiServerError,
+        RestApiServer,
+        transient_api_error,
+    )
+    from tpukube.core import retry
 
+    retrier = circuit = None
+    if cfg is not None:
+        circuit = retry.CircuitBreaker(
+            failure_threshold=cfg.circuit_failure_threshold,
+            reset_seconds=cfg.circuit_reset_seconds,
+            half_open_probes=cfg.circuit_half_open_probes,
+            name="apiserver", journal=journal,
+        )
+        retrier = retry.Retrier(
+            retry.policy_from_config(cfg), name="apiserver",
+            retryable=transient_api_error, journal=journal,
+        )
     try:
         return RestApiServer(
             base_url=args.kube_api,
             token_path=args.kube_token_file,
             ca_path=args.kube_ca_file,
+            retrier=retrier,
+            circuit=circuit,
         )
     except ApiServerError as e:
         if args.kube_api:  # explicitly requested: configuration error
@@ -209,7 +237,7 @@ def main_plugin(argv: Optional[list[str]] = None) -> int:
         # kubelet choices back onto the pod (apiserver channel optional —
         # the sim drives these objects directly)
         intent_watch = None
-        api = _make_apiserver(args)
+        api = _make_apiserver(args, cfg, journal=journal)
         if api is not None:
             from tpukube.apiserver import (
                 AllocIntentWatcher,
@@ -241,7 +269,11 @@ def main_plugin(argv: Optional[list[str]] = None) -> int:
 
         if kubelet_watch is not None:
             try:
-                server.register_with_kubelet()
+                # jittered backoff + max attempts via the unified retry
+                # policy (retry_* config knobs) — the session watcher's
+                # poll-cadence retry remains the outer safety net
+                kubelet_watch.retrier.journal = journal
+                kubelet_watch.retrier.call(server.register_with_kubelet)
             except Exception as e:
                 # kubelet not up yet (DaemonSet boot race): the session
                 # watcher registers on a later poll — do not crash-loop
@@ -295,14 +327,14 @@ def main_syncer(argv: Optional[list[str]] = None) -> int:
                    help="serve /metrics on this port (0 = disabled)")
     _add_kube_api_args(p)
     args = p.parse_args(argv)
-    _setup(args)
+    cfg = _setup(args)
     node = args.node or os.environ.get("NODE_NAME")
     if not node:
         p.error("--node or $NODE_NAME required")
 
     from tpukube.apiserver import NodeAnnotationSyncer
 
-    api = _make_apiserver(args)
+    api = _make_apiserver(args, cfg)
     if api is None:
         p.error("no apiserver: pass --kube-api or run in-cluster")
     syncer = NodeAnnotationSyncer(
@@ -397,7 +429,7 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
     loops = []
     reconcile = evictions = node_refresh = lifecycle = None
     pod_informer = None
-    api = _make_apiserver(args)
+    api = _make_apiserver(args, cfg, journal=extender.events)
     if api is not None:
         from tpukube.apiserver import (
             AllocReconcileLoop,
@@ -426,6 +458,18 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
         # with bindVerb delegated here, the extender must create the real
         # Binding — kube-scheduler won't
         extender.binder = pod_binder(api)
+        # the channel's retry/circuit objects ride on the extender so
+        # /metrics exports tpukube_retry_* / tpukube_circuit_*
+        extender.api_retrier = api.retrier
+        extender.api_circuit = api.circuit
+        if api.circuit is not None and api.circuit.enabled:
+            # degraded mode: while the apiserver circuit is open, fail
+            # filter/bind safe (no bind, no preemption plan) — an
+            # extender that cannot effect decisions must not make them
+            extender.degraded_gate = (
+                lambda: ("apiserver circuit open"
+                         if api.circuit.is_open() else None)
+            )
 
         # PDB precheck (dry-run Eviction POST): a preemption plan with a
         # PDB-blocked victim is refused before any irreversible eviction
@@ -513,13 +557,17 @@ def main_sim(argv: Optional[list[str]] = None) -> int:
         "tpukube-sim",
         "run a BASELINE config scenario against the real control-plane stack",
     )
-    p.add_argument("scenario", type=int, choices=range(1, 8),
+    p.add_argument("scenario", type=int, choices=range(1, 10),
                    help="BASELINE config number (1..5), 6 = the "
                         "steady-state churn benchmark (completions -> "
                         "release loop -> re-scheduling), 7 = fault "
                         "telemetry (chip + ICI link faults through the "
                         "telemetry pipeline: events, per-chip metrics, "
-                        "fleet rollup, SLO scrape)")
+                        "fleet rollup, SLO scrape), 8 = apiserver chaos "
+                        "under churn (seeded fault schedule, retry/"
+                        "circuit/degraded mode; chaos_seed config), "
+                        "9 = extender crash + cold restart mid-gang-"
+                        "commit (rebuild_from_pods + reconcile repair)")
     args = p.parse_args(argv)
     cfg = _setup(args)
 

@@ -66,6 +66,34 @@ class TpuKubeConfig:
     # tests/test_lint.py asserts the zero-overhead default.
     lock_monitor: bool = False
 
+    # unified retry policy (core/retry.py): jittered exponential
+    # backoff for every control-plane seam a Retrier is wired into
+    # (apiserver requests, eviction GET-confirms, kubelet
+    # registration). The knobs only shape retries where a Retrier
+    # exists — nothing new retries by default at a seam that did not
+    # retry before this policy existed.
+    retry_max_attempts: int = 5
+    retry_base_delay_seconds: float = 0.1
+    retry_max_delay_seconds: float = 5.0
+    retry_jitter: float = 0.5  # fraction of each delay randomized away
+    retry_deadline_seconds: float = 30.0  # overall wall budget (0 = none)
+    # per-attempt transport-timeout cap: one hung attempt must not eat
+    # the whole overall deadline (0 = keep the transport's own default)
+    retry_attempt_timeout_seconds: float = 0.0
+    # apiserver circuit breaker (core/retry.py CircuitBreaker):
+    # failure_threshold consecutive transport/5xx failures open the
+    # circuit; requests then fail fast for reset_seconds before
+    # half-open probing. 0 DISABLES the breaker (the default — legacy
+    # behavior), and with it the extender's degraded mode.
+    circuit_failure_threshold: int = 0
+    circuit_reset_seconds: float = 30.0
+    circuit_half_open_probes: int = 1
+    # chaos harness (tpukube/chaos/): deterministic fault-schedule seed
+    # for the sim's chaos scenarios (8/9). 0 = chaos off everywhere;
+    # scenario code falls back to its own fixed seed so `tpukube-sim 8`
+    # is reproducible out of the box.
+    chaos_seed: int = 0
+
     # Which ICI slice this node belongs to (multi-slice clusters name
     # their pod slices; coords are slice-local — SURVEY.md §3 ICI/DCN note)
     slice_id: str = "slice-0"
@@ -181,4 +209,31 @@ def load_config(
             "trace_sink_max_bytes, events_capacity, and "
             "events_sink_max_bytes must be >= 0"
         )
+    if cfg.retry_max_attempts < 1:
+        raise ValueError("retry_max_attempts must be >= 1")
+    if cfg.retry_base_delay_seconds <= 0 or cfg.retry_max_delay_seconds <= 0:
+        raise ValueError("retry delays must be positive")
+    if cfg.retry_max_delay_seconds < cfg.retry_base_delay_seconds:
+        raise ValueError(
+            "retry_max_delay_seconds must be >= retry_base_delay_seconds"
+        )
+    if not 0.0 <= cfg.retry_jitter < 1.0:
+        raise ValueError("retry_jitter must be in [0, 1)")
+    if cfg.retry_deadline_seconds < 0:
+        raise ValueError("retry_deadline_seconds must be >= 0 (0 = none)")
+    if cfg.retry_attempt_timeout_seconds < 0:
+        raise ValueError(
+            "retry_attempt_timeout_seconds must be >= 0 (0 = transport "
+            "default)"
+        )
+    if cfg.circuit_failure_threshold < 0:
+        raise ValueError(
+            "circuit_failure_threshold must be >= 0 (0 = disabled)"
+        )
+    if cfg.circuit_reset_seconds <= 0:
+        raise ValueError("circuit_reset_seconds must be positive")
+    if cfg.circuit_half_open_probes < 1:
+        raise ValueError("circuit_half_open_probes must be >= 1")
+    if cfg.chaos_seed < 0:
+        raise ValueError("chaos_seed must be >= 0 (0 = chaos off)")
     return cfg

@@ -134,6 +134,22 @@ def build_extender_registry(extender, reconcile=None, evictions=None,
     events = getattr(extender, "events", None)
     if events is not None:
         _add_events_counter(reg, events)
+    # unified retry/circuit layer (ISSUE 4): series render only when
+    # the daemon actually wired the channel objects — sim/dev
+    # extenders keep the legacy exposition byte-identical
+    _add_retry_metrics(
+        reg,
+        retriers=[r for r in (getattr(extender, "api_retrier", None),)
+                  if r is not None],
+        circuits=[c for c in (getattr(extender, "api_circuit", None),)
+                  if c is not None],
+    )
+    if getattr(extender, "degraded_gate", None) is not None:
+        reg.gauge(
+            "tpukube_degraded_mode",
+            fn=lambda: 1.0 if extender._degraded_reason() else 0.0,
+            help_text="1 while the extender fails filter/bind safe "
+                      "because its apiserver circuit is open.")
     return reg
 
 
@@ -185,6 +201,10 @@ def build_plugin_registry(server, health=None, kubelet_watch=None,
     if kubelet_watch is not None:
         reg.counter("tpukube_plugin_reregistrations_total",
                     fn=lambda: kubelet_watch.reregistrations)
+        # the registration retrier's counters (unified retry layer)
+        _add_retry_metrics(
+            reg, retriers=[getattr(kubelet_watch, "retrier", None)]
+        )
     if intent_watch is not None:
         reg.counter("tpukube_plugin_intent_watch_events_total",
                     fn=lambda: intent_watch.watch_events)
@@ -193,6 +213,47 @@ def build_plugin_registry(server, health=None, kubelet_watch=None,
     if events is not None:
         _add_events_counter(reg, events)
     return reg
+
+
+def _add_retry_metrics(reg: Registry, retriers=(), circuits=()) -> None:
+    """Retry/circuit families (core/retry.py), one child per named
+    Retrier/CircuitBreaker — shared by both daemons' builders so the
+    series shapes can never drift apart."""
+    retriers = [r for r in retriers if r is not None]
+    circuits = [c for c in circuits if c is not None]
+    if retriers:
+        attempts = reg.counter(
+            "tpukube_retry_attempts_total",
+            help_text="Call attempts made under the unified retry "
+                      "policy, by operation.")
+        retries = reg.counter(
+            "tpukube_retry_retries_total",
+            help_text="Attempts beyond the first (each one is a "
+                      "transient failure that was retried).")
+        exhausted = reg.counter(
+            "tpukube_retry_exhausted_total",
+            help_text="Calls that gave up after max attempts or the "
+                      "overall deadline (RetryExhausted events).")
+        for r in retriers:
+            attempts.labels(op=r.name).set_function(
+                lambda r=r: r.stats.attempts)
+            retries.labels(op=r.name).set_function(
+                lambda r=r: r.stats.retries)
+            exhausted.labels(op=r.name).set_function(
+                lambda r=r: r.stats.exhausted)
+    if circuits:
+        state = reg.gauge(
+            "tpukube_circuit_state",
+            help_text="Breaker state: 0 closed, 1 half-open, 2 open.")
+        opens = reg.counter(
+            "tpukube_circuit_opens_total",
+            help_text="Times the breaker tripped open (CircuitOpen "
+                      "events).")
+        for c in circuits:
+            state.labels(circuit=c.name).set_function(
+                lambda c=c: c.state_code())
+            opens.labels(circuit=c.name).set_function(
+                lambda c=c: c.opens)
 
 
 def _add_events_counter(reg: Registry, events) -> None:

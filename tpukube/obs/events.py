@@ -16,7 +16,8 @@ reason fails lint instead of silently fragmenting the journal):
   GangReserved, GangCommitted, GangRollback, GangDissolved,
   PreemptionPlanned, PreemptionExecuted, VictimEvicted, VictimGone,
   ChipUnhealthy, ChipRecovered, LinkFault, LinkRecovered,
-  WatchReconnected, AllocDiverged, KubeletReregistered, BindFailed
+  WatchReconnected, AllocDiverged, KubeletReregistered, BindFailed,
+  CircuitOpen, CircuitClosed, RetryExhausted, DegradedMode
 
 Dedup follows the K8s model: an event with the same (reason, object,
 message) as a live ring entry bumps that entry's ``count`` and
@@ -48,6 +49,9 @@ REASONS: tuple[str, ...] = (
     "BindFailed",
     "ChipRecovered",
     "ChipUnhealthy",
+    "CircuitClosed",
+    "CircuitOpen",
+    "DegradedMode",
     "GangCommitted",
     "GangDissolved",
     "GangReserved",
@@ -57,6 +61,7 @@ REASONS: tuple[str, ...] = (
     "LinkRecovered",
     "PreemptionExecuted",
     "PreemptionPlanned",
+    "RetryExhausted",
     "VictimEvicted",
     "VictimGone",
     "WatchReconnected",

@@ -58,6 +58,14 @@ DECLARED_SERIES: frozenset[str] = frozenset({
     "tpukube_lifecycle_releases_total",
     # both daemons (event journal)
     "tpukube_events_total",
+    # both daemons (unified retry/circuit layer, core/retry.py; series
+    # render only where a Retrier/CircuitBreaker is actually wired)
+    "tpukube_retry_attempts_total",
+    "tpukube_retry_retries_total",
+    "tpukube_retry_exhausted_total",
+    "tpukube_circuit_state",
+    "tpukube_circuit_opens_total",
+    "tpukube_degraded_mode",
     # node agent (tpukube.metrics.build_plugin_registry)
     "tpukube_plugin_allocations_total",
     "tpukube_plugin_devices",

@@ -507,7 +507,8 @@ class KubeletSessionWatcher:
     """
 
     def __init__(self, server: DevicePluginServer,
-                 poll_seconds: Optional[float] = None):
+                 poll_seconds: Optional[float] = None,
+                 retrier=None):
         self._server = server
         if poll_seconds is None:
             poll_seconds = server.config.health_poll_seconds
@@ -518,6 +519,20 @@ class KubeletSessionWatcher:
         self._needs_register = False
         self.reregistrations = 0  # metrics/tests
         self.events = None  # optional EventJournal (daemon main wires it)
+        # registration attempts run under the unified retry policy
+        # (config retry_* knobs): jittered exponential backoff with a
+        # max-attempt cap INSIDE one poll, on top of the poll-cadence
+        # outer retry the _needs_register flag already provides — a
+        # kubelet that is up-but-not-serving-yet converges in hundreds
+        # of ms instead of a whole poll interval per attempt
+        if retrier is None:
+            from tpukube.core import retry
+
+            retrier = retry.Retrier(
+                retry.policy_from_config(server.config),
+                name="kubelet-register",
+            )
+        self.retrier = retrier
 
     def _ident(self) -> Optional[tuple[int, int, int]]:
         try:
@@ -567,12 +582,19 @@ class KubeletSessionWatcher:
             self._server.restart()
         if kubelet_restarted:
             log.warning("kubelet socket identity changed; re-registering")
+        # was this poll entered because an EARLIER registration failed
+        # (initial-registration failure via mark_unregistered, or a
+        # previous poll whose Register died)? The pre-existing flag —
+        # read BEFORE this poll re-arms it — is that memory; success
+        # below is then a recovery worth journaling as such.
+        recovering = self._needs_register
         # registration state is tracked separately from kubelet identity:
         # after a rebind whose Register failed, the next poll sees the
         # socket present and the identity unchanged — only this flag makes
         # it retry instead of leaving the plugin silently unregistered
         self._needs_register = True
-        self._server.register_with_kubelet()
+        self.retrier.journal = self.events
+        self.retrier.call(self._server.register_with_kubelet)
         # commit the observed identity only AFTER registration succeeded —
         # a failed Register (new kubelet not serving yet) must leave the
         # restart event pending so the next poll retries
@@ -580,11 +602,18 @@ class KubeletSessionWatcher:
         self._needs_register = False
         self.reregistrations += 1
         if self.events is not None:
+            attempts = self.retrier.last_attempts
+            if recovering:
+                msg = "registration recovered after earlier failure"
+            else:
+                msg = "kubelet restarted; plugin re-registered"
+            if attempts > 1:
+                msg += f" (succeeded on attempt {attempts})"
             try:
                 self.events.emit(
                     "KubeletReregistered",
                     obj=f"node/{self._server._device.host}",
-                    message="kubelet restarted; plugin re-registered",
+                    message=msg,
                     node=self._server._device.host,
                 )
             except Exception:

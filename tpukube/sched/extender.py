@@ -156,6 +156,21 @@ class Extender:
         # by bind() when a binder is set, consumed by _handle_bind's
         # effector undo
         self._bind_gang_info: dict[str, tuple[Any, bool]] = {}
+        # Degraded mode (ISSUE 4): a callable returning a human reason
+        # while the apiserver circuit is open (None = healthy). While
+        # degraded, /filter and /bind FAIL SAFE — no feasibility
+        # answer, no preemption plan, no bind — because an extender
+        # that cannot reach the apiserver cannot effect (or verify) any
+        # decision it makes; the scheduler retries once the circuit
+        # half-opens. cli wires this to the channel's CircuitBreaker;
+        # None (the default) disables the gate entirely. The gate must
+        # only read memory — it is consulted on the webhook hot path.
+        self.degraded_gate = None
+        # the apiserver channel's Retrier/CircuitBreaker, attached by
+        # the daemon main purely so /metrics can export their counters
+        # (tpukube_retry_* / tpukube_circuit_*); None in sim/dev
+        self.api_retrier = None
+        self.api_circuit = None
 
     def _emit_event(self, reason: str, obj: str, message: str,
                     warning: bool = True) -> None:
@@ -167,6 +182,18 @@ class Extender:
             )
         except Exception:
             log.exception("event emit failed: %s %s", reason, obj)
+
+    def _degraded_reason(self) -> Optional[str]:
+        """The degraded gate's answer, never letting a broken gate
+        break scheduling (a gate failure reads as healthy)."""
+        gate = self.degraded_gate
+        if gate is None:
+            return None
+        try:
+            return gate()
+        except Exception:
+            log.exception("degraded gate failed; treating as healthy")
+            return None
 
     def _remember(self, pod: PodInfo) -> None:
         now = time.monotonic()
@@ -1035,6 +1062,21 @@ class Extender:
         """
         if kind == "bind":
             return self._handle_bind(body)
+        if kind == "filter":
+            reason = self._degraded_reason()
+            if reason is not None:
+                # fail safe BEFORE any mutation or trace record (the
+                # schema-error contract): no reservation is created, no
+                # preemption planned, and the refusal replays as
+                # nothing because it changed nothing
+                pod, nodes, names = kube.parse_extender_args(body)
+                mk = (kube.filter_result if nodes is not None
+                      else kube.filter_result_names)
+                self._emit_event(
+                    "DegradedMode", "extender/filter",
+                    f"failing filter requests safe: {reason}",
+                )
+                return mk([], {}, error=f"degraded mode: {reason}")
         with self._decision_lock:
             if kind == "filter":
                 pod, nodes, names = kube.parse_extender_args(body)
@@ -1108,6 +1150,17 @@ class Extender:
         wire response reports the failure to the scheduler for a retry."""
         name, ns, uid, node = kube.parse_binding_args(body)
         key = f"{ns}/{name}"
+        degraded = self._degraded_reason()
+        if degraded is not None:
+            # same fail-safe contract as filter: refused before any
+            # mutation, nothing recorded — a bind the effector could
+            # not deliver anyway must not touch the ledger or execute
+            # a preemption plan
+            self._emit_event(
+                "DegradedMode", "extender/bind",
+                f"failing bind requests safe: {degraded}",
+            )
+            return kube.binding_result(f"{key}: degraded mode: {degraded}")
         blocked = self._precheck_preemption(key)
         if blocked:
             # refused BEFORE any mutation, so nothing is recorded (same

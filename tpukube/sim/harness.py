@@ -44,12 +44,16 @@ from tpukube.sched.extender import Extender, make_app
 
 
 class _PodStoreApi:
-    """Adapter giving EvictionExecutor and PodLifecycleReleaseLoop the
-    apiserver surface over the harness's in-memory pod store (no PDBs in
-    the sim)."""
+    """Adapter giving EvictionExecutor, PodLifecycleReleaseLoop, the
+    bind effector, and the restart rebuild the apiserver surface over
+    the harness's in-memory pod store (no PDBs in the sim).
+    ``nodes_fn`` supplies Node objects for ``list_nodes`` (the
+    restart-rebuild's topology source)."""
 
-    def __init__(self, pods: dict[str, dict[str, Any]]) -> None:
+    def __init__(self, pods: dict[str, dict[str, Any]],
+                 nodes_fn=None) -> None:
         self._pods = pods
+        self._nodes_fn = nodes_fn
 
     def evict_pod(
         self, namespace: str, name: str, dry_run: bool = False
@@ -71,6 +75,52 @@ class _PodStoreApi:
             if node_name is None
             or p.get("spec", {}).get("nodeName") == node_name
         ]
+
+    def list_nodes(self) -> list[dict[str, Any]]:
+        return self._nodes_fn() if self._nodes_fn is not None else []
+
+    def bind_pod(
+        self, namespace: str, name: str, node: str,
+        annotations: Optional[dict[str, str]] = None,
+    ) -> None:
+        """FakeApiServer.bind_pod semantics over the dict store:
+        conflict check first, already-bound-to-the-same-node is
+        idempotent-retry success (what makes torn bind writes safe to
+        retry), 404 when the pod is gone."""
+        from tpukube.apiserver import ApiServerError
+
+        key = f"{namespace}/{name}"
+        pod = self._pods.get(key)
+        if pod is None:
+            raise ApiServerError(f"pod {key} not found", code=404)
+        spec = pod.setdefault("spec", {})
+        bound_to = spec.get("nodeName")
+        if bound_to and bound_to != node:
+            raise ApiServerError(
+                f"pod {key} is already bound to {bound_to!r}, "
+                f"not {node!r}", code=409,
+            )
+        if annotations:
+            pod["metadata"].setdefault("annotations", {}).update(annotations)
+        spec["nodeName"] = node
+
+    def patch_pod_annotations(
+        self, namespace: str, name: str, annotations: dict[str, Optional[str]]
+    ) -> None:
+        """Merge-patch (None deletes), 404 on a missing pod — mirrors
+        the real channel so reconcile/divergence paths run unchanged."""
+        from tpukube.apiserver import ApiServerError
+
+        key = f"{namespace}/{name}"
+        pod = self._pods.get(key)
+        if pod is None:
+            raise ApiServerError(f"pod {key} not found", code=404)
+        annos = pod["metadata"].setdefault("annotations", {})
+        for k, v in annotations.items():
+            if v is None:
+                annos.pop(k, None)
+            else:
+                annos[k] = v
 
 
 def _free_port() -> int:
@@ -198,7 +248,32 @@ class SimCluster:
                 )
         self.extender = Extender(self.config)
         self.pods: dict[str, dict[str, Any]] = {}  # key -> pod object
-        store_api = _PodStoreApi(self.pods)
+        self._store_api = self._make_store_api()
+        self._wire_extender()
+        self._node_obj_cache: dict[str, dict[str, Any]] = {}
+        self._synced_objs: list[dict[str, Any]] = []  # see _extender_node_args
+        self._port = _free_port()
+        self._http: Optional[_AppThread] = None
+        # keep-alive connection per client thread (kube-scheduler likewise
+        # reuses connections to its extenders; per-request TCP setup was
+        # the dominant term in the measured gang-commit latency).
+        # http.client connections are not thread-safe, and tests drive
+        # schedule() from many threads at once — hence thread-local.
+        self._tls = threading.local()
+
+    def _make_store_api(self):
+        """The apiserver surface the effectors run against; the chaos
+        harness overrides this to wrap it in a fault injector."""
+        return _PodStoreApi(self.pods, nodes_fn=self.node_objects)
+
+    def _wire_extender(self) -> None:
+        """Attach the effectors a real extender daemon wires (eviction
+        executor, lifecycle release loop, PDB precheck) to
+        ``self.extender`` — called at construction AND after a
+        restart_extender() cold start, exactly like a fresh daemon
+        main. The chaos harness extends this with binder/retry/circuit
+        wiring."""
+        store_api = self._store_api
         self._evictions = EvictionExecutor(
             self.extender, store_api
         )  # drained inline by schedule(); not started as a thread
@@ -216,16 +291,6 @@ class SimCluster:
                 *pod_key.split("/", 1), dry_run=True
             )
         )
-        self._node_obj_cache: dict[str, dict[str, Any]] = {}
-        self._synced_objs: list[dict[str, Any]] = []  # see _extender_node_args
-        self._port = _free_port()
-        self._http: Optional[_AppThread] = None
-        # keep-alive connection per client thread (kube-scheduler likewise
-        # reuses connections to its extenders; per-request TCP setup was
-        # the dominant term in the measured gang-commit latency).
-        # http.client connections are not thread-safe, and tests drive
-        # schedule() from many threads at once — hence thread-local.
-        self._tls = threading.local()
 
     # -- lifecycle ---------------------------------------------------------
     @property
@@ -279,6 +344,44 @@ class SimCluster:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+    # -- crash / cold restart (chaos scenario 9) -----------------------------
+    def crash_extender(self) -> None:
+        """Simulate extender process death mid-flight: the HTTP
+        listener disappears and every piece of in-memory scheduler
+        state — ledger, gang reservations, pending webhook context,
+        queued evictions — is gone. Nothing is flushed or unwound;
+        that is the point."""
+        conn = getattr(self._tls, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._tls.conn = None
+        if self._http is not None:
+            self._http.stop()
+            self._http = None
+
+    def restart_extender(self) -> int:
+        """Cold-start a fresh extender the way a restarted daemon does:
+        new Extender, ledger + gang reservations rebuilt from the
+        apiserver (node annotations, then live bound pods' alloc
+        annotations — apiserver.rebuild_extender), effectors re-wired,
+        HTTP serving resumed on the same port. Returns the number of
+        allocations restored."""
+        from tpukube.apiserver import rebuild_extender
+
+        if self._http is not None:
+            raise RuntimeError("crash_extender() first — the old "
+                               "extender is still serving")
+        self.extender = Extender(self.config)
+        self._wire_extender()
+        restored = rebuild_extender(self.extender, self._store_api)
+        # the fresh extender has ingested nothing over the webhook
+        # channel yet: the next schedule() must send full node objects
+        self._synced_objs = []
+        self._http = _AppThread(make_app(self.extender), "127.0.0.1",
+                                self._port)
+        self._http.start()
+        return restored
 
     # -- kube-object minting -----------------------------------------------
     def _invalidate_node(self, name: str) -> None:
@@ -523,11 +626,17 @@ class SimCluster:
             self._invalidate_node(name)
 
     # -- node-agent composition check (config 2's fan-out leg) ---------------
-    def execute_allocation(self, alloc: AllocResult) -> dict[str, str]:
+    def execute_allocation(self, alloc: AllocResult,
+                           restart_agent: bool = False) -> dict[str, str]:
         """Run the bound pod's Allocate through a REAL device-plugin stack
         (gRPC over unix sockets) for the target node, returning the env the
         container would receive. Sessions are sequential because libtpuinfo
-        is single-instance per process."""
+        is single-instance per process.
+
+        ``restart_agent=True`` tears the plugin server down and cold-starts
+        it between registration and Allocate (socket unlinked + rebound +
+        re-registered) — the node-agent half of the chaos crash story: a
+        restarted agent must still serve the extender's planned intent."""
         import tempfile
 
         from tpukube.core.config import load_config as _load
@@ -567,6 +676,18 @@ class SimCluster:
                 kubelet.wait_for_devices(
                     server.resource_name, len(device.device_list())
                 )
+                if restart_agent:
+                    # cold restart mid-session: socket torn down and
+                    # rebound, registration redone, intent re-fed (a
+                    # restarted agent's intent watcher re-syncs from
+                    # the pod's alloc annotation exactly like this)
+                    server.restart()
+                    server.intents.put(alloc.pod_key,
+                                       list(alloc.device_ids))
+                    server.register_with_kubelet()
+                    kubelet.wait_for_devices(
+                        server.resource_name, len(device.device_list())
+                    )
                 return kubelet.allocate(server.resource_name, alloc.device_ids)
 
     # -- metrics ------------------------------------------------------------

@@ -28,6 +28,8 @@ def run(scenario: int, config: TpuKubeConfig | None = None) -> dict[str, Any]:
         5: multi_tenant_northstar,
         6: churn,
         7: fault_telemetry,
+        8: apiserver_chaos,
+        9: crash_recovery,
     }[scenario]
     t0 = time.perf_counter()
     result = fn(config)
@@ -409,3 +411,291 @@ def fault_telemetry(config: TpuKubeConfig | None) -> dict[str, Any]:
                 for name, entry in slo_eval.items()
             },
         }
+
+
+def apiserver_chaos(config: TpuKubeConfig | None) -> dict[str, Any]:
+    """Scenario 8: seeded apiserver chaos under gang + burst churn.
+
+    A ChaosSimCluster runs the full control plane — preempting gang,
+    burst fill, completion churn — while the fault schedule injects
+    503s, transport timeouts, torn writes, and slow responses into the
+    eviction / lifecycle / bind-effector seams. A blackout phase
+    (every request failing) then trips the apiserver circuit and
+    proves degraded mode: filter requests fail SAFE while the circuit
+    is open, and scheduling resumes through the half-open probe once
+    the chaos stops. Acceptance: zero leaked gang reservations and
+    zero ledger/apiserver divergence after the dust settles.
+    """
+    from tpukube.chaos import (
+        ChaosSimCluster,
+        ChaosSpec,
+        FaultSchedule,
+        converge,
+        leaked_reservations,
+        ledger_divergence,
+    )
+
+    import os
+
+    # canonical topology, but the seed knob must work WITHOUT --config:
+    # the scenario's fixed env dict would otherwise shadow the
+    # process's TPUKUBE_CHAOS_SEED entirely
+    env = {
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    }
+    if os.environ.get("TPUKUBE_CHAOS_SEED"):
+        env["TPUKUBE_CHAOS_SEED"] = os.environ["TPUKUBE_CHAOS_SEED"]
+    cfg = config or load_config(env=env)
+    seed = cfg.chaos_seed or 1337
+    storm = ChaosSpec(
+        error_rate=0.12, timeout_rate=0.08, torn_rate=0.10,
+        slow_rate=0.05, slow_seconds=0.001,
+        gone_rate=0.10, drop_event_rate=0.05, dup_event_rate=0.05,
+    )
+    schedule_ = FaultSchedule(seed, storm)
+
+    with ChaosSimCluster(cfg, schedule_) as c:
+
+        def robust(pod, deadline_s: float = 60.0,
+                   retry_unschedulable: bool = True):
+            """schedule() with the outer retry a real kube-scheduler
+            queue provides: degraded-mode refusals wait out the
+            circuit's reset window; chaos-exhausted binds,
+            victims-terminating gates, and release lag just requeue.
+            Each retry also steps the lifecycle loop — the real
+            daemon's release watch runs concurrently; the sim steps
+            it deterministically."""
+            t0 = time.monotonic()
+            while True:
+                try:
+                    return c.schedule(pod)
+                except RuntimeError as e:
+                    msg = str(e)
+                    if not retry_unschedulable and "unschedulable" in msg:
+                        raise
+                    if time.monotonic() - t0 > deadline_s:
+                        raise
+                    if "degraded mode" in msg:
+                        time.sleep(c.CIRCUIT_RESET_S)
+                    try:
+                        c._lifecycle.check_once()
+                    except RuntimeError:
+                        pass  # chaos-injected resync failure; next lap
+                    continue
+
+        # fill the mesh with bursts, then a priority gang preempts its
+        # way in — evictions, confirms, and binds all under fault fire
+        fill = 0
+        while True:
+            try:
+                robust(c.make_pod(f"burst-{fill}", tpu=1),
+                       deadline_s=20.0, retry_unschedulable=False)
+                fill += 1
+            except RuntimeError:
+                break
+        n_chips = sum(m.num_chips for m in c.slices.values())
+        group = PodGroup("storm", min_member=n_chips // 2)
+        for i in range(n_chips // 2):
+            robust(c.make_pod(f"storm-{i}", tpu=1, priority=100,
+                              group=group))
+
+        # churn: survivors finish, replacements land in the freed chips
+        survivors = sorted(
+            a.pod_key.split("/", 1)[1]
+            for a in c.extender.state.allocations()
+            if a.pod_key.startswith("default/burst-")
+        )
+        finished = survivors[:4]
+        for name in finished:
+            try:
+                c.complete_pod(name)
+            except RuntimeError:
+                pass  # release deferred by an injected fault; converge
+        converge(c)
+        for i in range(len(finished)):
+            robust(c.make_pod(f"refill-{i}", tpu=1), deadline_s=20.0)
+
+        # free one chip BEFORE the blackout so the probe pod passes
+        # filter and reaches the (failing) bind effector — a full mesh
+        # would answer "unschedulable" without ever touching the
+        # circuit
+        try:
+            c.complete_pod("refill-0")
+        except RuntimeError:
+            pass
+        converge(c)
+
+        # blackout: every apiserver call fails until the circuit opens
+        # and the extender fails filter requests safe (degraded mode)
+        schedule_.resume(ChaosSpec(error_rate=1.0))
+        degraded_before = c.extender.events.counts_by_reason().get(
+            "DegradedMode", 0)
+        blackout_refused = False
+        try:
+            c.schedule(c.make_pod("blackout-probe", tpu=1), retries=12)
+        except RuntimeError:
+            blackout_refused = True
+        degraded_refusals = c.extender.events.counts_by_reason().get(
+            "DegradedMode", 0) - degraded_before
+
+        # quiet: chaos off, circuit half-opens, scheduling resumes
+        schedule_.stop()
+        time.sleep(c.CIRCUIT_RESET_S * 2)
+        robust(c.make_pod("recovery-probe", tpu=1))
+        converge_rounds = converge(c)
+
+        leaks = leaked_reservations(c)
+        div = ledger_divergence(c)
+        reasons = c.extender.events.counts_by_reason()
+        gangs = c.extender.gang_snapshot()
+        committed = [g for g in gangs if g["committed"]]
+        result = {
+            "metric": "apiserver_chaos",
+            "value": schedule_.injected(),
+            "unit": "faults injected",
+            "faults": schedule_.report(),
+            "blackout_refused": blackout_refused,
+            "degraded_refusals": degraded_refusals,
+            "circuit": {
+                "opens": c.circuit.opens,
+                "state": c.circuit.state(),
+            },
+            "retry": {
+                "bind_attempts": c.bind_retrier.stats.attempts,
+                "bind_retries": c.bind_retrier.stats.retries,
+                "bind_exhausted": c.bind_retrier.stats.exhausted,
+                "retry_exhausted_events": reasons.get("RetryExhausted", 0),
+            },
+            "gang_committed": bool(committed),
+            "preemptions": c.extender.preemptions,
+            "converge_rounds": converge_rounds,
+            "evictions_pending": c._evictions.depth(),
+            "leaked_reservations": len(leaks),
+            "ledger_divergence": len(div),
+            "utilization_percent": round(100 * c.utilization(), 2),
+        }
+        # the acceptance invariants FAIL the scenario, not just dent a
+        # number — a chaos run that leaks is a bug, full stop
+        problems = [str(p) for p in leaks] + div
+        if c._evictions.depth():
+            problems.append(
+                f"{c._evictions.depth()} eviction(s) still pending")
+        if not committed:
+            problems.append("the storm gang never committed")
+        if not (blackout_refused and degraded_refusals > 0
+                and c.circuit.opens > 0):
+            problems.append(
+                "blackout did not trip the circuit into degraded mode")
+        if problems:
+            raise RuntimeError("scenario 8 invariants violated: "
+                               + "; ".join(problems))
+        return result
+
+
+def crash_recovery(config: TpuKubeConfig | None) -> dict[str, Any]:
+    """Scenario 9: extender crash mid-gang-commit + cold restart.
+
+    Half a gang binds, then the extender "process" dies — HTTP gone,
+    ledger, reservations, and pending webhook context lost. A fresh
+    extender rebuilds from the apiserver (node annotations + live
+    bound pods' alloc annotations, via rebuild_from_pods), restoring
+    the partial gang as a partial RESERVATION; the remaining members
+    then bind and the gang commits. Rebuild residue the restart must
+    skip — a finished pod's lingering annotation and an unbound pod's
+    partial-failure annotation — is planted up front. The node-agent
+    half restarts too: one member's Allocate runs through a device
+    plugin that is torn down and re-registered mid-session.
+    Acceptance: gang committed, zero leaked reservations, zero ledger
+    divergence, recovery within the scenario wall.
+    """
+    from tpukube.chaos import converge, leaked_reservations, \
+        ledger_divergence
+    from tpukube.core import codec
+    from tpukube.core.types import AllocResult, TopologyCoord
+
+    cfg = config or load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        group = PodGroup("phoenix", min_member=8)
+        for i in range(4):
+            c.schedule(c.make_pod(f"phoenix-{i}", tpu=1, priority=10,
+                                  group=group))
+
+        # rebuild-residue plants: a finished pod whose annotation
+        # lingers (chips are free — restoring it would leak) and an
+        # unbound pod carrying bind partial-failure residue
+        c.schedule(c.make_pod("finished", tpu=1))
+        c.pods["default/finished"].setdefault("status", {})[
+            "phase"] = "Succeeded"
+        residue = c.make_pod("residue", tpu=1)
+        residue["metadata"]["annotations"][codec.ANNO_ALLOC] = (
+            codec.encode_alloc(AllocResult(
+                pod_key="default/residue", node_name="host-0-0-0",
+                device_ids=["tpu-0"], coords=[TopologyCoord(0, 0, 0)],
+                env={}, priority=0, uid="uid-default-residue",
+            ))
+        )
+
+        ledger_before = len(c.extender.state.allocations())
+        t0 = time.perf_counter()
+        c.crash_extender()
+        restored = c.restart_extender()
+        gangs = c.extender.gang_snapshot()
+        partial = [g for g in gangs if g["group"] == "phoenix"]
+        restored_partial = bool(
+            partial and not partial[0]["committed"]
+            and partial[0]["members_bound"] == 4
+        )
+
+        # the crashed half's survivors + the rest of the gang
+        last_alloc = None
+        for i in range(4, 8):
+            _, last_alloc = c.schedule(
+                c.make_pod(f"phoenix-{i}", tpu=1, priority=10, group=group)
+            )
+        converge(c)
+        recovery_s = time.perf_counter() - t0
+
+        leaks = leaked_reservations(c)
+        div = ledger_divergence(c)
+        gangs = c.extender.gang_snapshot()
+        committed = [g for g in gangs if g["group"] == "phoenix"
+                     and g["committed"]]
+
+        # node-agent teardown + cold restart mid-session: the restarted
+        # agent re-registers and still serves the planned intent
+        env = c.execute_allocation(last_alloc, restart_agent=True)
+
+        result = {
+            "metric": "crash_recovery",
+            "value": round(recovery_s, 3),
+            "unit": "s crash -> ledger converged",
+            "recovery_s": round(recovery_s, 3),
+            "members_before_crash": 4,
+            "ledger_before_crash": ledger_before,
+            "restored": restored,
+            "partial_gang_restored": restored_partial,
+            "gang_committed": bool(committed),
+            "leaked_reservations": len(leaks),
+            "ledger_divergence": len(div),
+            "agent_restart_allocate_ok": bool(env),
+        }
+        problems = [str(p) for p in leaks] + div
+        if restored != 4:
+            problems.append(
+                f"rebuild restored {restored} allocation(s), wanted 4 "
+                f"(residue/finished must be skipped)")
+        if not restored_partial:
+            problems.append("partial gang did not restore as an "
+                            "uncommitted reservation")
+        if not committed:
+            problems.append("gang did not commit after restart")
+        if not env:
+            problems.append("restarted node agent failed the Allocate")
+        if problems:
+            raise RuntimeError("scenario 9 invariants violated: "
+                               + "; ".join(problems))
+        return result
