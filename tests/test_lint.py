@@ -9,7 +9,11 @@ import os
 import threading
 
 from tpukube.analysis import base, lockgraph
-from tpukube.analysis.consistency import check_names, check_rules_file
+from tpukube.analysis.consistency import (
+    check_names,
+    check_rules_file,
+    check_snapshot_discipline,
+)
 from tpukube.analysis.hygiene import check_exceptions
 from tpukube.analysis.locks import (
     check_lock_discipline,
@@ -206,6 +210,61 @@ def test_rules_file_check_catches_unrendered_series(tmp_path):
     # the shipped rules file is clean against the declared registry
     assert check_rules_file(
         os.path.join(REPO, "deploy", "prometheus-rules.yaml")) == []
+
+
+# -- snapshot-discipline -----------------------------------------------------
+
+VIOLATING_SNAPSHOT = '''\
+from tpukube.sched import slicefit
+from tpukube.sched.slicefit import _Sweep, occupancy_grid
+
+def rebuild_per_webhook(mesh, occupied):
+    grid = slicefit.occupancy_grid(mesh, occupied)   # finding
+    sweep = _Sweep(mesh, grid)                       # finding
+    return sweep
+
+def qualified(mesh, grid):
+    return slicefit._Sweep(mesh, grid)               # finding
+'''
+
+CLEAN_SNAPSHOT = '''\
+def through_the_cache(extender, sid):
+    ss = extender.snapshots.current().slice(sid)
+    return ss.blocked_sweep()
+
+def request_specific(mesh, blocked):
+    from tpukube.sched.snapshot import sweep_for
+    return sweep_for(mesh, blocked)
+'''
+
+
+def test_snapshot_discipline_catches_and_passes(tmp_path):
+    findings = check_snapshot_discipline(
+        _sf(tmp_path, "sched/extender.py", VIOLATING_SNAPSHOT))
+    assert len(findings) == 3
+    assert all(f.rule == "snapshot-discipline" for f in findings)
+    assert any("occupancy_grid" in f.message for f in findings)
+    assert any("_Sweep" in f.message for f in findings)
+    assert check_snapshot_discipline(
+        _sf(tmp_path, "sched/policy.py", CLEAN_SNAPSHOT)) == []
+    # the defining modules keep their own constructor seams
+    assert check_snapshot_discipline(
+        _sf(tmp_path, "sched/snapshot.py", VIOLATING_SNAPSHOT)) == []
+    assert check_snapshot_discipline(
+        _sf(tmp_path, "sched/slicefit.py", VIOLATING_SNAPSHOT)) == []
+
+
+def test_snapshot_discipline_waivable(tmp_path):
+    src = (
+        "from tpukube.sched.slicefit import occupancy_grid\n"
+        "def special(mesh, occ):\n"
+        "    # tpukube: allow(snapshot-discipline) one-off debug dump\n"
+        "    return occupancy_grid(mesh, occ)\n"
+    )
+    sf = _sf(tmp_path, "sched/tooling.py", src)
+    raw = check_snapshot_discipline(sf)
+    assert len(raw) == 1
+    assert base.apply_waivers(sf, raw) == []
 
 
 # -- exception-hygiene -------------------------------------------------------

@@ -1,0 +1,491 @@
+"""Epoch-cached scheduling snapshots + vectorized slice-fit sweep
+(ISSUE 5): placement parity of the vectorized ``find_slice`` against
+the pre-change per-origin reference implementation (kept here as the
+oracle), epoch invalidation at every mutation seam, cache-on vs
+cache-defeated placement parity through the real webhook stack, and
+the snapshot observability surface (/metrics + /statusz).
+
+The chaos angle: scenario 8 (apiserver chaos, tests/test_chaos.py)
+runs the full control plane with the snapshot cache ON and asserts
+zero ledger divergence — a stale-snapshot placement would surface
+there as a double-booked chip. The seam tests here prove why it
+cannot: every mutation path (commit/release/upsert, reserve/rollback/
+dissolve/assignment, eviction confirm, restart rebuild — torn writes
+reach the ledger through commit) bumps an epoch the cache keys on.
+"""
+
+import random
+import time
+
+import pytest
+
+from tpukube.core import codec
+from tpukube.core.config import load_config
+from tpukube.core.mesh import Box, MeshSpec, surface
+from tpukube.core.types import (
+    RESOURCE_TPU,
+    AllocResult,
+    ChipInfo,
+    ContainerInfo,
+    NodeInfo,
+    PodGroup,
+    PodInfo,
+    ResourceList,
+    TopologyCoord,
+    canonical_link,
+    make_device_id,
+)
+from tpukube.sched import slicefit
+from tpukube.sched.extender import Extender
+from tpukube.sched.slicefit import (
+    _candidate_shapes,
+    _Sweep,
+    box_breaks_link,
+    box_coords,
+    find_slice,
+    occupancy_grid,
+)
+from tpukube.sched.snapshot import sweep_for
+
+
+# -- the oracle: the pre-change find_slice, per-origin Python loop -----------
+
+def reference_find_slice(mesh, occupied, count=None, shape=None,
+                         allow_irregular=False, broken=None):
+    """The pre-vectorization implementation, verbatim in structure:
+    iterate shapes in candidate order, iterate origins in lexicographic
+    order, score each box with the per-box ``contact`` path, keep the
+    strict minimum of (surface, -contact, origin). The vectorized
+    ``find_slice`` must return byte-identical coordinates."""
+    slicefit._validate_request(count, shape)
+    grid = occupancy_grid(mesh, occupied)
+    sweep = _Sweep(mesh, grid)
+    best_key = None
+    best_box = None
+    tier = None
+    for shp in _candidate_shapes(mesh, count, shape):
+        s = surface(shp)
+        if tier is not None and s > tier:
+            break
+        for origin in sweep.origins(shp):
+            box = Box(TopologyCoord(*(int(v) for v in origin)), shp)
+            if broken and box_breaks_link(mesh, box, broken):
+                continue
+            key = (s, -sweep.contact(box), tuple(int(v) for v in origin))
+            if best_key is None or key < best_key:
+                best_key, best_box, tier = key, box, s
+    if best_box is not None:
+        return box_coords(mesh, best_box)
+    if allow_irregular and shape is None and count is not None:
+        return slicefit._find_connected(mesh, grid, count, broken)
+    return None
+
+
+PROPERTY_MESHES = [
+    MeshSpec((4, 4, 4), host_block=(2, 2, 1)),
+    MeshSpec((4, 4, 1), host_block=(2, 2, 1), torus=(True, False, False)),
+    MeshSpec((4, 2, 3), host_block=(1, 1, 1), torus=(True, True, True)),
+    MeshSpec((2, 3, 1), host_block=(1, 1, 1), torus=(False, True, False)),
+    MeshSpec((1, 4, 2), host_block=(1, 1, 1), torus=(False, True, False)),
+    MeshSpec((8, 8, 2), host_block=(2, 2, 1)),
+]
+
+
+def test_vectorized_find_slice_matches_reference_oracle():
+    """ISSUE 5 acceptance: randomized occupancy grids x request
+    counts/shapes x broken-link sets — the vectorized sweep returns
+    coordinates BYTE-IDENTICAL to the reference implementation."""
+    rng = random.Random(1234)
+    trials = 0
+    for mesh in PROPERTY_MESHES:
+        coords = list(mesh.all_coords())
+        for _ in range(40):
+            occupied = {
+                c for c in coords
+                if rng.random() < rng.choice([0.0, 0.2, 0.5, 0.8])
+            }
+            broken = set()
+            if rng.random() < 0.5:
+                for _ in range(rng.randint(1, 3)):
+                    a = rng.choice(coords)
+                    nbs = mesh.neighbors(a)
+                    if nbs:
+                        broken.add(canonical_link(a, rng.choice(nbs)))
+            if rng.random() < 0.5:
+                req = dict(count=rng.choice([1, 2, 3, 4, 6, 8, 12, 16]))
+            else:
+                n = rng.choice([2, 4, 8])
+                shapes = _candidate_shapes(mesh, n, None)
+                if not shapes:
+                    continue
+                req = dict(shape=tuple(rng.choice(shapes)))
+            irregular = rng.random() < 0.3 and "count" in req
+            got = find_slice(mesh, occupied, broken=broken or None,
+                             allow_irregular=irregular, **req)
+            want = reference_find_slice(
+                mesh, occupied, broken=broken or None,
+                allow_irregular=irregular, **req)
+            assert got == want, (mesh.dims, mesh.torus, req, occupied,
+                                 broken)
+            trials += 1
+    assert trials > 150  # the sweep above must not degenerate
+
+
+def test_batched_contacts_match_per_box_contact():
+    """``_Sweep.contacts`` (one integral-image gather per face per
+    shape tier) must agree entry-for-entry with the per-box ``contact``
+    slab path, including torus wrap, walls, and length-1/2 axes."""
+    rng = random.Random(7)
+    for mesh in PROPERTY_MESHES:
+        coords = list(mesh.all_coords())
+        occupied = set(rng.sample(coords, k=len(coords) // 3))
+        sweep = _Sweep(mesh, occupancy_grid(mesh, occupied))
+        shapes = {
+            s for n in (1, 2, 4, 8) for s in _candidate_shapes(mesh, n, None)
+        }
+        for shp in shapes:
+            batched = sweep.contacts(shp)
+            for origin, got in zip(sweep.origins(shp), batched):
+                box = Box(TopologyCoord(*(int(v) for v in origin)), shp)
+                assert sweep.contact(box) == int(got), (
+                    mesh.dims, mesh.torus, shp, origin)
+
+
+def test_candidate_shapes_memoized():
+    mesh = MeshSpec((4, 4, 4), host_block=(2, 2, 1))
+    a = _candidate_shapes(mesh, 8, None)
+    b = _candidate_shapes(MeshSpec((4, 4, 4), host_block=(1, 1, 1)), 8, None)
+    assert a is b  # keyed on dims+request, host partition irrelevant
+    assert list(a) == list(slicefit.factor_shapes(8, mesh.dims))
+    assert _candidate_shapes(mesh, None, (1, 4, 2)) is _candidate_shapes(
+        mesh, None, (1, 4, 2))
+
+
+# -- fixtures ----------------------------------------------------------------
+
+def _mini_extender(dims=(4, 4, 1), host_block=(2, 2, 1)):
+    cfg = load_config(env={})
+    mesh = MeshSpec(dims=dims, host_block=host_block)
+    ext = Extender(cfg)
+    for host in mesh.all_hosts():
+        chips = [
+            ChipInfo(chip_id=f"{host}-c{i}", index=i, coord=c,
+                     hbm_bytes=cfg.hbm_bytes_per_chip)
+            for i, c in enumerate(mesh.coords_of_host(host))
+        ]
+        ext.state.upsert_node(host, codec.annotate_node(
+            NodeInfo(name=host, chips=chips, slice_id=cfg.slice_id), mesh))
+    return ext, mesh, cfg
+
+
+def _pod(name, tpu=1, priority=0, group=None):
+    return PodInfo(name=name, priority=priority, group=group, containers=[
+        ContainerInfo(name="main",
+                      requests=ResourceList({RESOURCE_TPU: tpu})),
+    ])
+
+
+def _alloc(pod_key, node, indices, mesh, coords=None):
+    return AllocResult(
+        pod_key=pod_key, node_name=node,
+        device_ids=[make_device_id(i) for i in indices],
+        coords=coords or [mesh.coords_of_host(node)[i] for i in indices],
+    )
+
+
+# -- the cache proper --------------------------------------------------------
+
+def test_snapshot_cached_until_mutation_and_counts_hits():
+    ext, mesh, cfg = _mini_extender()
+    snap1 = ext.snapshots.current()
+    snap2 = ext.snapshots.current()
+    assert snap1 is snap2  # no mutation: the SAME object, not a rebuild
+    r0, h0 = ext.snapshots.rebuilds, ext.snapshots.hits
+    assert h0 >= 1
+    ext.state.commit(_alloc("default/a", "host-0-0-0", [0, 1], mesh))
+    snap3 = ext.snapshots.current()
+    assert snap3 is not snap1
+    assert ext.snapshots.rebuilds == r0 + 1
+    sid = cfg.slice_id
+    assert snap3.slice(sid).occupied >= {
+        TopologyCoord(0, 0, 0), TopologyCoord(1, 0, 0)}
+
+
+def test_snapshot_slice_content_matches_direct_accessors():
+    ext, mesh, cfg = _mini_extender()
+    ext.state.commit(_alloc("default/a", "host-0-0-0", [0, 1, 2], mesh))
+    res = ext.gang.ensure_reservation(
+        _pod("g-0", tpu=1, group=PodGroup("g", min_member=4)), 1)
+    assert res is not None
+    sid = cfg.slice_id
+    ss = ext.snapshots.current().slice(sid)
+    assert ss.occupied == ext.state.occupied_coords(sid)
+    assert ss.reserved == ext.gang.reserved_coords(sid)
+    assert ss.unhealthy == ext.state.unhealthy_coords(sid)
+    assert ss.broken == ext.state.broken_links(sid)
+    assert ss.utilization == ext.state.slice_utilization(sid)
+    # cached fragmentation == the grid-based wrapper's number
+    assert ss.fragmentation() == pytest.approx(
+        slicefit.fragmentation(mesh, ss.occupied))
+    assert ss.largest_free_box() == slicefit.largest_free_box(
+        mesh, occupancy_grid(mesh, ss.occupied))
+
+
+# -- epoch invalidation: every mutation seam ---------------------------------
+
+def test_ledger_seams_bump_epoch():
+    ext, mesh, cfg = _mini_extender()
+    epochs = [ext.state.epoch()]
+
+    def bumped():
+        epochs.append(ext.state.epoch())
+        assert epochs[-1] > epochs[-2], "mutation did not bump the epoch"
+
+    ext.state.commit(_alloc("default/a", "host-0-0-0", [0], mesh))
+    bumped()
+    ext.state.release("default/a")
+    bumped()
+    # node re-annotation (the inject_fault path: health flips arrive as
+    # a NEW annotation payload through upsert_node)
+    host = "host-0-0-0"
+    chips = [
+        ChipInfo(chip_id=f"{host}-c{i}", index=i, coord=c,
+                 hbm_bytes=cfg.hbm_bytes_per_chip)
+        for i, c in enumerate(mesh.coords_of_host(host))
+    ]
+    from tpukube.core.types import Health
+
+    chips[0].health = Health.UNHEALTHY
+    annos = codec.annotate_node(
+        NodeInfo(name=host, chips=chips, slice_id=cfg.slice_id), mesh)
+    ext.state.upsert_node(host, annos)
+    bumped()
+    # UNCHANGED payload: decoded view kept, epoch must NOT bump (this
+    # is what keeps the cache hot across identical webhook resends)
+    before = ext.state.epoch()
+    snap = ext.snapshots.current()
+    ext.state.upsert_node(host, annos)
+    assert ext.state.epoch() == before
+    assert ext.snapshots.current() is snap
+    # release of an unknown pod: no mutation, no bump
+    ext.state.release("default/ghost")
+    assert ext.state.epoch() == before
+
+
+def test_gang_seams_bump_epoch():
+    ext, mesh, cfg = _mini_extender()
+    sid = cfg.slice_id
+    epochs = [ext.gang.epoch()]
+
+    def bumped():
+        epochs.append(ext.gang.epoch())
+        assert epochs[-1] > epochs[-2], "gang mutation did not bump epoch"
+
+    group = PodGroup("g", min_member=2)
+    res = ext.gang.ensure_reservation(_pod("g-0", group=group), 1)
+    bumped()
+    # member assignment (the bind seam)
+    coords = sorted(res.coords)[:1]
+    node = ext.state.hosts_by_coord(sid)[coords[0]]
+    ext.state.commit(AllocResult(
+        pod_key="default/g-0", node_name=node,
+        device_ids=[make_device_id(
+            ext.state.node(node).index_at(coords[0]))],
+        coords=list(coords),
+    ))
+    ext.gang.on_bound(res, "default/g-0", list(coords), node)
+    bumped()
+    # member release back into the pool
+    ext.gang.on_release("default/g-0")
+    bumped()
+    # terminating-victim mask registration + eviction confirm
+    ext.gang.register_terminating(
+        res, {"default/v": (sid, [TopologyCoord(3, 3, 0)])})
+    bumped()
+    assert TopologyCoord(3, 3, 0) in ext.snapshots.current().slice(
+        sid).reserved
+    assert ext.gang.on_victim_gone("default/v")
+    bumped()
+    assert TopologyCoord(3, 3, 0) not in ext.snapshots.current().slice(
+        sid).reserved
+    # dissolve (preemption victim death)
+    ext.gang.dissolve(res.key)
+    bumped()
+    # TTL rollback through the sweep
+    res2 = ext.gang.ensure_reservation(_pod("h-0", group=PodGroup(
+        "h", min_member=2)), 1)
+    assert res2 is not None
+    bumped()
+    rolled = ext.gang.sweep(now=time.monotonic() + 10_000)
+    assert rolled == [("default", "h")]
+    bumped()
+
+
+def test_restart_rebuild_bumps_epoch_and_restores_snapshot():
+    ext, mesh, cfg = _mini_extender()
+    alloc = _alloc("default/a", "host-0-0-0", [0, 1], mesh)
+    pods = [{codec.ANNO_ALLOC: codec.encode_alloc(alloc)}]
+    e0 = ext.state.epoch()
+    snap0 = ext.snapshots.current()
+    assert ext.rebuild_from_pods(pods) == 1
+    assert ext.state.epoch() > e0
+    snap1 = ext.snapshots.current()
+    assert snap1 is not snap0
+    assert TopologyCoord(0, 0, 0) in snap1.slice(cfg.slice_id).occupied
+
+
+def test_stale_snapshot_never_served_through_webhook_cycle():
+    """The integration form of the seam tests: schedule through the
+    real webhook handlers and assert every placement-visible mutation
+    invalidates the cache (a stale snapshot would mask or free the
+    wrong chips — the scenario-8 failure mode)."""
+    from tpukube.sim import SimCluster
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        sid = c.extender._config.slice_id
+        _, alloc = c.schedule(c.make_pod("a", tpu=2))
+        snap = c.extender.snapshots.current()
+        assert set(alloc.coords) <= snap.slice(sid).occupied
+        # pod completion -> lifecycle release -> chips free again
+        c.complete_pod("a")
+        snap2 = c.extender.snapshots.current()
+        assert snap2 is not snap
+        assert not set(alloc.coords) & snap2.slice(sid).occupied
+        # chip fault re-annotates the node; the refreshed webhook send
+        # must land in the snapshot as an unhealthy (occupied) chip
+        c.inject_fault("host-0-0-0", 0)
+        c.schedule(c.make_pod("b", tpu=1))
+        bad = c.nodes["host-0-0-0"].chips[0].coord
+        snap3 = c.extender.snapshots.current()
+        assert bad in snap3.slice(sid).unhealthy
+        # and the cache is actually HOT between mutations: idle reads hit
+        h0 = c.extender.snapshots.hits
+        c.extender.snapshots.current()
+        assert c.extender.snapshots.hits == h0 + 1
+
+
+# -- cache-on vs cache-defeated parity through the real stack ----------------
+
+def _drive_workload(c):
+    """A placement-sensitive sequence: burst fill, a preempting gang,
+    completions, refill — every decision depends on the sweeps."""
+    placements = {}
+    for i in range(6):
+        node, alloc = c.schedule(c.make_pod(f"burst-{i}", tpu=1,
+                                            priority=0))
+        placements[f"burst-{i}"] = (node, tuple(alloc.coords))
+    group = PodGroup("train", min_member=4)
+    for i in range(4):
+        node, alloc = c.schedule(c.make_pod(
+            f"train-{i}", tpu=2, priority=100, group=group))
+        placements[f"train-{i}"] = (node, tuple(alloc.coords))
+    c.complete_pod("burst-1")
+    node, alloc = c.schedule(c.make_pod("refill-0", tpu=1))
+    placements["refill-0"] = (node, tuple(alloc.coords))
+    return placements
+
+
+def test_placement_parity_with_cache_defeated():
+    """ISSUE 5 acceptance: the epoch cache is a pure performance layer
+    — the same workload scheduled with the cache defeated (invalidate
+    before every lookup, i.e. the pre-change rebuild-per-webhook
+    behavior) must produce IDENTICAL placements, preemptions included."""
+    from tpukube.sim import SimCluster
+
+    env = {
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,2",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    }
+    with SimCluster(load_config(env=env)) as c:
+        cached = _drive_workload(c)
+        assert c.extender.snapshots.hits > 0  # the cache really engaged
+    with SimCluster(load_config(env=env)) as c:
+        snaps = c.extender.snapshots
+        orig = snaps.current
+
+        def paranoid_current():
+            snaps.invalidate()
+            return orig()
+
+        snaps.current = paranoid_current
+        uncached = _drive_workload(c)
+        assert snaps.hits == 0
+    assert cached == uncached
+
+
+# -- observability -----------------------------------------------------------
+
+def test_snapshot_metrics_and_statusz_render():
+    from tpukube.metrics import render_extender_metrics
+    from tpukube.obs.statusz import extender_statusz
+
+    ext, mesh, cfg = _mini_extender()
+    ext.state.commit(_alloc("default/a", "host-0-0-0", [0, 1], mesh))
+    ext.snapshots.current()
+    ext.snapshots.current()
+    text = render_extender_metrics(ext)
+    assert "# TYPE tpukube_snapshot_rebuilds_total counter" in text
+    assert "# TYPE tpukube_snapshot_hits_total counter" in text
+    assert 'tpukube_snapshot_rebuild_seconds{quantile="0.5"}' in text
+    sid = cfg.slice_id
+    assert f'tpukube_slice_fragmentation{{slice="{sid}"}}' in text
+    assert f'tpukube_slice_largest_free_box_chips{{slice="{sid}"}}' in text
+    # the rendered fragmentation is the snapshot's cached number
+    ss = ext.snapshots.current().slice(sid)
+    line = next(l for l in text.splitlines()
+                if l.startswith("tpukube_slice_fragmentation"))
+    assert float(line.split(" ")[1]) == pytest.approx(
+        ss.fragmentation(), abs=1e-6)
+
+    doc = extender_statusz(ext)
+    snap = doc["snapshot"]
+    assert snap["rebuilds"] >= 1 and snap["hits"] >= 1
+    assert 0.0 <= snap["hit_rate"] <= 1.0
+    assert snap["slices"][sid]["fragmentation"] == pytest.approx(
+        round(ss.fragmentation(), 4))
+    assert snap["slices"][sid]["largest_free_box"] == ss.largest_free_box()
+    assert snap["epoch"]["ledger"] == ext.state.epoch()
+    assert snap["epoch"]["gang"] == ext.gang.epoch()
+
+
+def test_observer_lookups_do_not_inflate_hit_counters():
+    """Scrape self-traffic must not mask the flat-hits diagnostic:
+    /metrics and /statusz reads go through observe(), which never
+    counts a hit — but a rebuild an observer performs is real work
+    and still counts."""
+    from tpukube.metrics import render_extender_metrics
+    from tpukube.obs.statusz import extender_statusz
+
+    ext, mesh, cfg = _mini_extender()
+    ext.snapshots.current()
+    h0, r0 = ext.snapshots.hits, ext.snapshots.rebuilds
+    ext.snapshots.observe()
+    render_extender_metrics(ext)
+    extender_statusz(ext)
+    assert ext.snapshots.hits == h0, "observer reads counted as hits"
+    assert ext.snapshots.rebuilds == r0  # warm cache: no rebuild either
+    # after a mutation, an observer-triggered rebuild IS counted
+    ext.state.commit(_alloc("default/obs", "host-1-1-0", [0], mesh))
+    render_extender_metrics(ext)
+    assert ext.snapshots.rebuilds == r0 + 1
+    assert ext.snapshots.hits == h0
+    # ...and the next scheduling lookup inherits it as a hit
+    ext.snapshots.current()
+    assert ext.snapshots.hits == h0 + 1
+
+
+def test_sweep_for_is_the_adhoc_constructor_seam():
+    """Request-specific grids (preemption, restore) build through
+    snapshot.sweep_for and behave exactly like a direct sweep."""
+    mesh = MeshSpec((4, 4, 1), host_block=(2, 2, 1))
+    blocked = {TopologyCoord(0, 0, 0), TopologyCoord(1, 1, 0)}
+    sweep = sweep_for(mesh, blocked)
+    boxes = list(slicefit.iter_free_boxes_in(sweep, count=4))
+    ref = list(slicefit.iter_free_boxes(
+        mesh, occupancy_grid(mesh, blocked), count=4))
+    assert [(b.box, b.surface, b.contact, b.origin_key) for b in boxes] \
+        == [(b.box, b.surface, b.contact, b.origin_key) for b in ref]

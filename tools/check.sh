@@ -30,6 +30,33 @@ JAX_PLATFORMS=cpu TPUKUBE_CHAOS_SEED=1337 \
 JAX_PLATFORMS=cpu python -m tpukube.cli sim 9 > /dev/null
 
 echo
+echo "== perf smoke (sched_micro filter/prioritize/plan p50 vs the"
+echo "   committed tools/perf_floor.json floor; >1.5x regression fails) =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+import sys
+
+import bench
+
+floor = json.load(open("tools/perf_floor.json"))
+m = bench.sched_micro()
+print(json.dumps(
+    {k: v for k, v in sorted(m.items()) if k != "mesh"}, indent=None))
+bad = []
+for key, base in floor["p50_ms_floor"].items():
+    if m[key] > base * floor["allowed_regression"]:
+        bad.append(f"{key}={m[key]:.3f}ms exceeds floor {base}ms "
+                   f"x {floor['allowed_regression']}")
+for key, need in floor.get("min_speedup", {}).items():
+    if m[key] < need:
+        bad.append(f"{key}={m[key]:.2f} below the required {need}x "
+                   f"(snapshot cache not engaging?)")
+if bad:
+    sys.exit("perf smoke FAILED: " + "; ".join(bad))
+print("perf smoke OK")
+PY
+
+echo
 echo "== native asan (libtpuinfo self-test under ASan/UBSan) =="
 if command -v g++ >/dev/null 2>&1; then
   make -C tpukube/native asan

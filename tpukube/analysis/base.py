@@ -27,6 +27,7 @@ ALL_RULES: tuple[str, ...] = (
     "lock-order",
     "shared-state",
     "name-consistency",
+    "snapshot-discipline",
     "exception-hygiene",
     "bare-waiver",
 )
@@ -106,6 +107,7 @@ def _passes() -> dict[str, Callable[[SourceFile], list[Finding]]]:
         "lock-order": locks.check_lock_order,
         "shared-state": locks.check_shared_state,
         "name-consistency": consistency.check_names,
+        "snapshot-discipline": consistency.check_snapshot_discipline,
         "exception-hygiene": hygiene.check_exceptions,
     }
 

@@ -28,6 +28,9 @@ _RULE_DOCS = {
     "name-consistency": "event reasons / metric series / "
                         "prometheus-rules refs resolve against the "
                         "declared registries",
+    "snapshot-discipline": "occupancy_grid/_Sweep built only in "
+                           "sched/snapshot.py (+ slicefit wrappers) — "
+                           "hot paths read the epoch cache",
     "exception-hygiene": "broad excepts must log, emit, re-raise, or "
                          "carry a justified waiver",
     "bare-waiver": "waiver pragmas must name known rules and carry a "

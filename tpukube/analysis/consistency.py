@@ -33,6 +33,15 @@ METRIC_CALLS = {
 #: suffixes a TYPE'd family implies (rules expressions reference these)
 DERIVED_SUFFIXES = ("_bucket", "_count", "_sum")
 
+#: the only modules allowed to construct occupancy grids / sweeps:
+#: the epoch-cached snapshot (which owns the per-cycle instances and
+#: the one ad-hoc seam, ``sweep_for``) and slicefit itself (the
+#: primitive definitions plus their grid-based thin wrappers)
+SNAPSHOT_HOME = ("sched/snapshot.py", "sched/slicefit.py")
+
+#: constructor names the snapshot-discipline pass polices
+SWEEP_CONSTRUCTORS = frozenset({"occupancy_grid", "_Sweep"})
+
 
 def _call_name(call: ast.Call) -> Optional[str]:
     fn = call.func
@@ -82,6 +91,35 @@ def check_names(sf: SourceFile) -> list[Finding]:
                     f"(dashboards and prometheus-rules key off the "
                     f"registry) or fix the typo",
                 ))
+    return findings
+
+
+def check_snapshot_discipline(sf: SourceFile) -> list[Finding]:
+    """Constructing ``occupancy_grid``/``_Sweep`` outside
+    ``sched/snapshot.py`` (and slicefit's own wrappers) is a finding:
+    the whole point of the epoch-cached scheduling snapshot (ISSUE 5)
+    is that webhook cycles share ONE derived-state build per epoch — a
+    call site quietly rebuilding sweeps per request reintroduces the
+    O(volume x shapes x origins) hot path without failing any test.
+    Route cluster-state sweeps through ``SnapshotCache.current()`` and
+    request-specific grids through ``snapshot.sweep_for`` (tests are
+    not linted and stay exempt)."""
+    if sf.in_scope(SNAPSHOT_HOME):
+        return []
+    findings: list[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name in SWEEP_CONSTRUCTORS:
+            findings.append(Finding(
+                "snapshot-discipline", sf.rel, node.lineno,
+                f"{name}() constructed outside sched/snapshot.py — "
+                f"read the epoch-cached snapshot "
+                f"(SnapshotCache.current()) or build request-specific "
+                f"grids through snapshot.sweep_for() so the per-cycle "
+                f"cache cannot silently rot",
+            ))
     return findings
 
 

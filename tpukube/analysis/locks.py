@@ -147,8 +147,16 @@ ORDERED_LOCKS = {
 CALL_ROOTS = {
     ("sched/extender.py", "Extender"): {
         "gang": ("gang", 2), "state": ("ledger", 3),
+        # SnapshotCache.current() takes the gang lock first (epoch
+        # read + build), then the ledger lock — level it at its
+        # smallest acquisition so calling it under the ledger lock
+        # flags as an inversion
+        "snapshots": ("gang", 2),
     },
-    ("sched/gang.py", "GangManager"): {"_state": ("ledger", 3)},
+    ("sched/gang.py", "GangManager"): {
+        "_state": ("ledger", 3),
+        "snapshots": ("gang", 2),
+    },
 }
 
 #: (path suffix, class) -> {self.<method>() that re-enters a lock}
@@ -261,10 +269,11 @@ def check_lock_order(sf: SourceFile) -> list[Finding]:
 GUARDED_ATTRS = {
     ("sched/state.py", "ClusterState"): {
         "_nodes": "_lock", "_slices": "_lock", "_allocs": "_lock",
-        "_hosts_cache": "_lock",
+        "_hosts_cache": "_lock", "_epoch": "_lock",
     },
     ("sched/gang.py", "GangManager"): {
         "_reservations": "_lock", "_terminating_coords": "_lock",
+        "_epoch": "_lock",
     },
     ("sched/extender.py", "Extender"): {
         "_pending": "_pending_lock",

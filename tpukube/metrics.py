@@ -134,6 +134,12 @@ def build_extender_registry(extender, reconcile=None, evictions=None,
     events = getattr(extender, "events", None)
     if events is not None:
         _add_events_counter(reg, events)
+    # epoch-cached scheduling snapshot (sched/snapshot.py): cache
+    # effectiveness counters + the per-slice fragmentation numbers the
+    # cache makes cheap enough to serve on every scrape
+    snapshots = getattr(extender, "snapshots", None)
+    if snapshots is not None:
+        _add_snapshot_metrics(reg, snapshots)
     # unified retry/circuit layer (ISSUE 4): series render only when
     # the daemon actually wired the channel objects — sim/dev
     # extenders keep the legacy exposition byte-identical
@@ -213,6 +219,57 @@ def build_plugin_registry(server, health=None, kubelet_watch=None,
     if events is not None:
         _add_events_counter(reg, events)
     return reg
+
+
+def _add_snapshot_metrics(reg: Registry, snapshots) -> None:
+    """Scheduling-snapshot cache families (sched/snapshot.py), shared
+    by every renderer that exposes a SnapshotCache — the extender's
+    main /metrics and its probe-port listener both build through here,
+    so the series shapes can never drift apart. A flat hits counter
+    under webhook load means every cycle is rebuilding (an epoch bump
+    on a read path, or a mutation storm) — the regression this cache
+    exists to prevent."""
+    reg.counter(
+        "tpukube_snapshot_rebuilds_total",
+        fn=lambda: snapshots.rebuilds,
+        help_text="Scheduling-snapshot rebuilds (one per ledger/"
+                  "reservation epoch actually consulted).")
+    reg.counter(
+        "tpukube_snapshot_hits_total",
+        fn=lambda: snapshots.hits,
+        help_text="Snapshot lookups answered from the epoch cache "
+                  "without re-deriving grids from the ledger.")
+    reg.summary(
+        "tpukube_snapshot_rebuild_seconds",
+        quantiles=(0.5, 0.99),
+        values_fn=snapshots.rebuild_seconds_snapshot,
+        help_text="Wall time of snapshot rebuilds (coord-set capture; "
+                  "sweep tables build lazily on first query).")
+
+    # all reads below go through observe(): a scrape must not count
+    # its own lookups as cache hits (that self-traffic would mask the
+    # flat-hits diagnostic described above)
+    def _slice_fn(sid: str, compute):
+        def get() -> float:
+            ss = snapshots.observe().slices.get(sid)
+            return float(compute(ss)) if ss is not None else 0.0
+        return get
+
+    frag = reg.gauge(
+        "tpukube_slice_fragmentation",
+        help_text="Free-space fragmentation per ICI slice: 1 - "
+                  "(largest free box)/(free chips); 0 = one perfect "
+                  "box, -> 1 as free space shatters.")
+    largest = reg.gauge(
+        "tpukube_slice_largest_free_box_chips",
+        help_text="Volume of the largest fully-free contiguous box "
+                  "per ICI slice — the biggest gang that could still "
+                  "land without preemption.")
+    for sid in snapshots.observe().slice_ids():
+        frag.labels(slice=sid).set_function(
+            _slice_fn(sid, lambda ss: ss.fragmentation()))
+        largest.labels(slice=sid).set_function(
+            _slice_fn(sid, lambda ss: ss.largest_free_box()))
 
 
 def _add_retry_metrics(reg: Registry, retriers=(), circuits=()) -> None:

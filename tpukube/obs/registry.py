@@ -58,6 +58,13 @@ DECLARED_SERIES: frozenset[str] = frozenset({
     "tpukube_lifecycle_releases_total",
     # both daemons (event journal)
     "tpukube_events_total",
+    # extender: epoch-cached scheduling snapshot (sched/snapshot.py) —
+    # cache effectiveness + the per-slice free-space health it serves
+    "tpukube_snapshot_rebuilds_total",
+    "tpukube_snapshot_hits_total",
+    "tpukube_snapshot_rebuild_seconds",
+    "tpukube_slice_fragmentation",
+    "tpukube_slice_largest_free_box_chips",
     # both daemons (unified retry/circuit layer, core/retry.py; series
     # render only where a Retrier/CircuitBreaker is actually wired)
     "tpukube_retry_attempts_total",

@@ -145,6 +145,11 @@ def extender_statusz(
         "trace": (extender.trace.stats() if extender.trace is not None
                   else {"enabled": False}),
         "fleet": fleet_health(extender),
+        # the epoch-cached scheduling snapshot (sched/snapshot.py):
+        # cache counters + per-slice fragmentation / largest-free-box —
+        # a hit_rate near zero under webhook load means every cycle is
+        # rebuilding (a mutation storm, or an epoch bump on a read path)
+        "snapshot": extender.snapshots.stats(),
     }
     events = getattr(extender, "events", None)
     if events is not None:

@@ -1,1 +1,2 @@
-"""Scheduler layer (L5): slicefit allocator, extender, gang, policy."""
+"""Scheduler layer (L5): slicefit allocator, epoch-cached scheduling
+snapshots, extender, gang, policy."""

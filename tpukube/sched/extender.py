@@ -105,6 +105,13 @@ class Extender:
             eviction_sink=self.pending_evictions,
             events=self.events,
         )
+        # The epoch-cached scheduling snapshot (sched/snapshot.py),
+        # owned by the gang manager and shared here: every filter/
+        # prioritize/preemption cycle takes it once at the top (under
+        # the decision lock) instead of re-deriving occupancy grids and
+        # sweep tables from the ledger per webhook; the /metrics and
+        # /statusz fragmentation renders read the same cache.
+        self.snapshots = self.gang.snapshots
         # Pods seen at filter time, so /bind (which only carries names) can
         # recover the request: key -> (pod, uid, seen_monotonic).
         self._pending: dict[str, tuple[PodInfo, str, float]] = {}
@@ -314,11 +321,8 @@ class Extender:
         self.latencies[handler].append(seconds)
         self.webhook_hist.labels(handler=handler).observe(seconds)
 
-    def _reserved_by_slice(self) -> dict[str, set[TopologyCoord]]:
-        return {
-            sid: self.gang.reserved_coords(sid)
-            for sid in self.state.slice_ids()
-        }
+    def _reserved_by_slice(self) -> dict[str, frozenset[TopologyCoord]]:
+        return self.snapshots.current().reserved_by_slice()
 
     def _try_preemption(self, pod: PodInfo, count: int) -> GangReservation:
         """Open a contiguous slice for a gang by planning the eviction of
@@ -332,7 +336,11 @@ class Extender:
         Raises GangError (propagates unschedulability) if no eligible
         victim set exists or the pod has no priority to preempt with."""
         assert pod.group is not None
-        slice_ids = self.state.slice_ids()
+        # one snapshot for the whole preemption plan: the planner's
+        # blocked sets (unhealthy + terminating) and link state come from
+        # the same epoch the candidate sweep is built against
+        snap = self.snapshots.current()
+        slice_ids = snap.slice_ids()
         if not slice_ids or pod.priority <= 0:
             raise GangError(
                 f"gang {pod.namespace}/{pod.group.name}: no contiguous slice "
@@ -357,15 +365,15 @@ class Extender:
             # eviction can free them sooner — a plan over them would
             # reserve with zero victims and bind ungated onto chips a
             # dying container still owns (ADVICE round 5 medium)
+            ss = snap.slice(sid)
             cand = policy.find_preemption_plan(
                 [w for w in workloads if w.slice_id == sid],
-                self.state.slice_mesh(sid),
-                self.state.unhealthy_coords(sid)
-                | self.gang.terminating_coords(sid),
+                ss.mesh,
+                ss.unhealthy | ss.terminating,
                 total,
                 pod.group.shape,
                 pod.priority,
-                broken=self.state.broken_links(sid),
+                broken=ss.broken,
             )
             if cand is None:
                 continue
@@ -570,22 +578,23 @@ class Extender:
         (greedy over slices by free capacity, largest feasible volume
         first — the preemption mirror of GangManager._plan_dcn_split).
         Returns slice -> plan covering exactly ``total`` chips, or None."""
+        snap = self.snapshots.current()
         order = sorted(
-            self.state.slice_ids(),
-            key=lambda s: (self.state.slice_utilization(s), s),
+            snap.slice_ids(),
+            key=lambda s: (snap.slice(s).utilization, s),
         )
         parts: dict[str, policy.PreemptionPlan] = {}
         remaining = total
         for sid in order:
             if remaining == 0:
                 break
-            mesh = self.state.slice_mesh(sid)
+            ss = snap.slice(sid)
+            mesh = ss.mesh
             in_slice = [w for w in workloads if w.slice_id == sid]
             # same blocked-set rule as the single-slice path: chips a
             # terminating victim still physically holds are unopenable
-            unhealthy = (self.state.unhealthy_coords(sid)
-                         | self.gang.terminating_coords(sid))
-            broken = self.state.broken_links(sid)
+            unhealthy = ss.unhealthy | ss.terminating
+            broken = ss.broken
             max_vol = min(
                 remaining,
                 ((mesh.num_chips - len(unhealthy)) // chips_per_pod)
@@ -723,19 +732,19 @@ class Extender:
                     return {n: 0 for n in names}
                 # overflow replica of a full gang: fall through to normal
             # the occupancy sweeps and gang masks depend only on cluster
-            # state — build once per request, not per node (hot path);
-            # both are slice-keyed (coords are slice-local)
-            reserved = self._reserved_by_slice()
+            # state — read once per request from the epoch-cached
+            # snapshot, which survives ACROSS requests until the next
+            # ledger/reservation mutation (the per-webhook sweep rebuild
+            # this replaces was the prioritize hot path); both are
+            # slice-keyed (coords are slice-local)
+            snap = self.snapshots.current()
+            reserved = snap.reserved_by_slice()
             sweeps: Optional[dict[str, "slicefit._Sweep"]] = None
             if self._config.score_mode == "topology" and resource == RESOURCE_TPU:
-                sweeps = {}
-                for sid in self.state.slice_ids():
-                    mesh = self.state.slice_mesh(sid)
-                    grid = slicefit.occupancy_grid(
-                        mesh,
-                        self.state.occupied_coords(sid) | reserved.get(sid, set()),
-                    )
-                    sweeps[sid] = slicefit._Sweep(mesh, grid)
+                sweeps = {
+                    sid: snap.slice(sid).blocked_sweep()
+                    for sid in snap.slice_ids()
+                }
             scores: dict[str, int] = {}
             for name in names:
                 scores[name] = self._score_node(name, resource, count, sweeps, reserved)
@@ -803,11 +812,7 @@ class Extender:
         sid = view.info.slice_id
         sweep = sweeps.get(sid) if sweeps is not None else None
         if sweep is None:
-            mesh = self.state.slice_mesh(sid)
-            grid = slicefit.occupancy_grid(
-                mesh, self.state.occupied_coords(sid)
-            )
-            sweep = slicefit._Sweep(mesh, grid)
+            sweep = self.snapshots.current().slice(sid).occupancy_sweep()
         contact = 0
         max_contact = 0
         for coord in plan:
@@ -853,10 +858,11 @@ class Extender:
                     return out
             return None
         sid = view.info.slice_id
-        mesh = self.state.slice_mesh(sid)
+        ss = self.snapshots.current().slice(sid)
+        mesh = ss.mesh
         mask_set = (
             reserved.get(sid, set()) if reserved is not None
-            else self.gang.reserved_coords(sid)
+            else ss.reserved
         )
         node_free = {
             c.coord for c in view.free_chips() if c.coord not in mask_set
@@ -870,7 +876,7 @@ class Extender:
             # so the bound chip realizes the score the node won on (other
             # hosts' FREE chips are not blockers; treating them as such,
             # as the old mask form did, mis-ranked fragmentation)
-            blocked = self.state.occupied_coords(sid) | mask_set
+            blocked = ss.occupied | mask_set
             best = max(
                 node_free,
                 key=lambda c: (
@@ -879,15 +885,16 @@ class Extender:
                 ),
             )
             return [best]
-        # everything outside this node's free set is masked occupied; built
-        # directly as a grid — a whole-mesh Python set here was the hottest
-        # line of /prioritize (this runs per node per webhook)
+        # everything outside this node's free set is masked occupied —
+        # a NODE-LOCAL grid, so it cannot live in the cluster snapshot;
+        # built directly as an ndarray and handed to the slicefit
+        # wrapper (whose sweep build is the module's own seam)
         mask = np.ones(mesh.dims, dtype=bool)
         for c in node_free:
             mask[tuple(c)] = False
         placed = slicefit.find_slice(
             mesh, mask, count=count, allow_irregular=True,
-            broken=self.state.broken_links(sid),
+            broken=ss.broken,
         )
         if placed is not None:
             return placed
@@ -1340,14 +1347,16 @@ class Extender:
         Per-slice sections carry the slice-local coord sets; the top-level
         fields aggregate across slices (mesh_dims is the sole slice's dims
         on a single-slice cluster, null otherwise)."""
-        slice_ids = self.state.slice_ids()
+        snap = self.snapshots.current()
+        slice_ids = snap.slice_ids()
         per_slice: dict[str, dict[str, Any]] = {}
         for sid in slice_ids:
+            ss = snap.slice(sid)
             per_slice[sid] = {
-                "occupied": self.state.occupied_coords(sid),
-                "reserved": self.gang.reserved_coords(sid),
-                "unhealthy": self.state.unhealthy_coords(sid),
-                "broken": sorted(self.state.broken_links(sid)),
+                "occupied": ss.occupied,
+                "reserved": ss.reserved,
+                "unhealthy": ss.unhealthy,
+                "broken": sorted(ss.broken),
             }
         nodes = []
         for name in self.state.node_names():
@@ -1378,7 +1387,7 @@ class Extender:
             })
         return {
             "mesh_dims": (
-                list(self.state.slice_mesh(slice_ids[0]).dims)
+                list(snap.slice(slice_ids[0]).mesh.dims)
                 if len(slice_ids) == 1 else None
             ),
             "utilization_percent": round(100.0 * self.state.utilization(), 2),
@@ -1397,10 +1406,18 @@ class Extender:
             "slices": [
                 {
                     "id": sid,
-                    "mesh_dims": list(self.state.slice_mesh(sid).dims),
+                    "mesh_dims": list(snap.slice(sid).mesh.dims),
                     "utilization_percent": round(
-                        100.0 * self.state.slice_utilization(sid), 2
+                        100.0 * snap.slice(sid).utilization, 2
                     ),
+                    # epoch-cached free-space health (snapshot-derived):
+                    # how shattered the slice's free space is, and the
+                    # biggest gang box it could still take
+                    "fragmentation": round(
+                        snap.slice(sid).fragmentation(), 4
+                    ),
+                    "largest_free_box_chips": snap.slice(
+                        sid).largest_free_box(),
                     "links_down": [
                         [list(a), list(b)] for a, b in per_slice[sid]["broken"]
                     ],

@@ -42,6 +42,7 @@ from tpukube.core.types import (
 )
 from tpukube.obs.registry import Histogram
 from tpukube.sched import slicefit
+from tpukube.sched.snapshot import SnapshotCache, sweep_for
 from tpukube.sched.state import ClusterState, StateError
 
 log = logging.getLogger("tpukube.gang")
@@ -189,6 +190,22 @@ class GangManager:
         self._terminating_coords: dict[
             str, tuple[str, frozenset[TopologyCoord]]
         ] = {}
+        # reservation epoch: bumped by every mutation of reservations,
+        # assignments, or the terminating masks — the gang half of the
+        # scheduling-snapshot cache key (sched/snapshot.py). A mutation
+        # path that forgets to bump serves stale placements; the
+        # invalidation tests cover every seam.
+        self._epoch = 0
+        # The epoch-cached scheduling snapshot, shared with the owning
+        # Extender: filter/prioritize/preemption cycles and the metrics
+        # /statusz renders all read ONE snapshot per epoch instead of
+        # re-deriving grids from the ledger per call.
+        self.snapshots = SnapshotCache(state, self)
+
+    def epoch(self) -> int:
+        """Monotonic mutation counter (the snapshot cache's key half)."""
+        with self._lock:
+            return self._epoch
 
     def _emit(self, reason: str, res_key: tuple[str, str], message: str,
               warning: bool = False) -> None:
@@ -251,11 +268,15 @@ class GangManager:
                 # would skip anyway) — and this runs on every non-gang
                 # filter, so skip the per-slice health/link snapshots
                 return rolled
-        unhealthy: dict[str, set[TopologyCoord]] = {}
-        broken: dict[str, set] = {}
-        for sid in self._state.slice_ids():
-            unhealthy[sid] = self._state.unhealthy_coords(sid)
-            broken[sid] = self._state.broken_links(sid)
+        # health/link state per slice from the epoch-cached snapshot
+        # (this runs on every gang interaction; the direct accessors
+        # re-scan every node view per call)
+        snap = self.snapshots.current()
+        unhealthy: dict[str, frozenset[TopologyCoord]] = {}
+        broken: dict[str, frozenset] = {}
+        for sid in snap.slice_ids():
+            unhealthy[sid] = snap.slice(sid).unhealthy
+            broken[sid] = snap.slice(sid).broken
         with self._lock:
             for key, res in list(self._reservations.items()):
                 if res.committed:
@@ -311,11 +332,13 @@ class GangManager:
             self._terminating_coords[pod_key] = (
                 entry[0], frozenset(entry[1])
             )
+        self._epoch += 1
 
     def _rollback_locked(self, res: GangReservation) -> None:
         for pod_key in list(res.assigned):
             self._evict_and_mask_locked(pod_key, res.assigned.get(pod_key))
         self._reservations.pop(res.key, None)
+        self._epoch += 1
         self.rollbacks += 1
 
     # -- reservation -------------------------------------------------------
@@ -355,19 +378,22 @@ class GangManager:
             # gangs. Deterministic tie-break on slice id.
             chosen: Optional[tuple[float, str, list[TopologyCoord]]] = None
             free_total = 0
+            # one snapshot for the whole reservation cycle: the blocked
+            # sweep (occupied | reserved, integral image prebuilt) is
+            # shared with every other search of this epoch
+            snap = self.snapshots.current()
             for sid in slice_ids:
-                occupied = self._state.occupied_coords(sid) | self.reserved_coords(sid)
-                mesh = self._state.slice_mesh(sid)
-                free_total += mesh.num_chips - len(occupied)
-                coords = slicefit.find_slice(
-                    mesh, occupied,
+                ss = snap.slice(sid)
+                free_total += ss.blocked_free_chips
+                coords = slicefit.find_slice_in(
+                    ss.blocked_sweep(),
                     count=None if pod.group.shape is not None else total,
                     shape=pod.group.shape,
-                    broken=self._state.broken_links(sid),
+                    broken=ss.broken,
                 )
                 if coords is None:
                     continue
-                rank = (-self._state.slice_utilization(sid), sid)
+                rank = (-ss.utilization, sid)
                 if chosen is None or rank < (chosen[0], chosen[1]):
                     chosen = (rank[0], sid, coords)
             if chosen is not None:
@@ -400,6 +426,7 @@ class GangManager:
                 priority=pod.priority,
             )
             self._reservations[key] = res
+            self._epoch += 1
             log.info(
                 "gang %s/%s reserved %d chips over %d slice(s)",
                 key[0], key[1], res.total_chips(), len(slice_coords),
@@ -420,27 +447,24 @@ class GangManager:
         remaining need first — fewest DCN boundaries for the job, emptiest
         slices consumed first (the single-slice path already failed, so
         bin-packing has nothing left to protect)."""
+        snap = self.snapshots.current()
         free_rank = sorted(
             slice_ids,
-            key=lambda s: (self._state.slice_utilization(s), s),
+            key=lambda s: (snap.slice(s).utilization, s),
         )
         parts: dict[str, set[TopologyCoord]] = {}
         remaining = total
         for sid in free_rank:
             if remaining == 0:
                 break
-            mesh = self._state.slice_mesh(sid)
-            occupied = set(
-                self._state.occupied_coords(sid) | self.reserved_coords(sid)
-            )
-            broken = self._state.broken_links(sid)
+            ss = snap.slice(sid)
             # ONE box per slice — the TPU_KUBE_GANG_* contract promises the
             # in-pod runtime one contiguous ICI sub-mesh per slice part
-            free_here = mesh.num_chips - len(occupied)
+            free_here = ss.blocked_free_chips
             vol = min(remaining, (free_here // chips_per_pod) * chips_per_pod)
             while vol >= chips_per_pod:
-                coords = slicefit.find_slice(
-                    mesh, occupied, count=vol, broken=broken
+                coords = slicefit.find_slice_in(
+                    ss.blocked_sweep(), count=vol, broken=ss.broken
                 )
                 if coords is not None:
                     parts[sid] = set(coords)
@@ -462,6 +486,7 @@ class GangManager:
             res = self._reservations.pop(key, None)
             if res is None:
                 return []
+            self._epoch += 1
             evicted = []
             for pod_key in list(res.assigned):
                 self._evict_and_mask_locked(pod_key,
@@ -583,6 +608,7 @@ class GangManager:
                 )
             res.committed = committed
             self._reservations[key] = res
+            self._epoch += 1
             log.info(
                 "gang %s/%s restored from pod annotations: %d members, "
                 "committed=%s", namespace, group.name, len(res.assigned),
@@ -608,16 +634,18 @@ class GangManager:
         shape = group.shape
         if shape is not None and shape[0] * shape[1] * shape[2] != total:
             shape = None  # malformed hint: fall back to count search
-        occupied = (
-            self._state.occupied_coords(slice_id) | self.reserved_coords(slice_id)
-        ) - assigned
-        grid = slicefit.occupancy_grid(mesh, occupied)
+        snap = self.snapshots.current()
+        ss = snap.slice(slice_id)
+        # members-look-free is request-specific: an ad-hoc sweep (via the
+        # snapshot module's sole constructor seam), not the cached one
+        occupied = (ss.occupied | ss.reserved) - assigned
+        sweep = sweep_for(mesh, occupied)
         best: Optional[tuple] = None
-        for sb in slicefit.iter_free_boxes(
-            mesh, grid,
+        for sb in slicefit.iter_free_boxes_in(
+            sweep,
             count=total if shape is None else None,
             shape=shape,
-            broken=self._state.broken_links(slice_id),
+            broken=ss.broken,
         ):
             box_set = set(slicefit.box_coords(mesh, sb.box))
             if assigned <= box_set and (
@@ -670,7 +698,14 @@ class GangManager:
             victim_gangs = {
                 w.gang_key for w in pending_victims or () if w.gang_key
             }
+            snap = self.snapshots.current()
             for slice_id, coords in parts.items():
+                try:
+                    ss = snap.slice(slice_id)
+                except KeyError:
+                    raise GangError(
+                        f"gang {key}: unknown slice {slice_id!r}"
+                    ) from None
                 # victim-held chips may legitimately still be OCCUPIED
                 # (their eviction is deferred), but another reservation's
                 # coords always clash — only reservations that are
@@ -681,25 +716,21 @@ class GangManager:
                     if other.key not in victim_gangs:
                         reserved |= other.unassigned_in(slice_id)
                 occupied = (
-                    self._state.occupied_coords(slice_id)
-                    - victim_held.get(slice_id, set())
+                    ss.occupied - victim_held.get(slice_id, set())
                 ) | reserved
                 # terminating victims' chips are ledger-free (their
                 # eviction already released them) but physically held
                 # until the pod object is gone — a preemption-opened box
                 # overlapping them would bind members onto chips a dying
                 # container still owns, with zero victims to gate on
-                # (the RLock makes the locked accessor safe here)
-                occupied |= self.terminating_coords(slice_id)
+                occupied |= ss.terminating
                 clash = [c for c in coords if c in occupied]
                 if clash:
                     raise GangError(
                         f"gang {key}: preempted box re-occupied at "
                         f"{clash[:3]} in {slice_id}; retry"
                     )
-                if slicefit.coords_break_link(
-                    set(coords), self._state.broken_links(slice_id)
-                ):
+                if slicefit.coords_break_link(set(coords), ss.broken):
                     raise GangError(
                         f"gang {key}: preempted box in {slice_id} spans a "
                         f"downed ICI link; retry"
@@ -715,6 +746,7 @@ class GangManager:
                 ),
             )
             self._reservations[key] = res
+            self._epoch += 1
             log.info(
                 "gang %s/%s reserved %d chips over %d slice(s) via preemption"
                 " (%d victim workload(s) pending first bind)",
@@ -764,6 +796,8 @@ class GangManager:
                     self._terminating_coords[pod_key] = (
                         sid, frozenset(coords)
                     )
+            if held:
+                self._epoch += 1
 
     def on_victim_gone(self, pod_key: str) -> bool:
         """A terminating eviction victim's pod object is confirmed gone
@@ -781,6 +815,9 @@ class GangManager:
                     )
                 except Exception:
                     log.exception("event emit failed: VictimGone %s", pod_key)
+            if hit:
+                # the unmasked chips are placeable again: invalidate
+                self._epoch += 1
             for res in self._reservations.values():
                 if pod_key in res.terminating_victims:
                     res.terminating_victims.discard(pod_key)
@@ -951,6 +988,7 @@ class GangManager:
             if bad:
                 raise GangError(f"gang {res.key}: coords {bad} not reservable")
             res.record_assignment(pod_key, sid, list(coords))
+            self._epoch += 1
             if not res.committed and len(res.assigned) >= res.group.min_member:
                 res.committed = True
                 res.commit_latency = time.monotonic() - res.created
@@ -1019,6 +1057,7 @@ class GangManager:
             for res in self._reservations.values():
                 if pod_key in res.assigned:
                     res.drop_assignment(pod_key)
+                    self._epoch += 1
                     if res.committed and not res.assigned:
                         self._reservations.pop(res.key, None)
                         log.info(
@@ -1047,6 +1086,7 @@ class GangManager:
                     pool.update(coords)
                     res.slice_coords[sid] = pool
                     res.record_assignment(pod_key, sid, list(coords))
+                    self._epoch += 1
                     return True
         return False
 

@@ -34,6 +34,7 @@ from typing import Optional
 from tpukube.core.mesh import MeshSpec
 from tpukube.core.types import DEFAULT_SLICE, TopologyCoord
 from tpukube.sched import slicefit
+from tpukube.sched.snapshot import sweep_for
 
 log = logging.getLogger("tpukube.policy")
 
@@ -85,10 +86,13 @@ def find_preemption_plan(
             blocked |= w.coords
 
     # Sweep candidate boxes over a grid where only BLOCKED chips count as
-    # occupied — victims' chips look free because evicting them is the plan.
-    grid = slicefit.occupancy_grid(mesh, blocked)
-    candidates = slicefit.iter_free_boxes(
-        mesh, grid,
+    # occupied — victims' chips look free because evicting them is the
+    # plan. The grid is REQUEST-specific (depends on the preemptor's
+    # priority), so it rides an ad-hoc sweep built through the snapshot
+    # module's constructor seam; origin enumeration and contact scoring
+    # still come batched per shape tier from the vectorized sweep.
+    candidates = slicefit.iter_free_boxes_in(
+        sweep_for(mesh, blocked),
         count=total if shape is None else None,
         shape=shape,
         broken=broken,

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import itertools
 from dataclasses import dataclass
+from functools import lru_cache
 from typing import Iterable, Optional
 
 import numpy as np
@@ -131,7 +132,12 @@ def box_coords(mesh: MeshSpec, box: Box) -> list[TopologyCoord]:
 class _Sweep:
     """One occupancy snapshot prepared for repeated box queries: the free
     grid tiled along torus axes (so wrapped origins become plain origins)
-    plus its zero-padded summed-area table."""
+    plus its zero-padded summed-area table.
+
+    Also the FREE-BOX INDEX of the epoch-cached scheduling snapshot
+    (sched/snapshot.py): ``origins``/``contacts`` results are memoized
+    per shape, so a sweep reused across webhook cycles answers repeat
+    shape queries from the index instead of re-scanning."""
 
     def __init__(self, mesh: MeshSpec, grid: np.ndarray):
         if grid.shape != mesh.dims:
@@ -150,12 +156,24 @@ class _Sweep:
         sat = np.zeros(tuple(s + 1 for s in ext.shape), dtype=np.int64)
         sat[1:, 1:, 1:] = ext.astype(np.int64).cumsum(0).cumsum(1).cumsum(2)
         self.sat = sat
+        # free-box index: shape -> origins / per-origin contact arrays
+        self._origins_cache: dict[Shape, np.ndarray] = {}
+        self._contacts_cache: dict[Shape, np.ndarray] = {}
 
     def origins(self, shape: Shape) -> np.ndarray:
         """[N, 3] origins (in-mesh) where a `shape` box is entirely free,
         wrapping over torus axes. Lexicographic order; full-extent boxes on
         a torus axis are canonicalized to origin 0 (all origins would name
-        the same chip set)."""
+        the same chip set). Memoized per shape — callers must not mutate
+        the returned array."""
+        cached = self._origins_cache.get(shape)
+        if cached is not None:
+            return cached
+        out = self._compute_origins(shape)
+        self._origins_cache[shape] = out
+        return out
+
+    def _compute_origins(self, shape: Shape) -> np.ndarray:
         s = self.sat
         a, b, c = shape
         dims = self.mesh.dims
@@ -232,6 +250,66 @@ class _Sweep:
         self._contact_grid = out
         return out
 
+    def _box_free(self, starts: np.ndarray, shape: Shape) -> np.ndarray:
+        """Free-chip count of a ``shape`` box at every start in
+        ``starts`` ([N, 3], ext-grid coordinates) — one vectorized
+        8-corner gather over the summed-area table, no per-origin loop."""
+        s = self.sat
+        x0, y0, z0 = starts[:, 0], starts[:, 1], starts[:, 2]
+        x1, y1, z1 = x0 + shape[0], y0 + shape[1], z0 + shape[2]
+        return (
+            s[x1, y1, z1] - s[x0, y1, z1] - s[x1, y0, z1] - s[x1, y1, z0]
+            + s[x0, y0, z1] + s[x0, y1, z0] + s[x1, y0, z0] - s[x0, y0, z0]
+        )
+
+    def contacts(self, shape: Shape) -> np.ndarray:
+        """``contact`` for EVERY free origin of ``shape`` at once (aligned
+        with ``origins(shape)``): each face's adjacent slab is itself a
+        box, so its occupied count is (slab area - free count) read off
+        the same integral image — the whole shape tier scores in a
+        handful of numpy gathers instead of a per-origin Python loop.
+        Must agree entry-for-entry with ``contact`` (property-tested)."""
+        cached = self._contacts_cache.get(shape)
+        if cached is not None:
+            return cached
+        origins = self.origins(shape)
+        total = np.zeros(len(origins), dtype=np.int64)
+        dims = self.mesh.dims
+        for axis in range(3):
+            if len(origins) == 0:
+                break
+            d = dims[axis]
+            extent = shape[axis]
+            slab = list(shape)
+            slab[axis] = 1
+            slab_shape = (slab[0], slab[1], slab[2])
+            area = slab[0] * slab[1] * slab[2]  # face area
+            axv = origins[:, axis]
+            if self.mesh.torus[axis] and d > 1:
+                if extent == d:
+                    continue  # box spans the whole ring: no face
+                lo = origins.copy()
+                lo[:, axis] = (axv - 1) % d
+                hi = origins.copy()
+                hi[:, axis] = axv + extent  # <= 2d-2, inside the tiling
+                total += area - self._box_free(lo, slab_shape)
+                total += area - self._box_free(hi, slab_shape)
+            else:
+                wall_lo = axv == 0
+                lo = origins.copy()
+                lo[:, axis] = np.maximum(axv - 1, 0)  # clamp; walls masked
+                total += np.where(
+                    wall_lo, area, area - self._box_free(lo, slab_shape)
+                )
+                wall_hi = axv + extent >= d
+                hi = origins.copy()
+                hi[:, axis] = np.minimum(axv + extent, d - 1)
+                total += np.where(
+                    wall_hi, area, area - self._box_free(hi, slab_shape)
+                )
+        self._contacts_cache[shape] = total
+        return total
+
     def contact(self, box: Box) -> int:
         """Faces of the box touching a mesh wall or occupied chips.
 
@@ -291,20 +369,35 @@ class ScoredBox:
         return (self.surface, self.contact, self.origin_key)
 
 
+@lru_cache(maxsize=4096)
+def _candidate_shapes_for(
+    dims: Shape, count: Optional[int], shape: Optional[Shape]
+) -> tuple[Shape, ...]:
+    """Memoized shape enumeration: the candidate list depends only on
+    (mesh dims, count, shape), and the same handful of requests repeats
+    on every webhook — re-factoring the volume each time was measurable
+    on the filter/prioritize microbench."""
+    if shape is not None:
+        perms = sorted(set(itertools.permutations(shape)))
+        return tuple(
+            p for p in perms if all(s <= d for s, d in zip(p, dims))
+        )
+    assert count is not None
+    return tuple(factor_shapes(count, dims))  # already compactness-sorted
+
+
 def _candidate_shapes(
     mesh: MeshSpec, count: Optional[int], shape: Optional[Shape]
-) -> list[Shape]:
+) -> tuple[Shape, ...]:
     """Shapes to sweep, most-preferred first.
 
     A pinned shape is honored up to axis permutation (a 4x4x1 request is
     geometrically the same slice as 1x4x4; jobs index their mesh axes
     logically, the physical orientation is the scheduler's choice).
     """
-    if shape is not None:
-        perms = sorted(set(itertools.permutations(shape)))
-        return [p for p in perms if all(s <= d for s, d in zip(p, mesh.dims))]
-    assert count is not None
-    return factor_shapes(count, mesh.dims)  # already compactness-sorted
+    return _candidate_shapes_for(
+        mesh.dims, count, None if shape is None else tuple(shape)
+    )
 
 
 def _validate_request(count: Optional[int], shape: Optional[Shape]) -> None:
@@ -316,6 +409,53 @@ def _validate_request(count: Optional[int], shape: Optional[Shape]) -> None:
         raise ValueError(f"shape dims must be >= 1, got {shape}")
 
 
+def _boxes_clear_of_links(
+    dims: Shape, origins: np.ndarray, shape: Shape, broken: set[Link]
+) -> np.ndarray:
+    """Boolean keep-mask over ``origins``: False where a ``shape`` box at
+    that origin contains BOTH endpoints of a downed link — the batched
+    form of ``box_breaks_link`` (same wrapped-interval test, one numpy
+    comparison per link instead of a per-origin Python call)."""
+    keep = np.ones(len(origins), dtype=bool)
+    dims_a = np.asarray(dims)
+    shape_a = np.asarray(shape)
+    for a, b in broken:
+        in_a = np.all((np.asarray(a) - origins) % dims_a < shape_a, axis=1)
+        in_b = np.all((np.asarray(b) - origins) % dims_a < shape_a, axis=1)
+        keep &= ~(in_a & in_b)
+    return keep
+
+
+def iter_free_boxes_in(
+    sweep: _Sweep,
+    count: Optional[int] = None,
+    shape: Optional[Shape] = None,
+    broken: Optional[set[Link]] = None,
+) -> Iterable[ScoredBox]:
+    """``iter_free_boxes`` over a PREPARED sweep (the snapshot fast
+    path): origins and contact scores come batched per shape tier from
+    the sweep's free-box index; only the yield loop is Python."""
+    _validate_request(count, shape)
+    mesh = sweep.mesh
+    for shp in _candidate_shapes(mesh, count, shape):
+        origins = sweep.origins(shp)
+        if len(origins) == 0:
+            continue
+        contacts = sweep.contacts(shp)
+        if broken:
+            keep = _boxes_clear_of_links(mesh.dims, origins, shp, broken)
+            origins, contacts = origins[keep], contacts[keep]
+        s = surface(shp)
+        for origin, contact in zip(origins, contacts):
+            ok = (int(origin[0]), int(origin[1]), int(origin[2]))
+            yield ScoredBox(
+                box=Box(TopologyCoord(*ok), shp),
+                surface=s,
+                contact=-int(contact),
+                origin_key=ok,
+            )
+
+
 def iter_free_boxes(
     mesh: MeshSpec,
     grid: np.ndarray,
@@ -324,20 +464,67 @@ def iter_free_boxes(
     broken: Optional[set[Link]] = None,
 ) -> Iterable[ScoredBox]:
     """All fully-free boxes matching the request, scored, unsorted.
-    Boxes spanning a downed ICI link (``broken``) are excluded."""
+    Boxes spanning a downed ICI link (``broken``) are excluded.
+    Thin wrapper: callers holding a scheduling snapshot use
+    ``iter_free_boxes_in`` and skip the per-call sweep build."""
+    return iter_free_boxes_in(_Sweep(mesh, grid), count=count,
+                              shape=shape, broken=broken)
+
+
+def find_slice_in(
+    sweep: _Sweep,
+    count: Optional[int] = None,
+    shape: Optional[Shape] = None,
+    allow_irregular: bool = False,
+    broken: Optional[set[Link]] = None,
+) -> Optional[list[TopologyCoord]]:
+    """``find_slice`` over a PREPARED sweep — the snapshot fast path.
+
+    The all-free test for every origin of a shape is one integral-image
+    subtraction (``_Sweep.origins``), contact scoring is batched per
+    shape tier (``_Sweep.contacts``), and the best candidate of a tier
+    falls out of one ``lexsort`` — no per-origin Python loop anywhere.
+    Selection order is bit-identical to the reference sweep: surface
+    strictly dominates, then max contact, then lexicographic origin,
+    first shape in candidate order winning ties.
+    """
     _validate_request(count, shape)
-    sweep = _Sweep(mesh, grid)
+    mesh = sweep.mesh
+    best_key: Optional[tuple] = None
+    best_box: Optional[Box] = None
+    tier: Optional[int] = None
     for shp in _candidate_shapes(mesh, count, shape):
-        for origin in sweep.origins(shp):
-            box = Box(TopologyCoord(*(int(v) for v in origin)), shp)
-            if broken and box_breaks_link(mesh, box, broken):
+        s = surface(shp)
+        if tier is not None and s > tier:
+            break  # strictly worse tier; current best cannot be beaten
+        origins = sweep.origins(shp)
+        if len(origins) == 0:
+            continue
+        contacts = sweep.contacts(shp)
+        if broken:
+            keep = _boxes_clear_of_links(mesh.dims, origins, shp, broken)
+            origins, contacts = origins[keep], contacts[keep]
+            if len(origins) == 0:
                 continue
-            yield ScoredBox(
-                box=box,
-                surface=surface(shp),
-                contact=-sweep.contact(box),
-                origin_key=tuple(int(v) for v in origin),
-            )
+        # best of this tier: max contact, then lexicographic origin
+        # (lexsort keys are minor-to-major, so -contacts is primary)
+        i = int(np.lexsort(
+            (origins[:, 2], origins[:, 1], origins[:, 0], -contacts)
+        )[0])
+        key = (
+            s,
+            -int(contacts[i]),
+            (int(origins[i, 0]), int(origins[i, 1]), int(origins[i, 2])),
+        )
+        if best_key is None or key < best_key:
+            best_key = key
+            best_box = Box(TopologyCoord(*key[2]), shp)
+            tier = s
+    if best_box is not None:
+        return box_coords(mesh, best_box)
+    if allow_irregular and shape is None and count is not None:
+        return _find_connected(mesh, sweep.grid, count, broken)
+    return None
 
 
 def find_slice(
@@ -357,34 +544,15 @@ def find_slice(
     Surface area strictly dominates the score, so the sweep stops after the
     first surface tier that yields any candidate — worse-surface shapes can
     never win and are not scored (the scheduler's hot path).
+
+    Thin wrapper: builds one throwaway sweep. Callers with a scheduling
+    snapshot (sched/snapshot.py) use ``find_slice_in`` on its cached
+    sweep instead.
     """
     _validate_request(count, shape)
-    grid = occupancy_grid(mesh, occupied)
-    sweep = _Sweep(mesh, grid)
-    best: Optional[ScoredBox] = None
-    tier: Optional[int] = None
-    for shp in _candidate_shapes(mesh, count, shape):
-        s = surface(shp)
-        if tier is not None and s > tier:
-            break  # strictly worse tier; current best cannot be beaten
-        for origin in sweep.origins(shp):
-            box = Box(TopologyCoord(*(int(v) for v in origin)), shp)
-            if broken and box_breaks_link(mesh, box, broken):
-                continue
-            sb = ScoredBox(
-                box=box,
-                surface=s,
-                contact=-sweep.contact(box),
-                origin_key=tuple(int(v) for v in origin),
-            )
-            if best is None or sb.sort_key < best.sort_key:
-                best = sb
-                tier = s
-    if best is not None:
-        return box_coords(mesh, best.box)
-    if allow_irregular and shape is None and count is not None:
-        return _find_connected(mesh, grid, count, broken)
-    return None
+    sweep = _Sweep(mesh, occupancy_grid(mesh, occupied))
+    return find_slice_in(sweep, count=count, shape=shape,
+                         allow_irregular=allow_irregular, broken=broken)
 
 
 def _find_connected(
@@ -448,11 +616,12 @@ def _find_connected(
     return None
 
 
-def largest_free_box(mesh: MeshSpec, grid: np.ndarray) -> int:
-    """Volume of the largest fully-free box (one SAT build, full shape scan)."""
-    sweep = _Sweep(mesh, grid)
+def largest_free_box_in(sweep: _Sweep) -> int:
+    """Volume of the largest fully-free box over a prepared sweep (full
+    shape scan against the free-box index — repeated calls on a cached
+    snapshot sweep answer from memoized origins)."""
     best = 0
-    X, Y, Z = mesh.dims
+    X, Y, Z = sweep.mesh.dims
     for a in range(1, X + 1):
         for b in range(1, Y + 1):
             if a * b * Z <= best:
@@ -466,14 +635,23 @@ def largest_free_box(mesh: MeshSpec, grid: np.ndarray) -> int:
     return best
 
 
+def largest_free_box(mesh: MeshSpec, grid: np.ndarray) -> int:
+    """Thin wrapper (one throwaway sweep); snapshot holders use
+    ``SliceSnapshot.largest_free_box`` which memoizes per epoch."""
+    return largest_free_box_in(_Sweep(mesh, grid))
+
+
 def fragmentation(mesh: MeshSpec, occupied: Iterable[TopologyCoord]) -> float:
     """Free-space fragmentation in [0, 1]: 1 - (largest free box)/(free chips).
 
     0 = all free chips form one perfect box; -> 1 as free space shatters.
     Exported to metrics and used by tests to validate packing behavior.
+    Thin wrapper: the /statusz + /metrics renders read the epoch-cached
+    ``SliceSnapshot.fragmentation`` instead of rebuilding a sweep per
+    scrape.
     """
     grid = occupancy_grid(mesh, occupied)
     free_count = int((~grid).sum())
     if free_count == 0:
         return 0.0
-    return 1.0 - largest_free_box(mesh, grid) / free_count
+    return 1.0 - largest_free_box_in(_Sweep(mesh, grid)) / free_count

@@ -1,0 +1,336 @@
+"""Epoch-cached scheduling snapshots (the kube-scheduler analog of the
+per-cycle scheduling snapshot + equivalence cache).
+
+Every /filter, /prioritize, and preemption plan used to re-derive
+topology state from the ledger: rebuild the occupancy grid, a fresh
+summed-area table, and the gang masks — per webhook, per slice. On the
+ROADMAP's hardware-speed north star that O(volume x shapes x origins)
+per-webhook rebuild was the dominant hot path. This module makes the
+derived state a CACHED artifact:
+
+  * :class:`SliceSnapshot` — one ICI slice's scheduling view: the
+    occupied / reserved / unhealthy / terminating coord sets, broken
+    links, and (lazily) the prepared :class:`~tpukube.sched.slicefit.
+    _Sweep` objects (occupancy grid + integral-image table + free-box
+    index) plus cached fragmentation / largest-free-box numbers.
+  * :class:`ClusterSnapshot` — the per-slice snapshots under one epoch
+    key.
+  * :class:`SnapshotCache` — epoch-tagged cache owned by the
+    GangManager (shared with the Extender): ``current()`` returns the
+    cached snapshot while the (ledger epoch, gang epoch) key is
+    unchanged and rebuilds lazily — at most once per epoch — otherwise.
+
+Epoch discipline: every ledger mutation (commit / release / node
+upsert / rebuild) bumps ``ClusterState.epoch()``; every reservation
+mutation (reserve / rollback / dissolve / assignment / terminating-mask
+change / eviction confirm) bumps ``GangManager.epoch()``. A snapshot is
+valid exactly while both epochs stand still, so a stale-snapshot
+placement is structurally impossible — the failure mode the chaos
+scenarios must never see.
+
+Locking: ``current()`` reads both epochs (ledger + gang locks) and
+builds OUTSIDE the cache's own mutex, which therefore stays a leaf lock
+— callers may hold the decision or gang lock (the existing
+``decision -> pending -> gang -> ledger`` order), never the reverse.
+Webhook cycles take the snapshot once at the top under the decision
+lock; metrics/statusz scrapes may race mutations, in which case the
+torn build is served once but never cached (the epoch re-check fails).
+
+tpukube-lint's ``snapshot-discipline`` pass enforces the routing: this
+module and ``slicefit`` (the primitive definitions and their grid-based
+thin wrappers) are the only places allowed to construct
+``occupancy_grid``/``_Sweep`` — a call site quietly rebuilding sweeps
+per webhook again is a lint finding, so the cache cannot silently rot.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Iterable, Optional
+
+from tpukube.core.mesh import MeshSpec
+from tpukube.core.types import Link, TopologyCoord
+from tpukube.sched import slicefit
+
+log = logging.getLogger("tpukube.snapshot")
+
+
+def sweep_for(
+    mesh: MeshSpec, blocked: Iterable[TopologyCoord]
+) -> "slicefit._Sweep":
+    """Ad-hoc sweep over a REQUEST-SPECIFIC blocked set (a preemption
+    plan's victims-look-free grid, a restore's members-look-free grid).
+    These grids depend on the request, not just cluster state, so they
+    cannot live in the epoch cache — but their construction still
+    routes through here so the snapshot-discipline lint keeps all sweep
+    building in one auditable place."""
+    return slicefit._Sweep(mesh, slicefit.occupancy_grid(mesh, blocked))
+
+
+class SliceSnapshot:
+    """One ICI slice's scheduling state, frozen at an epoch and prepared
+    for repeated queries. Coord sets are frozen (callers must not — and
+    cannot — mutate them); sweeps, fragmentation, and the largest free
+    box build lazily on first use and are then shared by every caller
+    of the same snapshot (races on the lazy builds are benign: the
+    result is deterministic and assignment is atomic)."""
+
+    __slots__ = (
+        "slice_id", "mesh", "occupied", "reserved", "unhealthy",
+        "terminating", "broken", "utilization",
+        "_occ_sweep", "_blocked_sweep", "_frag", "_largest",
+    )
+
+    def __init__(
+        self,
+        slice_id: str,
+        mesh: MeshSpec,
+        occupied: frozenset[TopologyCoord],
+        reserved: frozenset[TopologyCoord],
+        unhealthy: frozenset[TopologyCoord],
+        terminating: frozenset[TopologyCoord],
+        broken: frozenset[Link],
+        utilization: float,
+    ):
+        self.slice_id = slice_id
+        self.mesh = mesh
+        #: chips with used shares or bad health (ledger view)
+        self.occupied = occupied
+        #: gang mask: unassigned reservation chips + terminating victims
+        self.reserved = reserved
+        self.unhealthy = unhealthy
+        #: evicted-but-still-terminating victims' chips (preemption
+        #: planners treat these like unhealthy: nothing frees them sooner)
+        self.terminating = terminating
+        self.broken = broken
+        self.utilization = utilization
+        self._occ_sweep: Optional[slicefit._Sweep] = None
+        self._blocked_sweep: Optional[slicefit._Sweep] = None
+        self._frag: Optional[float] = None
+        self._largest: Optional[int] = None
+
+    # -- prepared sweeps ---------------------------------------------------
+    def occupancy_sweep(self) -> "slicefit._Sweep":
+        """Sweep over the OCCUPIED grid (allocated + unhealthy chips) —
+        the scorer's fallback and the fragmentation metric's base."""
+        sweep = self._occ_sweep
+        if sweep is None:
+            sweep = self._occ_sweep = sweep_for(self.mesh, self.occupied)
+        return sweep
+
+    def blocked_sweep(self) -> "slicefit._Sweep":
+        """Sweep over occupied | reserved — what every placement search
+        (gang reservation, prioritize scoring) masks against."""
+        sweep = self._blocked_sweep
+        if sweep is None:
+            sweep = self._blocked_sweep = sweep_for(
+                self.mesh, self.occupied | self.reserved
+            )
+        return sweep
+
+    # -- derived numbers ---------------------------------------------------
+    @property
+    def free_chips(self) -> int:
+        """Chips neither occupied nor unhealthy (reservation-blind).
+        Pure set arithmetic — counting must not force a sweep build."""
+        return self.mesh.num_chips - len(self.occupied)
+
+    @property
+    def blocked_free_chips(self) -> int:
+        """Chips free for a NEW placement (occupied and reserved both
+        masked) — the gang layer's capacity-ranking number. The union
+        handles the (normally disjoint) sets overlapping, exactly as
+        the OR'd grid the blocked sweep is built from would."""
+        return self.mesh.num_chips - len(self.occupied | self.reserved)
+
+    def largest_free_box(self) -> int:
+        if self._largest is None:
+            self._largest = slicefit.largest_free_box_in(
+                self.occupancy_sweep()
+            )
+        return self._largest
+
+    def fragmentation(self) -> float:
+        """Cached ``slicefit.fragmentation`` over the occupied grid."""
+        if self._frag is None:
+            free = self.free_chips
+            self._frag = (
+                0.0 if free == 0
+                else 1.0 - self.largest_free_box() / free
+            )
+        return self._frag
+
+
+class ClusterSnapshot:
+    """Per-slice snapshots under one (ledger epoch, gang epoch) key."""
+
+    __slots__ = ("key", "slices", "built_at", "build_seconds")
+
+    def __init__(self, key: tuple[int, int],
+                 slices: dict[str, SliceSnapshot],
+                 build_seconds: float = 0.0):
+        self.key = key
+        self.slices = slices
+        self.built_at = time.monotonic()
+        self.build_seconds = build_seconds
+
+    def slice_ids(self) -> list[str]:
+        return sorted(self.slices)
+
+    def slice(self, slice_id: str) -> SliceSnapshot:
+        try:
+            return self.slices[slice_id]
+        except KeyError:
+            raise KeyError(
+                f"snapshot holds no slice {slice_id!r} "
+                f"(has {sorted(self.slices)})"
+            ) from None
+
+    def reserved_by_slice(self) -> dict[str, frozenset[TopologyCoord]]:
+        """The per-slice gang mask, in the shape the extender's
+        feasibility/scoring helpers consume."""
+        return {sid: ss.reserved for sid, ss in self.slices.items()}
+
+
+class SnapshotCache:
+    """The epoch-tagged snapshot owner. One instance per GangManager
+    (the Extender shares it): ``current()`` is safe from any thread and
+    from under the decision/gang locks, and rebuilds at most once per
+    (ledger, gang) epoch pair."""
+
+    REBUILD_WINDOW = 512  # rebuild-latency samples kept for quantiles
+
+    def __init__(self, state, gang):
+        self._state = state
+        self._gang = gang
+        # leaf mutex: guards only the cached-snapshot slot and the
+        # counters — never held while taking the gang/ledger locks
+        self._lock = threading.Lock()
+        self._snap: Optional[ClusterSnapshot] = None
+        self.rebuilds = 0
+        self.hits = 0
+        self._rebuild_seconds: deque[float] = deque(
+            maxlen=self.REBUILD_WINDOW
+        )
+
+    # -- epoch key ---------------------------------------------------------
+    def epoch_key(self) -> tuple[int, int]:
+        return (self._state.epoch(), self._gang.epoch())
+
+    def invalidate(self) -> None:
+        """Drop the cached snapshot (tests and the no-cache microbench
+        baseline; production invalidation is epoch bumps, never this)."""
+        with self._lock:
+            self._snap = None
+
+    # -- the cache ---------------------------------------------------------
+    def current(self) -> ClusterSnapshot:
+        """The scheduling snapshot for the current epochs: cached while
+        nothing mutated, rebuilt lazily otherwise.
+
+        Torn-build story: every mutation path runs under the extender's
+        decision lock, and so does every PLACEMENT lookup — a placement
+        cycle's build therefore always passes the epoch re-check below
+        (the epochs cannot move under it), which is what makes a
+        stale- or torn-snapshot placement structurally impossible.
+        Only lock-free OBSERVER reads (metrics/statusz scrapes, which
+        should come through :meth:`observe`) can race a mutation; a
+        build that fails the re-check is served to that one caller
+        uncached — no worse than the pre-snapshot renderers, which
+        read the accessors sequentially without a global freeze — and
+        the next lookup rebuilds clean."""
+        return self._lookup(count_hit=True)
+
+    def observe(self) -> ClusterSnapshot:
+        """Cache lookup for observability readers (metrics/statusz).
+        Never counts a hit — scrape self-traffic counted as hits would
+        mask the 'flat hits counter under webhook load' diagnostic the
+        counters exist for. A rebuild it performs is still real work
+        (one the next scheduling lookup then inherits) and counts."""
+        return self._lookup(count_hit=False)
+
+    def _lookup(self, count_hit: bool) -> ClusterSnapshot:
+        key = self.epoch_key()
+        with self._lock:
+            snap = self._snap
+            if snap is not None and snap.key == key:
+                if count_hit:
+                    self.hits += 1
+                return snap
+        for _ in range(3):
+            t0 = time.perf_counter()
+            snap = self._build(key)
+            snap.build_seconds = time.perf_counter() - t0
+            after = self.epoch_key()
+            with self._lock:
+                self.rebuilds += 1
+                self._rebuild_seconds.append(snap.build_seconds)
+                if after == key:
+                    self._snap = snap
+                    return snap
+            key = after
+        return snap  # an observer raced mutations: serve uncached
+
+    def _build(self, key: tuple[int, int]) -> ClusterSnapshot:
+        slices: dict[str, SliceSnapshot] = {}
+        for sid in self._state.slice_ids():
+            try:
+                mesh = self._state.slice_mesh(sid)
+            except Exception as e:
+                # slice vanished mid-build (a racing scrape); the epoch
+                # re-check in current() refuses to cache this build
+                log.warning("snapshot build: slice %s vanished: %s",
+                            sid, e)
+                continue
+            slices[sid] = SliceSnapshot(
+                slice_id=sid,
+                mesh=mesh,
+                occupied=frozenset(self._state.occupied_coords(sid)),
+                reserved=frozenset(self._gang.reserved_coords(sid)),
+                unhealthy=frozenset(self._state.unhealthy_coords(sid)),
+                terminating=frozenset(self._gang.terminating_coords(sid)),
+                broken=frozenset(self._state.broken_links(sid)),
+                utilization=self._state.slice_utilization(sid),
+            )
+        return ClusterSnapshot(key=key, slices=slices)
+
+    # -- observability -----------------------------------------------------
+    def rebuild_seconds_snapshot(self) -> list[float]:
+        """Copy of the rebuild-latency window (the /metrics summary's
+        values_fn — copied under the mutex so a concurrent rebuild can
+        never corrupt the scrape)."""
+        with self._lock:
+            return list(self._rebuild_seconds)
+
+    def stats(self) -> dict[str, Any]:
+        """The /statusz document: cache counters plus the per-slice
+        fragmentation numbers the snapshot makes cheap to serve.
+        Reads via observe() — a statusz poll must not inflate the
+        hit counters it reports."""
+        snap = self.observe()
+        with self._lock:
+            rebuilds, hits = self.rebuilds, self.hits
+            last = (self._rebuild_seconds[-1]
+                    if self._rebuild_seconds else None)
+        lookups = rebuilds + hits
+        return {
+            "epoch": {"ledger": snap.key[0], "gang": snap.key[1]},
+            "rebuilds": rebuilds,
+            "hits": hits,
+            "hit_rate": round(hits / lookups, 4) if lookups else None,
+            "last_rebuild_s": (round(last, 6) if last is not None
+                               else None),
+            "slices": {
+                sid: {
+                    "fragmentation": round(ss.fragmentation(), 4),
+                    "largest_free_box": ss.largest_free_box(),
+                    "free_chips": ss.free_chips,
+                    "reserved_chips": len(ss.reserved),
+                    "links_down": len(ss.broken),
+                }
+                for sid, ss in snap.slices.items()
+            },
+        }

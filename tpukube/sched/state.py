@@ -188,6 +188,17 @@ class ClusterState:
         # frozen coord->host snapshots handed to hot-path callers; rebuilt
         # lazily after any host-map mutation (annotations rarely change)
         self._hosts_cache: dict[str, dict[TopologyCoord, str]] = {}
+        # ledger epoch: bumped by EVERY mutation (node upsert, commit,
+        # release — rebuild_from_pods goes through commit). The epoch-
+        # cached scheduling snapshot (sched/snapshot.py) keys its
+        # validity on this, so a missed bump here would serve stale
+        # placements — treat any new mutation path as epoch-bumping.
+        self._epoch = 0
+
+    def epoch(self) -> int:
+        """Monotonic mutation counter (the snapshot cache's key half)."""
+        with self._lock:
+            return self._epoch
 
     # -- node ingestion ----------------------------------------------------
     def upsert_node(self, name: str, annotations: dict[str, str]) -> bool:
@@ -267,6 +278,7 @@ class ClusterState:
                 view.share_counts = prev.share_counts
                 view.id_weights = prev.id_weights
             self._nodes[name] = view
+            self._epoch += 1
         return True
 
     # -- views -------------------------------------------------------------
@@ -450,6 +462,7 @@ class ClusterState:
                 pending_shares[index] = pending_shares.get(index, 0) + want
             view.add_ids(adding)
             self._allocs[alloc.pod_key] = alloc
+            self._epoch += 1
 
     def release(self, pod_key: str) -> Optional[AllocResult]:
         """Pod gone (deleted/preempted): free its shares."""
@@ -460,6 +473,7 @@ class ClusterState:
             view = self._nodes.get(alloc.node_name)
             if view is not None:
                 view.remove_ids(alloc.device_ids)
+            self._epoch += 1
             return alloc
 
     # -- restart story -----------------------------------------------------
