@@ -1,0 +1,543 @@
+"""CFG dataflow engine (ISSUE 7): engine-level path queries over
+tricky control flow, (violating, clean) fixture pairs for the
+epoch-discipline and reservation-leak passes — try/finally with return
+inside, with inside a loop, early return under the lock, bare raise
+re-raise, nested `with A, B:` — and the mutation-kill test proving
+every existing epoch-bump seam in sched/state.py + sched/gang.py is
+covered: deleting any single `self._epoch += 1` makes the pass report.
+"""
+
+import ast
+import os
+import textwrap
+
+from tpukube.analysis import base, cfg
+from tpukube.analysis.epochs import check_epochs
+from tpukube.analysis.leaks import check_leaks
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _sf(tmp_path, rel, src):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(src)
+    return base.SourceFile(p, rel=rel)
+
+
+def _func(src: str):
+    return ast.parse(textwrap.dedent(src)).body[0]
+
+
+def _calls(node: cfg.Node, name: str) -> bool:
+    if node.stmt is None:
+        return False
+    return any(
+        isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+        and n.func.attr == name
+        for n in cfg.shallow_walk(node.stmt)
+    )
+
+
+def _start(g: cfg.FunctionCFG, name: str) -> cfg.Node:
+    return next(n for n in g.nodes if _calls(n, name))
+
+
+# -- engine ------------------------------------------------------------------
+
+def test_return_inside_try_finally_runs_cleanup():
+    """A `return` inside try/finally must route THROUGH the finally
+    body — a settle there covers the early exit."""
+    g = cfg.build_cfg(_func("""
+        def f(self):
+            self.acquire()
+            try:
+                return 1
+            finally:
+                self.settle()
+    """))
+    rets, rzs = cfg.escapes_function(
+        g, _start(g, "acquire"), lambda n: _calls(n, "settle"))
+    assert rets == [] and rzs == []
+
+
+def test_loop_break_path_can_skip_settle():
+    g = cfg.build_cfg(_func("""
+        def f(self):
+            self.acquire()
+            while self.more():
+                if self.bad():
+                    break
+                self.settle()
+                return 2
+            return None
+    """))
+    rets, rzs = cfg.escapes_function(
+        g, _start(g, "acquire"), lambda n: _calls(n, "settle"))
+    # two unsettled normal exits: loop-never-entered and break
+    assert rets and rzs == []
+
+
+def test_explicit_raise_reaches_raise_exit():
+    g = cfg.build_cfg(_func("""
+        def f(self):
+            self.acquire()
+            if self.bad():
+                raise RuntimeError("boom")
+            self.settle()
+            return 1
+    """))
+    rets, rzs = cfg.escapes_function(
+        g, _start(g, "acquire"), lambda n: _calls(n, "settle"))
+    assert rets == [] and len(rzs) == 1
+
+
+def test_handlerless_try_bodies_are_assumed_not_to_raise():
+    """try/finally WITHOUT handlers signals cleanup, not expected
+    exceptions: no implicit exception edges, so acquire->settle with
+    plain statements between stays clean (the bind() wrapper shape)."""
+    g = cfg.build_cfg(_func("""
+        def f(self):
+            try:
+                self.acquire()
+                self.other_work()
+                self.settle()
+                return 1
+            finally:
+                self.observe()
+    """))
+    rets, rzs = cfg.escapes_function(
+        g, _start(g, "acquire"), lambda n: _calls(n, "settle"))
+    assert rets == [] and rzs == []
+
+
+def test_try_with_handlers_gets_implicit_exception_edges():
+    g = cfg.build_cfg(_func("""
+        def f(self):
+            self.acquire()
+            try:
+                self.might_fail()
+            except ValueError:
+                return None
+            self.settle()
+            return 1
+    """))
+    rets, rzs = cfg.escapes_function(
+        g, _start(g, "acquire"), lambda n: _calls(n, "settle"))
+    # the handler's `return None` path never settles
+    assert len(rets) == 1 and rzs == []
+
+
+def test_region_query_sees_all_three_exit_kinds():
+    src = """
+        def f(self, key):
+            with self._lock:
+                self.seam(key)
+                if key:
+                    return 1
+                self._epoch += 1
+            return 0
+    """
+    g = cfg.build_cfg(_func(src), lock_attrs={"_lock"})
+    start = _start(g, "seam")
+    rid = g.outermost_region(start, "_lock")
+    assert rid is not None
+
+    def bump(n):
+        return n.stmt is not None and any(
+            isinstance(x, ast.AugAssign) for x in cfg.shallow_walk(n.stmt))
+
+    # the `return 1` leaves the region without a bump
+    assert cfg.escapes_region(g, start, rid, bump)
+
+
+def test_shallow_walk_skips_nested_defs_and_lambdas():
+    stmt = ast.parse(textwrap.dedent("""
+        def outer(self):
+            def helper():
+                self.hidden_mutation()
+            return max(self.xs, key=lambda v: self.also_hidden(v))
+    """)).body[0]
+    names = {
+        n.func.attr for n in cfg.shallow_walk(stmt)
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+    }
+    assert "hidden_mutation" not in names
+    assert "also_hidden" not in names
+
+
+# -- epoch-discipline fixture pairs ------------------------------------------
+
+EPOCH_TRY_FINALLY_VIO = '''\
+class GangManager:
+    def vio(self, key):
+        with self._lock:
+            try:
+                self._reservations.pop(key, None)
+                return True
+            finally:
+                self._log()
+'''
+
+EPOCH_TRY_FINALLY_OK = '''\
+class GangManager:
+    def ok(self, key):
+        with self._lock:
+            try:
+                self._reservations.pop(key, None)
+                return True
+            finally:
+                self._epoch += 1
+'''
+
+EPOCH_WITH_IN_LOOP_VIO = '''\
+class GangManager:
+    def vio(self, keys):
+        for k in keys:
+            with self._lock:
+                self._reservations.pop(k, None)
+                if k == "skip":
+                    continue
+                self._epoch += 1
+'''
+
+EPOCH_WITH_IN_LOOP_OK = '''\
+class GangManager:
+    def ok(self, keys):
+        for k in keys:
+            with self._lock:
+                self._reservations.pop(k, None)
+                self._epoch += 1
+                if k == "skip":
+                    continue
+'''
+
+EPOCH_EARLY_RETURN_VIO = '''\
+class GangManager:
+    def vio(self, key):
+        with self._lock:
+            res = self._reservations.pop(key, None)
+            if res is None:
+                return None
+            self._epoch += 1
+            return res
+'''
+
+EPOCH_EARLY_RETURN_OK = '''\
+class GangManager:
+    def ok(self, key):
+        with self._lock:
+            res = self._reservations.get(key)
+            if res is None:
+                return None
+            self._reservations.pop(key, None)
+            self._epoch += 1
+            return res
+'''
+
+EPOCH_BARE_RAISE_VIO = '''\
+class GangManager:
+    def vio(self, key, res):
+        with self._lock:
+            try:
+                self._reservations[key] = res
+                self._validate(res)
+            except Exception:
+                raise
+            self._epoch += 1
+'''
+
+EPOCH_BARE_RAISE_OK = '''\
+class GangManager:
+    def ok(self, key, res):
+        with self._lock:
+            try:
+                self._reservations[key] = res
+                self._validate(res)
+            except Exception:
+                self._epoch += 1
+                raise
+            self._epoch += 1
+'''
+
+EPOCH_MULTI_WITH_VIO = '''\
+class GangManager:
+    def vio(self, key, res):
+        with self._ttl_lock, self._lock:
+            self._reservations[key] = res
+        self._epoch += 1
+'''
+
+EPOCH_MULTI_WITH_OK = '''\
+class GangManager:
+    def ok(self, key, res):
+        with self._ttl_lock, self._lock:
+            self._reservations[key] = res
+            self._epoch += 1
+'''
+
+
+def test_epoch_fixture_pairs(tmp_path):
+    pairs = [
+        (EPOCH_TRY_FINALLY_VIO, EPOCH_TRY_FINALLY_OK),
+        (EPOCH_WITH_IN_LOOP_VIO, EPOCH_WITH_IN_LOOP_OK),
+        (EPOCH_EARLY_RETURN_VIO, EPOCH_EARLY_RETURN_OK),
+        (EPOCH_BARE_RAISE_VIO, EPOCH_BARE_RAISE_OK),
+        (EPOCH_MULTI_WITH_VIO, EPOCH_MULTI_WITH_OK),
+    ]
+    for i, (vio, ok) in enumerate(pairs):
+        bad = check_epochs(_sf(tmp_path, f"v{i}/sched/gang.py", vio))
+        assert bad, f"pair {i}: violation not flagged"
+        assert all(f.rule == "epoch-discipline" for f in bad)
+        assert all("_epoch" in f.message for f in bad)
+        good = check_epochs(_sf(tmp_path, f"o{i}/sched/gang.py", ok))
+        assert good == [], f"pair {i}: clean twin flagged: {good}"
+
+
+def test_epoch_seam_via_tuple_unpacking_is_not_invisible(tmp_path):
+    """`self._reservations[k], old = res, None` writes the seam exactly
+    like the plain form — unpacking targets must not evade the pass."""
+    src = '''\
+class GangManager:
+    def vio(self, key, res):
+        with self._lock:
+            self._reservations[key], old = res, None
+'''
+    findings = check_epochs(_sf(tmp_path, "sched/gang.py", src))
+    assert len(findings) == 1
+    assert "_reservations" in findings[0].message
+
+
+def test_epoch_seam_outside_lock_is_a_finding(tmp_path):
+    src = '''\
+class ClusterState:
+    def vio(self, key, alloc):
+        self._allocs[key] = alloc
+        self._epoch += 1
+'''
+    findings = check_epochs(_sf(tmp_path, "sched/state.py", src))
+    assert len(findings) == 1
+    assert "outside" in findings[0].message
+
+
+def test_epoch_locked_helper_checked_to_function_exit(tmp_path):
+    vio = '''\
+class GangManager:
+    def _drop_locked(self, key):
+        self._reservations.pop(key, None)
+'''
+    ok = vio.replace(
+        "self._reservations.pop(key, None)",
+        "self._reservations.pop(key, None)\n        self._epoch += 1")
+    assert check_epochs(_sf(tmp_path, "a/sched/gang.py", vio))
+    assert check_epochs(_sf(tmp_path, "b/sched/gang.py", ok)) == []
+
+
+def test_epoch_out_of_scope_module_is_ignored(tmp_path):
+    assert check_epochs(
+        _sf(tmp_path, "obs/other.py", EPOCH_EARLY_RETURN_VIO)) == []
+
+
+def test_epoch_findings_waivable(tmp_path):
+    src = EPOCH_EARLY_RETURN_VIO.replace(
+        "            res = self._reservations.pop(key, None)",
+        "            # tpukube: allow(epoch-discipline) fixture: "
+        "pop miss mutates nothing\n"
+        "            res = self._reservations.pop(key, None)")
+    sf = _sf(tmp_path, "sched/gang.py", src)
+    raw = check_epochs(sf)
+    assert len(raw) == 1
+    assert base.apply_waivers(sf, raw) == []
+
+
+# -- reservation-leak fixture pairs ------------------------------------------
+
+LEAK_TRY_FINALLY_VIO = '''\
+class Extender:
+    def bind(self, key, alloc):
+        try:
+            self.state.commit(alloc)
+            if self.broken:
+                raise RuntimeError("boom")
+            return alloc
+        finally:
+            self._observe(key)
+'''
+
+LEAK_TRY_FINALLY_OK = '''\
+class Extender:
+    def bind(self, key, alloc):
+        try:
+            self.state.commit(alloc)
+            if self.broken:
+                self.state.release(key)
+                raise RuntimeError("boom")
+            return alloc
+        finally:
+            self._observe(key)
+'''
+
+LEAK_EARLY_RETURN_VIO = '''\
+class Extender:
+    def _execute_pending_preemption(self, res):
+        victims = self.gang.take_pending_victims(res)
+        if not victims:
+            return
+        self._apply_victims(victims)
+'''
+
+LEAK_EARLY_RETURN_OK = '''\
+class Extender:
+    def _execute_pending_preemption(self, res):
+        if not self.gang.peek_pending_victims(res):
+            return
+        victims = self.gang.take_pending_victims(res)
+        self._apply_victims(victims)
+'''
+
+LEAK_BARE_RAISE_VIO = '''\
+class Extender:
+    def bind(self, key, alloc):
+        self.state.commit(alloc)
+        try:
+            self._effector(alloc)
+        except Exception:
+            raise
+        return alloc
+'''
+
+LEAK_BARE_RAISE_OK = '''\
+class Extender:
+    def bind(self, key, alloc):
+        self.state.commit(alloc)
+        try:
+            self._effector(alloc)
+        except Exception:
+            self.state.release(key)
+            raise
+        return alloc
+'''
+
+LEAK_PLAN_DROPPED_VIO = '''\
+class Extender:
+    def _try_preemption(self, pod, count):
+        plan = None
+        for sid in self.slices:
+            with self._scan_guard:
+                cand = policy.find_preemption_plan(sid)
+            if cand is not None:
+                plan = cand
+        if plan is None:
+            raise GangError("no plan")
+        return None
+'''
+
+LEAK_PLAN_DROPPED_OK = '''\
+class Extender:
+    def _try_preemption(self, pod, count):
+        for sid in self.slices:
+            with self._scan_guard:
+                cand = policy.find_preemption_plan(sid)
+            if cand is not None:
+                return self.gang.reserve_exact(pod, count, cand)
+        raise GangError("no plan")
+'''
+
+LEAK_RESTORE_VIO = '''\
+class GangManager:
+    def restore(self, namespace, group, allocs):
+        with self._lock:
+            sid = self._state.slice_of_node(allocs[0].node_name)
+            if sid is None:
+                return None
+            res = self._make(group, sid)
+            self._reservations[(namespace, group.name)] = res
+            self._epoch += 1
+            return res
+'''
+
+LEAK_RESTORE_OK = '''\
+class GangManager:
+    def restore(self, namespace, group, allocs):
+        def rollback_all(why):
+            self._note(why)
+
+        with self._lock:
+            sid = self._state.slice_of_node(allocs[0].node_name)
+            if sid is None:
+                rollback_all("member node unknown")
+                return None
+            res = self._make(group, sid)
+            self._reservations[(namespace, group.name)] = res
+            self._epoch += 1
+            return res
+'''
+
+
+def test_leak_fixture_pairs(tmp_path):
+    pairs = [
+        ("sched/extender.py", LEAK_TRY_FINALLY_VIO, LEAK_TRY_FINALLY_OK),
+        ("sched/extender.py", LEAK_EARLY_RETURN_VIO, LEAK_EARLY_RETURN_OK),
+        ("sched/extender.py", LEAK_BARE_RAISE_VIO, LEAK_BARE_RAISE_OK),
+        ("sched/extender.py", LEAK_PLAN_DROPPED_VIO, LEAK_PLAN_DROPPED_OK),
+        ("sched/gang.py", LEAK_RESTORE_VIO, LEAK_RESTORE_OK),
+    ]
+    for i, (rel, vio, ok) in enumerate(pairs):
+        bad = check_leaks(_sf(tmp_path, f"v{i}/{rel}", vio))
+        assert bad, f"pair {i}: violation not flagged"
+        assert all(f.rule == "reservation-leak" for f in bad)
+        good = check_leaks(_sf(tmp_path, f"o{i}/{rel}", ok))
+        assert good == [], f"pair {i}: clean twin flagged: {good}"
+
+
+def test_leak_out_of_scope_is_ignored(tmp_path):
+    # same code outside the registered files/functions: no findings
+    assert check_leaks(
+        _sf(tmp_path, "sim/other.py", LEAK_TRY_FINALLY_VIO)) == []
+    renamed = LEAK_TRY_FINALLY_VIO.replace("def bind", "def helper")
+    assert check_leaks(
+        _sf(tmp_path, "sched/extender.py", renamed)) == []
+
+
+def test_leak_findings_waivable(tmp_path):
+    src = LEAK_BARE_RAISE_VIO.replace(
+        "        self.state.commit(alloc)",
+        "        # tpukube: allow(reservation-leak) fixture: the "
+        "effector's caller releases\n"
+        "        self.state.commit(alloc)")
+    sf = _sf(tmp_path, "sched/extender.py", src)
+    raw = check_leaks(sf)
+    assert len(raw) == 1
+    assert base.apply_waivers(sf, raw) == []
+
+
+# -- the real tree ------------------------------------------------------------
+
+def test_real_tree_clean_under_both_passes():
+    tree = os.path.join(REPO, "tpukube")
+    findings = base.run_all(
+        [tree], rules=["epoch-discipline", "reservation-leak"])
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_mutation_kill_every_epoch_bump_is_covered():
+    """ISSUE 7 acceptance: deleting ANY single `self._epoch += 1` in
+    sched/state.py or sched/gang.py makes epoch-discipline report a
+    finding — the registry provably covers every existing bump seam."""
+    for rel in ("sched/state.py", "sched/gang.py"):
+        path = os.path.join(REPO, "tpukube", rel)
+        lines = open(path).read().splitlines(keepends=True)
+        bumps = [i for i, ln in enumerate(lines)
+                 if ln.strip() == "self._epoch += 1"]
+        assert bumps, f"{rel}: no epoch bumps found?"
+        for i in bumps:
+            mutated = list(lines)
+            indent = len(lines[i]) - len(lines[i].lstrip())
+            mutated[i] = " " * indent + "pass\n"
+            sf = base.SourceFile(path, text="".join(mutated), rel=rel)
+            findings = check_epochs(sf)
+            assert findings, (
+                f"{rel}:{i + 1}: deleting this epoch bump went "
+                f"UNDETECTED — the seam it guards is not covered by "
+                f"analysis/epochs.py EPOCH_REGISTRY"
+            )

@@ -334,6 +334,166 @@ def silent():
     assert "made-up-rule" in findings[0].message
 
 
+def test_unused_waiver_is_a_finding(tmp_path):
+    """Satellite: a waiver that suppresses zero findings is stale and
+    must not outlive the code it excused."""
+    stale = '''\
+def fine():
+    # tpukube: allow(exception-hygiene) nothing here needs this anymore
+    return 1
+'''
+    (tmp_path / "a").mkdir()
+    f = tmp_path / "a" / "mod.py"
+    f.write_text(stale)
+    findings = base.run_all([f])
+    assert [x.rule for x in findings] == ["unused-waiver"]
+    assert "suppressed no findings" in findings[0].message
+
+    # the same waiver actually suppressing something: NOT stale
+    used = '''\
+def silent():
+    try:
+        work()
+    # tpukube: allow(exception-hygiene) fixture: caller records the error
+    except Exception:
+        pass
+'''
+    f.write_text(used)
+    assert base.run_all([f]) == []
+
+
+def test_unused_waiver_skipped_when_its_rule_did_not_run(tmp_path):
+    """A partial --rules run proves nothing about a waiver for a
+    deselected rule — no false staleness."""
+    stale = '''\
+def fine():
+    # tpukube: allow(exception-hygiene) justified but stale
+    return 1
+'''
+    (tmp_path / "a").mkdir()
+    f = tmp_path / "a" / "mod.py"
+    f.write_text(stale)
+    findings = base.run_all(
+        [f], rules=["lock-discipline", "unused-waiver", "bare-waiver"])
+    assert findings == []
+
+
+def test_unused_waiver_is_not_itself_waivable(tmp_path):
+    """The meta rules cannot excuse themselves: naming unused-waiver
+    (or bare-waiver) in a pragma is a bare-waiver finding."""
+    src = '''\
+def fine():
+    # tpukube: allow(unused-waiver) meta rules are not waivable
+    return 1
+'''
+    (tmp_path / "a").mkdir()
+    f = tmp_path / "a" / "mod.py"
+    f.write_text(src)
+    findings = base.run_all([f])
+    assert "bare-waiver" in [x.rule for x in findings]
+
+
+def test_known_rules_message_excludes_meta_rules_by_name(tmp_path):
+    """Satellite: the 'known rules' message is built from WAIVABLE_RULES
+    (by name), not a positional ALL_RULES[:-1] slice that broke the day
+    rules were appended after bare-waiver."""
+    assert "bare-waiver" not in base.WAIVABLE_RULES
+    assert "unused-waiver" not in base.WAIVABLE_RULES
+    assert "epoch-discipline" in base.WAIVABLE_RULES
+    assert "reservation-leak" in base.WAIVABLE_RULES
+    src = '''\
+def fine():
+    # tpukube: allow(made-up-rule) whatever
+    return 1
+'''
+    sf = _sf(tmp_path, "mod.py", src)
+    findings = base.waiver_findings(sf)
+    assert len(findings) == 1
+    assert "bare-waiver" not in findings[0].message.split("known: ")[1]
+    assert "epoch-discipline" in findings[0].message
+
+
+def test_changed_mode_lints_only_files_changed_vs_ref(tmp_path):
+    """Satellite: tpukube-lint --changed [REF] for the fast pre-commit
+    loop — only changed/untracked .py files are linted."""
+    import subprocess
+
+    from tpukube.analysis.cli import main
+
+    repo = tmp_path / "repo"
+    (repo / "sched").mkdir(parents=True)
+
+    def git(*args):
+        subprocess.run(["git", *args], cwd=repo, check=True,
+                       capture_output=True)
+
+    git("init", "-q")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    clean = "def fine():\n    return 1\n"
+    (repo / "sched" / "gang.py").write_text(clean)
+    (repo / "other.py").write_text(clean)
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+
+    # nothing changed: clean exit, nothing linted (paths before the
+    # flag — a bare `--changed path` would bind the path as the ref)
+    assert main([str(repo), "--changed"]) == 0
+
+    # a committed file changes to a violation: --changed catches it
+    (repo / "sched" / "gang.py").write_text(VIOLATING_DISCIPLINE)
+    assert main([str(repo), "--changed"]) == 1
+
+    # vs a ref where that change is already committed: nothing to lint
+    git("add", "-A")
+    git("commit", "-qm", "violation")
+    assert main([str(repo), "--changed=HEAD"]) == 0
+    # ...but vs the PREVIOUS commit the violation is a changed file
+    assert main([str(repo), "--changed=HEAD~1"]) == 1
+
+    # untracked new files are part of the pre-commit loop — also when
+    # the linted path is a SUBDIRECTORY of the repo (ls-files --others
+    # must run from the toplevel or it prints subtree-relative names
+    # that resolve to nonexistent paths and get dropped)
+    (repo / "sched" / "state.py").write_text(VIOLATING_DISCIPLINE)
+    out = base.changed_paths([repo], ref="HEAD")
+    assert [p.name for p in out] == ["state.py"]
+    out = base.changed_paths([repo / "sched"], ref="HEAD")
+    assert [p.name for p in out] == ["state.py"]
+    assert main([str(repo), "--changed"]) == 1
+    assert main([str(repo / "sched"), "--changed"]) == 1
+
+    # a bad ref is a usage error (exit 2), not findings
+    assert main([str(repo), "--changed=no-such-ref"]) == 2
+
+    # the prometheus-rules cross-check survives changed-only mode: the
+    # rules file is discovered from the ORIGINAL path argument, not the
+    # substituted changed-file list (whose parents have no deploy/)
+    (repo / "deploy").mkdir()
+    (repo / "deploy" / "prometheus-rules.yaml").write_text(
+        "apiVersion: monitoring.coreos.com/v1\n"
+        "kind: PrometheusRule\n"
+        "spec:\n"
+        "  groups:\n"
+        "    - name: g\n"
+        "      rules:\n"
+        "        - record: r\n"
+        "          expr: rate(tpukube_nonexistent_total[5m])\n"
+    )
+    (repo / "sched" / "state.py").write_text(clean)
+    (repo / "sched" / "gang.py").write_text(clean)
+    git("add", "-A")
+    git("commit", "-qm", "rules")
+    (repo / "sched" / "gang.py").write_text(clean + "\n# touched\n")
+    assert main([str(repo), "--changed"]) == 1  # rules-file finding
+
+    # ...and even with ZERO changed .py files ("only the rules file
+    # changed" is exactly when the cross-check matters most)
+    git("add", "-A")
+    git("commit", "-qm", "touch")
+    assert main([str(repo), "--changed"]) == 1
+
+
 def test_unparseable_file_is_a_finding_not_a_crash(tmp_path):
     (tmp_path / "broken.py").write_text("def broken(:\n    pass\n")
     findings = base.run_all([tmp_path])

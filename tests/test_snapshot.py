@@ -489,3 +489,123 @@ def test_sweep_for_is_the_adhoc_constructor_seam():
         mesh, occupancy_grid(mesh, blocked), count=4))
     assert [(b.box, b.surface, b.contact, b.origin_key) for b in boxes] \
         == [(b.box, b.surface, b.contact, b.origin_key) for b in ref]
+
+
+# -- the audit sentinel (ISSUE 7) --------------------------------------------
+
+def test_audit_off_by_default_and_validated():
+    cfg = load_config(env={})
+    assert cfg.snapshot_audit_rate == 0.0
+    ext, _, _ = _mini_extender()
+    assert ext.snapshots.audit_rate == 0.0
+    ext.snapshots.current()
+    ext.snapshots.current()
+    assert ext.snapshots.audit_checks == 0
+    with pytest.raises(ValueError):
+        load_config(env={"TPUKUBE_SNAPSHOT_AUDIT_RATE": "1.5"})
+    with pytest.raises(ValueError):
+        load_config(env={"TPUKUBE_SNAPSHOT_AUDIT_RATE": "-0.1"})
+
+
+def test_audit_clean_on_disciplined_mutations():
+    """With every seam bumping (the shipped tree), a rate-1.0 audit
+    checks every scheduling hit and finds zero divergences."""
+    cfg = load_config(env={"TPUKUBE_SNAPSHOT_AUDIT_RATE": "1.0"})
+    ext = Extender(cfg)
+    mesh = MeshSpec(dims=(4, 4, 1), host_block=(2, 2, 1))
+    for host in mesh.all_hosts():
+        chips = [
+            ChipInfo(chip_id=f"{host}-c{i}", index=i, coord=c,
+                     hbm_bytes=cfg.hbm_bytes_per_chip)
+            for i, c in enumerate(mesh.coords_of_host(host))
+        ]
+        ext.state.upsert_node(host, codec.annotate_node(
+            NodeInfo(name=host, chips=chips, slice_id=cfg.slice_id), mesh))
+    assert ext.snapshots.audit_rate == 1.0
+    ext.snapshots.current()                      # rebuild
+    ext.snapshots.current()                      # hit -> audited
+    ext.state.commit(_alloc("d/p0", "host-0-0-0", [0], mesh))
+    ext.snapshots.current()                      # rebuild (epoch moved)
+    ext.snapshots.current()                      # hit -> audited
+    assert ext.snapshots.audit_checks >= 2
+    assert ext.snapshots.audit_divergences == 0
+
+
+def test_audit_catches_a_missed_epoch_bump():
+    """Simulate exactly the bug class the sentinel exists for: mutate
+    the ledger, then rewind the epoch so the cache believes nothing
+    changed. The next audited hit must raise SnapshotAuditError and
+    count the divergence."""
+    from tpukube.sched.snapshot import SnapshotAuditError
+
+    cfg = load_config(env={"TPUKUBE_SNAPSHOT_AUDIT_RATE": "1.0"})
+    ext = Extender(cfg)
+    mesh = MeshSpec(dims=(4, 4, 1), host_block=(2, 2, 1))
+    for host in mesh.all_hosts():
+        chips = [
+            ChipInfo(chip_id=f"{host}-c{i}", index=i, coord=c,
+                     hbm_bytes=cfg.hbm_bytes_per_chip)
+            for i, c in enumerate(mesh.coords_of_host(host))
+        ]
+        ext.state.upsert_node(host, codec.annotate_node(
+            NodeInfo(name=host, chips=chips, slice_id=cfg.slice_id), mesh))
+    ext.snapshots.current()
+    # a mutation whose bump we then erase — the stale-cache heisenbug
+    ext.state.commit(_alloc("d/leak", "host-0-0-0", [0], mesh))
+    with ext.state._lock:
+        ext.state._epoch -= 1
+    with pytest.raises(SnapshotAuditError) as ei:
+        ext.snapshots.current()  # hit (key unchanged) -> audit -> boom
+    assert "occupied" in str(ei.value)
+    assert ext.snapshots.audit_divergences == 1
+
+
+def test_audit_metrics_and_statusz_render():
+    from tpukube.metrics import render_extender_metrics
+    from tpukube.obs.statusz import extender_statusz
+
+    cfg = load_config(env={"TPUKUBE_SNAPSHOT_AUDIT_RATE": "1.0"})
+    ext = Extender(cfg)
+    text = render_extender_metrics(ext)
+    assert "tpukube_snapshot_audit_checks_total" in text
+    assert "tpukube_snapshot_audit_divergence_total" in text
+    doc = extender_statusz(ext)
+    assert doc["snapshot"]["audit"]["rate"] == 1.0
+    # off by default: the audit series do NOT render (legacy exposition
+    # byte-identical), but the statusz section still reports the zeros
+    ext0 = Extender(load_config(env={}))
+    text0 = render_extender_metrics(ext0)
+    assert "tpukube_snapshot_audit" not in text0
+    assert extender_statusz(ext0)["snapshot"]["audit"]["checks"] == 0
+
+
+def test_audit_runs_under_the_real_webhook_stack():
+    """SimCluster wiring: schedule real pods over HTTP with the
+    sentinel at 1.0 — audits happen and find nothing."""
+    from tpukube.sim import SimCluster
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        "TPUKUBE_SNAPSHOT_AUDIT_RATE": "1.0",
+    })
+    with SimCluster(cfg) as c:
+        for i in range(3):
+            c.schedule(c.make_pod(f"aud-{i}", tpu=1))
+        c.delete_pod("aud-0")
+        c.schedule(c.make_pod("aud-3", tpu=1))
+        snaps = c.extender.snapshots
+        assert snaps.audit_rate == 1.0
+        assert snaps.audit_checks > 0
+        assert snaps.audit_divergences == 0
+
+
+def test_audit_via_scenarios_passthrough(monkeypatch):
+    """The TPUKUBE_SNAPSHOT_AUDIT_RATE env knob reaches the canonical
+    scenario configs (the acceptance drive runs scenarios 1-9 this
+    way); a gang scenario under rate 1.0 reports zero divergences."""
+    from tpukube.sim import scenarios
+
+    monkeypatch.setenv("TPUKUBE_SNAPSHOT_AUDIT_RATE", "1.0")
+    result = scenarios.run(4, None)  # 16-pod gang, preemption-free
+    assert result["scenario"] == 4

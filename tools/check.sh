@@ -6,7 +6,8 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== tpukube-lint (static analysis: lock discipline/order, shared"
-echo "   state, name consistency, exception hygiene) =="
+echo "   state, name consistency, exception hygiene, CFG dataflow:"
+echo "   epoch discipline + reservation leaks, stale waivers) =="
 python -m tpukube.analysis tpukube
 
 echo
@@ -51,6 +52,17 @@ for key, need in floor.get("min_speedup", {}).items():
     if m[key] < need:
         bad.append(f"{key}={m[key]:.2f} below the required {need}x "
                    f"(snapshot cache not engaging?)")
+if "lint_wall_s_floor" in floor:
+    # the CFG dataflow passes must not blow up lint wall time — the
+    # static analysis runs on every tier-1 invocation
+    ls = bench.lint_stats()
+    print(json.dumps({"lint_wall_s": ls["wall_s"],
+                      "lint_findings": ls["findings"]}))
+    limit = floor["lint_wall_s_floor"] * floor["allowed_regression"]
+    if ls["wall_s"] > limit:
+        bad.append(f"lint wall {ls['wall_s']:.2f}s exceeds floor "
+                   f"{floor['lint_wall_s_floor']}s "
+                   f"x {floor['allowed_regression']}")
 if bad:
     sys.exit("perf smoke FAILED: " + "; ".join(bad))
 print("perf smoke OK")

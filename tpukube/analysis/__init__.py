@@ -25,9 +25,30 @@ Static passes (AST-based, see the per-module docstrings):
   exception-hygiene broad ``except Exception`` must log, emit an
                     event, re-raise, or carry a justified waiver
 
+Two further passes run on a per-function CFG + path-dataflow engine
+(``cfg.py``: branches, loops, try/except/finally, with regions,
+return/raise/break/continue edges — ISSUE 7):
+
+  epoch-discipline  every declared mutation seam in
+                    sched/{state,gang}.py (the epoch owners) is
+                    followed by an epoch bump on every path before the
+                    enclosing lock's ``with`` exits (``epochs.py``;
+                    the snapshot cache keys on those epochs)
+  reservation-leak  every path from a reservation/preemption-plan
+                    acquire in sched/{gang,extender}.py to function
+                    exit reaches commit, rollback, or a hand-off —
+                    exception edges included (``leaks.py``)
+
+The runtime counterpart of epoch-discipline is the snapshot audit
+sentinel (``sched/snapshot.py``, config ``snapshot_audit_rate``):
+sampled cache hits rebuild from the ledger and raise on divergence.
+
 Waivers: ``# tpukube: allow(<rule>[, <rule>]) <justification>`` on the
 flagged line (or the line above). A waiver without a justification is
-itself a lint error (``bare-waiver``).
+itself a lint error (``bare-waiver``), and one that suppresses zero
+findings in a full run is stale (``unused-waiver``).
+``tpukube-lint tpukube/ --changed[=REF]`` lints only files changed vs
+a git ref for the fast pre-commit loop.
 
 The dynamic half (``lockgraph``) instruments ``threading.Lock``/
 ``RLock`` creation behind the ``lock_monitor`` config flag, records

@@ -21,7 +21,7 @@ from dataclasses import dataclass
 from pathlib import Path
 from typing import Callable, Iterable, Optional
 
-#: every rule a waiver may name (bare-waiver itself is not waivable)
+#: every rule name the runner knows
 ALL_RULES: tuple[str, ...] = (
     "lock-discipline",
     "lock-order",
@@ -29,7 +29,21 @@ ALL_RULES: tuple[str, ...] = (
     "name-consistency",
     "snapshot-discipline",
     "exception-hygiene",
+    "epoch-discipline",
+    "reservation-leak",
+    "unused-waiver",
     "bare-waiver",
+)
+
+#: the meta rules lint the waiver mechanism itself — a malformed or
+#: stale pragma cannot excuse itself, so neither is waivable
+META_RULES: tuple[str, ...] = ("unused-waiver", "bare-waiver")
+
+#: rules a waiver pragma may legitimately name — by NAME, not tuple
+#: position: the old ``ALL_RULES[:-1]`` slice silently broke the
+#: "known rules" message the day a rule was appended after bare-waiver
+WAIVABLE_RULES: tuple[str, ...] = tuple(
+    r for r in ALL_RULES if r not in META_RULES
 )
 
 WAIVER_RE = re.compile(
@@ -100,7 +114,7 @@ class SourceFile:
 
 def _passes() -> dict[str, Callable[[SourceFile], list[Finding]]]:
     # imported lazily: the pass modules import from base
-    from tpukube.analysis import consistency, hygiene, locks
+    from tpukube.analysis import consistency, epochs, hygiene, leaks, locks
 
     return {
         "lock-discipline": locks.check_lock_discipline,
@@ -109,6 +123,8 @@ def _passes() -> dict[str, Callable[[SourceFile], list[Finding]]]:
         "name-consistency": consistency.check_names,
         "snapshot-discipline": consistency.check_snapshot_discipline,
         "exception-hygiene": hygiene.check_exceptions,
+        "epoch-discipline": epochs.check_epochs,
+        "reservation-leak": leaks.check_leaks,
     }
 
 
@@ -141,6 +157,47 @@ def iter_source_files(
     return out, errors
 
 
+def changed_paths(paths: Iterable, ref: str = "HEAD") -> list[Path]:
+    """The lintable .py files under ``paths`` that differ from git
+    ``ref`` (worktree + index) or are untracked — the fast pre-commit
+    loop behind ``tpukube-lint --changed``. Raises ``ValueError`` on
+    git trouble (not a repo, unknown ref): the CLI maps that to a
+    usage error, distinct from findings."""
+    import subprocess
+
+    roots = [Path(p).resolve() for p in paths]
+    start = roots[0] if roots[0].is_dir() else roots[0].parent
+
+    def _git(cwd: Path, *args: str) -> list[str]:
+        proc = subprocess.run(
+            ["git", *args], cwd=cwd, capture_output=True, text=True,
+        )
+        if proc.returncode != 0:
+            raise ValueError(
+                f"git {' '.join(args)} failed: "
+                f"{proc.stderr.strip() or proc.stdout.strip()}"
+            )
+        return [ln for ln in proc.stdout.splitlines() if ln]
+
+    top = Path(_git(start, "rev-parse", "--show-toplevel")[0])
+    # run the listings from the TOPLEVEL: ls-files --others prints
+    # cwd-relative paths and, from a subdirectory, only that subtree —
+    # joined to `top` below, a subdir cwd would silently drop exactly
+    # the untracked files a pre-commit loop most needs to lint
+    names = set(_git(top, "diff", "--name-only", ref, "--"))
+    names |= set(_git(top, "ls-files", "--others", "--exclude-standard"))
+    out: list[Path] = []
+    for name in sorted(names):
+        f = (top / name).resolve()
+        if f.suffix != ".py" or f.name.endswith("_pb2.py"):
+            continue
+        if not f.exists():  # deleted vs ref: nothing to lint
+            continue
+        if any(f == r or r in f.parents for r in roots):
+            out.append(f)
+    return out
+
+
 def find_rules_file(paths: Iterable) -> Optional[Path]:
     """Locate deploy/prometheus-rules.yaml relative to the linted tree
     (the deploy/ directory is the package directory's sibling)."""
@@ -165,23 +222,59 @@ def waiver_findings(sf: SourceFile) -> list[Finding]:
                 f"justification — say why the rule does not apply here",
             ))
         for rule in w.rules:
-            if rule not in ALL_RULES:
+            if rule not in WAIVABLE_RULES:
                 out.append(Finding(
                     "bare-waiver", sf.rel, w.line,
-                    f"waiver names unknown rule {rule!r} "
-                    f"(known: {', '.join(ALL_RULES[:-1])})",
+                    f"waiver names unknown or unwaivable rule {rule!r} "
+                    f"(known: {', '.join(WAIVABLE_RULES)})",
                 ))
     return out
 
 
-def apply_waivers(sf: SourceFile,
-                  findings: Iterable[Finding]) -> list[Finding]:
-    """Drop findings covered by a waiver pragma. bare-waiver findings
-    are never waivable — a malformed pragma cannot excuse itself."""
-    return [
-        f for f in findings
-        if f.rule == "bare-waiver" or sf.waiver_for(f.rule, f.line) is None
-    ]
+def apply_waivers(sf: SourceFile, findings: Iterable[Finding],
+                  used: Optional[set] = None) -> list[Finding]:
+    """Drop findings covered by a waiver pragma; the meta rules
+    (bare-waiver, unused-waiver) are never waivable — a malformed or
+    stale pragma cannot excuse itself. ``used`` (when given) collects
+    the ``(waiver line, rule)`` pairs that actually suppressed a
+    finding — the input of the stale-waiver check."""
+    kept: list[Finding] = []
+    for f in findings:
+        if f.rule in META_RULES:
+            kept.append(f)
+            continue
+        w = sf.waiver_for(f.rule, f.line)
+        if w is None:
+            kept.append(f)
+        elif used is not None:
+            used.add((w.line, f.rule))
+    return kept
+
+
+def unused_waiver_findings(sf: SourceFile, used: set,
+                           selected: set) -> list[Finding]:
+    """Stale-waiver lint: a waiver whose rules all RAN in this
+    invocation and suppressed nothing has outlived the code it excused
+    — delete it (or fix the rule name). Waivers naming a rule that was
+    deselected are skipped: a partial ``--rules`` run proves nothing
+    about them. Waivers that only name unknown rules are bare-waiver's
+    problem, not staleness."""
+    out: list[Finding] = []
+    for w in sf.waivers.values():
+        considered = [r for r in w.rules
+                      if r in WAIVABLE_RULES and r in selected]
+        if not considered or len(considered) != len(
+                [r for r in w.rules if r in WAIVABLE_RULES]):
+            continue
+        if any((w.line, r) in used for r in considered):
+            continue
+        out.append(Finding(
+            "unused-waiver", sf.rel, w.line,
+            f"waiver for ({', '.join(w.rules)}) suppressed no findings "
+            f"in this run — the code it excused is gone; delete the "
+            f"pragma so it cannot hide a future regression",
+        ))
+    return out
 
 
 def run_all(paths: Iterable, rules: Optional[Iterable[str]] = None,
@@ -202,7 +295,11 @@ def run_all(paths: Iterable, rules: Optional[Iterable[str]] = None,
             per_file.extend(check(sf))
         if "bare-waiver" in selected:
             per_file.extend(waiver_findings(sf))
-        findings.extend(apply_waivers(sf, per_file))
+        used: set = set()
+        kept = apply_waivers(sf, per_file, used)
+        if "unused-waiver" in selected:
+            kept.extend(unused_waiver_findings(sf, used, selected))
+        findings.extend(kept)
     if "name-consistency" in selected:
         from tpukube.analysis import consistency
 

@@ -33,6 +33,15 @@ _RULE_DOCS = {
                            "hot paths read the epoch cache",
     "exception-hygiene": "broad excepts must log, emit, re-raise, or "
                          "carry a justified waiver",
+    "epoch-discipline": "every declared mutation seam is followed by "
+                        "an epoch bump on every path before the "
+                        "enclosing lock's `with` exits (CFG dataflow)",
+    "reservation-leak": "every path from a reservation/preemption-plan "
+                        "acquire to function exit reaches commit, "
+                        "rollback, or a hand-off — exception edges "
+                        "included (CFG dataflow)",
+    "unused-waiver": "a waiver that suppressed zero findings is stale "
+                     "and must be deleted",
     "bare-waiver": "waiver pragmas must name known rules and carry a "
                    "justification",
 }
@@ -53,6 +62,16 @@ def main(argv: Optional[list[str]] = None) -> int:
                    help="prometheus-rules.yaml to cross-check (default: "
                         "auto-discover deploy/prometheus-rules.yaml "
                         "next to the linted tree)")
+    p.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                   metavar="REF",
+                   help="lint only files changed vs a git ref "
+                        "(worktree + index + untracked). Write the ref "
+                        "as --changed=REF — a bare `--changed` before a "
+                        "path would swallow the path as its ref — or "
+                        "put paths first: `tpukube-lint tpukube/ "
+                        "--changed`. Default ref: HEAD. The fast "
+                        "pre-commit loop; tools/check.sh still runs "
+                        "the full tree")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="one JSON object per finding")
     p.add_argument("--list-rules", action="store_true",
@@ -75,7 +94,26 @@ def main(argv: Optional[list[str]] = None) -> int:
     import yaml
 
     try:
-        findings = run_all(paths, rules=rules, rules_file=args.rules_file)
+        rules_file = args.rules_file
+        if args.changed is not None:
+            from tpukube.analysis.base import changed_paths, find_rules_file
+
+            if rules_file is None:
+                # discover deploy/prometheus-rules.yaml from the
+                # ORIGINAL path arguments: the changed-file list that
+                # replaces them below has no deploy/ sibling, and the
+                # rules cross-check must not silently vanish in
+                # changed-only mode
+                rules_file = find_rules_file(paths)
+            paths = changed_paths(paths, ref=args.changed)
+            if not paths and rules_file is None:
+                print(f"tpukube-lint: no lintable files changed vs "
+                      f"{args.changed}")
+                return 0
+            # an empty .py list still cross-checks the rules file:
+            # "only deploy/prometheus-rules.yaml changed" is exactly
+            # when the name-consistency rules check matters most
+        findings = run_all(paths, rules=rules, rules_file=rules_file)
     except (ValueError, OSError, yaml.YAMLError) as e:
         # unknown rule names, an unreadable path/--rules-file, or a
         # malformed rules yaml are USAGE errors (exit 2), distinct from

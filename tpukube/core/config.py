@@ -93,6 +93,14 @@ class TpuKubeConfig:
     # scenario code falls back to its own fixed seed so `tpukube-sim 8`
     # is reproducible out of the box.
     chaos_seed: int = 0
+    # snapshot audit sentinel (sched/snapshot.py SnapshotCache): on
+    # this fraction of scheduling-path cache hits, rebuild the snapshot
+    # from the ledger and RAISE on divergence — the runtime counterpart
+    # of tpukube-lint's epoch-discipline pass, catching any mutation
+    # seam the static registry misses. 0 (default) disables the audit;
+    # 1.0 audits every hit (sim scenarios and the chaos suite run
+    # green at 1.0 with zero divergences).
+    snapshot_audit_rate: float = 0.0
 
     # Which ICI slice this node belongs to (multi-slice clusters name
     # their pod slices; coords are slice-local — SURVEY.md §3 ICI/DCN note)
@@ -236,4 +244,8 @@ def load_config(
         raise ValueError("circuit_half_open_probes must be >= 1")
     if cfg.chaos_seed < 0:
         raise ValueError("chaos_seed must be >= 0 (0 = chaos off)")
+    if not 0.0 <= cfg.snapshot_audit_rate <= 1.0:
+        raise ValueError(
+            "snapshot_audit_rate must be in [0, 1] (0 = audit off)"
+        )
     return cfg

@@ -245,6 +245,20 @@ def _add_snapshot_metrics(reg: Registry, snapshots) -> None:
         values_fn=snapshots.rebuild_seconds_snapshot,
         help_text="Wall time of snapshot rebuilds (coord-set capture; "
                   "sweep tables build lazily on first query).")
+    if getattr(snapshots, "audit_rate", 0.0) > 0.0:
+        # audit-sentinel series render only when the sentinel is on
+        # (snapshot_audit_rate > 0) — legacy exposition byte-identical
+        reg.counter(
+            "tpukube_snapshot_audit_checks_total",
+            fn=lambda: snapshots.audit_checks,
+            help_text="Sampled cache-hit audits: snapshot rebuilt from "
+                      "the ledger and compared against the cache.")
+        reg.counter(
+            "tpukube_snapshot_audit_divergence_total",
+            fn=lambda: snapshots.audit_divergences,
+            help_text="Audits that found the cached snapshot diverging "
+                      "from the ledger — a mutation path missing an "
+                      "epoch bump. Any nonzero value is a bug.")
 
     # all reads below go through observe(): a scrape must not count
     # its own lookups as cache hits (that self-traffic would mask the

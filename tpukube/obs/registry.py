@@ -63,6 +63,11 @@ DECLARED_SERIES: frozenset[str] = frozenset({
     "tpukube_snapshot_rebuilds_total",
     "tpukube_snapshot_hits_total",
     "tpukube_snapshot_rebuild_seconds",
+    # snapshot audit sentinel (snapshot_audit_rate > 0): sampled
+    # cache-hit rebuild-and-compare checks and the divergences they
+    # caught (any nonzero divergence count is a missed epoch bump)
+    "tpukube_snapshot_audit_checks_total",
+    "tpukube_snapshot_audit_divergence_total",
     "tpukube_slice_fragmentation",
     "tpukube_slice_largest_free_box_chips",
     # both daemons (unified retry/circuit layer, core/retry.py; series

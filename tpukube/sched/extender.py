@@ -112,6 +112,10 @@ class Extender:
         # sweep tables from the ledger per webhook; the /metrics and
         # /statusz fragmentation renders read the same cache.
         self.snapshots = self.gang.snapshots
+        # audit sentinel: on this fraction of scheduling cache hits the
+        # cache rebuilds from the ledger and raises on divergence — the
+        # runtime check behind the epoch-discipline lint (0 = off)
+        self.snapshots.audit_rate = config.snapshot_audit_rate
         # Pods seen at filter time, so /bind (which only carries names) can
         # recover the request: key -> (pod, uid, seen_monotonic).
         self._pending: dict[str, tuple[PodInfo, str, float]] = {}

@@ -483,9 +483,12 @@ class GangManager:
         allocation, queue their evictions, drop the reservation. Gangs die
         all-or-nothing exactly as they are born. Returns evicted pod keys."""
         with self._lock:
-            res = self._reservations.pop(key, None)
+            # look up before popping: the no-such-gang path mutates
+            # nothing and owes no epoch bump (epoch-discipline lint)
+            res = self._reservations.get(key)
             if res is None:
                 return []
+            self._reservations.pop(key, None)
             self._epoch += 1
             evicted = []
             for pod_key in list(res.assigned):
@@ -789,6 +792,8 @@ class GangManager:
         containers still physically hold. Gates the gang's member binds
         AND masks the chips from every other placement until
         on_victim_gone confirms the pod object is gone."""
+        if not held:
+            return
         with self._lock:
             for pod_key, (sid, coords) in held.items():
                 res.terminating_victims.add(pod_key)
@@ -796,8 +801,7 @@ class GangManager:
                     self._terminating_coords[pod_key] = (
                         sid, frozenset(coords)
                     )
-            if held:
-                self._epoch += 1
+            self._epoch += 1
 
     def on_victim_gone(self, pod_key: str) -> bool:
         """A terminating eviction victim's pod object is confirmed gone
@@ -805,17 +809,22 @@ class GangManager:
         ``victim_gone`` decision): unmask its chips and unblock any gang
         waiting on it. Returns True if anything was tracking the pod."""
         with self._lock:
-            hit = self._terminating_coords.pop(pod_key, None) is not None
-            if hit and self._events is not None:
-                try:
-                    self._events.emit(
-                        "VictimGone", obj=f"pod/{pod_key}",
-                        message="eviction victim's pod object confirmed "
-                                "gone; its chips are placeable again",
-                    )
-                except Exception:
-                    log.exception("event emit failed: VictimGone %s", pod_key)
+            # membership first, pop only on a hit: the unknown-pod path
+            # mutates nothing and owes no bump (epoch-discipline lint)
+            hit = pod_key in self._terminating_coords
             if hit:
+                self._terminating_coords.pop(pod_key, None)
+                if self._events is not None:
+                    try:
+                        self._events.emit(
+                            "VictimGone", obj=f"pod/{pod_key}",
+                            message="eviction victim's pod object "
+                                    "confirmed gone; its chips are "
+                                    "placeable again",
+                        )
+                    except Exception:
+                        log.exception("event emit failed: VictimGone %s",
+                                      pod_key)
                 # the unmasked chips are placeable again: invalidate
                 self._epoch += 1
             for res in self._reservations.values():
@@ -1057,13 +1066,15 @@ class GangManager:
             for res in self._reservations.values():
                 if pod_key in res.assigned:
                     res.drop_assignment(pod_key)
-                    self._epoch += 1
                     if res.committed and not res.assigned:
                         self._reservations.pop(res.key, None)
                         log.info(
                             "gang %s/%s dissolved (all members released)",
                             res.namespace, res.group.name,
                         )
+                    # one bump AFTER the last seam of the batch (the
+                    # epoch-discipline lint checks bump-follows-seam)
+                    self._epoch += 1
                     return
 
     def reassign(self, pod_key: str, coords: list[TopologyCoord]) -> bool:

@@ -41,11 +41,21 @@ module and ``slicefit`` (the primitive definitions and their grid-based
 thin wrappers) are the only places allowed to construct
 ``occupancy_grid``/``_Sweep`` — a call site quietly rebuilding sweeps
 per webhook again is a lint finding, so the cache cannot silently rot.
+
+The epoch discipline itself is enforced twice over (ISSUE 7): the
+``epoch-discipline`` CFG dataflow pass (``analysis/epochs.py``) proves
+statically that every registered mutation seam bumps before its lock
+region exits, and the config-gated audit sentinel here
+(``snapshot_audit_rate``) rebuilds a sampled fraction of cache hits
+from the ledger at runtime, raising :class:`SnapshotAuditError` on any
+divergence — so a seam the static registry misses still cannot serve
+stale placements silently.
 """
 
 from __future__ import annotations
 
 import logging
+import random
 import threading
 import time
 from collections import deque
@@ -56,6 +66,14 @@ from tpukube.core.types import Link, TopologyCoord
 from tpukube.sched import slicefit
 
 log = logging.getLogger("tpukube.snapshot")
+
+
+class SnapshotAuditError(RuntimeError):
+    """The audit sentinel rebuilt a snapshot from the ledger and it
+    diverged from the epoch-cached one: some mutation path changed
+    scheduling state WITHOUT bumping an epoch — the stale-cache bug
+    class the epoch discipline (static: tpukube-lint epoch-discipline;
+    registries in analysis/epochs.py) exists to prevent."""
 
 
 def sweep_for(
@@ -195,6 +213,45 @@ class ClusterSnapshot:
         return {sid: ss.reserved for sid, ss in self.slices.items()}
 
 
+def _audit_divergence(cached: ClusterSnapshot,
+                      rebuilt: ClusterSnapshot) -> list[str]:
+    """Human-readable differences between a cached snapshot and a fresh
+    ledger rebuild at the same epochs (empty = identical). Compares the
+    captured coord/link sets and utilization — the inputs every sweep,
+    score, and placement decision derives from; the lazy sweep tables
+    are pure functions of these."""
+    diffs: list[str] = []
+    if set(cached.slices) != set(rebuilt.slices):
+        diffs.append(
+            f"slice set {sorted(cached.slices)} != "
+            f"{sorted(rebuilt.slices)}"
+        )
+        return diffs
+    for sid in sorted(cached.slices):
+        a, b = cached.slices[sid], rebuilt.slices[sid]
+        for attr in ("occupied", "reserved", "unhealthy", "terminating",
+                     "broken"):
+            va, vb = getattr(a, attr), getattr(b, attr)
+            if va != vb:
+                extra = sorted(tuple(x) if not isinstance(x, tuple) else x
+                               for x in (va - vb))[:3]
+                missing = sorted(tuple(x) if not isinstance(x, tuple)
+                                 else x for x in (vb - va))[:3]
+                diffs.append(
+                    f"{sid}.{attr}: cached has {len(va)}, ledger has "
+                    f"{len(vb)} (stale extra {extra}, missing {missing})"
+                )
+        if abs(a.utilization - b.utilization) > 1e-9:
+            diffs.append(
+                f"{sid}.utilization: cached {a.utilization:.6f} != "
+                f"ledger {b.utilization:.6f}"
+            )
+        if a.mesh != b.mesh:
+            diffs.append(f"{sid}.mesh: cached {a.mesh.dims} != "
+                         f"ledger {b.mesh.dims}")
+    return diffs
+
+
 class SnapshotCache:
     """The epoch-tagged snapshot owner. One instance per GangManager
     (the Extender shares it): ``current()`` is safe from any thread and
@@ -215,6 +272,18 @@ class SnapshotCache:
         self._rebuild_seconds: deque[float] = deque(
             maxlen=self.REBUILD_WINDOW
         )
+        # Audit sentinel (config ``snapshot_audit_rate``, wired by the
+        # Extender): on a sampled fraction of cache HITS, rebuild the
+        # snapshot from the ledger and raise SnapshotAuditError on any
+        # divergence — the runtime counterpart of the epoch-discipline
+        # static pass, catching mutation seams its registry misses.
+        # 0.0 (default) disables the sentinel entirely.
+        self.audit_rate = 0.0
+        self.audit_checks = 0
+        self.audit_divergences = 0
+        # deterministic sampling stream: audits are a debugging tool
+        # and must not add nondeterminism to seeded chaos runs
+        self._audit_rng = random.Random(0xA0D17)
 
     # -- epoch key ---------------------------------------------------------
     def epoch_key(self) -> tuple[int, int]:
@@ -259,7 +328,17 @@ class SnapshotCache:
             if snap is not None and snap.key == key:
                 if count_hit:
                     self.hits += 1
-                return snap
+                hit: Optional[ClusterSnapshot] = snap
+            else:
+                hit = None
+        if hit is not None:
+            if count_hit and self.audit_rate > 0.0:
+                # audit OUTSIDE the leaf mutex: the rebuild takes the
+                # gang/ledger locks, which must never nest inside it.
+                # Only counted (scheduling) hits are audited — observer
+                # scrapes may race mutations and would false-positive.
+                self._maybe_audit(hit)
+            return hit
         for _ in range(3):
             t0 = time.perf_counter()
             snap = self._build(key)
@@ -273,6 +352,36 @@ class SnapshotCache:
                     return snap
             key = after
         return snap  # an observer raced mutations: serve uncached
+
+    # -- audit sentinel ----------------------------------------------------
+    def _maybe_audit(self, snap: ClusterSnapshot) -> None:
+        """Sampled hit audit: rebuild from the ledger and compare.
+        Raises :class:`SnapshotAuditError` on divergence — a mutation
+        happened without an epoch bump, so the cache was serving stale
+        placements. Callers under the decision lock cannot race
+        mutations; a lookup that still observes moving epochs (a
+        lock-free test caller) is skipped rather than misreported."""
+        if (self.audit_rate < 1.0
+                and self._audit_rng.random() >= self.audit_rate):
+            return
+        rebuilt = self._build(snap.key)
+        if self.epoch_key() != snap.key:
+            return  # raced a mutation: the cached epochs moved mid-audit
+        with self._lock:
+            self.audit_checks += 1
+        diffs = _audit_divergence(snap, rebuilt)
+        if diffs:
+            with self._lock:
+                self.audit_divergences += 1
+            detail = "; ".join(diffs[:4])
+            log.error("snapshot audit DIVERGENCE at epochs %s: %s",
+                      snap.key, detail)
+            raise SnapshotAuditError(
+                f"cached snapshot at epochs {snap.key} diverges from a "
+                f"ledger rebuild ({detail}) — some mutation path is "
+                f"missing an epoch bump (see analysis/epochs.py "
+                f"EPOCH_REGISTRY and the epoch-discipline lint)"
+            )
 
     def _build(self, key: tuple[int, int]) -> ClusterSnapshot:
         slices: dict[str, SliceSnapshot] = {}
@@ -313,6 +422,7 @@ class SnapshotCache:
         snap = self.observe()
         with self._lock:
             rebuilds, hits = self.rebuilds, self.hits
+            checks, diverged = self.audit_checks, self.audit_divergences
             last = (self._rebuild_seconds[-1]
                     if self._rebuild_seconds else None)
         lookups = rebuilds + hits
@@ -320,6 +430,11 @@ class SnapshotCache:
             "epoch": {"ledger": snap.key[0], "gang": snap.key[1]},
             "rebuilds": rebuilds,
             "hits": hits,
+            "audit": {
+                "rate": self.audit_rate,
+                "checks": checks,
+                "divergences": diverged,
+            },
             "hit_rate": round(hits / lookups, 4) if lookups else None,
             "last_rebuild_s": (round(last, 6) if last is not None
                                else None),

@@ -219,6 +219,12 @@ class ClusterState:
             sl = self._slices.get(info.slice_id)
             if sl is None:
                 sl = self._slices[info.slice_id] = SliceView(mesh=mesh)
+                # the slice set feeds snapshot.slice_ids(): bump at the
+                # seam itself, not only at the end of the upsert — the
+                # validation raises below must not leave a registered
+                # slice invisible to the epoch cache (found by
+                # tpukube-lint's epoch-discipline pass)
+                self._epoch += 1
             elif sl.mesh != mesh:
                 raise StateError(
                     f"node {name} reports mesh {mesh.dims} for slice "
@@ -467,9 +473,13 @@ class ClusterState:
     def release(self, pod_key: str) -> Optional[AllocResult]:
         """Pod gone (deleted/preempted): free its shares."""
         with self._lock:
-            alloc = self._allocs.pop(pod_key, None)
+            # look up before popping: the unknown-pod path mutates
+            # nothing, so it owes no epoch bump (tpukube-lint
+            # epoch-discipline checks every path after a seam write)
+            alloc = self._allocs.get(pod_key)
             if alloc is None:
                 return None
+            self._allocs.pop(pod_key, None)
             view = self._nodes.get(alloc.node_name)
             if view is not None:
                 view.remove_ids(alloc.device_ids)

@@ -18,6 +18,34 @@ from tpukube.core.config import TpuKubeConfig, load_config
 from tpukube.core.types import PodGroup
 from tpukube.sim.harness import SimCluster
 
+#: knobs that pass through from the process environment into every
+#: scenario's canonical config (which would otherwise shadow them):
+#: the chaos seed (tools/check.sh pins it for reproducible smoke) and
+#: the snapshot audit sentinel (the acceptance drive runs scenarios
+#: 1-9 at TPUKUBE_SNAPSHOT_AUDIT_RATE=1.0 asserting zero divergences)
+_PASSTHROUGH_KEYS = ("TPUKUBE_CHAOS_SEED", "TPUKUBE_SNAPSHOT_AUDIT_RATE")
+
+
+def _env(defaults: dict[str, str]) -> dict[str, str]:
+    import os
+
+    env = dict(defaults)
+    for key in _PASSTHROUGH_KEYS:
+        if os.environ.get(key):
+            env[key] = os.environ[key]
+    return env
+
+
+def _audit_stats(c: SimCluster) -> dict[str, Any]:
+    """The snapshot audit sentinel's counters for a scenario result
+    (all zero when snapshot_audit_rate is 0 — the default)."""
+    snaps = c.extender.snapshots
+    return {
+        "rate": snaps.audit_rate,
+        "checks": snaps.audit_checks,
+        "divergences": snaps.audit_divergences,
+    }
+
 
 def run(scenario: int, config: TpuKubeConfig | None = None) -> dict[str, Any]:
     fn = {
@@ -50,10 +78,10 @@ def _metrics(c: SimCluster) -> dict[str, float]:
 
 def smoke_single_pod(config: TpuKubeConfig | None) -> dict[str, Any]:
     """Config 1: one pod, one chip, full schedule + Allocate walk."""
-    cfg = config or load_config(env={
+    cfg = config or load_config(env=_env({
         "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
         "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
-    })
+    }))
     with SimCluster(cfg) as c:
         node, alloc = c.schedule(c.make_pod("smoke", tpu=1))
         env = c.execute_allocation(alloc)
@@ -68,10 +96,10 @@ def smoke_single_pod(config: TpuKubeConfig | None) -> dict[str, Any]:
 
 def dp_fanout(config: TpuKubeConfig | None) -> dict[str, Any]:
     """Config 2: 4-pod data-parallel job, 1 chip per pod, no topology hint."""
-    cfg = config or load_config(env={
+    cfg = config or load_config(env=_env({
         "TPUKUBE_SIM_MESH_DIMS": "4,2,1",
         "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
-    })
+    }))
     with SimCluster(cfg) as c:
         placements = {}
         for i in range(4):
@@ -87,11 +115,11 @@ def dp_fanout(config: TpuKubeConfig | None) -> dict[str, Any]:
 
 def fractional_vtpu(config: TpuKubeConfig | None) -> dict[str, Any]:
     """Config 3: two inference pods share one chip via vTPU shares."""
-    cfg = config or load_config(env={
+    cfg = config or load_config(env=_env({
         "TPUKUBE_SIM_MESH_DIMS": "2,1,1",
         "TPUKUBE_SIM_HOST_BLOCK": "2,1,1",
         "TPUKUBE_SHARES_PER_CHIP": "2",
-    })
+    }))
     with SimCluster(cfg, vtpu_nodes={"host-0-0-0"},
                     vtpu_shares=cfg.shares_per_chip) as c:
         results = []
@@ -113,10 +141,10 @@ def fractional_vtpu(config: TpuKubeConfig | None) -> dict[str, Any]:
 
 def gang_16(config: TpuKubeConfig | None) -> dict[str, Any]:
     """Config 4: 16-pod gang onto a contiguous box of a 64-chip mesh."""
-    cfg = config or load_config(env={
+    cfg = config or load_config(env=_env({
         "TPUKUBE_SIM_MESH_DIMS": "4,4,4",
         "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
-    })
+    }))
     with SimCluster(cfg) as c:
         for i in range(2):
             c.schedule(c.make_pod(f"bg-{i}", tpu=4))
@@ -149,10 +177,10 @@ def multi_tenant_northstar(config: TpuKubeConfig | None) -> dict[str, Any]:
     """Config 5: the north-star scenario (also bench.py): 80 burst infer
     pods, a 64-pod priority training gang that preempts its way to a
     contiguous slice, then burst backfill to measure utilization."""
-    cfg = config or load_config(env={
+    cfg = config or load_config(env=_env({
         "TPUKUBE_SIM_MESH_DIMS": "8,8,2",
         "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
-    })
+    }))
     with SimCluster(cfg) as c:
         for i in range(80):
             c.schedule(c.make_pod(f"infer-{i}", tpu=1, priority=0))
@@ -200,10 +228,10 @@ def churn(config: TpuKubeConfig | None) -> dict[str, Any]:
     schedule into the freed capacity. Measures utilization stability
     (min across waves — a release leak shows up as the floor dropping)
     and the re-schedule latency p50 (finish → replacement bound)."""
-    cfg = config or load_config(env={
+    cfg = config or load_config(env=_env({
         "TPUKUBE_SIM_MESH_DIMS": "8,8,2",
         "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
-    })
+    }))
     waves, wave_size = 6, 16
     with SimCluster(cfg) as c:
         n_chips = sum(m.num_chips for m in c.slices.values())
@@ -288,10 +316,10 @@ def fault_telemetry(config: TpuKubeConfig | None) -> dict[str, Any]:
     from tpukube.obs.statusz import plugin_statusz
     from tpukube.plugin import DevicePluginServer
 
-    cfg = config or load_config(env={
+    cfg = config or load_config(env=_env({
         "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
         "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
-    })
+    }))
 
     def fetch(url: str) -> str:
         with urllib.request.urlopen(url, timeout=5) as r:
@@ -435,18 +463,12 @@ def apiserver_chaos(config: TpuKubeConfig | None) -> dict[str, Any]:
         ledger_divergence,
     )
 
-    import os
-
-    # canonical topology, but the seed knob must work WITHOUT --config:
-    # the scenario's fixed env dict would otherwise shadow the
-    # process's TPUKUBE_CHAOS_SEED entirely
-    env = {
+    # canonical topology; the seed + audit knobs must work WITHOUT
+    # --config — _env passes them through from the process environment
+    cfg = config or load_config(env=_env({
         "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
         "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
-    }
-    if os.environ.get("TPUKUBE_CHAOS_SEED"):
-        env["TPUKUBE_CHAOS_SEED"] = os.environ["TPUKUBE_CHAOS_SEED"]
-    cfg = config or load_config(env=env)
+    }))
     seed = cfg.chaos_seed or 1337
     storm = ChaosSpec(
         error_rate=0.12, timeout_rate=0.08, torn_rate=0.10,
@@ -573,6 +595,7 @@ def apiserver_chaos(config: TpuKubeConfig | None) -> dict[str, Any]:
             "evictions_pending": c._evictions.depth(),
             "leaked_reservations": len(leaks),
             "ledger_divergence": len(div),
+            "snapshot_audit": _audit_stats(c),
             "utilization_percent": round(100 * c.utilization(), 2),
         }
         # the acceptance invariants FAIL the scenario, not just dent a
@@ -614,10 +637,10 @@ def crash_recovery(config: TpuKubeConfig | None) -> dict[str, Any]:
     from tpukube.core import codec
     from tpukube.core.types import AllocResult, TopologyCoord
 
-    cfg = config or load_config(env={
+    cfg = config or load_config(env=_env({
         "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
         "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
-    })
+    }))
     with SimCluster(cfg) as c:
         group = PodGroup("phoenix", min_member=8)
         for i in range(4):
@@ -681,6 +704,7 @@ def crash_recovery(config: TpuKubeConfig | None) -> dict[str, Any]:
             "gang_committed": bool(committed),
             "leaked_reservations": len(leaks),
             "ledger_divergence": len(div),
+            "snapshot_audit": _audit_stats(c),
             "agent_restart_allocate_ok": bool(env),
         }
         problems = [str(p) for p in leaks] + div
