@@ -163,7 +163,78 @@ def sched_micro() -> dict:
         out["filter_nocache_p50_ms"] / out["filter_p50_ms"], 2)
     out["prioritize_speedup"] = round(
         out["prioritize_nocache_p50_ms"] / out["prioritize_p50_ms"], 2)
+    # ISSUE 8 satellite: the same /filter webhook through the FULL
+    # dispatch (handle(): parse + decision lock + trace record) both
+    # in-process and over real HTTP, so the recorded numbers separate
+    # scheduling compute from socket/JSON-transport overhead — the
+    # split that motivated batching (BENCH r01-r05's residual
+    # sched_wall_s was HTTP-dominated once PR 5 killed the compute).
+    import http.client
+
+    from tpukube.sched.extender import make_app
+    from tpukube.sim.harness import _AppThread, _free_port
+
+    pod_obj = {
+        "metadata": {"name": "micro-probe", "namespace": "default",
+                     "uid": "uid-micro-probe", "annotations": {},
+                     "labels": {}},
+        "spec": {"priority": 0, "containers": [{
+            "name": "main",
+            "resources": {"requests": {RESOURCE_TPU: "1"}},
+        }]},
+    }
+    body = {"Pod": pod_obj, "NodeNames": names}
+
+    def run_inproc():
+        ext.handle("filter", body)
+
+    run_inproc()  # warm
+    out["filter_inproc_p50_ms"] = p50_ms(run_inproc)
+    port = _free_port()
+    app_thread = _AppThread(make_app(ext), "127.0.0.1", port)
+    app_thread.start()
+    try:
+        payload = json.dumps(body).encode()
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+
+        def run_http():
+            conn.request("POST", "/filter", body=payload,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+
+        run_http()  # warm (and establish keep-alive)
+        out["filter_http_p50_ms"] = p50_ms(run_http)
+        conn.close()
+    finally:
+        app_thread.stop()
+    out["http_overhead_ms"] = round(
+        out["filter_http_p50_ms"] - out["filter_inproc_p50_ms"], 3)
     return out
+
+
+def kilonode() -> dict:
+    """ISSUE 8 acceptance: the 1k-node / 100k-pod churn trace
+    (scenario 10) on the discrete-event fake clock — pods-scheduled/sec
+    and per-webhook p99 at kilonode scale, plus the wall the < 60s
+    acceptance bounds. ``TPUKUBE_KILONODE_PODS`` scales it down for
+    smoke runs (tools/check.sh uses 8000)."""
+    from tpukube.sim import scenarios
+
+    r = scenarios.run(10)
+    return {
+        "nodes": r["nodes"],
+        "chips": r["chips"],
+        "pods_total": r["pods_total"],
+        "wall_s": r["wall_s"],
+        "pods_per_sec": r["pods_per_sec"],
+        "sim_seconds": r["sim_seconds"],
+        "time_compression": r["time_compression"],
+        "webhook_p99_ms": r["webhook_p99_ms"],
+        "plan_ms_per_pod": r["cycle"]["plan_ms_per_pod"],
+        "plan_hit_ratio": r["cycle"]["plan_hit_ratio"],
+        "utilization_percent": r["utilization_percent"],
+    }
 
 
 def run() -> dict:
@@ -186,6 +257,7 @@ def run() -> dict:
     result["lint"] = lint_stats()
     result["chaos"] = chaos_stats()
     result["sched_micro"] = sched_micro()
+    result["kilonode"] = kilonode()
     return result
 
 

@@ -267,6 +267,68 @@ def test_snapshot_discipline_waivable(tmp_path):
     assert base.apply_waivers(sf, raw) == []
 
 
+# ISSUE 8: the batch planner's stricter arm — no SnapshotCache read or
+# ad-hoc sweep outside the one pinning seam. A consumer quietly taking
+# a second snapshot mid-batch forks the cluster view the plan answers
+# from.
+
+VIOLATING_CYCLE = '''\
+from tpukube.sched.snapshot import sweep_for
+
+class SchedulingCycle:
+    def _pin_snapshot(self):
+        return self._ext.snapshots.current()      # the one allowed seam
+
+    def _plan_pod(self, pod):
+        snap = self._ext.snapshots.current()      # finding: second read
+        self._ext.snapshots.observe()             # finding: observer read
+        return sweep_for(snap.mesh, set())        # finding: ad-hoc sweep
+'''
+
+CLEAN_CYCLE = '''\
+class SchedulingCycle:
+    def _pin_snapshot(self):
+        return self._ext.snapshots.current()
+
+    def _plan_pod(self, pod, snap):
+        self.cycle_hist.observe(0.5)              # histogram, not a cache
+        return snap.slice("s0").blocked_sweep()   # the pinned snapshot
+'''
+
+
+def test_cycle_snapshot_discipline_catches_and_passes(tmp_path):
+    findings = check_snapshot_discipline(
+        _sf(tmp_path, "sched/cycle.py", VIOLATING_CYCLE))
+    assert len(findings) == 3
+    assert all(f.rule == "snapshot-discipline" for f in findings)
+    assert all("_pin_snapshot" in f.message for f in findings)
+    assert check_snapshot_discipline(
+        _sf(tmp_path, "sched/cycle.py", CLEAN_CYCLE)) == []
+    # the same source OUTSIDE cycle.py is judged by the general rule
+    # only (cache reads are fine there; it has no sweep constructors)
+    assert check_snapshot_discipline(
+        _sf(tmp_path, "sched/other.py", CLEAN_CYCLE)) == []
+
+
+def test_cycle_snapshot_discipline_waivable(tmp_path):
+    src = (
+        "class C:\n"
+        "    def helper(self):\n"
+        "        # tpukube: allow(snapshot-discipline) audit-only read\n"
+        "        return self._ext.snapshots.observe()\n"
+    )
+    sf = _sf(tmp_path, "sched/cycle.py", src)
+    raw = check_snapshot_discipline(sf)
+    assert len(raw) == 1
+    assert base.apply_waivers(sf, raw) == []
+
+
+def test_shipped_cycle_module_is_snapshot_disciplined():
+    path = os.path.join(REPO, "tpukube", "sched", "cycle.py")
+    sf = base.SourceFile(path, rel="sched/cycle.py")
+    assert base.apply_waivers(sf, check_snapshot_discipline(sf)) == []
+
+
 # -- exception-hygiene -------------------------------------------------------
 
 def test_exception_hygiene_catches_silent_broad_except(tmp_path):

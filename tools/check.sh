@@ -69,6 +69,43 @@ print("perf smoke OK")
 PY
 
 echo
+echo "== kilonode smoke (scenario 10: 1024 nodes, batched cycles +"
+echo "   fake clock; deterministic trace — throughput floors from"
+echo "   tools/perf_floor.json) =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+import os
+import sys
+
+floor = json.load(open("tools/perf_floor.json"))["kilonode"]
+os.environ.setdefault("TPUKUBE_KILONODE_PODS", str(floor["pods"]))
+
+from tpukube.sim import scenarios
+
+# the scenario itself raises on invariant violations (gang uncommitted,
+# ledger divergence, pod shortfall); the floors below catch perf rot
+r = scenarios.run(10)
+print(json.dumps({
+    "pods_total": r["pods_total"], "wall_s": r["wall_s"],
+    "pods_per_sec": r["pods_per_sec"],
+    "plan_ms_per_pod": r["cycle"]["plan_ms_per_pod"],
+    "plan_hit_ratio": r["cycle"]["plan_hit_ratio"],
+    "webhook_p99_ms": r["webhook_p99_ms"],
+    "time_compression": r["time_compression"],
+}))
+bad = []
+if r["pods_per_sec"] < floor["pods_per_sec_min"]:
+    bad.append(f"pods_per_sec={r['pods_per_sec']} below the "
+               f"{floor['pods_per_sec_min']}/s floor")
+if r["cycle"]["plan_ms_per_pod"] > floor["plan_ms_per_pod_max"]:
+    bad.append(f"plan_ms_per_pod={r['cycle']['plan_ms_per_pod']} exceeds "
+               f"the {floor['plan_ms_per_pod_max']}ms ceiling")
+if bad:
+    sys.exit("kilonode smoke FAILED: " + "; ".join(bad))
+print("kilonode smoke OK")
+PY
+
+echo
 echo "== native asan (libtpuinfo self-test under ASan/UBSan) =="
 if command -v g++ >/dev/null 2>&1; then
   make -C tpukube/native asan

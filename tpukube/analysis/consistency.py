@@ -42,6 +42,22 @@ SNAPSHOT_HOME = ("sched/snapshot.py", "sched/slicefit.py")
 #: constructor names the snapshot-discipline pass polices
 SWEEP_CONSTRUCTORS = frozenset({"occupancy_grid", "_Sweep"})
 
+#: the batch planner (ISSUE 8): its whole contract is ONE pinned
+#: snapshot per cycle, taken through the ``_pin_snapshot`` seam — any
+#: other SnapshotCache read (or ad-hoc sweep) inside it forks the
+#: cluster view mid-batch and the plan silently stops being the thing
+#: /filter, /prioritize, and /bind answer from
+CYCLE_HOME = "sched/cycle.py"
+CYCLE_PIN_SEAM = "_pin_snapshot"
+
+#: call names that read a SnapshotCache (checked only when invoked on
+#: an attribute chain mentioning ``snapshots``, so e.g. a histogram's
+#: ``observe()`` is not confused for a cache read)
+CYCLE_CACHE_READS = frozenset({"current", "observe"})
+
+#: the ad-hoc grid seam — flagged in cycle.py wherever it appears
+CYCLE_GRID_BUILDERS = frozenset({"sweep_for"})
+
 
 def _call_name(call: ast.Call) -> Optional[str]:
     fn = call.func
@@ -103,9 +119,20 @@ def check_snapshot_discipline(sf: SourceFile) -> list[Finding]:
     O(volume x shapes x origins) hot path without failing any test.
     Route cluster-state sweeps through ``SnapshotCache.current()`` and
     request-specific grids through ``snapshot.sweep_for`` (tests are
-    not linted and stay exempt)."""
+    not linted and stay exempt).
+
+    The batch planner (``sched/cycle.py``, ISSUE 8) is held to a
+    STRICTER contract: a batch-plan consumer may not construct any
+    ad-hoc snapshot view at all — no ``SnapshotCache.current()`` /
+    ``observe()`` read and no ``sweep_for()`` grid outside the one
+    pinning seam (``_pin_snapshot``). The whole point of a cycle is
+    that every pod in the batch plans against ONE epoch-pinned
+    snapshot; a second read mid-module forks the cluster view and the
+    plan silently stops being what the webhooks answer from."""
     if sf.in_scope(SNAPSHOT_HOME):
         return []
+    if sf.in_scope((CYCLE_HOME,)):
+        return _check_cycle_snapshot_reads(sf)
     findings: list[Finding] = []
     for node in ast.walk(sf.tree):
         if not isinstance(node, ast.Call):
@@ -120,6 +147,46 @@ def check_snapshot_discipline(sf: SourceFile) -> list[Finding]:
                 f"grids through snapshot.sweep_for() so the per-cycle "
                 f"cache cannot silently rot",
             ))
+    return findings
+
+
+def _check_cycle_snapshot_reads(sf: SourceFile) -> list[Finding]:
+    """The cycle-module arm of snapshot-discipline: walk with the
+    enclosing function tracked, flagging sweep constructors AND cache
+    reads everywhere except the pinning seam."""
+    findings: list[Finding] = []
+
+    def on_snapshots(call: ast.Call) -> bool:
+        fn = call.func
+        while isinstance(fn, ast.Attribute):
+            fn = fn.value
+            if isinstance(fn, ast.Attribute) and fn.attr == "snapshots":
+                return True
+        return isinstance(fn, ast.Name) and fn.id == "snapshots"
+
+    def visit(node: ast.AST, func: Optional[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            func = node.name
+        elif isinstance(node, ast.Call):
+            name = _call_name(node)
+            bad = (name in SWEEP_CONSTRUCTORS
+                   or name in CYCLE_GRID_BUILDERS
+                   or (name in CYCLE_CACHE_READS
+                       and on_snapshots(node)
+                       and func != CYCLE_PIN_SEAM))
+            if bad:
+                findings.append(Finding(
+                    "snapshot-discipline", sf.rel, node.lineno,
+                    f"{name}() in the batch planner outside the "
+                    f"{CYCLE_PIN_SEAM} seam — batch-plan consumers must "
+                    f"use the cycle's ONE pinned snapshot; a second "
+                    f"cache read or ad-hoc sweep mid-batch forks the "
+                    f"cluster view the plan was built against",
+                ))
+        for child in ast.iter_child_nodes(node):
+            visit(child, func)
+
+    visit(sf.tree, None)
     return findings
 
 

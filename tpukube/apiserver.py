@@ -1752,10 +1752,17 @@ class EvictionExecutor(_PollLoop):
     intervened". The sim harness's ``drain_evictions`` is a thin wrapper
     over :meth:`drain`."""
 
-    def __init__(self, extender, api, poll_seconds: float = 1.0) -> None:
+    def __init__(self, extender, api, poll_seconds: float = 1.0,
+                 clock=None) -> None:
+        from tpukube.core.clock import SYSTEM
+
         super().__init__(poll_seconds, "tpukube-evictions")
         self._extender = extender
         self._api = api
+        # eviction-confirm ages and the watch-confirm grace window are
+        # scheduling-semantic time: injectable (core/clock.py) so the
+        # discrete-event sim drives them on compressed time
+        self._clock = clock if clock is not None else SYSTEM
         # eviction accepted by the apiserver but deletion not yet
         # confirmed: a 2xx on the Eviction subresource only STARTS
         # graceful termination; the pod keeps its devices until its
@@ -1812,7 +1819,7 @@ class EvictionExecutor(_PollLoop):
         with self._state_lock:
             if not self._pending_since:
                 return 0.0
-            now = time.monotonic() if now is None else now
+            now = self._clock.monotonic() if now is None else now
             return max(0.0, now - min(self._pending_since.values()))
 
     def pending_snapshot(
@@ -1821,7 +1828,7 @@ class EvictionExecutor(_PollLoop):
         """Every unconfirmed eviction with its state and age (seconds
         since first drain attempt; None before the first attempt) — the
         /statusz rendering of the queue the depth gauge only counts."""
-        now = time.monotonic() if now is None else now
+        now = self._clock.monotonic() if now is None else now
         with self._state_lock:
             out = []
             for pod_key in list(self._extender.pending_evictions):
@@ -1924,7 +1931,9 @@ class EvictionExecutor(_PollLoop):
                 except IndexError:  # racing consumer emptied it
                     break
                 with self._state_lock:
-                    self._pending_since.setdefault(pod_key, time.monotonic())
+                    self._pending_since.setdefault(
+                        pod_key, self._clock.monotonic()
+                    )
                     self._expecting.add(pod_key)
                 ok = None
                 err = None
@@ -1978,7 +1987,7 @@ class EvictionExecutor(_PollLoop):
         done = []
         watch_live = (self._watch_confirmer is not None
                       and self._watch_confirmer.watch_alive())
-        now = time.monotonic()
+        now = self._clock.monotonic()
         with self._state_lock:
             tracked = sorted(
                 pod_key for pod_key in self._terminating

@@ -102,6 +102,24 @@ class TpuKubeConfig:
     # green at 1.0 with zero divergences).
     snapshot_audit_rate: float = 0.0
 
+    # Batched scheduling cycles (sched/cycle.py SchedulingCycle): when
+    # batch_enabled is true the extender admits pending pods into a
+    # scheduling queue, plans placements for a whole batch against ONE
+    # epoch-pinned ClusterSnapshot per cycle (kube-scheduler's
+    # snapshot-per-cycle model), and answers /filter, /prioritize, and
+    # /bind from the batch plan instead of re-planning per webhook.
+    # false (the default) preserves the legacy per-pod webhook path
+    # bit-identically — nothing batch-related is even constructed.
+    batch_enabled: bool = False
+    # most pods planned per cycle; pods beyond the cap wait for the
+    # next cycle (their own /filter triggers it)
+    batch_max_pods: int = 64
+    # minimum simulated/wall seconds between full batch replans when
+    # the queue is already drained (0 = plan eagerly on every webhook
+    # that misses the plan — the latency-first default; kilonode sims
+    # raise it to coalesce arrival storms into fewer, bigger cycles)
+    cycle_interval_seconds: float = 0.0
+
     # Which ICI slice this node belongs to (multi-slice clusters name
     # their pod slices; coords are slice-local — SURVEY.md §3 ICI/DCN note)
     slice_id: str = "slice-0"
@@ -247,5 +265,11 @@ def load_config(
     if not 0.0 <= cfg.snapshot_audit_rate <= 1.0:
         raise ValueError(
             "snapshot_audit_rate must be in [0, 1] (0 = audit off)"
+        )
+    if cfg.batch_max_pods < 1:
+        raise ValueError("batch_max_pods must be >= 1")
+    if cfg.cycle_interval_seconds < 0:
+        raise ValueError(
+            "cycle_interval_seconds must be >= 0 (0 = plan on demand)"
         )
     return cfg

@@ -140,6 +140,12 @@ def build_extender_registry(extender, reconcile=None, evictions=None,
     snapshots = getattr(extender, "snapshots", None)
     if snapshots is not None:
         _add_snapshot_metrics(reg, snapshots)
+    # batched scheduling cycles (sched/cycle.py): series render only
+    # when batch_enabled actually built a planner — the legacy
+    # exposition stays byte-identical with batching off
+    cycle = getattr(extender, "cycle", None)
+    if cycle is not None:
+        _add_cycle_metrics(reg, cycle)
     # unified retry/circuit layer (ISSUE 4): series render only when
     # the daemon actually wired the channel objects — sim/dev
     # extenders keep the legacy exposition byte-identical
@@ -284,6 +290,59 @@ def _add_snapshot_metrics(reg: Registry, snapshots) -> None:
             _slice_fn(sid, lambda ss: ss.fragmentation()))
         largest.labels(slice=sid).set_function(
             _slice_fn(sid, lambda ss: ss.largest_free_box()))
+
+
+def _add_cycle_metrics(reg: Registry, cycle) -> None:
+    """Batched-scheduling-cycle families (sched/cycle.py): throughput
+    counters (``rate(tpukube_cycle_pods_planned_total)`` is the
+    pods-scheduled/sec dashboard panel), the plan-hit/miss split whose
+    ratio /statusz reports, batch-size and cycle-wall distributions.
+    A flat hits counter with batching on means webhooks are not finding
+    their plans — the re-planning regression batching exists to kill."""
+    reg.counter(
+        "tpukube_cycles_total",
+        fn=lambda: cycle.cycles,
+        help_text="Batch scheduling cycles run (one snapshot pin and "
+                  "one queue drain each).")
+    reg.counter(
+        "tpukube_cycle_pods_planned_total",
+        fn=lambda: cycle.pods_planned,
+        help_text="Pods planned by batch cycles; its rate is "
+                  "pods-scheduled/sec.")
+    reg.counter(
+        "tpukube_cycle_plan_hits_total",
+        fn=lambda: cycle.plan_hits,
+        help_text="Webhooks answered from the batch plan (a lookup, "
+                  "not a re-plan).")
+    reg.counter(
+        "tpukube_cycle_plan_misses_total",
+        fn=lambda: cycle.plan_misses,
+        help_text="Webhooks the plan could not answer (fresh pod, "
+                  "changed node set, deferred preemption) — the "
+                  "legacy per-pod path served them.")
+    reg.counter(
+        "tpukube_cycle_assumes_total",
+        fn=lambda: cycle.assumes,
+        help_text="Placements committed as assumed allocations at plan "
+                  "time (consumed — or undone — by /bind).")
+    reg.summary(
+        "tpukube_cycle_batch_size",
+        quantiles=(0.5, 0.99),
+        values_fn=lambda: list(cycle.batch_sizes),
+        help_text="Pods planned per cycle (recent window).")
+    reg.summary(
+        "tpukube_cycle_wall_seconds",
+        quantiles=(0.5, 0.99),
+        values_fn=lambda: list(cycle.cycle_walls),
+        help_text="Wall time per batch cycle (recent window; the "
+                  "_bucket histogram is cumulative).")
+    # the cumulative histogram the summary's window flattens
+    reg.register(cycle.cycle_hist)
+    reg.gauge(
+        "tpukube_cycle_queue_depth",
+        fn=lambda: cycle.queue_depth(),
+        help_text="Pending pods admitted to the scheduling queue but "
+                  "not yet planned.")
 
 
 def _add_retry_metrics(reg: Registry, retriers=(), circuits=()) -> None:

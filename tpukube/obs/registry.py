@@ -70,6 +70,17 @@ DECLARED_SERIES: frozenset[str] = frozenset({
     "tpukube_snapshot_audit_divergence_total",
     "tpukube_slice_fragmentation",
     "tpukube_slice_largest_free_box_chips",
+    # extender: batched scheduling cycles (sched/cycle.py; series
+    # render only when batch_enabled is on — legacy exposition stays
+    # byte-identical with batching off)
+    "tpukube_cycles_total",
+    "tpukube_cycle_pods_planned_total",
+    "tpukube_cycle_plan_hits_total",
+    "tpukube_cycle_plan_misses_total",
+    "tpukube_cycle_assumes_total",
+    "tpukube_cycle_batch_size",
+    "tpukube_cycle_wall_seconds",
+    "tpukube_cycle_queue_depth",
     # both daemons (unified retry/circuit layer, core/retry.py; series
     # render only where a Retrier/CircuitBreaker is actually wired)
     "tpukube_retry_attempts_total",

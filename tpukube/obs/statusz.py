@@ -150,6 +150,12 @@ def extender_statusz(
         # a hit_rate near zero under webhook load means every cycle is
         # rebuilding (a mutation storm, or an epoch bump on a read path)
         "snapshot": extender.snapshots.stats(),
+        # batched scheduling cycles (sched/cycle.py): queue depth,
+        # batch sizes, and the plan-hit ratio — near zero with batching
+        # on means webhooks are re-planning instead of reading plans
+        "cycle": (extender.cycle.stats()
+                  if getattr(extender, "cycle", None) is not None
+                  else {"enabled": False}),
     }
     events = getattr(extender, "events", None)
     if events is not None:

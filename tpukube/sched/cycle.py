@@ -1,0 +1,810 @@
+"""Batched scheduling cycles (ISSUE 8 tentpole).
+
+The extender protocol is per-pod: kube-scheduler sends /filter,
+/prioritize, and /bind for one pod at a time, and the legacy path
+re-plans inside every webhook. After the epoch-cached snapshot (PR 5)
+removed the compute hot path, the residual wall is per-pod overhead —
+three webhook round-trips each redoing overlapping work.
+
+:class:`SchedulingCycle` turns that into kube-scheduler's
+snapshot-per-cycle model, batched:
+
+  * pending pods are ADMITTED into a scheduling queue — by their own
+    /filter webhook, or ahead of time by the pod informer / sim batch
+    driver (:meth:`enqueue`);
+  * a CYCLE drains the queue (priority- and gang-aware order, capped at
+    ``batch_max_pods``) and plans every pod against ONE epoch-pinned
+    :class:`~tpukube.sched.snapshot.ClusterSnapshot`, committing each
+    planned placement to the ledger as an ASSUMED allocation (the
+    kube-scheduler assume-cache move) so later pods in the batch see
+    earlier ones exactly as the sequential per-pod path would;
+  * /filter, /prioritize, and /bind then ANSWER FROM THE PLAN — a dict
+    lookup — instead of re-planning; /bind consumes the assumed
+    allocation (or undoes it and falls back to the legacy path when the
+    scheduler picked a different node than planned).
+
+Placement parity is a hard contract, enforced by tests/test_cycle.py:
+with batching on, every placement decision (node, chips, preemption
+plan, DCN split) is bit-identical to the legacy per-pod path, because
+the planner either runs the SAME per-pod code (gang / vTPU /
+multi-chip pods — the "general path") or a fast path proven equal to
+it (single whole-chip pods under topology scoring — the common churn
+shape, planned incrementally against a cycle-local overlay so a
+thousand-pod batch costs one snapshot build, not a thousand).
+
+Locking: the cycle is owned by the Extender and ONLY touched under its
+decision lock (handle() routes every webhook through it), so the plan
+needs no lock of its own. The pinned snapshot is taken once per cycle
+through the one seam ``_pin_snapshot`` — tpukube-lint's
+snapshot-discipline pass forbids any other ``SnapshotCache`` read or
+ad-hoc sweep construction in this module, so batch-plan consumers
+cannot quietly fork their own view of the cluster.
+"""
+
+from __future__ import annotations
+
+import heapq
+import logging
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Optional
+
+from tpukube.core import codec
+from tpukube.core.types import (
+    RESOURCE_TPU,
+    AllocResult,
+    PodInfo,
+    TopologyCoord,
+    make_device_id,
+)
+from tpukube.obs.registry import Histogram
+from tpukube.sched.gang import GangError
+from tpukube.sched.state import StateError
+
+log = logging.getLogger("tpukube.cycle")
+
+
+class PodPlan:
+    """One pod's planned webhook answers + (optionally) its assumed
+    allocation. ``names`` is the node-name tuple the plan was computed
+    against — a webhook asking about a different node set is a plan
+    miss (the legacy path answers it)."""
+
+    __slots__ = (
+        "pod", "uid", "names", "feasible", "failed", "scores", "node",
+        "alloc", "assumed", "bind_error", "error", "planned_at", "seq",
+        "epoch_key",
+    )
+
+    def __init__(self, pod: PodInfo, names: tuple[str, ...],
+                 planned_at: float, seq: int):
+        self.pod = pod
+        self.uid = pod.uid
+        self.names = names
+        #: (ledger, gang) epochs when planning finished — a NON-assumed
+        #: entry is only servable while these stand still (its answer
+        #: was "unschedulable"/"failed" against THAT state; the legacy
+        #: path would recompute after any mutation, so must we)
+        self.epoch_key: Optional[tuple[int, int]] = None
+        self.feasible: Optional[list[str]] = None
+        self.failed: dict[str, str] = {}
+        self.scores: dict[str, int] = {}
+        self.node: Optional[str] = None        # planner's predicted pick
+        self.alloc: Optional[AllocResult] = None
+        self.assumed = False                   # alloc committed, bind pending
+        self.bind_error: Optional[str] = None  # planned /bind error answer
+        self.error: Optional[str] = None       # planned /filter error answer
+        self.planned_at = planned_at
+        self.seq = seq
+
+
+class _SliceOverlay:
+    """Cycle-local incremental view of one ICI slice for the fast path:
+    the pinned snapshot's blocked contact values (as a plain dict over
+    the free chips — numpy scalar indexing per query was the measured
+    kilonode bottleneck) plus per-node free sets, updated in O(1) per
+    placement instead of re-deriving O(volume) sweeps per pod. Values
+    are proven equal to the legacy per-pod reads (contact_grid /
+    point_contact / free-count feasibility) by tests/test_cycle.py's
+    parity suite."""
+
+    __slots__ = ("mesh", "contact", "free_by_node", "owner")
+
+    def __init__(self, mesh, contact, free_by_node, owner):
+        self.mesh = mesh
+        #: free coord -> its contact against the blocked set; seeded
+        #: from the pinned snapshot's vectorized contact grid and
+        #: mutated incrementally (blocked chips are never queried)
+        self.contact = contact
+        #: node -> set of free, unreserved chip coords on that node
+        self.free_by_node = free_by_node
+        #: free coord -> owning node name (for best-score fanout)
+        self.owner = owner
+
+    def block(self, node: str, coord: TopologyCoord) -> set[str]:
+        """Mark ``coord`` newly blocked (assumed allocation): remove it
+        from its node's free set and bump each free neighbor's contact
+        once per reaching direction — the exact increment
+        ``slicefit.point_contact`` would observe (a length-2 torus axis
+        reaches the same neighbor twice and counts twice). Returns the
+        nodes whose best contact may have changed."""
+        self.free_by_node[node].discard(coord)
+        self.contact.pop(coord, None)
+        self.owner.pop(coord, None)
+        touched = {node}
+        mesh = self.mesh
+        contact = self.contact
+        owner = self.owner
+        for axis in range(3):
+            d = mesh.dims[axis]
+            wrap = mesh.torus[axis] and d > 1
+            for step in (-1, 1):
+                idx = coord[axis] + step
+                if wrap:
+                    idx %= d
+                elif idx < 0 or idx >= d:
+                    continue  # true wall: no neighbor to update
+                v = list(coord)
+                v[axis] = idx
+                nb = TopologyCoord(*v)
+                if nb in contact:  # a free chip whose snugness grew
+                    contact[nb] += 1
+                    touched.add(owner[nb])
+        return touched
+
+    def best_chip(self, node: str) -> Optional[TopologyCoord]:
+        """The node's snuggest free chip under the legacy tie-break:
+        max (contact, then lexicographically smallest coord) — the
+        same key ``Extender._plan_chips``'s count==1 path uses."""
+        free = self.free_by_node.get(node)
+        if not free:
+            return None
+        cg = self.contact
+        return max(free, key=lambda c: (cg[c], tuple(-v for v in c)))
+
+    def best_contact(self, node: str) -> int:
+        """Max contact over the node's free chips (-1 when none) — the
+        quantity the legacy /prioritize count==1 path scores."""
+        free = self.free_by_node.get(node)
+        if not free:
+            return -1
+        cg = self.contact
+        return max(cg[c] for c in free)
+
+
+class SchedulingCycle:
+    """The batch planner, owned by (and locked by) one Extender."""
+
+    #: recent batch sizes / cycle walls kept for the /metrics summaries
+    WINDOW = 512
+
+    def __init__(self, extender, config) -> None:
+        self._ext = extender
+        self._max_pods = config.batch_max_pods
+        self._interval = config.cycle_interval_seconds
+        self._ttl = config.reservation_ttl_seconds
+        # scheduling queue: pod key -> (PodInfo, enqueue seq, the
+        # webhook's candidate node names or None for driver/informer
+        # admissions). Insertion order is the arrival order; the cycle
+        # re-sorts by priority. Per-pod names matter on real clusters:
+        # kube-scheduler's /filter carries only the nodes that passed
+        # its built-in predicates for THAT pod, so planning a queued
+        # pod against another pod's candidate list would assume
+        # placements onto nodes the pod may not even tolerate.
+        self._queue: dict[str, tuple[PodInfo, int, Optional[tuple[str, ...]]]] = {}
+        self._plans: dict[str, PodPlan] = {}
+        self._seq = 0
+        self._last_drain = float("-inf")  # clock time of last full drain
+        # counters (read by /metrics + /statusz under no extra lock —
+        # the decision lock already serializes every writer)
+        self.cycles = 0
+        self.pods_planned = 0
+        self.plan_hits = 0
+        self.plan_misses = 0
+        self.assumes = 0
+        self.assume_undos = 0
+        self.batch_sizes: deque[int] = deque(maxlen=self.WINDOW)
+        self.cycle_walls: deque[float] = deque(maxlen=self.WINDOW)
+        self.cycle_wall_total = 0.0  # cumulative (the windows rotate)
+        self.cycle_hist = Histogram("tpukube_cycle_wall_seconds",
+                                    bucket_only=True)
+
+    # -- queue admission -----------------------------------------------------
+    def enqueue(self, pod: PodInfo,
+                names: Optional[tuple[str, ...]] = None) -> None:
+        """Admit a pending pod (idempotent per pod key). ``names`` is
+        the admitting webhook's candidate node list; None (the pod
+        informer / sim batch driver) means every known node is a
+        candidate and materialized webhook answers are not expected."""
+        key = pod.key()
+        if key in self._queue:
+            # keep the original seq (arrival order) but the fresh
+            # object and candidate set
+            self._queue[key] = (pod, self._queue[key][1], names)
+            return
+        self._seq += 1
+        self._queue[key] = (pod, self._seq, names)
+
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def planned_node(self, pod_key: str) -> Optional[str]:
+        """The live plan's predicted node for ``pod_key`` (None when
+        unplanned or found unschedulable)."""
+        entry = self._plans.get(pod_key)
+        return entry.node if entry is not None else None
+
+    def _entry_current(self, entry: PodPlan) -> bool:
+        """An ASSUMED entry stays servable regardless of later epochs —
+        its allocation is committed, and the answer IS that commitment
+        (re-planning would double-commit). A non-assumed entry (failed,
+        unschedulable, deferred) is a cached computation over a state
+        that may have moved: servable only while the epochs stand
+        still, exactly as the re-computing legacy path behaves."""
+        if entry.assumed:
+            return True
+        return entry.epoch_key == self._ext.snapshots.epoch_key()
+
+    # -- webhook answers -----------------------------------------------------
+    def filter_response(
+        self,
+        pod: PodInfo,
+        raw_nodes: Optional[list[dict[str, Any]]],
+        node_names: Optional[list[str]],
+    ) -> Any:
+        """The /filter decision in batch mode: ingest nodes, admit the
+        pod, ensure it is planned (running a cycle if needed), and
+        answer from the plan. Raises exactly what the legacy path
+        raises (the caller maps errors to the wire error response)."""
+        from tpukube.sched import kube
+
+        ext = self._ext
+        if raw_nodes is not None:
+            names = ext._ingest_nodes(raw_nodes)
+            by_name: Optional[dict[str, Any]] = dict(zip(names, raw_nodes))
+        else:
+            names = list(node_names or [])
+            by_name = None
+        mk = (kube.filter_result if raw_nodes is not None
+              else kube.filter_result_names)
+
+        ask = ext.device_request(pod)  # ExtenderError propagates (legacy)
+        if ask is None:
+            # not a TPU pod: everything feasible, nothing to plan
+            return mk(raw_nodes if raw_nodes is not None else names, {})
+
+        key = pod.key()
+        entry = self._plans.get(key)
+        fresh = (entry is not None and entry.uid == pod.uid
+                 and entry.names == tuple(names)
+                 and self._entry_current(entry))
+        if fresh:
+            self.plan_hits += 1
+        else:
+            if entry is not None and entry.assumed:
+                # the scheduler is re-filtering a pod we already assumed
+                # (changed node set / recreated pod): the old plan's
+                # commitment must not shadow the new cycle
+                self._undo_assume(entry)
+            self._plans.pop(key, None)
+            self.enqueue(pod, tuple(names))
+            self.run_cycle(must_plan=key)
+            entry = self._plans.get(key)
+            if entry is None:
+                # beyond the batch cap even after a cycle: legacy
+                # answer (quiet: the handle() wrapper already times
+                # this webhook — exactly one sample per webhook)
+                self.plan_misses += 1
+                with self._quiet():
+                    feasible, failed = ext.filter(
+                        pod, raw_nodes=raw_nodes, node_names=node_names
+                    )
+                return mk(feasible, failed)
+            self.plan_misses += 1  # planned now, not answered from cache
+        if entry.error is not None:
+            return mk([], {}, error=entry.error)
+        feasible = entry.feasible
+        if feasible is None:
+            # driver-enqueued pod planned without materialized answers
+            # (its webhooks were not expected): the planned node alone
+            # is a correct — if minimal — feasibility answer, and the
+            # scheduler's pick then consumes the assumed allocation
+            feasible = [entry.node] if entry.node is not None else []
+        if by_name is not None:
+            return mk([by_name[n] for n in feasible if n in by_name],
+                      dict(entry.failed))
+        return mk(list(feasible), dict(entry.failed))
+
+    def prioritize_response(
+        self, pod: PodInfo, names: list[str]
+    ) -> Optional[dict[str, int]]:
+        """Planned scores for exactly the requested names, or None when
+        the plan cannot answer (the caller falls back to the legacy
+        path and counts a miss)."""
+        entry = self._plans.get(pod.key())
+        if (entry is None or entry.uid != pod.uid or entry.error is not None
+                or not self._entry_current(entry)
+                or not all(n in entry.scores for n in names)):
+            self.plan_misses += 1
+            return None
+        self.plan_hits += 1
+        return {n: entry.scores[n] for n in names}
+
+    def take_for_bind(
+        self, key: str, uid: str, node: str
+    ) -> Optional[tuple[str, Any]]:
+        """Consume the plan's /bind answer: ("ok", AllocResult) for an
+        assumed allocation on the requested node, ("err", message) for
+        a planned bind failure, None when the legacy bind path must run
+        (no plan, deferred preemption, or the scheduler picked a
+        different node — the assume is undone first)."""
+        entry = self._plans.get(key)
+        if entry is None or (uid and entry.uid and uid != entry.uid):
+            return None
+        if entry.assumed and entry.alloc is not None:
+            self._plans.pop(key, None)
+            if entry.node == node:
+                self.plan_hits += 1
+                # the pod is bound for real now: retire its pending-
+                # webhook context exactly where the legacy bind does
+                with self._ext._pending_lock:
+                    self._ext._pending.pop(key, None)
+                return ("ok", entry.alloc)
+            # scheduler disagreed with the predicted node (another
+            # extender's scores, a racing cycle): undo and re-plan
+            self.plan_misses += 1
+            self._undo_assume(entry)
+            return None
+        if (entry.bind_error is not None and entry.node == node
+                and self._entry_current(entry)):
+            self.plan_hits += 1
+            self._plans.pop(key, None)
+            return ("err", entry.bind_error)
+        self.plan_misses += 1
+        return None
+
+    def on_release(self, pod_key: str) -> None:
+        """A recorded release arrived (pod deleted/evicted): a plan
+        entry still assuming this pod must not keep counting it bound —
+        the ledger release itself already happened in the decision."""
+        entry = self._plans.pop(pod_key, None)
+        if entry is not None and entry.assumed:
+            # the alloc is already released by the decision; only the
+            # bookkeeping the assume added must unwind
+            self._ext.binds_total -= 1
+            self.assume_undos += 1
+
+    # -- the cycle -----------------------------------------------------------
+    def run_pending(self) -> int:
+        """Drive cycles until the queue drains (the sim batch driver /
+        pod-informer entry point; webhook-triggered planning goes
+        through filter_response). Returns pods planned."""
+        planned = 0
+        while self._queue:
+            planned += self.run_cycle(drain=True)
+        return planned
+
+    def run_cycle(self, must_plan: Optional[str] = None,
+                  drain: bool = False) -> int:
+        """Plan one batch. ``must_plan`` (a webhook's pod) is always
+        included; the rest of the queue joins when ``drain`` is set or
+        ``cycle_interval_seconds`` has elapsed since the last full
+        drain — otherwise an arrival storm coalesces into fewer, bigger
+        cycles instead of replanning per webhook. Each pod plans
+        against ITS OWN candidate node list (the admitting webhook's,
+        or every known node for driver/informer admissions) and only
+        webhook-admitted pods pay for materialized filter/score
+        answers."""
+        ext = self._ext
+        now = ext.clock.monotonic()
+        self._expire_plans(now)
+        full = (drain or self._interval <= 0
+                or now - self._last_drain >= self._interval)
+        batch: list[tuple[PodInfo, int, Optional[tuple[str, ...]]]] = []
+        if full:
+            order = sorted(
+                self._queue.values(),
+                key=lambda e: (
+                    -e[0].priority,
+                    # gang-aware: members of one gang plan adjacently
+                    # (their reservation assembles within one cycle),
+                    # gangs ahead of strays within a priority band
+                    (0, e[0].group.name) if e[0].group is not None
+                    else (1, ""),
+                    e[1],
+                ),
+            )
+            batch = order[: self._max_pods]
+            self._last_drain = now
+        if must_plan is not None and must_plan in self._queue and not any(
+            p.key() == must_plan for p, _, _ in batch
+        ):
+            batch = batch[: max(0, self._max_pods - 1)]
+            batch.append(self._queue[must_plan])
+        if not batch:
+            return 0
+        t0 = time.perf_counter()
+        snap = self._pin_snapshot()
+        default_names: Optional[list[str]] = None
+        overlays: dict[str, _SliceOverlay] = {}
+        fast_state: Optional[dict[str, Any]] = None
+        for pod, seq, pod_names in batch:
+            key = pod.key()
+            self._queue.pop(key, None)
+            if pod_names is not None:
+                names = list(pod_names)
+                needs_answers = True  # a webhook will read the answers
+            else:
+                if default_names is None:
+                    default_names = ext.state.node_names()
+                names = default_names
+                needs_answers = False
+            if self._fast_eligible(pod):
+                # the same janitor the legacy filter runs per webhook;
+                # BEFORE the staleness check — a TTL/fault rollback
+                # bumps the epoch and must force an overlay rebuild
+                ext.gang.sweep()
+                if fast_state is None or (
+                    ext.snapshots.epoch_key() != fast_state["key"]
+                ):
+                    # first fast pod, or a general-path pod mutated
+                    # reservations mid-batch: (re)pin and rebuild
+                    snap = self._pin_snapshot()
+                    fast_state = self._build_fast_state(snap, overlays)
+                entry = self._plan_fast(pod, seq, names, fast_state,
+                                        needs_answers)
+                if entry.assumed:
+                    # commit moved the ledger epoch exactly as planned
+                    fast_state["key"] = ext.snapshots.epoch_key()
+            else:
+                entry = self._plan_general(pod, seq, names)
+            entry.epoch_key = ext.snapshots.epoch_key()
+            self._plans[key] = entry
+            self.pods_planned += 1
+        self.cycles += 1
+        self.batch_sizes.append(len(batch))
+        wall = time.perf_counter() - t0
+        self.cycle_walls.append(wall)
+        self.cycle_wall_total += wall
+        self.cycle_hist.observe(wall)
+        return len(batch)
+
+    def _pin_snapshot(self):
+        """The ONE place this module reads the epoch cache — the
+        snapshot-discipline lint pins every other SnapshotCache read or
+        sweep construction in cycle.py to this seam."""
+        return self._ext.snapshots.current()
+
+    @contextmanager
+    def _quiet(self):
+        """Suppress webhook-latency observation around plan-time
+        internal calls: with batching on, each REAL webhook records
+        exactly one latency sample (handle() times the plan/lookup),
+        never the phantom prioritize/bind samples the planner's
+        internal calls would otherwise add. Single-threaded by
+        construction — every caller holds the decision lock."""
+        ext = self._ext
+        prev = ext._suppress_latency
+        ext._suppress_latency = True
+        try:
+            yield
+        finally:
+            ext._suppress_latency = prev
+
+    # -- the general path (gang / vTPU / multi-chip) -------------------------
+    def _plan_general(self, pod: PodInfo, seq: int,
+                      names: list[str]) -> PodPlan:
+        """Plan one pod by running the SAME per-pod code the legacy
+        webhooks run, in webhook order (filter -> prioritize -> pick ->
+        bind), recording each answer. Bit-identity with the legacy path
+        is structural: it IS the legacy path, executed at plan time."""
+        from tpukube.sched.extender import ExtenderError
+
+        ext = self._ext
+        entry = PodPlan(pod, tuple(names), ext.clock.monotonic(), seq)
+        with self._quiet():
+            # quiet: plan-time internal calls must not feed the webhook
+            # latency histograms — each REAL webhook records exactly
+            # one sample (the filter wrapper times the whole plan; the
+            # prioritize/bind webhooks time their plan lookups)
+            try:
+                feasible, failed = ext.filter(pod, node_names=list(names))
+            except (ExtenderError, GangError, StateError,
+                    codec.CodecError) as e:
+                entry.error = str(e)
+                return entry
+            entry.feasible = [
+                n if isinstance(n, str) else n["metadata"]["name"]
+                for n in feasible
+            ]
+            entry.failed = dict(failed)
+            if not entry.feasible:
+                return entry
+            try:
+                entry.scores = ext.prioritize(
+                    pod, node_names=list(entry.feasible)
+                )
+            except (ExtenderError, GangError, StateError,
+                    codec.CodecError) as e:
+                log.warning("plan prioritize failed: %s", e)
+                entry.scores = {n: 0 for n in entry.feasible}
+            entry.node = max(sorted(entry.scores),
+                             key=lambda h: entry.scores[h])
+            res = None
+            if pod.group is not None:
+                res = ext.gang.reservation(pod.namespace, pod.group.name)
+            if res is not None and (
+                ext.gang.peek_pending_victims(res)
+                or ext.gang.terminating_victims_of(res)
+            ):
+                # two-phase preemption: its execution (and the PDB
+                # precheck guarding it) belongs to the real /bind
+                # webhook — defer
+                return entry
+            try:
+                entry.alloc = ext.bind(pod.name, pod.namespace, pod.uid,
+                                       entry.node)
+                entry.assumed = True
+                self.assumes += 1
+                # bind() consumed the pending-webhook context; re-arm
+                # it so a node-mismatch fallback (or duplicate filter)
+                # can still re-plan through the legacy path
+                ext._remember(pod)
+            except (ExtenderError, GangError, StateError,
+                    codec.CodecError) as e:
+                entry.bind_error = str(e)
+            return entry
+
+    # -- the fast path (single whole-chip pods, topology scoring) ------------
+    def _fast_eligible(self, pod: PodInfo) -> bool:
+        from tpukube.sched.extender import ExtenderError
+
+        if pod.group is not None:
+            return False
+        if self._ext._config.score_mode != "topology":
+            return False
+        try:
+            ask = self._ext.device_request(pod)
+        except ExtenderError:
+            return False  # the general path reports the schema error
+        return ask is not None and ask[0] == RESOURCE_TPU and ask[1] == 1
+
+    def _build_fast_state(self, snap,
+                          overlays: dict[str, _SliceOverlay]
+                          ) -> dict[str, Any]:
+        """Per-cycle shared structures for the fast path, derived from
+        the pinned snapshot over EVERY known node (per-pod candidate
+        lists select from it at query time): slice overlays (free-chip
+        contact dicts + free sets), the vTPU-mode set, and the
+        best-node heap the driver placement loop pops from — O(nodes)
+        to build once, O(log nodes) per placement after."""
+        ext = self._ext
+        overlays.clear()
+        vtpu_nodes: set[str] = set()
+        node_slice: dict[str, str] = {}
+        node_best: dict[str, int] = {}
+        heap: list[tuple[int, str, int]] = []
+        reserved = snap.reserved_by_slice()
+        grids: dict[str, list] = {}
+        for sid in snap.slice_ids():
+            ss = snap.slice(sid)
+            # the pinned snapshot's vectorized contact grid, read once
+            # into plain nested lists (fast scalar access) — the shared
+            # cached ndarray itself is never mutated
+            grids[sid] = ss.blocked_sweep().contact_grid().tolist()
+            overlays[sid] = _SliceOverlay(
+                mesh=ss.mesh, contact={}, free_by_node={}, owner={},
+            )
+        for name in ext.state.node_names():
+            view = ext.state.node(name)
+            if view is None:
+                continue
+            if view.shares_per_chip > 1:
+                vtpu_nodes.add(name)
+                continue
+            sid = view.info.slice_id
+            ov = overlays.get(sid)
+            if ov is None:
+                continue  # slice raced away mid-cycle: unknown at query
+            node_slice[name] = sid
+            mask = reserved.get(sid, frozenset())
+            grid = grids[sid]
+            free = {c.coord for c in view.free_chips()
+                    if c.coord not in mask}
+            ov.free_by_node[name] = free
+            best = -1
+            for c in free:
+                ov.owner[c] = name
+                contact = grid[c[0]][c[1]][c[2]]
+                ov.contact[c] = contact
+                if contact > best:
+                    best = contact
+            node_best[name] = best
+            if best >= 0:
+                heap.append((-best, name, best))
+        heapq.heapify(heap)
+        return {
+            "key": ext.snapshots.epoch_key(),
+            "overlays": overlays,
+            "vtpu": vtpu_nodes,
+            "node_slice": node_slice,
+            "node_best": node_best,
+            "heap": heap,
+        }
+
+    def _plan_fast(self, pod: PodInfo, seq: int, names: list[str],
+                   fs: dict[str, Any], needs_answers: bool) -> PodPlan:
+        """One single-chip pod against the cycle overlay: O(nodes) to
+        materialize webhook answers (skipped for driver-enqueued pods
+        whose webhooks never ask), O(1) to place and assume."""
+        from tpukube.sched.extender import MAX_SCORE, ExtenderError
+
+        ext = self._ext
+        entry = PodPlan(pod, tuple(names), ext.clock.monotonic(), seq)
+        ext._remember(pod)
+        overlays: dict[str, _SliceOverlay] = fs["overlays"]
+        node_slice: dict[str, str] = fs["node_slice"]
+
+        best_node: Optional[str] = None
+        if needs_answers:
+            best_score = -1
+            feasible: list[str] = []
+            failed: dict[str, str] = {}
+            scores: dict[str, int] = {}
+            for name in names:
+                sid = node_slice.get(name)
+                if sid is None:
+                    failed[name] = (
+                        "node is vTPU mode, pod wants whole chips"
+                        if name in fs["vtpu"]
+                        else "no tpukube node-topology annotation"
+                    )
+                    continue
+                ov = overlays[sid]
+                free = len(ov.free_by_node.get(name, ()))
+                if free < 1:
+                    failed[name] = (
+                        f"wants 1 chips, node has {free} free "
+                        f"(gang reservations excluded)"
+                    )
+                    continue
+                feasible.append(name)
+                contact = ov.best_contact(name)
+                score = (round(MAX_SCORE * contact / 6)
+                         if contact >= 0 else 0)
+                scores[name] = score
+                if score > best_score or (
+                    score == best_score
+                    and (best_node is None or name < best_node)
+                ):
+                    best_score, best_node = score, name
+            entry.feasible = feasible
+            entry.failed = failed
+            entry.scores = scores
+        else:
+            # driver path: pop the argmax node off the lazily-validated
+            # heap — identical choice to the materialized loop (best
+            # contact maps 1:1 to score, ties break on smallest name),
+            # at O(log nodes) instead of O(nodes x chips) per pod
+            heap = fs["heap"]
+            node_best = fs["node_best"]
+            while heap:
+                _, name, best = heapq.heappop(heap)
+                if node_best.get(name, -1) == best and best >= 0:
+                    # push the entry straight back: if the placement
+                    # below leaves this node's best unchanged, the node
+                    # must stay in the heap (the refresh only pushes on
+                    # CHANGE); a duplicate is harmless under lazy
+                    # validation
+                    heapq.heappush(heap, (-best, name, best))
+                    best_node = name
+                    break
+        if best_node is None:
+            if needs_answers:
+                return entry
+            entry.error = "unschedulable: no feasible node in the batch plan"
+            return entry
+        entry.node = best_node
+        ov = overlays[node_slice[best_node]]
+        coord = ov.best_chip(best_node)
+        view = ext.state.node(best_node)
+        if coord is None or view is None:
+            entry.bind_error = (
+                f"{pod.key()}: node {best_node} can no longer fit 1 x "
+                f"{RESOURCE_TPU}"
+            )
+            return entry
+        try:
+            did = make_device_id(view.index_at(coord))
+            alloc = AllocResult(
+                pod_key=pod.key(),
+                node_name=best_node,
+                device_ids=[did],
+                coords=[coord],
+                env={},
+                priority=pod.priority,
+                uid=pod.uid or "",
+            )
+            ext.state.commit(alloc)
+        except (StateError, ExtenderError) as e:
+            entry.bind_error = str(e)
+            return entry
+        ext.binds_total += 1
+        entry.alloc = alloc
+        entry.assumed = True
+        self.assumes += 1
+        # O(1) overlay update + best-score refresh for the few nodes
+        # the placement touched (heap entries are validated lazily)
+        heap = fs["heap"]
+        node_best = fs["node_best"]
+        for name in ov.block(best_node, coord):
+            best = ov.best_contact(name)
+            if node_best.get(name, -1) != best:
+                node_best[name] = best
+                if best >= 0:
+                    heapq.heappush(heap, (-best, name, best))
+        return entry
+
+    # -- hygiene -------------------------------------------------------------
+    def _undo_assume(self, entry: PodPlan) -> None:
+        """Release an assumed-but-unbound allocation (node mismatch,
+        re-filter, expiry): the ledger/gang release the legacy effector
+        undo performs, minus the wire response."""
+        ext = self._ext
+        key = entry.pod.key()
+        if ext.state.release(key) is not None:
+            ext.gang.on_release(key)
+            ext.binds_total -= 1
+            self.assume_undos += 1
+            log.warning("assumed allocation for %s undone (re-plan)", key)
+        entry.assumed = False
+        entry.alloc = None
+
+    def _expire_plans(self, now: float) -> None:
+        """Plans whose /bind never came expire on the reservation-TTL
+        horizon — the same janitor contract the gang sweep applies to
+        its reservations. Assumed allocations are released; non-assumed
+        entries (unschedulable / failed answers) are dropped too — a
+        daemon fed a stream of never-binding pods with unique names
+        must not grow ``_plans`` without bound."""
+        for key, entry in list(self._plans.items()):
+            if now - entry.planned_at <= self._ttl:
+                continue
+            if entry.assumed:
+                log.warning(
+                    "assumed allocation for %s never bound within %.0fs; "
+                    "releasing", key, self._ttl,
+                )
+                self._undo_assume(entry)
+            self._plans.pop(key, None)
+
+    # -- observability -------------------------------------------------------
+    def stats(self) -> dict[str, Any]:
+        """The /statusz "cycle" section."""
+        lookups = self.plan_hits + self.plan_misses
+        walls = list(self.cycle_walls)
+        return {
+            "enabled": True,
+            "cycles": self.cycles,
+            "pods_planned": self.pods_planned,
+            "queue_depth": len(self._queue),
+            "plans_live": len(self._plans),
+            "assumes": self.assumes,
+            "assume_undos": self.assume_undos,
+            "plan_hits": self.plan_hits,
+            "plan_misses": self.plan_misses,
+            "plan_hit_ratio": (round(self.plan_hits / lookups, 4)
+                               if lookups else None),
+            "last_batch_size": (self.batch_sizes[-1]
+                                if self.batch_sizes else 0),
+            "last_cycle_wall_s": (round(walls[-1], 6) if walls else None),
+            # normalized planning cost — the perf-floor smoke's number
+            # (cycle walls alone mix 1-pod and 1024-pod batches)
+            "plan_ms_per_pod": (
+                round(1000 * self.cycle_wall_total / self.pods_planned, 4)
+                if self.pods_planned else None
+            ),
+            "batch_max_pods": self._max_pods,
+            "cycle_interval_seconds": self._interval,
+        }

@@ -72,8 +72,16 @@ class Extender:
         config: TpuKubeConfig,
         state: Optional[ClusterState] = None,
         trace: Optional["DecisionTrace"] = None,
+        clock=None,
     ):
+        from tpukube.core.clock import SYSTEM
+
         self._config = config
+        # scheduling-semantic time (pending-webhook TTL, gang
+        # reservation TTLs via the gang manager, assumed-plan expiry):
+        # injectable so the discrete-event sim can compress hours of
+        # churn into seconds; latency MEASUREMENT stays on real time
+        self.clock = clock if clock is not None else SYSTEM
         self.state = state or ClusterState()
         # decision trace (SURVEY.md §6 tracing): make_app records at the
         # HTTP boundary, release() records inline; trace_capacity=0 disables
@@ -104,6 +112,7 @@ class Extender:
             ttl_seconds=config.reservation_ttl_seconds,
             eviction_sink=self.pending_evictions,
             events=self.events,
+            clock=self.clock,
         )
         # The epoch-cached scheduling snapshot (sched/snapshot.py),
         # owned by the gang manager and shared here: every filter/
@@ -116,10 +125,21 @@ class Extender:
         # cache rebuilds from the ledger and raises on divergence — the
         # runtime check behind the epoch-discipline lint (0 = off)
         self.snapshots.audit_rate = config.snapshot_audit_rate
+        # Batched scheduling cycles (sched/cycle.py): with batch_enabled
+        # the webhooks answer from a per-cycle batch plan instead of
+        # re-planning per request; None (the config default) keeps the
+        # legacy per-pod path bit-identically — nothing batch-related
+        # is constructed or consulted.
+        self.cycle = None
+        if config.batch_enabled:
+            from tpukube.sched.cycle import SchedulingCycle
+
+            self.cycle = SchedulingCycle(self, config)
         # Pods seen at filter time, so /bind (which only carries names) can
         # recover the request: key -> (pod, uid, seen_monotonic).
         self._pending: dict[str, tuple[PodInfo, str, float]] = {}
         self._pending_lock = threading.Lock()
+        self._pending_pruned = self.clock.monotonic()
         # Serializes every decision (mutation + trace record as ONE step):
         # webhooks run on the aiohttp loop but releases arrive from other
         # threads (sim pod-lifecycle, watchers); without this lock a trace
@@ -142,6 +162,10 @@ class Extender:
                                       bucket_only=True)
         for handler in self.latencies:
             self.webhook_hist.labels(handler=handler)
+        # True only while the batch planner's plan-time internal calls
+        # run (under the decision lock): their filter/prioritize/bind
+        # invocations are not webhooks and must not feed the histograms
+        self._suppress_latency = False
         self.preemptions = 0   # victims evicted for higher-priority gangs
         self.binds_total = 0   # successful binds (metrics counter)
         # The bind EFFECTOR: with bindVerb configured, kube-scheduler
@@ -207,9 +231,15 @@ class Extender:
             return None
 
     def _remember(self, pod: PodInfo) -> None:
-        now = time.monotonic()
+        now = self.clock.monotonic()
         with self._pending_lock:
             self._pending[pod.key()] = (pod, pod.uid, now)
+            # amortized prune: a full scan per call was O(pending) on
+            # the batch fast path (100k-pod kilonode traces); sweeping
+            # a few times per TTL window keeps the same bound
+            if now - self._pending_pruned < self.PENDING_TTL_S / 4:
+                return
+            self._pending_pruned = now
             stale = [
                 k for k, (_, _, t) in self._pending.items()
                 if now - t > self.PENDING_TTL_S
@@ -321,7 +351,12 @@ class Extender:
 
     def _observe_latency(self, handler: str, seconds: float) -> None:
         """One webhook latency sample: into the bounded window (quantile
-        summaries) AND the cumulative histogram (_bucket counters)."""
+        summaries) AND the cumulative histogram (_bucket counters).
+        Suppressed while the batch planner runs its plan-time internal
+        calls (SchedulingCycle._quiet) so each real webhook records
+        exactly one sample in batch mode too."""
+        if self._suppress_latency:
+            return
         self.latencies[handler].append(seconds)
         self.webhook_hist.labels(handler=handler).observe(seconds)
 
@@ -1054,6 +1089,33 @@ class Extender:
             ids.append(make_device_id(index, (k, n)))
         return ids
 
+    # -- batch-driver hooks (sched/cycle.py; sim driver + pod informer) -----
+    def admit(self, pod: PodInfo) -> None:
+        """Admit a pending pod into the scheduling queue ahead of its
+        /filter webhook (pod-informer feed / sim batch driver). No-op
+        without batching — the webhook path needs no pre-admission."""
+        if self.cycle is not None:
+            with self._decision_lock:
+                self.cycle.enqueue(pod)
+
+    def plan_pending(self) -> int:
+        """Drive batch cycles until the admitted queue drains; returns
+        pods planned. The sim batch driver's entry point — webhook
+        arrivals plan through handle('filter') instead."""
+        if self.cycle is None:
+            return 0
+        with self._decision_lock:
+            return self.cycle.run_pending()
+
+    def planned_node(self, pod_key: str) -> Optional[str]:
+        """The batch plan's predicted node for a pod (None = no live
+        plan / plan found the pod unschedulable). Drivers use it to
+        issue the /bind the plan anticipates."""
+        if self.cycle is None:
+            return None
+        with self._decision_lock:
+            return self.cycle.planned_node(pod_key)
+
     # -- pod lifecycle ------------------------------------------------------
     def release(self, pod_key: str) -> None:
         self.handle("release", {"pod_key": pod_key})
@@ -1094,28 +1156,57 @@ class Extender:
                 mk = (kube.filter_result if nodes is not None
                       else kube.filter_result_names)
                 try:
-                    feasible, failed = self.filter(
-                        pod, raw_nodes=nodes, node_names=names
-                    )
-                    response: Any = mk(feasible, failed)
+                    if self.cycle is not None:
+                        # batch mode: admit + plan (one snapshot per
+                        # cycle), answer from the plan
+                        t0 = time.monotonic()
+                        try:
+                            response: Any = self.cycle.filter_response(
+                                pod, nodes, names
+                            )
+                        finally:
+                            self._observe_latency(
+                                "filter", time.monotonic() - t0
+                            )
+                    else:
+                        feasible, failed = self.filter(
+                            pod, raw_nodes=nodes, node_names=names
+                        )
+                        response = mk(feasible, failed)
                 except (ExtenderError, GangError, StateError,
                         codec.CodecError) as e:
                     response = mk([], {}, error=str(e))
             elif kind == "prioritize":
                 pod, nodes, names = kube.parse_extender_args(body)
-                try:
-                    scores = self.prioritize(
-                        pod, raw_nodes=nodes, node_names=names
+                scores = None
+                if self.cycle is not None:
+                    if nodes is not None:
+                        names = self._ingest_nodes(nodes)
+                        nodes = None
+                    t0 = time.monotonic()
+                    scores = self.cycle.prioritize_response(
+                        pod, list(names or [])
                     )
-                except (ExtenderError, GangError, StateError,
-                        codec.CodecError) as e:
-                    log.warning("prioritize failed: %s", e)
-                    scores = {}
+                    if scores is not None:
+                        self._observe_latency(
+                            "prioritize", time.monotonic() - t0
+                        )
+                if scores is None:
+                    try:
+                        scores = self.prioritize(
+                            pod, raw_nodes=nodes, node_names=names
+                        )
+                    except (ExtenderError, GangError, StateError,
+                            codec.CodecError) as e:
+                        log.warning("prioritize failed: %s", e)
+                        scores = {}
                 response = kube.host_priority_list(scores)
             elif kind == "release":
                 pod_key = body["pod_key"]
                 self.state.release(pod_key)
                 self.gang.on_release(pod_key)
+                if self.cycle is not None:
+                    self.cycle.on_release(pod_key)
                 with self._pending_lock:
                     self._pending.pop(pod_key, None)
                 response = None
@@ -1185,18 +1276,41 @@ class Extender:
         alloc = None
         gang_info = None
         with self._decision_lock:
+            planned = None
+            if self.cycle is not None:
+                # batch mode: consume the plan's assumed allocation (or
+                # its planned error) instead of re-planning; a miss —
+                # no plan, deferred preemption, node disagreement —
+                # falls through to the legacy bind below
+                t0 = time.monotonic()
+                planned = self.cycle.take_for_bind(key, uid, node)
+                if planned is not None:
+                    self._observe_latency("bind", time.monotonic() - t0)
             try:
-                alloc = self.bind(name, ns, uid, node)
-                # consume THIS bind's gang marker under the same lock; a
-                # FAILED bind must not pop (the key may belong to another
-                # in-flight bind's pending effector)
-                gang_info = self._bind_gang_info.pop(key, None)
-                # the alloc annotation rides back to the
-                # harness/apiserver-writer
-                response: Any = kube.binding_result()
-                response["Annotations"] = {
-                    codec.ANNO_ALLOC: codec.encode_alloc(alloc)
-                }
+                if planned is not None:
+                    verdict, payload = planned
+                    if verdict == "ok":
+                        alloc = payload
+                        gang_info = self._bind_gang_info.pop(key, None)
+                        response: Any = kube.binding_result()
+                        response["Annotations"] = {
+                            codec.ANNO_ALLOC: codec.encode_alloc(alloc)
+                        }
+                    else:
+                        response = kube.binding_result(payload)
+                else:
+                    alloc = self.bind(name, ns, uid, node)
+                    # consume THIS bind's gang marker under the same
+                    # lock; a FAILED bind must not pop (the key may
+                    # belong to another in-flight bind's pending
+                    # effector)
+                    gang_info = self._bind_gang_info.pop(key, None)
+                    # the alloc annotation rides back to the
+                    # harness/apiserver-writer
+                    response = kube.binding_result()
+                    response["Annotations"] = {
+                        codec.ANNO_ALLOC: codec.encode_alloc(alloc)
+                    }
             except (ExtenderError, GangError, StateError,
                     codec.CodecError) as e:
                 # an errored response must NEVER run the effector, even

@@ -159,9 +159,16 @@ class GangManager:
     LATENCY_WINDOW = 4096
 
     def __init__(self, state: ClusterState, ttl_seconds: float = 30.0,
-                 eviction_sink: Optional[deque] = None, events=None):
+                 eviction_sink: Optional[deque] = None, events=None,
+                 clock=None):
+        from tpukube.core.clock import SYSTEM
+
         self._state = state
         self._ttl = ttl_seconds
+        # scheduling-semantic time (reservation creation stamps, TTL
+        # sweeps, commit-latency measurement against those stamps):
+        # injectable for the discrete-event sim (core/clock.py)
+        self._clock = clock if clock is not None else SYSTEM
         # structured event journal (obs/events.py), shared with the
         # owning Extender; None = no journal (standalone/unit tests)
         self._events = events
@@ -259,7 +266,7 @@ class GangManager:
         uncommitted reservation whose slice lost a chip to a health fault
         or an internal ICI link to a link fault.
         Returns the rolled-back group keys."""
-        now = time.monotonic() if now is None else now
+        now = self._clock.monotonic() if now is None else now
         rolled: list[tuple[str, str]] = []
         with self._lock:
             if all(r.committed for r in self._reservations.values()):
@@ -424,6 +431,7 @@ class GangManager:
                 slice_coords=slice_coords,
                 chips_per_pod=chips_per_pod,
                 priority=pod.priority,
+                created=self._clock.monotonic(),
             )
             self._reservations[key] = res
             self._epoch += 1
@@ -604,6 +612,7 @@ class GangManager:
                 slice_coords=slice_coords,
                 chips_per_pod=chips_per_pod,
                 priority=max(a.priority for a in allocs),
+                created=self._clock.monotonic(),
             )
             for a in allocs:
                 res.record_assignment(
@@ -744,6 +753,7 @@ class GangManager:
                 slice_coords={s: set(cs) for s, cs in parts.items()},
                 chips_per_pod=chips_per_pod,
                 priority=pod.priority,
+                created=self._clock.monotonic(),
                 pending_victims=(
                     list(pending_victims) if pending_victims else None
                 ),
@@ -1000,7 +1010,7 @@ class GangManager:
             self._epoch += 1
             if not res.committed and len(res.assigned) >= res.group.min_member:
                 res.committed = True
-                res.commit_latency = time.monotonic() - res.created
+                res.commit_latency = self._clock.monotonic() - res.created
                 self.commit_latencies.append(res.commit_latency)
                 self.commit_hist.observe(res.commit_latency)
                 log.info(

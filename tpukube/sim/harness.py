@@ -184,11 +184,26 @@ class SimCluster:
         vtpu_nodes: Optional[set[str]] = None,
         vtpu_shares: int = 2,
         slices: Optional[dict[str, MeshSpec]] = None,
+        clock=None,
+        in_process: bool = False,
     ):
         """Single-slice by default (``mesh``); pass ``slices`` (slice id ->
         MeshSpec) for a multi-slice cluster — node names are then prefixed
-        "<slice>-host-i-j-k" so they stay unique cluster-wide."""
+        "<slice>-host-i-j-k" so they stay unique cluster-wide.
+
+        ``clock`` (core/clock.py) threads an injectable — typically a
+        :class:`~tpukube.core.clock.FakeClock` — through every
+        scheduling-semantic timer (gang TTLs, pending-webhook pruning,
+        eviction-confirm ages), so kilonode churn traces simulate hours
+        in seconds of wall time. ``in_process=True`` skips the HTTP
+        listener and routes the webhook protocol straight into
+        ``Extender.handle`` — the same decision path minus sockets and
+        JSON transport, for benches that measure scheduling compute."""
+        from tpukube.core.clock import SYSTEM
+
         self.config = config or load_config(env={})
+        self.clock = clock if clock is not None else SYSTEM
+        self._in_process = in_process
         if slices is not None and mesh is not None:
             raise ValueError("pass either mesh or slices, not both")
         # the dynamic lock-order detector must be live BEFORE the
@@ -246,7 +261,7 @@ class SimCluster:
                     name=name, chips=chips, shares_per_chip=shares,
                     slice_id=sid,
                 )
-        self.extender = Extender(self.config)
+        self.extender = Extender(self.config, clock=self.clock)
         self.pods: dict[str, dict[str, Any]] = {}  # key -> pod object
         self._store_api = self._make_store_api()
         self._wire_extender()
@@ -275,7 +290,7 @@ class SimCluster:
         wiring."""
         store_api = self._store_api
         self._evictions = EvictionExecutor(
-            self.extender, store_api
+            self.extender, store_api, clock=self.clock
         )  # drained inline by schedule(); not started as a thread
         # same release loop a real extender daemon runs, stepped
         # deterministically (delete_pod/complete_pod) instead of as a
@@ -297,7 +312,20 @@ class SimCluster:
     def base_url(self) -> str:
         return f"http://127.0.0.1:{self._port}"
 
+    def advance(self, seconds: float) -> None:
+        """Advance the injected fake clock (discrete-event time).
+        Raises on a real clock — a sim that thinks it is compressing
+        time while actually sleeping wall time is a silent lie."""
+        advance = getattr(self.clock, "advance", None)
+        if advance is None:
+            raise RuntimeError(
+                "advance() needs a FakeClock (pass clock=FakeClock())"
+            )
+        advance(seconds)
+
     def start(self) -> None:
+        if self._in_process:
+            return  # webhooks dispatch straight into Extender.handle
         try:
             self._http = _AppThread(make_app(self.extender), "127.0.0.1",
                                     self._port)
@@ -372,15 +400,16 @@ class SimCluster:
         if self._http is not None:
             raise RuntimeError("crash_extender() first — the old "
                                "extender is still serving")
-        self.extender = Extender(self.config)
+        self.extender = Extender(self.config, clock=self.clock)
         self._wire_extender()
         restored = rebuild_extender(self.extender, self._store_api)
         # the fresh extender has ingested nothing over the webhook
         # channel yet: the next schedule() must send full node objects
         self._synced_objs = []
-        self._http = _AppThread(make_app(self.extender), "127.0.0.1",
-                                self._port)
-        self._http.start()
+        if not self._in_process:
+            self._http = _AppThread(make_app(self.extender), "127.0.0.1",
+                                    self._port)
+            self._http.start()
         return restored
 
     # -- kube-object minting -----------------------------------------------
@@ -471,6 +500,16 @@ class SimCluster:
 
     # -- the scheduler loop (what kube-scheduler would do) -------------------
     def _post(self, path: str, body: dict[str, Any]) -> Any:
+        if self._in_process:
+            # the same webhook dispatch (decision lock, trace record,
+            # plan lookups) minus sockets and JSON transport — what the
+            # kilonode scenarios and the no-HTTP microbench measure
+            from tpukube.sched import kube
+
+            try:
+                return self.extender.handle(path.strip("/"), body)
+            except kube.KubeSchemaError as e:
+                raise RuntimeError(f"HTTP 400 from {path}: {e}")
         payload = json.dumps(body).encode()
         for attempt in (0, 1):  # one reconnect if the kept-alive conn died
             conn = getattr(self._tls, "conn", None)
@@ -566,6 +605,90 @@ class SimCluster:
             alloc = codec.decode_alloc(meta["annotations"][codec.ANNO_ALLOC])
             return best, alloc
         raise RuntimeError(f"bind error after {retries} cycles: {last_err}")
+
+    def schedule_pending(
+        self, pods: list[dict[str, Any]], retries: int = 4
+    ) -> dict[str, tuple[str, AllocResult]]:
+        """Batch-drive many pending pods through the scheduling-cycle
+        planner (requires ``batch_enabled``): admit them all into the
+        extender's queue, run planning cycles, then issue each pod's
+        /bind against the planned node — the protocol's one mandatory
+        per-pod step (the commitment + annotation write-back). The
+        planner already computed every pod's filter/prioritize answer;
+        pods whose plan failed (lost races, victims terminating) requeue
+        for another round. Returns pod key -> (node, alloc); raises if
+        any pod stays unschedulable after ``retries`` rounds."""
+        from tpukube.sched import kube
+
+        ext = self.extender
+        if ext.cycle is None:
+            raise RuntimeError("schedule_pending needs batch_enabled=true")
+        self._sync_nodes()
+        results: dict[str, tuple[str, AllocResult]] = {}
+        remaining = list(pods)
+        for _ in range(retries):
+            if not remaining:
+                break
+            self.drain_evictions()
+            for obj in remaining:
+                ext.admit(kube.pod_from_k8s(obj))
+            ext.plan_pending()
+            still: list[dict[str, Any]] = []
+            for obj in remaining:
+                meta = obj["metadata"]
+                key = f"{meta['namespace']}/{meta['name']}"
+                node = ext.planned_node(key)
+                if node is None:
+                    still.append(obj)
+                    continue
+                bres = self._post("/bind", {
+                    "PodName": meta["name"],
+                    "PodNamespace": meta["namespace"],
+                    "PodUID": meta["uid"],
+                    "Node": node,
+                })
+                if bres.get("Error"):
+                    still.append(obj)
+                    continue
+                meta.setdefault("annotations", {}).update(
+                    bres.get("Annotations", {})
+                )
+                obj["spec"]["nodeName"] = node
+                results[key] = (node, codec.decode_alloc(
+                    meta["annotations"][codec.ANNO_ALLOC]
+                ))
+            remaining = still
+        if remaining:
+            names = [o["metadata"]["name"] for o in remaining[:3]]
+            raise RuntimeError(
+                f"{len(remaining)} pod(s) unschedulable after {retries} "
+                f"batch rounds (first: {names})"
+            )
+        return results
+
+    def _sync_nodes(self) -> None:
+        """Push node annotations through the recorded ``upsert_node``
+        decision (the nodeCacheCapable out-of-band refresh): the batch
+        driver skips /filter webhooks, which are how node topology
+        normally reaches the extender. Identity-cached like
+        _extender_node_args — unchanged node sets cost nothing."""
+        objs = self.node_objects()
+        synced = self._synced_objs
+        if len(objs) == len(synced) and all(
+            a is b for a, b in zip(objs, synced)
+        ):
+            return
+        for obj in objs:
+            res = self.extender.handle("upsert_node", {
+                "name": obj["metadata"]["name"],
+                "annotations": obj["metadata"]["annotations"],
+            })
+            if isinstance(res, dict) and res.get("error"):
+                raise RuntimeError(
+                    f"node sync failed for "
+                    f"{obj['metadata']['name']}: {res['error']}"
+                )
+        self._synced_objs = objs
 
     def delete_pod(self, name: str, namespace: str = "default") -> None:
         """Remove the pod object, then let the lifecycle release loop

@@ -20,10 +20,18 @@ from tpukube.sim.harness import SimCluster
 
 #: knobs that pass through from the process environment into every
 #: scenario's canonical config (which would otherwise shadow them):
-#: the chaos seed (tools/check.sh pins it for reproducible smoke) and
-#: the snapshot audit sentinel (the acceptance drive runs scenarios
-#: 1-9 at TPUKUBE_SNAPSHOT_AUDIT_RATE=1.0 asserting zero divergences)
-_PASSTHROUGH_KEYS = ("TPUKUBE_CHAOS_SEED", "TPUKUBE_SNAPSHOT_AUDIT_RATE")
+#: the chaos seed (tools/check.sh pins it for reproducible smoke), the
+#: snapshot audit sentinel (the acceptance drive runs scenarios
+#: 1-9 at TPUKUBE_SNAPSHOT_AUDIT_RATE=1.0 asserting zero divergences),
+#: and the batching knobs (the ISSUE 8 parity suite re-runs scenarios
+#: with TPUKUBE_BATCH_ENABLED=1 asserting bit-identical placements)
+_PASSTHROUGH_KEYS = (
+    "TPUKUBE_CHAOS_SEED",
+    "TPUKUBE_SNAPSHOT_AUDIT_RATE",
+    "TPUKUBE_BATCH_ENABLED",
+    "TPUKUBE_BATCH_MAX_PODS",
+    "TPUKUBE_CYCLE_INTERVAL_SECONDS",
+)
 
 
 def _env(defaults: dict[str, str]) -> dict[str, str]:
@@ -58,6 +66,7 @@ def run(scenario: int, config: TpuKubeConfig | None = None) -> dict[str, Any]:
         7: fault_telemetry,
         8: apiserver_chaos,
         9: crash_recovery,
+        10: kilonode_churn,
     }[scenario]
     t0 = time.perf_counter()
     result = fn(config)
@@ -613,6 +622,130 @@ def apiserver_chaos(config: TpuKubeConfig | None) -> dict[str, Any]:
         if problems:
             raise RuntimeError("scenario 8 invariants violated: "
                                + "; ".join(problems))
+        return result
+
+
+def kilonode_churn(config: TpuKubeConfig | None) -> dict[str, Any]:
+    """Scenario 10: the kilonode scale trace (ISSUE 8 acceptance) —
+    1024 nodes / 4096 chips, a committed 256-member training gang, and
+    a ~100k-pod burst-churn trace driven through the batched
+    scheduling cycles on a discrete-event fake clock: hours of
+    simulated churn (waves arrive, run, complete on a simulated
+    cadence; TTL sweeps and eviction ages all read the fake clock) in
+    seconds of wall time. Every ~100th pod additionally runs the FULL
+    per-pod webhook protocol (filter -> prioritize -> bind, in-process)
+    so webhook latency quantiles are measured, not inferred.
+
+    ``TPUKUBE_KILONODE_PODS`` scales the trace (default 100000; the
+    check.sh smoke stage runs a shorter fixed-seed trace). Raises on
+    invariant violations: gang uncommitted, ledger/store divergence,
+    or a pod count short of the target.
+    """
+    import os
+    from collections import deque as _deque
+
+    from tpukube.chaos import ledger_divergence
+    from tpukube.core.clock import FakeClock
+    from tpukube.obs.registry import quantile
+
+    cfg = config or load_config(env=_env({
+        "TPUKUBE_SIM_MESH_DIMS": "16,16,16",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        "TPUKUBE_BATCH_ENABLED": "1",
+        "TPUKUBE_BATCH_MAX_PODS": "1024",
+    }))
+    total_target = int(os.environ.get("TPUKUBE_KILONODE_PODS", "100000"))
+    gang_size = 256
+    sample_every = 101  # full-webhook-protocol sampling cadence
+    clock = FakeClock()
+    t0 = time.perf_counter()
+    with SimCluster(cfg, clock=clock, in_process=True) as c:
+        n_nodes = len(c.nodes)
+        n_chips = sum(m.num_chips for m in c.slices.values())
+
+        # a long-lived training gang pins a contiguous block while the
+        # burst plane churns around it — the config-5 shape at 16x scale
+        group = PodGroup("kilotrain", min_member=gang_size)
+        gang_pods = [
+            c.make_pod(f"kt-{i}", tpu=1, priority=100, group=group)
+            for i in range(gang_size)
+        ]
+        c.schedule_pending(gang_pods)
+        scheduled = gang_size
+        sampled = 0
+
+        capacity = n_chips - gang_size
+        wave = min(cfg.batch_max_pods, capacity // 2)
+        alive: _deque[str] = _deque()
+        seq = 0
+        while scheduled < total_target:
+            room = capacity - len(alive)
+            n = min(wave, room, total_target - scheduled)
+            if n > 0:
+                batch = []
+                for _ in range(n):
+                    name = f"burst-{seq}"
+                    seq += 1
+                    if seq % sample_every == 0:
+                        # full per-pod webhook protocol for this one:
+                        # filter/prioritize/bind latencies get sampled
+                        c.schedule(c.make_pod(name, tpu=1))
+                        sampled += 1
+                    else:
+                        batch.append(c.make_pod(name, tpu=1))
+                    alive.append(name)
+                if batch:
+                    c.schedule_pending(batch)
+                scheduled += n
+            # the wave runs for five simulated minutes, then enough of
+            # the oldest pods complete to make room for the next wave —
+            # the mesh stays near-full, the steady-churn shape
+            c.advance(300.0)
+            done = min(len(alive), max(0, len(alive) + wave - capacity))
+            for _ in range(done):
+                c.pods.pop(f"default/{alive.popleft()}", None)
+            c._lifecycle.check_once()
+        wall = time.perf_counter() - t0
+
+        ext = c.extender
+        gangs = [g for g in ext.gang_snapshot() if g["group"] == "kilotrain"]
+        committed = bool(gangs and gangs[0]["committed"])
+        div = ledger_divergence(c)
+        webhook_p99_ms = {
+            handler: round(1000 * quantile(window, 0.99), 3)
+            for handler, window in ext.latencies.items()
+        }
+        result = {
+            "metric": "kilonode_churn",
+            "value": round(scheduled / wall, 1),
+            "unit": "pods scheduled per second",
+            "nodes": n_nodes,
+            "chips": n_chips,
+            "pods_total": scheduled,
+            "pods_sampled_full_protocol": sampled,
+            "wall_s": round(wall, 3),
+            "pods_per_sec": round(scheduled / wall, 1),
+            # the fake clock's whole point: simulated hours per wall
+            # second — the compression factor that makes kilonode
+            # fleets measurable at all
+            "sim_seconds": round(clock.monotonic(), 1),
+            "time_compression": round(clock.monotonic() / wall, 1),
+            "webhook_p99_ms": webhook_p99_ms,
+            "gang_committed": committed,
+            "ledger_divergence": len(div),
+            "cycle": ext.cycle.stats() if ext.cycle is not None else None,
+            "utilization_percent": round(100 * c.utilization(), 2),
+        }
+        problems = list(div)
+        if not committed:
+            problems.append("the kilotrain gang never committed")
+        if scheduled < total_target:
+            problems.append(
+                f"only {scheduled}/{total_target} pods scheduled"
+            )
+        if problems:
+            raise RuntimeError("scenario 10 invariants violated: "
+                               + "; ".join(problems[:5]))
         return result
 
 
