@@ -1,0 +1,733 @@
+"""ISSUE 9: the multi-tenant serving plane.
+
+Three load-bearing contracts:
+
+  * OFF IS OFF — with ``tenancy_enabled`` false (the default) nothing
+    tenant-related is constructed, no tenant series render, and every
+    placement path is the pre-tenancy code; a NEUTRAL plane (enabled,
+    one tenant, no quotas, no burn) must additionally change no
+    placement — proven per-workload and across whole sim scenarios.
+  * QUOTAS NEVER VIOLATE — the admission gate refuses (with a typed
+    journal event) any placement that would push a tenant over its
+    caps, under random arrival orders, and the DRF queue order keeps
+    the dominant-share spread bounded.
+  * REFUSALS ARE NEVER SILENT — every shed/denial increments a counter
+    AND lands in the journal as TenantAdmissionShed/TenantQuotaDenied.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from types import SimpleNamespace
+
+import pytest
+
+from tpukube.core.clock import FakeClock
+from tpukube.core.config import load_config
+from tpukube.core.types import PodGroup
+from tpukube.sched import kube
+from tpukube.sim.harness import SimCluster
+from tpukube.tenancy import BurnMonitor, TenantPlane, parse_quotas
+
+TENANT_LABEL = "tpu.qiniu.com/tenant"
+
+SMALL = {
+    "TPUKUBE_SIM_MESH_DIMS": "4,4,2",
+    "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+}
+
+
+def _cfg(tenancy: bool = True, batch: bool = False, quotas: str = "",
+         **extra: str):
+    env = dict(SMALL)
+    env.update(extra)
+    if tenancy:
+        env["TPUKUBE_TENANCY_ENABLED"] = "1"
+    if quotas:
+        env["TPUKUBE_TENANCY_QUOTAS"] = quotas
+    if batch:
+        env["TPUKUBE_BATCH_ENABLED"] = "1"
+    return load_config(env=env)
+
+
+def _placement(alloc):
+    return (alloc.node_name, tuple(sorted(alloc.device_ids)),
+            tuple(sorted(tuple(c) for c in alloc.coords)))
+
+
+# -- quota spec / config -----------------------------------------------------
+
+def test_parse_quotas():
+    q = parse_quotas("teamA=chips:16,hbm:0.25;teamB=chips:8")
+    assert q["teamA"].chips == 16 and q["teamA"].hbm_fraction == 0.25
+    assert q["teamB"].chips == 8 and q["teamB"].hbm_fraction is None
+    assert parse_quotas("") == {}
+    assert parse_quotas(" ; ") == {}
+
+
+@pytest.mark.parametrize("bad", [
+    "noequals", "a=", "a=chips", "a=chips:x", "a=chips:0",
+    "a=hbm:1.5", "a=hbm:0", "a=cores:2", "a=chips:1;a=chips:2",
+])
+def test_parse_quotas_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_quotas(bad)
+
+
+def test_config_validates_quota_spec_and_defaults_off():
+    cfg = load_config(env={})
+    assert cfg.tenancy_enabled is False
+    from tpukube.sched.extender import Extender
+
+    assert Extender(cfg).tenants is None
+    with pytest.raises(ValueError, match="tenancy_quotas"):
+        load_config(env={"TPUKUBE_TENANCY_ENABLED": "1",
+                         "TPUKUBE_TENANCY_QUOTAS": "a=chips:-3"})
+    with pytest.raises(ValueError, match="tenancy_burn_threshold"):
+        load_config(env={"TPUKUBE_TENANCY_BURN_THRESHOLD": "-1"})
+    # quotas without the plane would be silently unenforced: refuse
+    with pytest.raises(ValueError, match="tenancy_enabled"):
+        load_config(env={"TPUKUBE_TENANCY_QUOTAS": "a=chips:4"})
+
+
+# -- tenant identity + ledger ------------------------------------------------
+
+def test_tenant_from_label_and_default():
+    cfg = _cfg()
+    from tpukube.sched.extender import Extender
+
+    ext = Extender(cfg)
+    labeled = kube.pod_from_k8s({
+        "metadata": {"name": "p", "labels": {TENANT_LABEL: "teamA"}},
+        "spec": {},
+    })
+    bare = kube.pod_from_k8s({"metadata": {"name": "q"}, "spec": {}})
+    assert ext.tenants.tenant_of(labeled) == "teamA"
+    assert ext.tenants.tenant_of(bare) == "default"
+
+
+def test_ledger_usage_from_allocations_and_reservations():
+    cfg = _cfg(quotas="a=chips:20")
+    with SimCluster(cfg, in_process=True) as c:
+        ext = c.extender
+        for i in range(3):
+            c.schedule(c.make_pod(f"a-{i}", tpu=1,
+                                  labels={TENANT_LABEL: "a"}))
+        c.schedule(c.make_pod("b-0", tpu=2, labels={TENANT_LABEL: "b"}))
+        c.schedule(c.make_pod("bare", tpu=1))
+        # a reserving (uncommitted) gang charges its tenant too
+        g = PodGroup("g", min_member=4)
+        c.make_pod("g-0", tpu=1, priority=5, group=g,
+                   labels={TENANT_LABEL: "a"})
+        args, _ = c._extender_node_args()
+        c._post("/filter", {"Pod": c.pods["default/g-0"], **args})
+        snap = ext.tenants.ledger.usage()
+        assert snap.usage["a"].chips == 3 + 4  # allocs + reservation
+        assert snap.usage["b"].chips == 2
+        assert snap.usage["default"].chips == 1
+        assert snap.capacity_chips == 32
+        assert snap.usage["a"].hbm_bytes > 0
+        assert 0 < snap.dominant_share("b") < snap.dominant_share("a")
+        # burst accounting: priority-0 non-gang chips only
+        assert snap.usage["a"].burst_chips == 3
+        # the alloc annotation carries the tenant (restart channel)
+        alloc = ext.state.allocation("default/a-0")
+        assert alloc.env["TPU_KUBE_TENANT"] == "a"
+
+
+def test_vtpu_shares_count_fractionally():
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,1,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,1,1",
+        "TPUKUBE_SHARES_PER_CHIP": "2",
+        "TPUKUBE_TENANCY_ENABLED": "1",
+    })
+    with SimCluster(cfg, vtpu_nodes={"host-0-0-0"}, vtpu_shares=2,
+                    in_process=True) as c:
+        c.schedule(c.make_pod("i-0", vtpu=1, labels={TENANT_LABEL: "a"}))
+        snap = c.extender.tenants.ledger.usage()
+        assert snap.usage["a"].chips == pytest.approx(0.5)
+        assert snap.vtpu_shares == 2
+
+
+def test_tenant_attribution_survives_restart():
+    cfg = _cfg()
+    with SimCluster(cfg) as c:
+        g = PodGroup("phoenix", min_member=2)
+        for i in range(2):
+            c.schedule(c.make_pod(f"p-{i}", tpu=1, priority=5, group=g,
+                                  labels={TENANT_LABEL: "teamX"}))
+        assert c.extender.gang.snapshot()[0].tenant == "teamX"
+        c.crash_extender()
+        c.restart_extender()
+        res = c.extender.gang.snapshot()
+        assert res and res[0].tenant == "teamX"
+        snap = c.extender.tenants.ledger.usage()
+        assert snap.usage["teamX"].chips == 2
+
+
+# -- admission: quotas -------------------------------------------------------
+
+def test_quota_denial_is_journaled_and_exact():
+    cfg = _cfg(quotas="a=chips:2")
+    with SimCluster(cfg, in_process=True) as c:
+        for i in range(2):
+            c.schedule(c.make_pod(f"a-{i}", tpu=1,
+                                  labels={TENANT_LABEL: "a"}))
+        with pytest.raises(RuntimeError, match="quota"):
+            c.schedule(c.make_pod("a-2", tpu=1,
+                                  labels={TENANT_LABEL: "a"}))
+        plane = c.extender.tenants
+        assert plane.quota_denials == {"a": 1}
+        reasons = c.extender.events.counts_by_reason()
+        assert reasons.get("TenantQuotaDenied", 0) == 1
+        # an unquota'd tenant is untouched
+        c.schedule(c.make_pod("b-0", tpu=1, labels={TENANT_LABEL: "b"}))
+
+
+def test_gang_charged_once_members_ride_the_reservation():
+    # first member charges the WHOLE gang; quota must cover it up front
+    cfg = _cfg(quotas="a=chips:4")
+    with SimCluster(cfg, in_process=True) as c:
+        g = PodGroup("fits", min_member=4)
+        for i in range(4):
+            c.schedule(c.make_pod(f"f-{i}", tpu=1, priority=5, group=g,
+                                  labels={TENANT_LABEL: "a"}))
+        assert c.extender.tenants.quota_denials == {}
+        g2 = PodGroup("toobig", min_member=2)
+        with pytest.raises(RuntimeError, match="quota"):
+            c.schedule(c.make_pod("t-0", tpu=1, priority=5, group=g2,
+                                  labels={TENANT_LABEL: "a"}))
+
+
+def test_overflow_gang_replicas_are_quota_charged():
+    """Replicas beyond min_member of a full gang schedule as NORMAL
+    pods on fresh chips (gang.assignable False) — they must be charged
+    against the quota like any burst, not ride the reservation's
+    exemption."""
+    cfg = _cfg(quotas="a=chips:4")
+    with SimCluster(cfg, in_process=True) as c:
+        g = PodGroup("full", min_member=4)
+        for i in range(4):
+            c.schedule(c.make_pod(f"m-{i}", tpu=1, priority=5, group=g,
+                                  labels={TENANT_LABEL: "a"}))
+        # the 5th replica would take a 5th chip: quota refuses it
+        with pytest.raises(RuntimeError, match="quota"):
+            c.schedule(c.make_pod("m-4", tpu=1, priority=5, group=g,
+                                  labels={TENANT_LABEL: "a"}))
+        assert c.extender.tenants.quota_denials == {"a": 1}
+
+
+# -- burn monitor + SLO shedding ---------------------------------------------
+
+def _gang_hist():
+    from tpukube.obs.registry import Histogram
+
+    return Histogram("gang_schedule_latency_seconds", bucket_only=True)
+
+
+def test_burn_monitor_windows():
+    clock = FakeClock()
+    hist = _gang_hist()
+    mon = BurnMonitor(clock, threshold=14.4, window=60.0)
+    mon.attach_default_slos({"gang_schedule_latency_seconds": hist})
+    assert mon.page_burning() is None  # no traffic, no burn
+    hist.observe(0.1)
+    clock.advance(1.0)  # the verdict is memoized per clock instant
+    assert mon.page_burning() is None  # within SLO
+    hist.observe(5.0)  # blows the 2.5s objective
+    clock.advance(1.0)
+    assert "gang-schedule-latency" in mon.page_burning()
+    assert mon.last_page_burning() is True
+    # the bad sample ages out of the sliding window
+    clock.advance(61.0)
+    mon.evaluate()  # slides B
+    clock.advance(61.0)
+    mon.evaluate()  # slides A past the sample
+    clock.advance(1.0)
+    assert mon.page_burning() is None
+    assert mon.last_page_burning() is False
+
+
+def test_burn_monitor_memoizes_per_clock_instant():
+    clock = FakeClock()
+    hist = _gang_hist()
+    mon = BurnMonitor(clock, threshold=14.4, window=60.0)
+    mon.attach_default_slos({"gang_schedule_latency_seconds": hist})
+    hist.observe(5.0)
+    clock.advance(1.0)
+    assert mon.page_burning() is not None
+    evals_a = dict(mon.last_burns)
+    # a whole drain's admissions at one tick share the one verdict
+    # (no re-scan) — the next tick re-evaluates
+    assert mon.page_burning() is not None
+    assert mon.last_burns == evals_a
+
+
+def test_burn_monitor_resets_after_idle_gap():
+    """Admissions drive evaluations, so an overnight-idle plane must
+    not judge the morning's first burst against a giant stale window
+    (a slow commit from last night would shed healthy traffic)."""
+    clock = FakeClock()
+    hist = _gang_hist()
+    mon = BurnMonitor(clock, threshold=14.4, window=60.0)
+    mon.attach_default_slos({"gang_schedule_latency_seconds": hist})
+    hist.observe(0.1)
+    clock.advance(1.0)
+    assert mon.page_burning() is None
+    hist.observe(5.0)  # the overnight bad sample
+    clock.advance(10_000.0)  # idle far past two windows
+    assert mon.page_burning() is None  # reset, not a stale-window shed
+    # a burn that is STILL happening re-crosses within one window
+    hist.observe(5.0)
+    clock.advance(30.0)
+    assert mon.page_burning() is not None
+
+
+def test_single_burst_tenant_never_sheds():
+    """With one bursting tenant its share IS the population mean, so
+    fairness-based shedding has no target — by design (quotas are the
+    single-tenant overload knob), and it is what keeps a neutral
+    single-tenant plane placement-identical to tenancy off."""
+    cfg = _cfg()
+    clock = FakeClock()
+    with SimCluster(cfg, clock=clock, in_process=True) as c:
+        ext = c.extender
+        for i in range(6):
+            c.schedule(c.make_pod(f"a-{i}", tpu=1,
+                                  labels={TENANT_LABEL: "a"}))
+        ext.gang.commit_hist.observe(5.0)  # page burn
+        clock.advance(1.0)
+        c.schedule(c.make_pod("a-more", tpu=1,
+                              labels={TENANT_LABEL: "a"}))
+        assert ext.tenants.counter_snapshot()[0] == {}
+
+
+def test_slo_shed_targets_overshare_low_priority_bursts_only():
+    cfg = _cfg()
+    clock = FakeClock()
+    with SimCluster(cfg, clock=clock, in_process=True) as c:
+        ext = c.extender
+        plane = ext.tenants
+        # tenant a hogs the burst plane, b sips
+        for i in range(6):
+            c.schedule(c.make_pod(f"a-{i}", tpu=1,
+                                  labels={TENANT_LABEL: "a"}))
+        c.schedule(c.make_pod("b-0", tpu=1, labels={TENANT_LABEL: "b"}))
+        # no burn -> nobody sheds
+        c.schedule(c.make_pod("a-ok", tpu=1, labels={TENANT_LABEL: "a"}))
+        # a gang commit blows the 2.5s SLO: page burn (advance past
+        # the per-tick verdict memo)
+        ext.gang.commit_hist.observe(5.0)
+        clock.advance(1.0)
+        with pytest.raises(RuntimeError, match="admission shed"):
+            c.schedule(c.make_pod("a-shed", tpu=1,
+                                  labels={TENANT_LABEL: "a"}))
+        # under-share tenant still admitted during the burn
+        c.schedule(c.make_pod("b-1", tpu=1, labels={TENANT_LABEL: "b"}))
+        # higher-priority work of the over-share tenant is not shed
+        c.schedule(c.make_pod("a-prio", tpu=1, priority=10,
+                              labels={TENANT_LABEL: "a"}))
+        # ...and neither are gang members (training never sheds)
+        g = PodGroup("train", min_member=2)
+        for i in range(2):
+            c.schedule(c.make_pod(f"g-{i}", tpu=1, priority=5, group=g,
+                                  labels={TENANT_LABEL: "a"}))
+        sheds, _ = plane.counter_snapshot()
+        assert sheds == {"a": 1}
+        reasons = ext.events.counts_by_reason()
+        assert reasons.get("TenantAdmissionShed", 0) == 1
+
+
+# -- DRF ordering: property test ---------------------------------------------
+
+def _drive_batch(c, pods):
+    """Admit + plan + bind a pod list through the batch planner,
+    tolerating unschedulable leftovers. Returns placed count."""
+    ext = c.extender
+    c._sync_nodes()
+    for obj in pods:
+        ext.admit(kube.pod_from_k8s(obj))
+    ext.plan_pending()
+    placed = 0
+    for obj in pods:
+        meta = obj["metadata"]
+        node = ext.planned_node(f"{meta['namespace']}/{meta['name']}")
+        if node is None:
+            continue
+        bres = c._post("/bind", {
+            "PodName": meta["name"], "PodNamespace": meta["namespace"],
+            "PodUID": meta["uid"], "Node": node,
+        })
+        if not bres.get("Error"):
+            placed += 1
+    return placed
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_drf_never_exceeds_quota_and_bounds_spread(seed):
+    """Property: under random arrival orders on a saturated mesh, the
+    allocator never exceeds any tenant quota and the dominant-share
+    spread stays bounded (max/min <= 2.0)."""
+    tenants = ["a", "b", "c"]
+    cfg = _cfg(batch=True, quotas=";".join(
+        f"{t}=chips:12" for t in tenants
+    ))
+    rng = random.Random(seed)
+    with SimCluster(cfg, in_process=True) as c:  # 32 chips
+        pods = []
+        for t in tenants:
+            for i in range(14):  # oversubscribed: 42 offered for 32
+                pods.append(c.make_pod(f"{t}-{i}", tpu=1,
+                                       labels={TENANT_LABEL: t}))
+        rng.shuffle(pods)
+        placed = _drive_batch(c, pods)
+        snap = c.extender.tenants.ledger.usage()
+        chips = {t: snap.usage.get(t).chips if t in snap.usage else 0.0
+                 for t in tenants}
+        for t in tenants:
+            assert chips[t] <= 12 + 1e-9, (t, chips)
+        assert placed == 32  # full plane despite quotas
+        ratio = max(chips.values()) / min(chips.values())
+        assert ratio <= 2.0, (chips, ratio)
+
+
+def test_drf_order_interleaves_tenants_per_pick():
+    """The queue order itself: all of tenant a enqueued before any of
+    b must still interleave a/b in the drained order."""
+    cfg = _cfg(batch=True)
+    with SimCluster(cfg, in_process=True) as c:
+        ext = c.extender
+        entries = []
+        for seq, (t, n) in enumerate(
+            [("a", f"a-{i}") for i in range(4)]
+            + [("b", f"b-{i}") for i in range(4)]
+        ):
+            pod = kube.pod_from_k8s(c.make_pod(
+                n, tpu=1, labels={TENANT_LABEL: t}))
+            entries.append((pod, seq, None))
+        ordered = ext.tenants.drf_order(entries)
+        tenants_in_order = [
+            e[0].labels[TENANT_LABEL] for e in ordered
+        ]
+        assert tenants_in_order == ["a", "b", "a", "b",
+                                    "a", "b", "a", "b"]
+
+
+# -- tenant-aware preemption victim choice -----------------------------------
+
+def test_preemption_prefers_overshare_victims_at_equal_cost():
+    from tpukube.core.mesh import MeshSpec
+    from tpukube.core.types import TopologyCoord
+    from tpukube.sched import policy
+
+    mesh = MeshSpec(dims=(4, 1, 1), host_block=(1, 1, 1))
+    wa = policy.Workload(
+        id="pa", priority=1, cost=1,
+        coords=frozenset({TopologyCoord(0, 0, 0), TopologyCoord(1, 0, 0)}),
+        pod_keys=("default/pa",), tenant="a",
+    )
+    wb = policy.Workload(
+        id="pb", priority=1, cost=1,
+        coords=frozenset({TopologyCoord(2, 0, 0), TopologyCoord(3, 0, 0)}),
+        pod_keys=("default/pb",), tenant="b",
+    )
+    base = policy.find_preemption_plan(
+        [wa, wb], mesh, set(), 2, None, 10
+    )
+    assert [w.id for w in base.victims] == ["pa"]  # legacy tie-break
+    biased = policy.find_preemption_plan(
+        [wa, wb], mesh, set(), 2, None, 10, overshare={"b": 0.5}
+    )
+    assert [w.id for w in biased.victims] == ["pb"]
+    # an all-zero bias map changes nothing (the tenancy-off shape)
+    neutral = policy.find_preemption_plan(
+        [wa, wb], mesh, set(), 2, None, 10, overshare={}
+    )
+    assert [w.id for w in neutral.victims] == ["pa"]
+    assert (neutral.cost_priority_sum, neutral.victim_count) == (
+        base.cost_priority_sum, base.victim_count
+    )
+
+
+# -- parity: off is off, neutral changes nothing -----------------------------
+
+def _mixed_workload_placements(cfg) -> dict:
+    """A placement-heavy workload: bursts, a preempting gang, backfill.
+    Returns pod -> placement."""
+    out = {}
+    with SimCluster(cfg, in_process=True) as c:
+        for i in range(12):
+            _, alloc = c.schedule(c.make_pod(f"burst-{i}", tpu=1))
+            out[f"burst-{i}"] = _placement(alloc)
+        g = PodGroup("train", min_member=16)
+        for i in range(16):
+            _, alloc = c.schedule(
+                c.make_pod(f"t-{i}", tpu=1, priority=50, group=g))
+            out[f"t-{i}"] = _placement(alloc)
+        fill = 0
+        while True:
+            try:
+                _, alloc = c.schedule(c.make_pod(f"fill-{fill}", tpu=1))
+            except RuntimeError:
+                break
+            out[f"fill-{fill}"] = _placement(alloc)
+            fill += 1
+    return out
+
+
+@pytest.mark.parametrize("batch", [False, True])
+def test_neutral_plane_placements_bit_identical(batch):
+    """tenancy on with one tenant and no quotas = the legacy
+    placements, webhook path and batch path alike (incl. preemption)."""
+    legacy = _mixed_workload_placements(_cfg(tenancy=False, batch=batch))
+    neutral = _mixed_workload_placements(_cfg(tenancy=True, batch=batch))
+    assert legacy == neutral
+
+
+def test_tenancy_off_renders_no_tenant_series_or_env():
+    from tpukube.metrics import render_extender_metrics
+    from tpukube.obs.statusz import extender_statusz
+
+    with SimCluster(_cfg(tenancy=False), in_process=True) as c:
+        _, alloc = c.schedule(c.make_pod("p", tpu=1))
+        assert "TPU_KUBE_TENANT" not in alloc.env
+        text = render_extender_metrics(c.extender)
+        assert "tpukube_tenant" not in text and "tenancy" not in text
+        assert extender_statusz(c.extender)["tenants"] == {
+            "enabled": False
+        }
+
+
+def test_tenancy_on_renders_tenant_series_and_statusz():
+    from tpukube.metrics import render_extender_metrics
+    from tpukube.obs.statusz import extender_statusz
+
+    with SimCluster(_cfg(quotas="a=chips:4,hbm:0.5"),
+                    in_process=True) as c:
+        c.schedule(c.make_pod("p", tpu=1, labels={TENANT_LABEL: "a"}))
+        text = render_extender_metrics(c.extender)
+        assert 'tpukube_tenant_chips_used{tenant="a"} 1' in text
+        assert 'tpukube_tenant_quota_chips{tenant="a"} 4' in text
+        assert "tpukube_tenancy_shedding 0" in text
+        doc = extender_statusz(c.extender)["tenants"]
+        assert doc["enabled"] and doc["tenants"]["a"]["chips_used"] == 1
+        # the exposition stays lint-clean with the new families on
+        from tpukube.obs.slo import validate_exposition
+
+        assert validate_exposition(text) == []
+
+
+#: per-scenario placement-relevant result keys (timing excluded) — the
+#: same table shape test_cycle.py uses for batch parity
+SCENARIO_KEYS = {
+    1: ("node", "devices", "env_keys", "utilization_percent"),
+    2: ("placements", "utilization_percent"),
+    3: ("pods", "shared_one_chip"),
+    4: ("gang_box", "contiguous", "utilization_percent"),
+    5: ("value", "vs_baseline", "preemptions", "pods_placed"),
+    6: ("value", "waves", "wave_size", "full_utilization_percent",
+        "util_min_after_refill_percent", "lifecycle_releases"),
+}
+
+
+def _scenario_result(n: int, tenancy: bool, keys):
+    from tpukube.sim import scenarios
+
+    old = os.environ.pop("TPUKUBE_TENANCY_ENABLED", None)
+    try:
+        if tenancy:
+            os.environ["TPUKUBE_TENANCY_ENABLED"] = "1"
+        r = scenarios.run(n)
+    finally:
+        os.environ.pop("TPUKUBE_TENANCY_ENABLED", None)
+        if old is not None:
+            os.environ["TPUKUBE_TENANCY_ENABLED"] = old
+    return {k: r[k] for k in keys}
+
+
+@pytest.mark.parametrize("scenario", sorted(SCENARIO_KEYS))
+def test_scenario_placements_bit_identical_with_neutral_tenancy(scenario):
+    keys = SCENARIO_KEYS[scenario]
+    legacy = _scenario_result(scenario, False, keys)
+    neutral = _scenario_result(scenario, True, keys)
+    assert legacy == neutral, f"scenario {scenario} diverged"
+
+
+# -- the informer admission feed (ROADMAP follow-up) -------------------------
+
+def _pending_pod(name: str, tpu: int = 1, bound: bool = False,
+                 phase: str = "", plain: bool = False):
+    requests = {} if plain else {"qiniu.com/tpu": str(tpu)}
+    pod = {
+        "metadata": {"name": name, "namespace": "default",
+                     "uid": f"uid-{name}"},
+        "spec": {"containers": [
+            {"name": "main", "resources": {"requests": requests}}
+        ]},
+    }
+    if bound:
+        pod["spec"]["nodeName"] = "host-0-0-0"
+    if phase:
+        pod["status"] = {"phase": phase}
+    return pod
+
+
+def test_pod_admission_feed_routes_pending_pods_into_the_queue():
+    from tpukube.apiserver import PodAdmissionFeed
+    from tpukube.sched.extender import Extender
+
+    ext = Extender(_cfg(tenancy=False, batch=True))
+    api = SimpleNamespace(list_pods=lambda node=None: [
+        _pending_pod("listed"), _pending_pod("bound", bound=True),
+    ])
+    feed = PodAdmissionFeed(ext, api, use_watch=False)
+    assert ext.cycle.queue_depth() == 0
+    feed._apply_watch_event("ADDED", _pending_pod("p1"))
+    assert ext.cycle.queue_depth() == 1
+    # idempotent per key; MODIFIED refreshes, never duplicates
+    feed._apply_watch_event("MODIFIED", _pending_pod("p1"))
+    assert ext.cycle.queue_depth() == 1
+    # bound / terminal / non-TPU / malformed pods never enter
+    feed._apply_watch_event("ADDED", _pending_pod("b", bound=True))
+    feed._apply_watch_event("ADDED", _pending_pod("done",
+                                                  phase="Succeeded"))
+    feed._apply_watch_event("ADDED", _pending_pod("cpu", plain=True))
+    feed._apply_watch_event("ADDED", {"metadata": {}})
+    feed._apply_watch_event("DELETED", _pending_pod("p1"))
+    assert ext.cycle.queue_depth() == 1
+    # the list-resync half admits pending pods too
+    assert feed.check_once() is True
+    assert ext.cycle.queue_depth() == 2
+    assert feed.admitted == 3
+
+
+def test_pod_admission_feed_is_noop_without_batching():
+    from tpukube.apiserver import PodAdmissionFeed
+    from tpukube.sched.extender import Extender
+
+    ext = Extender(_cfg(tenancy=False, batch=False))
+    feed = PodAdmissionFeed(ext, SimpleNamespace(), use_watch=False)
+    feed._apply_watch_event("ADDED", _pending_pod("p1"))
+    assert ext.cycle is None  # nothing to enqueue into, nothing broke
+
+
+def test_informer_fed_pods_plan_and_bind_end_to_end():
+    """Regression for the ROADMAP follow-up: a pod arriving through the
+    informer feed (no /filter webhook) is planned by the next cycle and
+    its /bind consumes the assumed allocation."""
+    from tpukube.apiserver import PodAdmissionFeed
+
+    with SimCluster(_cfg(tenancy=False, batch=True),
+                    in_process=True) as c:
+        ext = c.extender
+        c._sync_nodes()
+        pod_obj = c.make_pod("fed", tpu=1)
+        feed = PodAdmissionFeed(
+            ext, SimpleNamespace(list_pods=lambda node=None: []),
+            use_watch=False,
+        )
+        feed._apply_watch_event("ADDED", pod_obj)
+        assert ext.cycle.queue_depth() == 1
+        assert ext.plan_pending() == 1
+        node = ext.planned_node("default/fed")
+        assert node is not None
+        bres = c._post("/bind", {
+            "PodName": "fed", "PodNamespace": "default",
+            "PodUID": pod_obj["metadata"]["uid"], "Node": node,
+        })
+        assert not bres.get("Error")
+        assert ext.state.allocation("default/fed") is not None
+
+
+def test_informer_redelivery_never_replans_an_assumed_allocation():
+    """Regression: a MODIFIED event (or list resync) for a pod whose
+    batch plan already ASSUMED an allocation must not re-enqueue it —
+    a replan would double-commit its chips and orphan the original
+    allocation from the plan table."""
+    from tpukube.apiserver import PodAdmissionFeed
+
+    with SimCluster(_cfg(tenancy=False, batch=True),
+                    in_process=True) as c:
+        ext = c.extender
+        c._sync_nodes()
+        pod_obj = c.make_pod("redeliver", tpu=1)
+        feed = PodAdmissionFeed(
+            ext, SimpleNamespace(list_pods=lambda node=None: [pod_obj]),
+            use_watch=False,
+        )
+        feed._apply_watch_event("ADDED", pod_obj)
+        assert ext.plan_pending() == 1  # planned + assumed
+        alloc = ext.state.allocation("default/redeliver")
+        assert alloc is not None
+        # the informer re-delivers the still-pending pod
+        feed._apply_watch_event("MODIFIED", pod_obj)
+        feed.check_once()  # list resync re-delivers it too
+        assert ext.cycle.queue_depth() == 0
+        assert ext.plan_pending() == 0  # nothing replanned
+        assert ext.state.allocation("default/redeliver") is alloc
+        # the eventual /bind still consumes the one assumed allocation
+        node = ext.planned_node("default/redeliver")
+        bres = c._post("/bind", {
+            "PodName": "redeliver", "PodNamespace": "default",
+            "PodUID": pod_obj["metadata"]["uid"], "Node": node,
+        })
+        assert not bres.get("Error")
+
+
+def test_shed_pod_recovers_after_burn_subsides():
+    """Regression: a shed refusal is TIME-dependent, so it must never
+    be served from the plan cache or block re-admission — once the
+    burn window slides past the bad sample, the same pod (same uid,
+    same epochs) schedules."""
+    clock = FakeClock()
+    with SimCluster(_cfg(batch=True), clock=clock,
+                    in_process=True) as c:
+        ext = c.extender
+        c._sync_nodes()
+        # two tenants so shedding has an over-share target
+        for i in range(4):
+            c.schedule(c.make_pod(f"a-{i}", tpu=1,
+                                  labels={TENANT_LABEL: "a"}))
+        c.schedule(c.make_pod("b-0", tpu=1, labels={TENANT_LABEL: "b"}))
+        ext.gang.commit_hist.observe(5.0)  # page burn
+        clock.advance(1.0)
+        victim = c.make_pod("a-shed", tpu=1, labels={TENANT_LABEL: "a"})
+        with pytest.raises(RuntimeError, match="admission shed"):
+            c.schedule(victim)
+        # the burn subsides with NO epoch movement (nothing scheduled)
+        clock.advance(200.0)  # past two 60s windows: monitor resets
+        node, alloc = c.schedule(victim)
+        assert ext.state.allocation("default/a-shed") is not None
+        # informer path recovers too: admit() re-runs the gate instead
+        # of deduping on the stale refusal entry
+        late = c.make_pod("a-late", tpu=1, labels={TENANT_LABEL: "a"})
+        assert ext.admit(kube.pod_from_k8s(late)) is True
+
+
+# -- scenario 11 (tier-1 scale) ----------------------------------------------
+
+def test_scenario_11_tenant_serving(monkeypatch):
+    """The acceptance scenario at tier-1 scale: diurnal tenant waves +
+    chaos + the SLO-burn shed event, deterministic under the fixed
+    seed. The scenario itself raises on quota violations, unbounded
+    share spread, lost gang commits, unjournaled sheds, leaks, or
+    ledger divergence."""
+    from tpukube.sim import scenarios
+
+    monkeypatch.setenv("TPUKUBE_TENANCY_WAVES", "7")
+    monkeypatch.delenv("TPUKUBE_TENANCY_ENABLED", raising=False)
+    r = scenarios.run(11)
+    assert r["quota_violations"] == 0
+    assert r["value"] is not None and r["value"] <= 2.0
+    assert set(r["gangs_committed"]) == {"diurnal-train", "slo-probe"}
+    assert r["preemptions"] > 0
+    assert sum(r["sheds_by_tenant"].values()) > 0
+    assert (sum(r["sheds_by_tenant"].values())
+            == r["shed_events_journaled"])
+    assert (sum(r["quota_denials_by_tenant"].values())
+            == r["denial_events_journaled"] > 0)
+    assert r["leaked_reservations"] == 0
+    assert r["ledger_divergence"] == 0
+    assert r["steady_utilization_min_percent"] >= 90

@@ -435,6 +435,7 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
             AllocReconcileLoop,
             EvictionExecutor,
             NodeTopologyRefreshLoop,
+            PodAdmissionFeed,
             PodInformer,
             PodLifecycleReleaseLoop,
             pod_binder,
@@ -490,9 +491,20 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
         # (one DELETED event instead of a per-key GET poll).
         lifecycle = PodLifecycleReleaseLoop(extender, api,
                                             evictions=evictions)
-        # ONE pod stream for both pod loops: the informer lists and
-        # watches once, fanning events to lifecycle + reconcile
-        pod_informer = PodInformer(api, [lifecycle, reconcile],
+        informer_children = [lifecycle, reconcile]
+        if cfg.batch_enabled:
+            # feed the batch scheduling queue from the SAME pod stream:
+            # pending TPU pods reach the cycle planner the moment their
+            # watch event lands, instead of waiting for their /filter
+            # webhook — batching stops being sim/webhook-only
+            informer_children.append(
+                PodAdmissionFeed(extender, api,
+                                 poll_seconds=cfg.health_poll_seconds)
+            )
+        # ONE pod stream for all pod loops: the informer lists and
+        # watches once, fanning events to lifecycle + reconcile (+ the
+        # batch admission feed when batching is on)
+        pod_informer = PodInformer(api, informer_children,
                                    poll_seconds=cfg.health_poll_seconds)
         # watch-stream reconnects land in the event journal: frequent
         # WatchReconnected events mean DELETED events are being missed
@@ -557,7 +569,7 @@ def main_sim(argv: Optional[list[str]] = None) -> int:
         "tpukube-sim",
         "run a BASELINE config scenario against the real control-plane stack",
     )
-    p.add_argument("scenario", type=int, choices=range(1, 11),
+    p.add_argument("scenario", type=int, choices=range(1, 12),
                    help="BASELINE config number (1..5), 6 = the "
                         "steady-state churn benchmark (completions -> "
                         "release loop -> re-scheduling), 7 = fault "

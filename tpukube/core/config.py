@@ -120,6 +120,34 @@ class TpuKubeConfig:
     # raise it to coalesce arrival storms into fewer, bigger cycles)
     cycle_interval_seconds: float = 0.0
 
+    # Multi-tenant serving plane (tpukube/tenancy, ISSUE 9). With
+    # tenancy_enabled the extender attaches a TenantPlane: tenant ids
+    # from the tenancy_label pod label (unlabeled pods belong to
+    # tenancy_default_tenant), per-tenant quota enforcement at
+    # admission, DRF ordering of the batch scheduling queue,
+    # tenant-aware preemption victim bias, and SLO-burn shedding of
+    # low-priority bursts. false (the default) constructs NOTHING —
+    # placements, /metrics exposition, and alloc annotations stay
+    # byte-identical to the pre-tenancy behavior.
+    tenancy_enabled: bool = False
+    tenancy_label: str = "tpu.qiniu.com/tenant"
+    tenancy_default_tenant: str = "default"
+    # per-tenant caps: "teamA=chips:16,hbm:0.25;teamB=chips:8" —
+    # chips are whole-chip equivalents (vTPU shares count 1/n), hbm a
+    # fraction of total cluster HBM. Empty = no quotas (fairness and
+    # shedding still apply).
+    tenancy_quotas: str = ""
+    # SLO-aware admission: while any DEFAULT_SLOS burn rate over the
+    # sliding window reaches this page threshold (obs/slo.py
+    # MULTIWINDOW_ALERTS' page burn), low-priority non-gang admissions
+    # from over-share tenants are shed with a TenantAdmissionShed
+    # journal event. 0 disables shedding (quotas still enforce).
+    tenancy_burn_threshold: float = 14.4
+    tenancy_burn_window_seconds: float = 60.0
+    # only pods at or below this priority are ever shed (burst-infer
+    # traffic; committed training gangs are never shed)
+    tenancy_shed_priority_max: int = 0
+
     # Which ICI slice this node belongs to (multi-slice clusters name
     # their pod slices; coords are slice-local — SURVEY.md §3 ICI/DCN note)
     slice_id: str = "slice-0"
@@ -268,6 +296,31 @@ def load_config(
         )
     if cfg.batch_max_pods < 1:
         raise ValueError("batch_max_pods must be >= 1")
+    if cfg.tenancy_quotas and not cfg.tenancy_enabled:
+        # quotas without the plane would be silently unenforced — an
+        # operator who wrote caps believes they are live; fail loudly
+        raise ValueError(
+            "tenancy_quotas is set but tenancy_enabled is false — "
+            "enable tenancy or drop the quotas"
+        )
+    if cfg.tenancy_enabled:
+        if not cfg.tenancy_label or not cfg.tenancy_default_tenant:
+            raise ValueError(
+                "tenancy_label and tenancy_default_tenant must be "
+                "non-empty"
+            )
+        # surface quota-spec mistakes at config load, not at the first
+        # webhook (lazy import: tenancy is only pulled in when used;
+        # parse_quotas raises ValueError with the offending fragment)
+        from tpukube.tenancy import parse_quotas
+
+        parse_quotas(cfg.tenancy_quotas)
+    if cfg.tenancy_burn_threshold < 0:
+        raise ValueError(
+            "tenancy_burn_threshold must be >= 0 (0 = no SLO shedding)"
+        )
+    if cfg.tenancy_burn_window_seconds <= 0:
+        raise ValueError("tenancy_burn_window_seconds must be positive")
     if cfg.cycle_interval_seconds < 0:
         raise ValueError(
             "cycle_interval_seconds must be >= 0 (0 = plan on demand)"

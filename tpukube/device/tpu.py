@@ -56,6 +56,14 @@ ENV_KUBE_SLICE = "TPU_KUBE_SLICE_ID"  # ICI domain (multi-slice clusters)
 ENV_GANG_NUM_SLICES = "TPU_KUBE_GANG_NUM_SLICES"
 ENV_GANG_SLICES = "TPU_KUBE_GANG_SLICES"
 ENV_GANG_SLICE_INDEX = "TPU_KUBE_GANG_SLICE_INDEX"
+# Tenant identity for the multi-tenant serving plane (tpukube/tenancy).
+# PRODUCED by the extender in the alloc annotation when tenancy is on
+# (like the gang env: the device plugin's Allocate sees only device
+# ids, so tenant attribution must ride the annotation); consumed by
+# the TenantLedger for restart-survivable per-tenant fractional
+# accounting and by tpukube.workload.meshenv so the in-pod runtime
+# knows whose HBM quota its XLA_PYTHON_CLIENT_MEM_FRACTION enforces.
+ENV_KUBE_TENANT = "TPU_KUBE_TENANT"
 ENV_HBM_LIMIT = "TPU_HBM_LIMIT_BYTES"
 ENV_MEM_FRACTION = "XLA_PYTHON_CLIENT_MEM_FRACTION"
 # vTPU TensorCore partition (BASELINE: "partitions TPU HBM and TensorCores"):

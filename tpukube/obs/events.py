@@ -17,7 +17,8 @@ reason fails lint instead of silently fragmenting the journal):
   PreemptionPlanned, PreemptionExecuted, VictimEvicted, VictimGone,
   ChipUnhealthy, ChipRecovered, LinkFault, LinkRecovered,
   WatchReconnected, AllocDiverged, KubeletReregistered, BindFailed,
-  CircuitOpen, CircuitClosed, RetryExhausted, DegradedMode
+  CircuitOpen, CircuitClosed, RetryExhausted, DegradedMode,
+  TenantQuotaDenied, TenantAdmissionShed
 
 Dedup follows the K8s model: an event with the same (reason, object,
 message) as a live ring entry bumps that entry's ``count`` and
@@ -62,6 +63,8 @@ REASONS: tuple[str, ...] = (
     "PreemptionExecuted",
     "PreemptionPlanned",
     "RetryExhausted",
+    "TenantAdmissionShed",
+    "TenantQuotaDenied",
     "VictimEvicted",
     "VictimGone",
     "WatchReconnected",

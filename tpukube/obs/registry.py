@@ -81,6 +81,18 @@ DECLARED_SERIES: frozenset[str] = frozenset({
     "tpukube_cycle_batch_size",
     "tpukube_cycle_wall_seconds",
     "tpukube_cycle_queue_depth",
+    # extender: multi-tenant serving plane (tpukube/tenancy; series
+    # render only when tenancy_enabled built a TenantPlane — legacy
+    # exposition stays byte-identical with tenancy off)
+    "tpukube_tenant_chips_used",
+    "tpukube_tenant_hbm_used_bytes",
+    "tpukube_tenant_dominant_share",
+    "tpukube_tenant_quota_chips",
+    "tpukube_tenant_quota_hbm_fraction",
+    "tpukube_tenant_sheds_total",
+    "tpukube_tenant_quota_denials_total",
+    "tpukube_tenancy_burn_rate",
+    "tpukube_tenancy_shedding",
     # both daemons (unified retry/circuit layer, core/retry.py; series
     # render only where a Retrier/CircuitBreaker is actually wired)
     "tpukube_retry_attempts_total",

@@ -156,6 +156,12 @@ def extender_statusz(
         "cycle": (extender.cycle.stats()
                   if getattr(extender, "cycle", None) is not None
                   else {"enabled": False}),
+        # multi-tenant serving plane (tpukube/tenancy): per-tenant
+        # usage/share/quota, shed and denial counters, and the SLO
+        # burn monitor feeding the shedding decision
+        "tenants": (extender.tenants.stats()
+                    if getattr(extender, "tenants", None) is not None
+                    else {"enabled": False}),
     }
     events = getattr(extender, "events", None)
     if events is not None:

@@ -226,6 +226,23 @@ class SchedulingCycle:
         self._seq += 1
         self._queue[key] = (pod, self._seq, names)
 
+    def offer(self, pod: PodInfo) -> bool:
+        """enqueue() unless the pod already has a LIVE plan — the
+        informer feed re-delivers pending pods (MODIFIED events, every
+        list resync), and replanning an ASSUMED allocation would commit
+        its chips twice: the replan's commit fails, the fresh (broken)
+        entry overwrites the assumed one in ``_plans``, and the
+        original allocation is orphaned until the pod object dies.
+        (Error entries are never live — _entry_current — so a shed or
+        unschedulable pod re-enters the queue and re-runs the gate.)
+        Invalidation on a genuinely changed pod belongs to
+        filter_response, which undoes the assume first. Returns True
+        when the pod actually entered the queue."""
+        if self.plan_is_live(pod):
+            return False
+        self.enqueue(pod)
+        return True
+
     def queue_depth(self) -> int:
         return len(self._queue)
 
@@ -238,13 +255,28 @@ class SchedulingCycle:
     def _entry_current(self, entry: PodPlan) -> bool:
         """An ASSUMED entry stays servable regardless of later epochs —
         its allocation is committed, and the answer IS that commitment
-        (re-planning would double-commit). A non-assumed entry (failed,
-        unschedulable, deferred) is a cached computation over a state
-        that may have moved: servable only while the epochs stand
-        still, exactly as the re-computing legacy path behaves."""
+        (re-planning would double-commit). A FILTER-ERROR answer is
+        never served from cache: refusals may be time-dependent (the
+        tenancy gate's SLO-burn shed subsides with no epoch moving),
+        so each retry must re-run the gate — exactly what the
+        recomputing legacy path did per webhook. Any other non-assumed
+        entry (unschedulable node set, deferred preemption, a planned
+        bind error — which take_for_bind consumes, so it cannot loop)
+        is a cached pure function of cluster state: servable only
+        while the epochs stand still."""
         if entry.assumed:
             return True
+        if entry.error is not None:
+            return False
         return entry.epoch_key == self._ext.snapshots.epoch_key()
+
+    def plan_is_live(self, pod: PodInfo) -> bool:
+        """True while this pod holds a servable plan (Extender.admit's
+        informer-re-delivery dedup runs this BEFORE the tenancy gate,
+        so an already-planned pod never journals a phantom refusal)."""
+        entry = self._plans.get(pod.key())
+        return (entry is not None and entry.uid == pod.uid
+                and self._entry_current(entry))
 
     # -- webhook answers -----------------------------------------------------
     def filter_response(
@@ -403,18 +435,25 @@ class SchedulingCycle:
                 or now - self._last_drain >= self._interval)
         batch: list[tuple[PodInfo, int, Optional[tuple[str, ...]]]] = []
         if full:
-            order = sorted(
-                self._queue.values(),
-                key=lambda e: (
-                    -e[0].priority,
-                    # gang-aware: members of one gang plan adjacently
-                    # (their reservation assembles within one cycle),
-                    # gangs ahead of strays within a priority band
-                    (0, e[0].group.name) if e[0].group is not None
-                    else (1, ""),
-                    e[1],
-                ),
-            )
+            if ext.tenants is not None:
+                # multi-tenant plane: priority bands first (unchanged),
+                # then progressive dominant-resource fairness within
+                # each band — a neutral plane (one tenant) reproduces
+                # the legacy order exactly (parity-tested)
+                order = ext.tenants.drf_order(list(self._queue.values()))
+            else:
+                order = sorted(
+                    self._queue.values(),
+                    key=lambda e: (
+                        -e[0].priority,
+                        # gang-aware: members of one gang plan adjacently
+                        # (their reservation assembles within one cycle),
+                        # gangs ahead of strays within a priority band
+                        (0, e[0].group.name) if e[0].group is not None
+                        else (1, ""),
+                        e[1],
+                    ),
+                )
             batch = order[: self._max_pods]
             self._last_drain = now
         if must_plan is not None and must_plan in self._queue and not any(
@@ -642,6 +681,14 @@ class SchedulingCycle:
 
         ext = self._ext
         entry = PodPlan(pod, tuple(names), ext.clock.monotonic(), seq)
+        if ext.tenants is not None:
+            # the same tenancy admission gate the general path hits
+            # inside ext.filter — the fast path answers webhooks too,
+            # so a quota breach or SLO shed must refuse identically
+            refusal = ext.tenants.admit(pod, RESOURCE_TPU, 1)
+            if refusal is not None:
+                entry.error = refusal
+                return entry
         ext._remember(pod)
         overlays: dict[str, _SliceOverlay] = fs["overlays"]
         node_slice: dict[str, str] = fs["node_slice"]
@@ -715,6 +762,13 @@ class SchedulingCycle:
                 f"{RESOURCE_TPU}"
             )
             return entry
+        env: dict[str, str] = {}
+        if ext.tenants is not None:
+            from tpukube.device.tpu import ENV_KUBE_TENANT
+
+            # same tenant attribution the legacy bind writes — the
+            # assumed allocation's annotation must match it exactly
+            env[ENV_KUBE_TENANT] = ext.tenants.tenant_of(pod)
         try:
             did = make_device_id(view.index_at(coord))
             alloc = AllocResult(
@@ -722,7 +776,7 @@ class SchedulingCycle:
                 node_name=best_node,
                 device_ids=[did],
                 coords=[coord],
-                env={},
+                env=env,
                 priority=pod.priority,
                 uid=pod.uid or "",
             )

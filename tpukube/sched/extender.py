@@ -166,6 +166,31 @@ class Extender:
         # run (under the decision lock): their filter/prioritize/bind
         # invocations are not webhooks and must not feed the histograms
         self._suppress_latency = False
+        # Multi-tenant serving plane (tpukube/tenancy, ISSUE 9): with
+        # tenancy_enabled the plane gates admissions (quotas + SLO-burn
+        # shedding), orders the batch queue by dominant-resource
+        # fairness, and biases preemption victim choice toward
+        # over-share tenants. None (the config default) constructs
+        # nothing — every placement path and the /metrics exposition
+        # stay byte-identical to the pre-tenancy behavior.
+        self.tenants = None
+        if config.tenancy_enabled:
+            from tpukube.tenancy import TenantPlane
+
+            self.tenants = TenantPlane(
+                config, self.state, self.gang, events=self.events,
+                clock=self.clock,
+            )
+            # SLO-aware admission reads the DEFAULT_SLOS burn straight
+            # off the daemon's own cumulative histograms — the same
+            # objectives deploy/prometheus-rules.yaml alerts on
+            self.tenants.burn.attach_default_slos({
+                "gang_schedule_latency_seconds": self.gang.commit_hist,
+                "tpukube_webhook_latency_seconds": self.webhook_hist,
+            })
+            # gang reservations carry their tenant so reserved-but-
+            # unbound chips are charged to the right owner
+            self.gang.tenant_of = self.tenants.tenant_of
         self.preemptions = 0   # victims evicted for higher-priority gangs
         self.binds_total = 0   # successful binds (metrics counter)
         # The bind EFFECTOR: with bindVerb configured, kube-scheduler
@@ -297,6 +322,15 @@ class Extender:
             by_name = (dict(zip(names, raw_nodes))
                        if raw_nodes is not None else None)
             resource, count = ask
+            if self.tenants is not None:
+                # tenancy admission gate: quota breaches and SLO-burn
+                # sheds refuse BEFORE any reservation or preemption
+                # plan exists — the refusal (journaled by the plane)
+                # rides back as the filter error and the scheduler's
+                # requeue turns it into a deferral
+                refusal = self.tenants.admit(pod, resource, count)
+                if refusal is not None:
+                    raise ExtenderError(refusal)
             self._remember(pod)
             res: Optional[GangReservation] = None
             if pod.group is not None:
@@ -395,6 +429,12 @@ class Extender:
                     f"gang needs {total} — refusing to preempt for it"
                 )
         workloads = self._preemption_workloads()
+        # tenant-aware victim bias (tpukube/tenancy): at equal priority
+        # cost the planner prefers boxes whose victims belong to the
+        # most over-entitlement tenants; None with tenancy off leaves
+        # the legacy ranking bit-identical
+        overshare = (self.tenants.overshare_map()
+                     if self.tenants is not None else None)
         plan = None
         plan_slice = None
         best_rank = None
@@ -413,6 +453,7 @@ class Extender:
                 pod.group.shape,
                 pod.priority,
                 broken=ss.broken,
+                overshare=overshare,
             )
             if cand is None:
                 continue
@@ -422,7 +463,8 @@ class Extender:
         if plan is None:
             if pod.group.allow_dcn and pod.group.shape is None:
                 split = self._plan_split_preemption(
-                    workloads, total, count, pod.priority
+                    workloads, total, count, pod.priority,
+                    overshare=overshare,
                 )
                 if split is not None:
                     victims = [w for p in split.values() for w in p.victims]
@@ -612,6 +654,7 @@ class Extender:
     def _plan_split_preemption(
         self, workloads: list[policy.Workload], total: int,
         chips_per_pod: int, priority: int,
+        overshare: Optional[dict[str, float]] = None,
     ) -> Optional[dict[str, policy.PreemptionPlan]]:
         """Preemption for a DCN-split gang: one cost-optimal box per slice
         (greedy over slices by free capacity, largest feasible volume
@@ -643,7 +686,7 @@ class Extender:
             while vol >= chips_per_pod:
                 cand = policy.find_preemption_plan(
                     in_slice, mesh, unhealthy, vol, None, priority,
-                    broken=broken,
+                    broken=broken, overshare=overshare,
                 )
                 if cand is not None:
                     parts[sid] = cand
@@ -686,6 +729,8 @@ class Extender:
                     pod_keys=tuple(members),
                     gang_key=res.key,
                     slice_id=sid,
+                    tenant=(res.tenant or self.tenants.default
+                            if self.tenants is not None else ""),
                 ))
         for alloc in self.state.allocations():
             if alloc.pod_key in gang_pods:
@@ -705,6 +750,8 @@ class Extender:
                 coords=frozenset(TopologyCoord.of(c) for c in alloc.coords),
                 pod_keys=(alloc.pod_key,),
                 slice_id=sid,
+                tenant=(self.tenants.tenant_of_alloc(alloc)
+                        if self.tenants is not None else ""),
             ))
         return out
 
@@ -1010,6 +1057,13 @@ class Extender:
                 # by a non-victim) never costs the victims their chips
                 self._execute_pending_preemption(res, view, device_ids)
             env: dict[str, str] = {}
+            if self.tenants is not None:
+                from tpukube.device.tpu import ENV_KUBE_TENANT
+
+                # tenant attribution rides the alloc annotation so the
+                # TenantLedger (and a restarted extender's rebuild)
+                # charge the right owner
+                env[ENV_KUBE_TENANT] = self.tenants.tenant_of(pod)
             if res is not None:
                 # gang context for the in-pod runtime (rides the alloc
                 # annotation / downward API — the device plugin's Allocate
@@ -1090,13 +1144,39 @@ class Extender:
         return ids
 
     # -- batch-driver hooks (sched/cycle.py; sim driver + pod informer) -----
-    def admit(self, pod: PodInfo) -> None:
+    def admit(self, pod: PodInfo) -> bool:
         """Admit a pending pod into the scheduling queue ahead of its
         /filter webhook (pod-informer feed / sim batch driver). No-op
-        without batching — the webhook path needs no pre-admission."""
-        if self.cycle is not None:
-            with self._decision_lock:
-                self.cycle.enqueue(pod)
+        without batching — the webhook path needs no pre-admission.
+        Returns True when the pod actually entered the queue (False:
+        batching off, tenancy refusal, or a live plan already exists —
+        informer re-deliveries must not replan an assumed allocation).
+
+        With the tenancy plane on, the admission gate runs HERE too —
+        at enqueue time, against pre-drain usage — so a shed burst
+        never even enters the queue (the plan-time gate inside the
+        planning arms stays authoritative for quota races within a
+        drain)."""
+        if self.cycle is None:
+            return False
+        with self._decision_lock:
+            if self.cycle.plan_is_live(pod):
+                # informer re-delivery of an already-planned pod: no
+                # re-enqueue, and — checked FIRST — no tenancy gate
+                # run, which would journal a phantom refusal against a
+                # pod whose own assumed usage already fills its quota
+                return False
+            if self.tenants is not None:
+                try:
+                    ask = self.device_request(pod)
+                except ExtenderError:
+                    ask = None  # planning reports the schema error
+                if ask is not None and self.tenants.admit(
+                    pod, ask[0], ask[1]
+                ) is not None:
+                    return False  # refused and journaled; not enqueued
+            self.cycle.enqueue(pod)
+            return True
 
     def plan_pending(self) -> int:
         """Drive batch cycles until the admitted queue drains; returns

@@ -72,6 +72,9 @@ class GangReservation:
     slice_coords: dict[str, set[TopologyCoord]]
     chips_per_pod: int
     priority: int = 0  # the reserving pods' priority (preemption blocking)
+    # serving-plane tenant the reservation's chips are accounted to
+    # ("" when tenancy is off — the TenantLedger never reads it then)
+    tenant: str = ""
     created: float = field(default_factory=time.monotonic)
     # pod_key -> (slice id, that member's chips)
     assigned: dict[str, tuple[str, list[TopologyCoord]]] = field(
@@ -197,6 +200,10 @@ class GangManager:
         self._terminating_coords: dict[
             str, tuple[str, frozenset[TopologyCoord]]
         ] = {}
+        # tenant resolver (pod -> tenant id), wired by the Extender
+        # when the multi-tenant serving plane is on; None (the
+        # default) stamps reservations with the empty tenant
+        self.tenant_of = None
         # reservation epoch: bumped by every mutation of reservations,
         # assignments, or the terminating masks — the gang half of the
         # scheduling-snapshot cache key (sched/snapshot.py). A mutation
@@ -213,6 +220,17 @@ class GangManager:
         """Monotonic mutation counter (the snapshot cache's key half)."""
         with self._lock:
             return self._epoch
+
+    def _tenant_for(self, pod: PodInfo) -> str:
+        """The reservation's tenant stamp; "" without a serving plane.
+        A broken resolver must never fail a reservation."""
+        if self.tenant_of is None:
+            return ""
+        try:
+            return self.tenant_of(pod)
+        except Exception:
+            log.exception("tenant resolver failed for %s", pod.key())
+            return ""
 
     def _emit(self, reason: str, res_key: tuple[str, str], message: str,
               warning: bool = False) -> None:
@@ -431,6 +449,7 @@ class GangManager:
                 slice_coords=slice_coords,
                 chips_per_pod=chips_per_pod,
                 priority=pod.priority,
+                tenant=self._tenant_for(pod),
                 created=self._clock.monotonic(),
             )
             self._reservations[key] = res
@@ -606,12 +625,20 @@ class GangManager:
                         return None
                     coords = coords_or_none
                 slice_coords = {slice_id: coords}
+            from tpukube.device.tpu import ENV_KUBE_TENANT
+
             res = GangReservation(
                 group=group,
                 namespace=namespace,
                 slice_coords=slice_coords,
                 chips_per_pod=chips_per_pod,
                 priority=max(a.priority for a in allocs),
+                # tenant attribution survives the restart through the
+                # members' alloc-annotation env, like the chips do
+                tenant=next(
+                    (a.env.get(ENV_KUBE_TENANT) for a in allocs
+                     if a.env.get(ENV_KUBE_TENANT)), "",
+                ),
                 created=self._clock.monotonic(),
             )
             for a in allocs:
@@ -753,6 +780,7 @@ class GangManager:
                 slice_coords={s: set(cs) for s, cs in parts.items()},
                 chips_per_pod=chips_per_pod,
                 priority=pod.priority,
+                tenant=self._tenant_for(pod),
                 created=self._clock.monotonic(),
                 pending_victims=(
                     list(pending_victims) if pending_victims else None

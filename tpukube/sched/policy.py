@@ -52,6 +52,7 @@ class Workload:
     pod_keys: tuple[str, ...] = ()
     gang_key: Optional[tuple[str, str]] = None
     slice_id: str = DEFAULT_SLICE  # the ICI domain the chips live in
+    tenant: str = ""  # serving-plane owner ("" when tenancy is off)
 
 
 @dataclass(frozen=True)
@@ -70,11 +71,19 @@ def find_preemption_plan(
     shape: Optional[tuple[int, int, int]],
     preemptor_priority: int,
     broken: Optional[set] = None,
+    overshare: Optional[dict[str, float]] = None,
 ) -> Optional[PreemptionPlan]:
     """Cheapest victim set whose eviction opens a contiguous `total`-chip
     box (or the exact `shape`). None when no eligible box exists. Boxes
     spanning a downed ICI link are never candidates — evicting pods cannot
-    repair a link, so such a box would be a degraded slice."""
+    repair a link, so such a box would be a degraded slice.
+
+    ``overshare`` (the tenancy plane's tenant -> over-entitlement map)
+    biases victim choice: at equal priority cost, the box whose victims
+    belong to the MOST over-share tenants wins — the lowest-share
+    preemptor takes chips back from whoever is furthest over. None (the
+    default, and every tenancy-off call) contributes a constant 0.0 to
+    the ranking, leaving the legacy order bit-identical."""
     # A chip may host several workloads (fractional vTPU co-tenants): all
     # of them must be evicted to free it, so the owner map is coord->list.
     owner: dict[TopologyCoord, list[Workload]] = {}
@@ -98,28 +107,36 @@ def find_preemption_plan(
         broken=broken,
     )
 
-    best: Optional[tuple] = None  # (key, coords, victims)
+    over = overshare or {}
+    best: Optional[tuple] = None  # (key, cost, coords, victims)
     for sb in candidates:
         coords = slicefit.box_coords(mesh, sb.box)
         victims = {
             w.id: w for c in coords for w in owner.get(c, ())
         }
         cost = sum(w.cost for w in victims.values())
+        # tenant bias: rounded once so float noise can never reorder
+        # plans; exactly 0.0 for every box when tenancy is off
+        bias = round(
+            sum(over.get(w.tenant, 0.0) for w in victims.values()), 9
+        )
         key = (
             cost,
+            -bias,  # more over-share victims = preferred at equal cost
             len(victims),
             sb.surface,
             sb.contact,  # already negated: lower = snugger
             sb.origin_key,
         )
         if best is None or key < best[0]:
-            best = (key, coords, [victims[i] for i in sorted(victims)])
+            best = (key, cost, coords,
+                    [victims[i] for i in sorted(victims)])
     if best is None:
         return None
-    key, coords, victims = best
+    _, cost, coords, victims = best
     return PreemptionPlan(
         coords=coords,
         victims=victims,
-        cost_priority_sum=key[0],
-        victim_count=key[1],
+        cost_priority_sum=cost,
+        victim_count=len(victims),
     )

@@ -471,6 +471,7 @@ class SimCluster:
         namespace: str = "default",
         priority: int = 0,
         group: Optional[PodGroup] = None,
+        labels: Optional[dict[str, str]] = None,
     ) -> dict[str, Any]:
         requests: dict[str, str] = {}
         if tpu:
@@ -486,7 +487,8 @@ class SimCluster:
                 "namespace": namespace,
                 "uid": f"uid-{namespace}-{name}",
                 "annotations": annotations,
-                "labels": {},
+                # the tenancy label rides here (tpukube/tenancy)
+                "labels": dict(labels or {}),
             },
             "spec": {
                 "priority": priority,

@@ -31,6 +31,11 @@ _PASSTHROUGH_KEYS = (
     "TPUKUBE_BATCH_ENABLED",
     "TPUKUBE_BATCH_MAX_PODS",
     "TPUKUBE_CYCLE_INTERVAL_SECONDS",
+    # tenancy (ISSUE 9): the parity suite re-runs scenarios with a
+    # NEUTRAL plane (TPUKUBE_TENANCY_ENABLED=1, no quotas) asserting
+    # bit-identical placements
+    "TPUKUBE_TENANCY_ENABLED",
+    "TPUKUBE_TENANCY_QUOTAS",
 )
 
 
@@ -67,6 +72,7 @@ def run(scenario: int, config: TpuKubeConfig | None = None) -> dict[str, Any]:
         8: apiserver_chaos,
         9: crash_recovery,
         10: kilonode_churn,
+        11: tenant_serving,
     }[scenario]
     t0 = time.perf_counter()
     result = fn(config)
@@ -450,6 +456,18 @@ def fault_telemetry(config: TpuKubeConfig | None) -> dict[str, Any]:
         }
 
 
+def scenario8_storm():
+    """Scenario 8's storm spec — ONE definition, reused verbatim by the
+    multi-tenant scenario 11 so both run the same fault mix."""
+    from tpukube.chaos import ChaosSpec
+
+    return ChaosSpec(
+        error_rate=0.12, timeout_rate=0.08, torn_rate=0.10,
+        slow_rate=0.05, slow_seconds=0.001,
+        gone_rate=0.10, drop_event_rate=0.05, dup_event_rate=0.05,
+    )
+
+
 def apiserver_chaos(config: TpuKubeConfig | None) -> dict[str, Any]:
     """Scenario 8: seeded apiserver chaos under gang + burst churn.
 
@@ -479,12 +497,7 @@ def apiserver_chaos(config: TpuKubeConfig | None) -> dict[str, Any]:
         "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
     }))
     seed = cfg.chaos_seed or 1337
-    storm = ChaosSpec(
-        error_rate=0.12, timeout_rate=0.08, torn_rate=0.10,
-        slow_rate=0.05, slow_seconds=0.001,
-        gone_rate=0.10, drop_event_rate=0.05, dup_event_rate=0.05,
-    )
-    schedule_ = FaultSchedule(seed, storm)
+    schedule_ = FaultSchedule(seed, scenario8_storm())
 
     with ChaosSimCluster(cfg, schedule_) as c:
 
@@ -746,6 +759,333 @@ def kilonode_churn(config: TpuKubeConfig | None) -> dict[str, Any]:
         if problems:
             raise RuntimeError("scenario 10 invariants violated: "
                                + "; ".join(problems[:5]))
+        return result
+
+
+def _complete_quiet(c: SimCluster, name: str) -> None:
+    """complete_pod whose lifecycle step may hit an injected apiserver
+    fault — the release is deferred, and converge() (the real daemons'
+    retrying poll loops) picks it up next lap."""
+    try:
+        c.complete_pod(name)
+    except RuntimeError:
+        pass
+
+
+def tenant_serving(config: TpuKubeConfig | None) -> dict[str, Any]:
+    """Scenario 11 (ISSUE 9): the multi-tenant serving plane under
+    chaos — diurnal burst-infer waves from four synthetic tenants over
+    a shared mesh while a committed training gang holds half of it, on
+    the fake clock, with scenario 8's fault schedule reused verbatim.
+
+    Shape: an 8x8x2 mesh (128 chips); tenant ``trainer`` commits a
+    64-member gang; four burst tenants (``team-0..3``, 18-chip quotas)
+    offer phase-shifted sinusoidal demand every simulated hour, far
+    above the 64-chip burst plane — the DRF queue order must equalize
+    their dominant shares. Mid-run a small priority-50 gang preempts
+    its way in (tenant-aware victim choice) and deliberately commits
+    slowly, burning the gang-schedule SLO past the page threshold —
+    the admission controller then sheds over-share tenants' bursts
+    with TenantAdmissionShed journal events.
+
+    Raises on any violation: a tenant over quota at any wave, a
+    steady-state max/min dominant-share ratio above 2.0, the training
+    gang losing its commit, a shed or denial that is not journaled,
+    leaked reservations, or ledger divergence.
+    ``TPUKUBE_TENANCY_WAVES`` scales the trace (default 8)."""
+    import math
+    import os
+
+    from tpukube.chaos import (
+        ChaosSimCluster,
+        FaultSchedule,
+        converge,
+        leaked_reservations,
+        ledger_divergence,
+    )
+    from tpukube.core.clock import FakeClock
+    from tpukube.sched import kube
+
+    teams = [f"team-{i}" for i in range(4)]
+    cfg = config or load_config(env=_env({
+        "TPUKUBE_SIM_MESH_DIMS": "8,8,2",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        "TPUKUBE_BATCH_ENABLED": "1",
+        "TPUKUBE_TENANCY_ENABLED": "1",
+        "TPUKUBE_TENANCY_QUOTAS": "trainer=chips:72;" + ";".join(
+            f"{t}=chips:18,hbm:0.2" for t in teams
+        ),
+        # burn windows ride the fake clock: hourly waves need a
+        # window wide enough that a wave gap is not an "idle reset"
+        # (BurnMonitor resets past two windows of silence)
+        "TPUKUBE_TENANCY_BURN_WINDOW_SECONDS": "3600",
+    }))
+    waves = int(os.environ.get("TPUKUBE_TENANCY_WAVES", "8"))
+    steady = [w for w in (2, 3, 4) if w < waves]
+    burn_wave = 5  # the slow-commit SLO event
+    seed = cfg.chaos_seed or 1337
+    schedule_ = FaultSchedule(seed, scenario8_storm())
+    clock = FakeClock()
+    label = cfg.tenancy_label
+
+    def demand(team_idx: int, hour: int) -> int:
+        """Diurnal offered load: phase-shifted sine, 12..28 pods/hour —
+        always above any achievable share, so every tenant stays
+        backlogged and DRF fairness is actually load-bearing."""
+        return round(20 + 8 * math.sin(
+            2 * math.pi * (hour + 6 * team_idx) / 24.0
+        ))
+
+    with ChaosSimCluster(cfg, schedule_, clock=clock,
+                         in_process=True) as c:
+        ext = c.extender
+        plane = ext.tenants
+        assert plane is not None
+
+        def robust(pod, deadline_rounds: int = 40):
+            """schedule() with the requeue loop a real scheduler
+            provides; each lap steps the effectors (eviction drain,
+            lifecycle) so preemption/termination gates make progress
+            under chaos, and degraded-mode refusals wait out the
+            circuit's (wall-clock) reset window exactly as scenario 8
+            does."""
+            last = None
+            for _ in range(deadline_rounds):
+                try:
+                    return c.schedule(pod)
+                except RuntimeError as e:
+                    last = e
+                    if "degraded mode" in str(e):
+                        time.sleep(c.CIRCUIT_RESET_S)
+                    converge(c, rounds=3)
+            raise RuntimeError(f"pod never scheduled: {last}")
+
+        # the trained gang: half the mesh, committed before traffic
+        train_group = PodGroup("diurnal-train", min_member=64)
+        for i in range(64):
+            robust(c.make_pod(
+                f"dt-{i}", tpu=1, priority=100, group=train_group,
+                labels={label: "trainer"},
+            ))
+
+        def committed(name: str) -> bool:
+            return any(g["committed"] for g in ext.gang_snapshot()
+                       if g["group"] == name)
+
+        def drive(pods) -> list[str]:
+            """Batch-drive one wave with the requeue semantics a real
+            scheduler provides: admit (the enqueue-time gate may shed),
+            plan (DRF order + plan-time gates), bind planned pods;
+            chaos bind casualties and degraded-mode refusals requeue
+            for another round (waiting out the circuit's wall-clock
+            reset). Pods still unplaced after the rounds are abandoned
+            — their objects leave the store and the lifecycle resync
+            (converge) releases any assumed allocation they held.
+            Returns placed pod names."""
+            remaining = list(pods)
+            placed: list[str] = []
+            for _ in range(8):
+                if not remaining:
+                    break
+                c._sync_nodes()
+                try:
+                    c.drain_evictions()
+                except RuntimeError:
+                    pass  # injected fault; converge retries below
+                for obj in remaining:
+                    ext.admit(kube.pod_from_k8s(obj))
+                ext.plan_pending()
+                still = []
+                for obj in remaining:
+                    meta = obj["metadata"]
+                    key = f"{meta['namespace']}/{meta['name']}"
+                    node = ext.planned_node(key)
+                    if node is None:
+                        still.append(obj)  # shed/denied/capacity
+                        continue
+                    bres = c._post("/bind", {
+                        "PodName": meta["name"],
+                        "PodNamespace": meta["namespace"],
+                        "PodUID": meta["uid"],
+                        "Node": node,
+                    })
+                    if bres.get("Error"):
+                        if "degraded mode" in bres["Error"]:
+                            time.sleep(c.CIRCUIT_RESET_S)
+                        still.append(obj)  # requeue next round
+                        continue
+                    meta.setdefault("annotations", {}).update(
+                        bres.get("Annotations", {})
+                    )
+                    obj["spec"]["nodeName"] = node
+                    placed.append(meta["name"])
+                remaining = still
+                converge(c, rounds=3)
+            for obj in remaining:
+                meta = obj["metadata"]
+                c.pods.pop(f"{meta['namespace']}/{meta['name']}", None)
+            converge(c, rounds=3)
+            return placed
+
+        def team_chips() -> dict[str, float]:
+            snap = plane.ledger.usage()
+            return {t: (snap.usage[t].chips if t in snap.usage else 0.0)
+                    for t in teams}
+
+        alive: list[tuple[str, str]] = []  # (team, pod name), placement order
+        seq = 0
+        violations: list[str] = []
+        ratio_samples: list[float] = []
+        util_samples: list[float] = []
+        pods_placed = 0
+        for wave in range(waves):
+            if wave == burn_wave:
+                # the SLO event: a small priority-50 gang preempts its
+                # way into the full mesh (tenant-aware victim choice)
+                # and commits SLOWLY — 3 simulated seconds from
+                # reservation to quorum blows the 2.5s gang SLO and
+                # burns the budget at page rate
+                probe_group = PodGroup("slo-probe", min_member=8)
+                for i in range(7):
+                    robust(c.make_pod(
+                        f"sp-{i}", tpu=1, priority=50, group=probe_group,
+                        labels={label: "trainer"},
+                    ))
+                c.advance(3.0)
+                robust(c.make_pod(
+                    "sp-7", tpu=1, priority=50, group=probe_group,
+                    labels={label: "trainer"},
+                ))
+                converge(c)
+                alive = [(t, n) for t, n in alive
+                         if ext.state.allocation(f"default/{n}")
+                         is not None]
+                # skewed day's-end completions: team-1 finishes its
+                # batch entirely and team-0 almost — the remaining
+                # teams are now over the burst population's mean
+                # share, exactly who shedding must select
+                done = [(t, n) for t, n in alive if t == "team-1"]
+                t0_alive = [(t, n) for t, n in alive if t == "team-0"]
+                done += t0_alive[: max(0, len(t0_alive) - 4)]
+                for t, n in done:
+                    _complete_quiet(c, n)
+                    alive.remove((t, n))
+                converge(c)
+            elif alive:
+                # steady churn: the oldest half-plane of bursts ends
+                done, alive = alive[:32], alive[32:]
+                for _, name in done:
+                    _complete_quiet(c, name)
+                converge(c)
+
+            wave_pods = []
+            for i, team in enumerate(teams):
+                for _ in range(demand(i, wave)):
+                    wave_pods.append((team, c.make_pod(
+                        f"b{seq}", tpu=1, priority=0,
+                        labels={label: team},
+                    )))
+                    seq += 1
+            placed = set(drive([obj for _, obj in wave_pods]))
+            for team, obj in wave_pods:
+                name = obj["metadata"]["name"]
+                if name in placed:
+                    alive.append((team, name))
+                    pods_placed += 1
+
+            # wave-end invariants
+            usage = team_chips()
+            snap = plane.ledger.usage()
+            for tenant, quota in plane.quotas.items():
+                held = (snap.usage[tenant].chips
+                        if tenant in snap.usage else 0.0)
+                if quota.chips is not None and held > quota.chips + 1e-6:
+                    violations.append(
+                        f"wave {wave}: {tenant} holds {held:g} chips over "
+                        f"its {quota.chips:g} quota"
+                    )
+            if not committed("diurnal-train"):
+                violations.append(
+                    f"wave {wave}: the training gang lost its commit"
+                )
+            util_samples.append(c.utilization())
+            if wave in steady:
+                shares = [usage[t] for t in teams]
+                if min(shares) > 0:
+                    ratio_samples.append(max(shares) / min(shares))
+                else:
+                    violations.append(
+                        f"wave {wave}: a tenant was starved to zero at "
+                        f"steady state ({usage})"
+                    )
+            c.advance(3600.0)
+
+        converge(c)
+        reasons = ext.events.counts_by_reason()
+        sheds, denials = plane.counter_snapshot()
+        shed_total = sum(sheds.values())
+        denial_total = sum(denials.values())
+        leaks = leaked_reservations(c)
+        div = ledger_divergence(c)
+        stats = plane.stats()
+        result = {
+            "metric": "tenant_serving",
+            "value": round(max(ratio_samples), 4) if ratio_samples else None,
+            "unit": "max/min dominant-share ratio at steady state",
+            "waves": waves,
+            "sim_hours": round(clock.monotonic() / 3600.0, 2),
+            "faults_injected": schedule_.injected(),
+            "pods_placed": pods_placed,
+            "preemptions": ext.preemptions,
+            "quota_violations": len(violations),
+            "sheds_by_tenant": sheds,
+            "quota_denials_by_tenant": denials,
+            "shed_events_journaled": reasons.get("TenantAdmissionShed", 0),
+            "denial_events_journaled": reasons.get("TenantQuotaDenied", 0),
+            "gangs_committed": [g["group"] for g in ext.gang_snapshot()
+                                if g["committed"]],
+            "steady_utilization_min_percent": round(
+                100 * min(util_samples[w] for w in steady), 2
+            ) if steady else None,
+            "leaked_reservations": len(leaks),
+            "ledger_divergence": len(div),
+            "snapshot_audit": _audit_stats(c),
+            "tenants": stats["tenants"],
+        }
+        problems = list(violations) + [str(p) for p in leaks] + div
+        if ratio_samples and max(ratio_samples) > 2.0:
+            problems.append(
+                f"steady-state share ratio {max(ratio_samples):.3f} > 2.0"
+            )
+        if waves > burn_wave:
+            if shed_total == 0:
+                problems.append("the SLO burn shed no admissions")
+            if not committed("slo-probe"):
+                problems.append("the slo-probe gang never committed")
+            if ext.preemptions == 0:
+                problems.append("the probe gang entered without "
+                                "preemption on a full mesh")
+        if denial_total == 0:
+            problems.append("no quota denial was ever exercised")
+        if shed_total != reasons.get("TenantAdmissionShed", 0):
+            problems.append(
+                f"{shed_total} sheds but "
+                f"{reasons.get('TenantAdmissionShed', 0)} journaled — "
+                f"sheds must never be silent"
+            )
+        if denial_total != reasons.get("TenantQuotaDenied", 0):
+            problems.append(
+                f"{denial_total} denials but "
+                f"{reasons.get('TenantQuotaDenied', 0)} journaled"
+            )
+        if steady and min(util_samples[w] for w in steady) < 0.90:
+            problems.append(
+                f"steady utilization fell to "
+                f"{100 * min(util_samples[w] for w in steady):.1f}%"
+            )
+        if problems:
+            raise RuntimeError("scenario 11 invariants violated: "
+                               + "; ".join(problems[:6]))
         return result
 
 

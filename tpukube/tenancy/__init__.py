@@ -1,0 +1,20 @@
+"""Multi-tenant serving plane (ISSUE 9): per-tenant quotas, DRF
+fairness, and SLO-aware admission over fractional vTPUs."""
+
+from tpukube.tenancy.core import (
+    BurnMonitor,
+    TenantLedger,
+    TenantPlane,
+    TenantQuota,
+    TenantUsage,
+    parse_quotas,
+)
+
+__all__ = [
+    "BurnMonitor",
+    "TenantLedger",
+    "TenantPlane",
+    "TenantQuota",
+    "TenantUsage",
+    "parse_quotas",
+]

@@ -25,6 +25,7 @@ from tpukube.device.tpu import (
     ENV_KUBE_HOST,
     ENV_KUBE_MESH_DIMS,
     ENV_KUBE_SLICE,
+    ENV_KUBE_TENANT,
     ENV_VISIBLE_DEVICES,
 )
 
@@ -40,6 +41,10 @@ class PodTpuEnv:
     host: str
     hbm_limit_bytes: int
     slice_id: str = ""
+    # serving-plane tenant this allocation is accounted to ("" when the
+    # cluster runs without tenancy) — whose HBM quota the MEM_FRACTION
+    # limit enforces
+    tenant: str = ""
     # DCN-spanning gang context (multislice DP): how many ICI slices the
     # gang covers and which one this pod is in. 1/0 for single-slice gangs.
     gang_num_slices: int = 1
@@ -71,6 +76,7 @@ class PodTpuEnv:
                 host=e.get(ENV_KUBE_HOST, ""),
                 hbm_limit_bytes=int(e.get(ENV_HBM_LIMIT, "0")),
                 slice_id=e.get(ENV_KUBE_SLICE, ""),
+                tenant=e.get(ENV_KUBE_TENANT, ""),
                 gang_num_slices=int(e.get(ENV_GANG_NUM_SLICES, "1")),
                 gang_slice_index=int(e.get(ENV_GANG_SLICE_INDEX, "0")),
                 gang_slices=gang_slices,

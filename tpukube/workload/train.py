@@ -67,7 +67,8 @@ def make_optimizer(lr: float = 3e-4) -> optax.GradientTransformation:
 
 def make_train_step(cfg: LlamaConfig, mesh: Mesh,
                     opt: Optional[optax.GradientTransformation] = None,
-                    remat: bool = True, seq_parallel: bool = True):
+                    remat: bool = True, seq_parallel: bool = True,
+                    donate: Optional[bool] = None):
     """Return (step, opt_init) where step(params, opt_state, tokens) ->
     (params, opt_state, loss) is jitted over the mesh.
 
@@ -79,6 +80,14 @@ def make_train_step(cfg: LlamaConfig, mesh: Mesh,
 
     remat applies jax.checkpoint to the loss (per-layer rematerialization via
     the scan body), trading FLOPs for HBM — the standard TPU memory lever.
+
+    donate controls params/opt-state buffer donation. Default: donate on
+    every backend EXCEPT the forced-multi-device CPU platform, whose XLA
+    runtime mis-aliases donated sharded buffers on repeated step calls
+    ("Expected aliased input ... to have the same size" INTERNAL error);
+    donation buys nothing on CPU anyway (host RAM, not HBM, and the CPU
+    runtime copies defensively). On TPU the donation stays on — it is
+    the difference between fitting and OOMing at the HBM boundary.
     """
     opt = opt or make_optimizer()
     pspecs = param_specs(cfg)
@@ -127,11 +136,13 @@ def make_train_step(cfg: LlamaConfig, mesh: Mesh,
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
+    if donate is None:
+        donate = jax.default_backend() != "cpu"
     jstep = jax.jit(
         step,
         in_shardings=(param_sh, None, batch_sh),
         out_shardings=(param_sh, None, None),
-        donate_argnums=(0, 1),
+        donate_argnums=((0, 1) if donate else ()),
     )
 
     def opt_init(params):
