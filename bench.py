@@ -163,6 +163,35 @@ def sched_micro() -> dict:
         out["filter_nocache_p50_ms"] / out["filter_p50_ms"], 2)
     out["prioritize_speedup"] = round(
         out["prioritize_nocache_p50_ms"] / out["prioritize_p50_ms"], 2)
+    # ISSUE 10: the snapshot-maintenance microbench — after a mutation,
+    # advancing the cached snapshot via the O(Δ) delta path vs the
+    # forced full O(chips) rebuild (invalidate drops the base). The
+    # acceptance floor (perf_floor.json min_speedup) is >= 5x.
+    probe_host = hosts[-1]
+
+    def mutate():
+        ext.state.commit(AllocResult(
+            pod_key="default/delta-probe", node_name=probe_host,
+            device_ids=[make_device_id(0)],
+            coords=[mesh.coords_of_host(probe_host)[0]],
+        ))
+        ext.state.release("default/delta-probe")
+
+    def run_delta():
+        mutate()
+        ext.snapshots.current()
+
+    def run_forced_rebuild():
+        mutate()
+        ext.snapshots.invalidate()
+        ext.snapshots.current()
+
+    run_delta()  # warm
+    out["snapshot_delta_p50_ms"] = p50_ms(run_delta)
+    out["snapshot_rebuild_p50_ms"] = p50_ms(run_forced_rebuild)
+    out["snapshot_delta_speedup"] = round(
+        out["snapshot_rebuild_p50_ms"]
+        / max(out["snapshot_delta_p50_ms"], 1e-6), 2)
     # ISSUE 8 satellite: the same /filter webhook through the FULL
     # dispatch (handle(): parse + decision lock + trace record) both
     # in-process and over real HTTP, so the recorded numbers separate
@@ -237,6 +266,81 @@ def kilonode() -> dict:
     }
 
 
+def kilonode10k() -> dict:
+    """ISSUE 10 acceptance: the 10k-node / 40k-chip churn drive
+    (scenario 12) — throughput with the incremental snapshot + fast-
+    state maintenance, plus the delta-apply vs forced-rebuild p50s.
+    ``TPUKUBE_KILONODE10K_PODS`` scales it (default 40000; check.sh
+    smoke uses a shorter fixed trace)."""
+    from tpukube.sim import scenarios
+
+    r = scenarios.run(12)
+    return {
+        "nodes": r["nodes"],
+        "chips": r["chips"],
+        "pods_total": r["pods_total"],
+        "wall_s": r["wall_s"],
+        "pods_per_sec": r["pods_per_sec"],
+        "time_compression": r["time_compression"],
+        "webhook_p99_ms": r["webhook_p99_ms"],
+        "plan_ms_per_pod": r["cycle"]["plan_ms_per_pod"],
+        "plan_hit_ratio": r["cycle"]["plan_hit_ratio"],
+        "fast_patches": r["cycle"]["fast_patches"],
+        "fast_rebuilds": r["cycle"]["fast_rebuilds"],
+        "gang_batches": r["cycle"]["gang_batches"],
+        "snapshot": r["snapshot"],
+        "utilization_percent": r["utilization_percent"],
+    }
+
+
+def kilonode_scaling() -> dict:
+    """ISSUE 10 satellite: the node-count scaling sweep BENCH_r06
+    needed — one churn point per fleet size (256 / 1k / 4k / 10k
+    nodes), each emitting the normalized planning cost
+    (``plan_ms_per_pod``) and the snapshot-maintenance cost per cycle
+    (``snapshot_ms_per_cycle``), so the curve's bend is measured
+    instead of inferred from a single operating point."""
+    from tpukube.core.config import load_config as _load
+    from tpukube.sim import scenarios
+
+    points = [
+        ("8,8,16", 256),     # 1024 chips
+        ("16,16,16", 1024),  # 4096 chips
+        ("32,32,16", 4096),  # 16384 chips
+        ("32,32,40", 10240),  # 40960 chips
+    ]
+    out = {}
+    for dims, nodes in points:
+        chips = 1
+        for d in dims.split(","):
+            chips *= int(d)
+        max_alive = min(4096, chips // 2)
+        cfg = _load(env={
+            "TPUKUBE_SIM_MESH_DIMS": dims,
+            "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+            "TPUKUBE_BATCH_ENABLED": "1",
+            "TPUKUBE_BATCH_MAX_PODS": "2048",
+        })
+        r = scenarios._kilonode_drive(
+            cfg, metric=f"scaling_{nodes}",
+            total_target=3 * max_alive,
+            gang_size=min(256, chips // 8),
+            max_alive=max_alive, delta_stats=True,
+        )
+        out[str(nodes)] = {
+            "chips": chips,
+            "pods_total": r["pods_total"],
+            "wall_s": r["wall_s"],
+            "pods_per_sec": r["pods_per_sec"],
+            "plan_ms_per_pod": r["cycle"]["plan_ms_per_pod"],
+            "snapshot_ms_per_cycle":
+                r["snapshot"]["snapshot_ms_per_cycle"],
+            "delta_apply_p50_ms": r["snapshot"]["delta_apply_p50_ms"],
+            "rebuild_p50_ms": r["snapshot"]["rebuild_p50_ms"],
+        }
+    return out
+
+
 def run() -> dict:
     from tpukube.sim import scenarios
 
@@ -258,6 +362,8 @@ def run() -> dict:
     result["chaos"] = chaos_stats()
     result["sched_micro"] = sched_micro()
     result["kilonode"] = kilonode()
+    result["kilonode10k"] = kilonode10k()
+    result["kilonode_scaling"] = kilonode_scaling()
     return result
 
 

@@ -294,6 +294,61 @@ def test_epoch_fixture_pairs(tmp_path):
         assert good == [], f"pair {i}: clean twin flagged: {good}"
 
 
+SNAPSHOT_SLOT_VIO = '''\
+class SnapshotCache:
+    def vio(self, snap, key):
+        with self._lock:
+            if snap.key == key:
+                self._snap = snap
+                return snap
+            self._snap_gen += 1
+'''
+
+SNAPSHOT_SLOT_OK = '''\
+class SnapshotCache:
+    def ok(self, snap, key):
+        with self._lock:
+            if snap.key == key:
+                self._snap = snap
+                self._snap_gen += 1
+                return snap
+'''
+
+
+def test_snapshot_cache_slot_writes_proven(tmp_path):
+    """ISSUE 10: sched/snapshot.py owns a mutation-application seam now
+    (the delta advance writes the cached-snapshot slot), so it carries
+    the EPOCH_REGISTRY entry PR 6 promised — every ``_snap`` write must
+    pair with a ``_snap_gen`` bump before the cache mutex releases."""
+    bad = check_epochs(_sf(tmp_path, "sched/snapshot.py",
+                           SNAPSHOT_SLOT_VIO))
+    assert len(bad) == 1
+    assert "_snap_gen" in bad[0].message
+    good = check_epochs(_sf(tmp_path, "o/sched/snapshot.py",
+                            SNAPSHOT_SLOT_OK))
+    assert good == []
+
+
+def test_snapshot_cache_mutation_kill():
+    """Deleting any ``self._snap_gen += 1`` in the real snapshot.py is
+    detected — the registry provably covers every slot write."""
+    path = os.path.join(REPO, "tpukube", "sched", "snapshot.py")
+    lines = open(path).read().splitlines(keepends=True)
+    bumps = [i for i, ln in enumerate(lines)
+             if ln.strip() == "self._snap_gen += 1"]
+    assert bumps, "snapshot.py: no _snap_gen bumps found?"
+    for i in bumps:
+        mutated = list(lines)
+        indent = len(lines[i]) - len(lines[i].lstrip())
+        mutated[i] = " " * indent + "pass\n"
+        sf = base.SourceFile(path, text="".join(mutated),
+                             rel="sched/snapshot.py")
+        assert check_epochs(sf), (
+            f"sched/snapshot.py:{i + 1}: deleting this _snap_gen bump "
+            f"went UNDETECTED"
+        )
+
+
 def test_epoch_seam_via_tuple_unpacking_is_not_invisible(tmp_path):
     """`self._reservations[k], old = res, None` writes the seam exactly
     like the plain form — unpacking targets must not evade the pass."""

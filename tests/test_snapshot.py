@@ -202,10 +202,14 @@ def test_snapshot_cached_until_mutation_and_counts_hits():
     assert snap1 is snap2  # no mutation: the SAME object, not a rebuild
     r0, h0 = ext.snapshots.rebuilds, ext.snapshots.hits
     assert h0 >= 1
+    d0 = ext.snapshots.delta_applies
     ext.state.commit(_alloc("default/a", "host-0-0-0", [0, 1], mesh))
     snap3 = ext.snapshots.current()
     assert snap3 is not snap1
-    assert ext.snapshots.rebuilds == r0 + 1
+    # the epoch moved, so the snapshot advanced — via the O(Δ) delta
+    # path (ISSUE 10), not a full rebuild
+    assert ext.snapshots.delta_applies == d0 + 1
+    assert ext.snapshots.rebuilds == r0
     sid = cfg.slice_id
     assert snap3.slice(sid).occupied >= {
         TopologyCoord(0, 0, 0), TopologyCoord(1, 0, 0)}
@@ -468,10 +472,13 @@ def test_observer_lookups_do_not_inflate_hit_counters():
     extender_statusz(ext)
     assert ext.snapshots.hits == h0, "observer reads counted as hits"
     assert ext.snapshots.rebuilds == r0  # warm cache: no rebuild either
-    # after a mutation, an observer-triggered rebuild IS counted
+    # after a mutation, an observer-triggered advance IS counted (the
+    # O(Δ) delta path serves it; a rebuild only on overflow/structural)
+    d0 = ext.snapshots.delta_applies
     ext.state.commit(_alloc("default/obs", "host-1-1-0", [0], mesh))
     render_extender_metrics(ext)
-    assert ext.snapshots.rebuilds == r0 + 1
+    assert ext.snapshots.delta_applies == d0 + 1
+    assert ext.snapshots.rebuilds == r0
     assert ext.snapshots.hits == h0
     # ...and the next scheduling lookup inherits it as a hit
     ext.snapshots.current()

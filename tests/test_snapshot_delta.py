@@ -1,0 +1,499 @@
+"""Incremental snapshot maintenance (ISSUE 10): the O(Δ) delta-advance
+path must be indistinguishable from a cold ledger rebuild at EVERY
+epoch — property-tested over random mutation sequences covering every
+seam (commit / release / upsert / reserve / bind / member-release /
+rollback / dissolve / terminating / victim-gone), including the
+overflow→full-rebuild fallback, the structural-change markers, and the
+unchanged-payload no-bump case — and the whole webhook stack must place
+bit-identically with the feature on vs the rebuild-every-epoch oracle.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from tpukube.core import codec
+from tpukube.core.config import load_config
+from tpukube.core.mesh import MeshSpec
+from tpukube.core.types import (
+    RESOURCE_TPU,
+    AllocResult,
+    ChipInfo,
+    ContainerInfo,
+    Health,
+    NodeInfo,
+    PodGroup,
+    PodInfo,
+    ResourceList,
+    TopologyCoord,
+    make_device_id,
+)
+from tpukube.sched.extender import Extender
+from tpukube.sched.snapshot import SnapshotDelta, _audit_divergence
+from tpukube.sim.harness import SimCluster
+
+
+def _mini_extender(dims=(4, 4, 2), host_block=(2, 2, 1), env=None):
+    cfg = load_config(env=env or {})
+    mesh = MeshSpec(dims=dims, host_block=host_block)
+    ext = Extender(cfg)
+    for host in mesh.all_hosts():
+        chips = [
+            ChipInfo(chip_id=f"{host}-c{i}", index=i, coord=c,
+                     hbm_bytes=cfg.hbm_bytes_per_chip)
+            for i, c in enumerate(mesh.coords_of_host(host))
+        ]
+        ext.state.upsert_node(host, codec.annotate_node(
+            NodeInfo(name=host, chips=chips, slice_id=cfg.slice_id),
+            mesh))
+    return ext, mesh, cfg
+
+
+def _pod(name, tpu=1, priority=0, group=None):
+    return PodInfo(name=name, priority=priority, group=group, containers=[
+        ContainerInfo(name="main",
+                      requests=ResourceList({RESOURCE_TPU: tpu})),
+    ])
+
+
+def _assert_fresh(ext, context=""):
+    """The delta-advanced snapshot equals a cold ledger rebuild."""
+    snap = ext.snapshots.current()
+    fresh = ext.snapshots._build(snap.key)
+    diffs = _audit_divergence(snap, fresh)
+    assert diffs == [], f"{context}: delta-advanced snapshot diverged: "\
+                        f"{diffs}"
+
+
+# -- the property test: random mutation replay -------------------------------
+
+class _Driver:
+    """Random-walk mutation driver over the real state/gang seams. The
+    test tracks just enough bookkeeping to keep every op legal; the
+    snapshot comparison after each op is the property."""
+
+    def __init__(self, ext, mesh, cfg, rng):
+        self.ext, self.mesh, self.cfg, self.rng = ext, mesh, cfg, rng
+        self.sid = cfg.slice_id
+        self.live: dict[str, AllocResult] = {}
+        self.gang_n = 0
+        self.pod_n = 0
+        self.terminating: list[str] = []
+
+    def _free_chip(self):
+        occupied = self.ext.state.occupied_coords(self.sid)
+        reserved = self.ext.gang.reserved_coords(self.sid)
+        hosts = self.ext.state.hosts_by_coord(self.sid)
+        free = [c for c in hosts if c not in occupied and
+                c not in reserved]
+        return self.rng.choice(sorted(free)) if free else None
+
+    def op_commit(self):
+        coord = self._free_chip()
+        if coord is None:
+            return
+        node = self.ext.state.hosts_by_coord(self.sid)[coord]
+        view = self.ext.state.node(node)
+        self.pod_n += 1
+        key = f"default/p-{self.pod_n}"
+        alloc = AllocResult(
+            pod_key=key, node_name=node,
+            device_ids=[make_device_id(view.index_at(coord))],
+            coords=[coord],
+        )
+        self.ext.state.commit(alloc)
+        self.live[key] = alloc
+
+    def op_release(self):
+        if not self.live:
+            return
+        key = self.rng.choice(sorted(self.live))
+        self.live.pop(key)
+        self.ext.state.release(key)
+        self.ext.gang.on_release(key)
+
+    def op_gang_cycle(self):
+        """reserve -> bind one member -> maybe rollback-by-TTL or
+        dissolve (each path exercises distinct seams)."""
+        self.gang_n += 1
+        group = PodGroup(f"g{self.gang_n}", min_member=2)
+        pod = _pod(f"g{self.gang_n}-0", group=group)
+        try:
+            res = self.ext.gang.ensure_reservation(pod, 1)
+        except Exception:
+            return  # mesh too full for a 2-chip box right now
+        _assert_fresh(self.ext, "after reserve")
+        roll = self.rng.random()
+        if roll < 0.4:
+            # bind one member, then leave the gang to TTL out later
+            coords = sorted(res.unassigned_in(self.sid))[:1]
+            if coords:
+                node = self.ext.state.hosts_by_coord(self.sid)[coords[0]]
+                view = self.ext.state.node(node)
+                key = f"default/g{self.gang_n}-0"
+                self.ext.state.commit(AllocResult(
+                    pod_key=key, node_name=node,
+                    device_ids=[make_device_id(
+                        view.index_at(coords[0]))],
+                    coords=list(coords),
+                ))
+                self.ext.gang.on_bound(res, key, list(coords), node)
+                _assert_fresh(self.ext, "after on_bound")
+                self.ext.gang.on_release(key)
+                self.ext.state.release(key)
+                _assert_fresh(self.ext, "after member release")
+            self.ext.gang.sweep(now=1e9)  # TTL rollback
+        elif roll < 0.7:
+            self.ext.gang.dissolve(res.key)
+        else:
+            self.ext.gang.sweep(now=1e9)
+
+    def op_terminating(self):
+        coord = self._free_chip()
+        if coord is None:
+            return
+        self.gang_n += 1
+        group = PodGroup(f"t{self.gang_n}", min_member=2)
+        try:
+            res = self.ext.gang.ensure_reservation(
+                _pod(f"t{self.gang_n}-0", group=group), 1)
+        except Exception:
+            return
+        victim = f"default/v-{self.gang_n}"
+        self.ext.gang.register_terminating(
+            res, {victim: (self.sid, [coord])})
+        self.terminating.append(victim)
+        _assert_fresh(self.ext, "after register_terminating")
+        if self.rng.random() < 0.7:
+            self.ext.gang.on_victim_gone(victim)
+            self.terminating.remove(victim)
+        self.ext.gang.dissolve(res.key)
+
+    def op_upsert_health_flip(self):
+        """A changed payload (health flip) is a structural marker: the
+        next lookup must full-rebuild, and still match the oracle."""
+        host = self.rng.choice(sorted(self.ext.state.node_names()))
+        view = self.ext.state.node(host)
+        r0 = self.ext.snapshots.rebuilds
+        chips = []
+        for i, c in enumerate(self.mesh.coords_of_host(host)):
+            chip = ChipInfo(chip_id=f"{host}-c{i}", index=i, coord=c,
+                            hbm_bytes=self.cfg.hbm_bytes_per_chip)
+            if i == 0:
+                chip.health = (
+                    Health.UNHEALTHY
+                    if view.chip(0).health is Health.HEALTHY
+                    else Health.HEALTHY
+                )
+            chips.append(chip)
+        self.ext.state.upsert_node(host, codec.annotate_node(
+            NodeInfo(name=host, chips=chips, slice_id=self.sid),
+            self.mesh))
+        self.ext.snapshots.current()
+        assert self.ext.snapshots.rebuilds == r0 + 1, \
+            "structural upsert must force a full rebuild, not a delta"
+
+    def op_upsert_unchanged(self):
+        """Identical payload: no bump, no delta, cache stays hot."""
+        host = self.rng.choice(sorted(self.ext.state.node_names()))
+        view = self.ext.state.node(host)
+        annos = {codec.ANNO_NODE_TOPOLOGY: view.raw_payload}
+        before = self.ext.state.epoch()
+        log_before = len(self.ext.snapshots._delta_log["ledger"])
+        snap = self.ext.snapshots.current()
+        self.ext.state.upsert_node(host, annos)
+        assert self.ext.state.epoch() == before
+        assert len(self.ext.snapshots._delta_log["ledger"]) == log_before
+        assert self.ext.snapshots.current() is snap
+
+    def step(self):
+        op = self.rng.choice([
+            self.op_commit, self.op_commit, self.op_commit,
+            self.op_release, self.op_release,
+            self.op_gang_cycle,
+            self.op_terminating,
+            self.op_upsert_health_flip,
+            self.op_upsert_unchanged,
+        ])
+        op()
+        _assert_fresh(self.ext, f"after {op.__name__}")
+
+
+@pytest.mark.parametrize("seed", [7, 23, 1031])
+def test_property_random_mutations_delta_equals_cold_rebuild(seed):
+    ext, mesh, cfg = _mini_extender()
+    driver = _Driver(ext, mesh, cfg, random.Random(seed))
+    for _ in range(120):
+        driver.step()
+    # the delta path actually carried the run (not rebuild-everything)
+    assert ext.snapshots.delta_applies > ext.snapshots.rebuilds
+
+
+def test_overflow_falls_back_to_full_rebuild(monkeypatch):
+    """More bumps than the log bound between two lookups: the advance
+    must detect the gap, count an overflow, rebuild — and be right."""
+    from collections import deque
+
+    ext, mesh, cfg = _mini_extender()
+    snap0 = ext.snapshots.current()
+    # shrink the live log so a short run overflows it
+    with ext.snapshots._lock:
+        for kind in ("ledger", "gang"):
+            ext.snapshots._delta_log[kind] = deque(
+                ext.snapshots._delta_log[kind], maxlen=4)
+    hosts = sorted(ext.state.hosts_by_coord(cfg.slice_id).items())
+    for n, (coord, node) in enumerate(hosts[:8]):
+        view = ext.state.node(node)
+        ext.state.commit(AllocResult(
+            pod_key=f"default/of-{n}", node_name=node,
+            device_ids=[make_device_id(view.index_at(coord))],
+            coords=[coord],
+        ))
+    r0, o0 = ext.snapshots.rebuilds, ext.snapshots.delta_overflows
+    _assert_fresh(ext, "after overflow")
+    assert ext.snapshots.delta_overflows == o0 + 1
+    assert ext.snapshots.rebuilds == r0 + 1
+    assert ext.snapshots.current() is not snap0
+
+
+def test_missing_note_degrades_to_rebuild_never_stale():
+    """A bump whose seam forgot to note() shows up as a log gap: the
+    advance refuses the chain and rebuilds — stale is impossible."""
+    ext, mesh, cfg = _mini_extender()
+    ext.snapshots.current()
+    # simulate a rogue seam: bump without a note
+    with ext.state._lock:
+        ext.state._epoch += 1
+    r0 = ext.snapshots.rebuilds
+    _assert_fresh(ext, "after unnoted bump")
+    assert ext.snapshots.rebuilds == r0 + 1
+
+
+def test_delta_disabled_is_the_rebuild_oracle():
+    ext, mesh, cfg = _mini_extender(
+        env={"TPUKUBE_SNAPSHOT_DELTA_ENABLED": "0"})
+    assert ext.snapshots.delta_enabled is False
+    ext.snapshots.current()
+    r0 = ext.snapshots.rebuilds
+    node = sorted(ext.state.node_names())[0]
+    view = ext.state.node(node)
+    ext.state.commit(AllocResult(
+        pod_key="default/a", node_name=node,
+        device_ids=[make_device_id(0)],
+        coords=[view.chip(0).coord],
+    ))
+    _assert_fresh(ext, "delta off")
+    assert ext.snapshots.rebuilds == r0 + 1
+    assert ext.snapshots.delta_applies == 0
+    assert not ext.snapshots._delta_log["ledger"]  # note() is a no-op
+
+
+def test_audit_sentinel_catches_a_wrong_delta():
+    """The runtime cross-check on the delta math: a delta that
+    mis-states its seam's effect serves a diverged snapshot, and the
+    audit (rate 1.0) must raise on the next scheduling hit."""
+    from tpukube.sched.snapshot import SnapshotAuditError
+
+    ext, mesh, cfg = _mini_extender()
+    ext.snapshots.audit_rate = 1.0
+    ext.snapshots.current()
+    node = sorted(ext.state.node_names())[0]
+    view = ext.state.node(node)
+    # a commit whose recorded delta LIES about the chip it occupied
+    with ext.state._lock:
+        view.add_ids([make_device_id(0)])
+        ext.state._allocs["default/liar"] = AllocResult(
+            pod_key="default/liar", node_name=node,
+            device_ids=[make_device_id(0)],
+            coords=[view.chip(0).coord],
+        )
+        ext.state._epoch += 1
+        ext.state._delta_sink.note(SnapshotDelta(
+            kind="ledger", epoch=ext.state._epoch,
+            slice_id=cfg.slice_id,
+            occupied_add=(view.chip(1).coord,),  # WRONG chip
+            used_shares_delta=1,
+        ))
+    ext.snapshots.current()  # applies the lying delta
+    with pytest.raises(SnapshotAuditError):
+        ext.snapshots.current()  # audited hit: rebuild-and-compare
+
+
+def test_utilization_advances_with_deltas():
+    ext, mesh, cfg = _mini_extender()
+    sid = cfg.slice_id
+    ext.snapshots.current()
+    node = sorted(ext.state.node_names())[0]
+    view = ext.state.node(node)
+    ext.state.commit(AllocResult(
+        pod_key="default/u", node_name=node,
+        device_ids=[make_device_id(i) for i in range(4)],
+        coords=[c.coord for c in view.info.chips],
+    ))
+    ss = ext.snapshots.current().slice(sid)
+    assert ss.utilization == ext.state.slice_utilization(sid)
+    ext.state.release("default/u")
+    ss = ext.snapshots.current().slice(sid)
+    assert ss.utilization == ext.state.slice_utilization(sid) == 0.0
+
+
+def test_untouched_slices_share_objects_touched_invalidate():
+    """Only touched slices get fresh SliceSnapshots (lazy sweeps of
+    untouched slices stay warm across the advance)."""
+    cfg = load_config(env={})
+    slices = {
+        "s0": MeshSpec(dims=(2, 2, 2), host_block=(2, 2, 1)),
+        "s1": MeshSpec(dims=(2, 2, 2), host_block=(2, 2, 1)),
+    }
+    with SimCluster(cfg, slices=slices, in_process=True) as c:
+        ext = c.extender
+        # warm: the first webhook ingests both slices' node annotations
+        c.schedule(c.make_pod("warm", tpu=1))
+        snap0 = ext.snapshots.current()
+        assert len(snap0.slices) == 2
+        # place one pod; only its slice's snapshot object may change
+        _, alloc = c.schedule(c.make_pod("one", tpu=1))
+        sid = ext.state.slice_of_node(alloc.node_name)
+        other = next(s for s in snap0.slices if s != sid)
+        snap1 = ext.snapshots.current()
+        assert snap1.slices[sid] is not snap0.slices[sid]
+        assert snap1.slices[other] is snap0.slices[other]
+
+
+# -- webhook-stack parity: delta-advanced vs rebuild-every-epoch oracle ------
+
+def _run_mixed_workload(delta: bool):
+    """The placement-relevant decision log of a workload exercising
+    singles, a multi-chip pod, churn, a gang, and a preemption — with
+    the delta path on vs the rebuild-every-epoch oracle."""
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,2",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        "TPUKUBE_SNAPSHOT_DELTA_ENABLED": "1" if delta else "0",
+        "TPUKUBE_SNAPSHOT_AUDIT_RATE": "1.0",
+    })
+    out = {}
+    with SimCluster(cfg, in_process=True) as c:
+        for i in range(6):
+            _, alloc = c.schedule(c.make_pod(f"s-{i}", tpu=1))
+            out[f"s-{i}"] = (alloc.node_name, tuple(alloc.device_ids))
+        _, alloc = c.schedule(c.make_pod("wide", tpu=4))
+        out["wide"] = (alloc.node_name, tuple(alloc.device_ids))
+        c.complete_pod("s-2")
+        _, alloc = c.schedule(c.make_pod("refill", tpu=1))
+        out["refill"] = (alloc.node_name, tuple(alloc.device_ids))
+        fill = 0
+        while True:
+            try:
+                _, alloc = c.schedule(c.make_pod(f"f-{fill}", tpu=1))
+                out[f"f-{fill}"] = (alloc.node_name,
+                                    tuple(alloc.device_ids))
+                fill += 1
+            except RuntimeError:
+                break
+        group = PodGroup("boss", min_member=8)
+        for i in range(8):
+            _, alloc = c.schedule(
+                c.make_pod(f"b-{i}", tpu=1, priority=100, group=group))
+            out[f"b-{i}"] = (alloc.node_name, tuple(alloc.device_ids))
+        out["__preempt"] = c.extender.preemptions
+        out["__audit_divergences"] = \
+            c.extender.snapshots.audit_divergences
+        out["__delta_applies_positive"] = \
+            c.extender.snapshots.delta_applies > 0
+    return out
+
+
+def test_webhook_placement_parity_delta_vs_rebuild_oracle():
+    oracle = _run_mixed_workload(delta=False)
+    live = _run_mixed_workload(delta=True)
+    assert live["__delta_applies_positive"]
+    assert live["__audit_divergences"] == 0
+    # placements bit-identical; normalize the differing meta keys
+    for d in (oracle, live):
+        d.pop("__delta_applies_positive")
+    assert oracle == live
+
+
+def test_delta_metrics_and_statusz_render_only_when_enabled():
+    from tpukube.metrics import render_extender_metrics
+    from tpukube.obs.statusz import extender_statusz
+
+    on, _, _ = _mini_extender()
+    on.snapshots.current()
+    text = render_extender_metrics(on)
+    assert "# TYPE tpukube_snapshot_delta_applies_total counter" in text
+    assert "tpukube_snapshot_delta_overflows_total 0" in text
+    assert "tpukube_snapshot_delta_apply_seconds" in text
+    doc = extender_statusz(on)["snapshot"]
+    assert doc["delta"]["enabled"] is True
+    assert "delta_hit_rate" in doc
+
+    off, _, _ = _mini_extender(
+        env={"TPUKUBE_SNAPSHOT_DELTA_ENABLED": "0"})
+    off.snapshots.current()
+    text = render_extender_metrics(off)
+    # legacy exposition byte-identical with the feature off: none of
+    # the delta series render
+    assert "tpukube_snapshot_delta" not in text
+    assert extender_statusz(off)["snapshot"]["delta"]["enabled"] is False
+
+
+def test_kilonode10k_scenario_smoke(monkeypatch):
+    """Scenario 12 at a tier-1-friendly scale: the full 10240-node /
+    40960-chip control plane, ~2.5k pods on the fake clock. The real
+    12k/40k-pod runs live in tools/check.sh and bench.py; this asserts
+    the machinery end to end — batched gang planning placed the
+    512-member gang, the delta path carried snapshot maintenance, zero
+    divergence, zero leaks (the scenario raises on either)."""
+    from tpukube.sim import scenarios
+
+    monkeypatch.setenv("TPUKUBE_KILONODE10K_PODS", "2500")
+    monkeypatch.delenv("TPUKUBE_BATCH_ENABLED", raising=False)
+    r = scenarios.run(12)
+    assert r["nodes"] == 10240 and r["chips"] == 40960
+    assert r["pods_total"] == 2500
+    assert r["gang_committed"]
+    assert r["ledger_divergence"] == 0
+    assert r["cycle"]["gang_batches"] >= 1
+    assert r["cycle"]["gang_batch_members"] == 512
+    assert r["cycle"]["plan_hit_ratio"] > 0.9
+    assert r["snapshot"]["delta_applies"] > 0
+    assert r["snapshot"]["rebuild_p50_ms"] > 0
+
+
+def test_largest_free_box_bisection_matches_exhaustive_scan():
+    """ISSUE 10 slicefit touch: largest_free_box_in bisects the third
+    extent (feasibility is monotone per axis) — the result must equal
+    the exhaustive all-tiers scan on arbitrary grids, torus included."""
+    import numpy as np
+
+    from tpukube.sched import slicefit
+    from tpukube.sched.slicefit import _Sweep
+
+    def exhaustive(sweep):
+        best = 0
+        X, Y, Z = sweep.mesh.dims
+        for a in range(1, X + 1):
+            for b in range(1, Y + 1):
+                for c in range(1, Z + 1):
+                    if a * b * c > best and len(
+                            sweep.origins((a, b, c))):
+                        best = a * b * c
+        return best
+
+    rng = random.Random(42)
+    for _ in range(40):
+        dims = (rng.randint(1, 6), rng.randint(1, 6), rng.randint(1, 6))
+        torus = (rng.random() < 0.3, rng.random() < 0.3,
+                 rng.random() < 0.3)
+        mesh = MeshSpec(dims=dims, host_block=(1, 1, 1), torus=torus)
+        grid = np.zeros(dims, dtype=bool)
+        for _ in range(rng.randint(0, mesh.num_chips)):
+            grid[rng.randrange(dims[0]), rng.randrange(dims[1]),
+                 rng.randrange(dims[2])] = True
+        got = slicefit.largest_free_box_in(_Sweep(mesh, grid))
+        want = exhaustive(_Sweep(mesh, grid))
+        assert got == want, (dims, torus, got, want)

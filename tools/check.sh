@@ -108,6 +108,51 @@ print("kilonode smoke OK")
 PY
 
 echo
+echo "== kilonode-10k smoke (scenario 12: 10240 nodes / 40960 chips,"
+echo "   incremental snapshot deltas + persistent fast state + batched"
+echo "   gang planning; deterministic trace — floors from"
+echo "   tools/perf_floor.json) =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+import os
+import sys
+
+floor = json.load(open("tools/perf_floor.json"))["kilonode10k"]
+os.environ.setdefault("TPUKUBE_KILONODE10K_PODS", str(floor["pods"]))
+
+from tpukube.sim import scenarios
+
+# the scenario itself raises on invariant violations (gang uncommitted,
+# ledger divergence, leaked reservations, pod shortfall); the floors
+# below catch perf rot in the ISSUE 10 hot path
+r = scenarios.run(12)
+print(json.dumps({
+    "pods_total": r["pods_total"], "wall_s": r["wall_s"],
+    "pods_per_sec": r["pods_per_sec"],
+    "plan_ms_per_pod": r["cycle"]["plan_ms_per_pod"],
+    "plan_hit_ratio": r["cycle"]["plan_hit_ratio"],
+    "fast_patches": r["cycle"]["fast_patches"],
+    "gang_batches": r["cycle"]["gang_batches"],
+    "snapshot": r["snapshot"],
+}))
+bad = []
+if r["pods_per_sec"] < floor["pods_per_sec_min"]:
+    bad.append(f"pods_per_sec={r['pods_per_sec']} below the "
+               f"{floor['pods_per_sec_min']}/s floor")
+if r["cycle"]["plan_ms_per_pod"] > floor["plan_ms_per_pod_max"]:
+    bad.append(f"plan_ms_per_pod={r['cycle']['plan_ms_per_pod']} exceeds "
+               f"the {floor['plan_ms_per_pod_max']}ms ceiling")
+speedup = r["snapshot"]["delta_speedup"]
+if speedup is None or speedup < floor["delta_speedup_min"]:
+    bad.append(f"delta_speedup={speedup} below the "
+               f"{floor['delta_speedup_min']}x floor (the O(delta) "
+               f"advance is not beating the forced full rebuild)")
+if bad:
+    sys.exit("kilonode-10k smoke FAILED: " + "; ".join(bad))
+print("kilonode-10k smoke OK")
+PY
+
+echo
 echo "== multitenant smoke (scenario 11: diurnal tenant waves + DRF"
 echo "   fairness + SLO-burn shedding under scenario-8 chaos; fixed"
 echo "   seed + fixed fault schedule — floors from tools/perf_floor.json) =="

@@ -70,10 +70,16 @@ class SeamSpec:
 #: (path suffix, class) -> SeamSpec. Growing ClusterState/GangManager a
 #: new snapshot-feeding structure means declaring it here — the pass
 #: then enforces the bump discipline everywhere it is mutated.
-#: sched/snapshot.py deliberately has NO entry and is therefore not
-#: read by this pass: the cache CONSUMES epochs and owns none of its
-#: own; the day it grows a mutation seam, declare a (suffix, class)
-#: entry here to bring it under the prover.
+#:
+#: sched/snapshot.py joined the registry the day it grew a mutation-
+#: application seam (ISSUE 10, the promise PR 6 recorded): the delta
+#: advance WRITES the cached-snapshot slot, and every such write must
+#: pair with a ``_snap_gen`` bump under the cache's leaf mutex — the
+#: statically-proven invariant that the cached slot never changes
+#: without its generation (and therefore the observably-served key)
+#: moving in the same locked region. The cache still owns no EPOCH of
+#: its own; ``_snap_gen`` is the slot-generation counter its stats
+#: report.
 EPOCH_REGISTRY: dict[tuple[str, str], SeamSpec] = {
     ("sched/state.py", "ClusterState"): SeamSpec(
         lock_attr="_lock",
@@ -84,6 +90,12 @@ EPOCH_REGISTRY: dict[tuple[str, str], SeamSpec] = {
         lock_attr="_lock",
         seam_attrs=frozenset({"_reservations", "_terminating_coords"}),
         mutator_calls=frozenset({"record_assignment", "drop_assignment"}),
+    ),
+    ("sched/snapshot.py", "SnapshotCache"): SeamSpec(
+        lock_attr="_lock",
+        seam_attrs=frozenset({"_snap"}),
+        mutator_calls=frozenset(),
+        bump_attr="_snap_gen",
     ),
 }
 

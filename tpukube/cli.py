@@ -569,7 +569,7 @@ def main_sim(argv: Optional[list[str]] = None) -> int:
         "tpukube-sim",
         "run a BASELINE config scenario against the real control-plane stack",
     )
-    p.add_argument("scenario", type=int, choices=range(1, 12),
+    p.add_argument("scenario", type=int, choices=range(1, 13),
                    help="BASELINE config number (1..5), 6 = the "
                         "steady-state churn benchmark (completions -> "
                         "release loop -> re-scheduling), 7 = fault "

@@ -101,6 +101,14 @@ class TpuKubeConfig:
     # 1.0 audits every hit (sim scenarios and the chaos suite run
     # green at 1.0 with zero divergences).
     snapshot_audit_rate: float = 0.0
+    # incremental snapshot maintenance (sched/snapshot.py, ISSUE 10):
+    # epoch bumps record typed SnapshotDeltas and the cache ADVANCES
+    # the cached snapshot O(Δ) instead of rebuilding O(chips) per
+    # epoch. Placements are bit-identical either way (parity-tested);
+    # false restores the rebuild-every-epoch behavior (the oracle) and
+    # keeps the /metrics exposition free of the tpukube_snapshot_delta_*
+    # series.
+    snapshot_delta_enabled: bool = True
 
     # Batched scheduling cycles (sched/cycle.py SchedulingCycle): when
     # batch_enabled is true the extender admits pending pods into a

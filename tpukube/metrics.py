@@ -257,6 +257,29 @@ def _add_snapshot_metrics(reg: Registry, snapshots) -> None:
         values_fn=snapshots.rebuild_seconds_snapshot,
         help_text="Wall time of snapshot rebuilds (coord-set capture; "
                   "sweep tables build lazily on first query).")
+    if getattr(snapshots, "delta_enabled", False):
+        # incremental-maintenance series render only while the feature
+        # is on — with snapshot_delta_enabled=false the exposition is
+        # byte-identical to the rebuild-every-epoch daemon's
+        reg.counter(
+            "tpukube_snapshot_delta_applies_total",
+            fn=lambda: snapshots.delta_applies,
+            help_text="Snapshot advances served by applying the queued "
+                      "SnapshotDeltas (O(Δ)) instead of rebuilding "
+                      "O(chips) from the ledger.")
+        reg.counter(
+            "tpukube_snapshot_delta_overflows_total",
+            fn=lambda: snapshots.delta_overflows,
+            help_text="Advances the delta log could not cover (bound "
+                      "overflow or an unnoted bump) — each fell back "
+                      "to a full rebuild. A growing rate means the log "
+                      "bound trails the batch depth.")
+        reg.summary(
+            "tpukube_snapshot_delta_apply_seconds",
+            quantiles=(0.5, 0.99),
+            values_fn=snapshots.delta_apply_seconds_snapshot,
+            help_text="Wall time of O(Δ) delta advances (one sample "
+                      "per advance, covering every queued delta).")
     if getattr(snapshots, "audit_rate", 0.0) > 0.0:
         # audit-sentinel series render only when the sentinel is on
         # (snapshot_audit_rate > 0) — legacy exposition byte-identical

@@ -68,6 +68,13 @@ DECLARED_SERIES: frozenset[str] = frozenset({
     # caught (any nonzero divergence count is a missed epoch bump)
     "tpukube_snapshot_audit_checks_total",
     "tpukube_snapshot_audit_divergence_total",
+    # incremental snapshot maintenance (ISSUE 10; series render only
+    # while snapshot_delta_enabled — legacy exposition stays
+    # byte-identical with the feature off): O(Δ) delta advances vs the
+    # full rebuilds the log could not cover, and the apply latency
+    "tpukube_snapshot_delta_applies_total",
+    "tpukube_snapshot_delta_overflows_total",
+    "tpukube_snapshot_delta_apply_seconds",
     "tpukube_slice_fragmentation",
     "tpukube_slice_largest_free_box_chips",
     # extender: batched scheduling cycles (sched/cycle.py; series

@@ -59,7 +59,8 @@ from tpukube.core.types import (
     make_device_id,
 )
 from tpukube.obs.registry import Histogram
-from tpukube.sched.gang import GangError
+from tpukube.sched import slicefit
+from tpukube.sched.gang import GangError, GangManager, NoSliceError
 from tpukube.sched.state import StateError
 
 log = logging.getLogger("tpukube.cycle")
@@ -100,39 +101,88 @@ class PodPlan:
 
 
 class _SliceOverlay:
-    """Cycle-local incremental view of one ICI slice for the fast path:
-    the pinned snapshot's blocked contact values (as a plain dict over
-    the free chips — numpy scalar indexing per query was the measured
-    kilonode bottleneck) plus per-node free sets, updated in O(1) per
-    placement instead of re-deriving O(volume) sweeps per pod. Values
-    are proven equal to the legacy per-pod reads (contact_grid /
-    point_contact / free-count feasibility) by tests/test_cycle.py's
-    parity suite."""
+    """Incremental view of one ICI slice for the fast path: the pinned
+    snapshot's blocked contact values (as a plain dict over the free
+    chips — numpy scalar indexing per query was the measured kilonode
+    bottleneck) plus per-node free sets, updated in O(1) per placement
+    instead of re-deriving O(volume) sweeps per pod. Values are proven
+    equal to the legacy per-pod reads (contact_grid / point_contact /
+    free-count feasibility) by tests/test_cycle.py's parity suite.
 
-    __slots__ = ("mesh", "contact", "free_by_node", "owner")
+    Since ISSUE 10 the overlay is PERSISTENT across cycles: it also
+    carries the mutable occupied/reserved membership sets (the union
+    the contact values count against) so it can be patched from the
+    snapshot cache's delta chain — blocking and unblocking chips as
+    commits, releases, and reservation moves land — instead of being
+    rebuilt O(chips) at the top of every cycle."""
 
-    def __init__(self, mesh, contact, free_by_node, owner):
+    __slots__ = ("mesh", "contact", "free_by_node", "owner", "occ",
+                 "resv", "hosts")
+
+    def __init__(self, mesh, contact, free_by_node, owner, occ, resv,
+                 hosts):
         self.mesh = mesh
         #: free coord -> its contact against the blocked set; seeded
         #: from the pinned snapshot's vectorized contact grid and
         #: mutated incrementally (blocked chips are never queried)
         self.contact = contact
         #: node -> set of free, unreserved chip coords on that node
+        #: (every tracked — annotated, whole-chip-mode — node has an
+        #: entry, possibly empty: membership = "tracked")
         self.free_by_node = free_by_node
         #: free coord -> owning node name (for best-score fanout)
         self.owner = owner
+        #: mutable occupied / reserved membership (blocked = occ ∪ resv
+        #: — the two sets may overlap: a preemption victim's chips are
+        #: occupied AND inside the new reservation until eviction)
+        self.occ = occ
+        self.resv = resv
+        #: coord -> node name for the whole slice (the ledger's shared
+        #: frozen host map; host moves are full-rebuild markers)
+        self.hosts = hosts
 
-    def block(self, node: str, coord: TopologyCoord) -> set[str]:
-        """Mark ``coord`` newly blocked (assumed allocation): remove it
-        from its node's free set and bump each free neighbor's contact
-        once per reaching direction — the exact increment
+    def _blocked(self, coord: TopologyCoord) -> bool:
+        return coord in self.occ or coord in self.resv
+
+    def set_occupied(self, coord: TopologyCoord) -> set[str]:
+        """An assumed/committed allocation claimed ``coord``. Returns
+        the nodes whose best contact may have changed."""
+        was = self._blocked(coord)
+        self.occ.add(coord)
+        return set() if was else self._block_effects(coord)
+
+    def clear_occupied(self, coord: TopologyCoord) -> set[str]:
+        """A release returned ``coord`` to fully-free (the ledger delta
+        only emits this for healthy, zero-share chips)."""
+        self.occ.discard(coord)
+        return set() if self._blocked(coord) else \
+            self._unblock_effects(coord)
+
+    def set_reserved(self, coord: TopologyCoord) -> set[str]:
+        was = self._blocked(coord)
+        self.resv.add(coord)
+        return set() if was else self._block_effects(coord)
+
+    def clear_reserved(self, coord: TopologyCoord) -> set[str]:
+        self.resv.discard(coord)
+        return set() if self._blocked(coord) else \
+            self._unblock_effects(coord)
+
+    def _block_effects(self, coord: TopologyCoord) -> set[str]:
+        """``coord`` just became blocked: remove it from its node's
+        free set and bump each free neighbor's contact once per
+        reaching direction — the exact increment
         ``slicefit.point_contact`` would observe (a length-2 torus axis
         reaches the same neighbor twice and counts twice). Returns the
         nodes whose best contact may have changed."""
-        self.free_by_node[node].discard(coord)
+        node = self.hosts.get(coord)
+        free = self.free_by_node.get(node) if node is not None else None
+        touched = set()
+        if free is not None:
+            free.discard(coord)
+            touched.add(node)
         self.contact.pop(coord, None)
         self.owner.pop(coord, None)
-        touched = {node}
         mesh = self.mesh
         contact = self.contact
         owner = self.owner
@@ -151,6 +201,41 @@ class _SliceOverlay:
                 if nb in contact:  # a free chip whose snugness grew
                     contact[nb] += 1
                     touched.add(owner[nb])
+        return touched
+
+    def _unblock_effects(self, coord: TopologyCoord) -> set[str]:
+        """``coord`` just became free: decrement each free neighbor's
+        contact (the inverse of ``_block_effects``) and — when its node
+        is tracked — return it to the free set with its own contact
+        computed against the current blocked union."""
+        mesh = self.mesh
+        contact = self.contact
+        owner = self.owner
+        touched = set()
+        for axis in range(3):
+            d = mesh.dims[axis]
+            wrap = mesh.torus[axis] and d > 1
+            for step in (-1, 1):
+                idx = coord[axis] + step
+                if wrap:
+                    idx %= d
+                elif idx < 0 or idx >= d:
+                    continue
+                v = list(coord)
+                v[axis] = idx
+                nb = TopologyCoord(*v)
+                if nb in contact:
+                    contact[nb] -= 1
+                    touched.add(owner[nb])
+        node = self.hosts.get(coord)
+        free = self.free_by_node.get(node) if node is not None else None
+        if free is not None:
+            free.add(coord)
+            contact[coord] = slicefit.point_contact(
+                mesh, coord, self._blocked
+            )
+            owner[coord] = node
+            touched.add(node)
         return touched
 
     def best_chip(self, node: str) -> Optional[TopologyCoord]:
@@ -196,6 +281,12 @@ class SchedulingCycle:
         self._plans: dict[str, PodPlan] = {}
         self._seq = 0
         self._last_drain = float("-inf")  # clock time of last full drain
+        # Persistent fast-path state (ISSUE 10): the overlay (per-node
+        # free sets, contact dict, best-node heap) survives ACROSS
+        # cycles and is patched from the snapshot cache's delta chain;
+        # a full O(chips) rebuild happens only on structural change or
+        # delta-log overflow. Owned by the decision lock like the rest.
+        self._fast_state: Optional[dict[str, Any]] = None
         # counters (read by /metrics + /statusz under no extra lock —
         # the decision lock already serializes every writer)
         self.cycles = 0
@@ -204,6 +295,10 @@ class SchedulingCycle:
         self.plan_misses = 0
         self.assumes = 0
         self.assume_undos = 0
+        self.fast_patches = 0    # fast state advanced O(Δ) from deltas
+        self.fast_rebuilds = 0   # fast state rebuilt O(chips)
+        self.gang_batches = 0          # gang groups planned batched
+        self.gang_batch_members = 0    # members planned by that arm
         self.batch_sizes: deque[int] = deque(maxlen=self.WINDOW)
         self.cycle_walls: deque[float] = deque(maxlen=self.WINDOW)
         self.cycle_wall_total = 0.0  # cumulative (the windows rotate)
@@ -464,11 +559,41 @@ class SchedulingCycle:
         if not batch:
             return 0
         t0 = time.perf_counter()
-        snap = self._pin_snapshot()
-        default_names: Optional[list[str]] = None
-        overlays: dict[str, _SliceOverlay] = {}
-        fast_state: Optional[dict[str, Any]] = None
-        for pod, seq, pod_names in batch:
+        # ONE shared tuple for driver/informer admissions: every such
+        # PodPlan stores `names` verbatim, and at 10k nodes a per-entry
+        # copy is ~80KB — tuple(t) on an existing tuple is identity, so
+        # sharing here dedupes every downstream tuple(names)
+        default_names: Optional[tuple[str, ...]] = None
+        i = 0
+        while i < len(batch):
+            pod, seq, pod_names = batch[i]
+            if pod.group is not None and pod_names is None:
+                # batched gang planning (ISSUE 10): the queue order put
+                # this gang's driver-admitted members adjacent — plan
+                # the whole run through ONE reservation sweep and ONE
+                # availability pass instead of the per-member general
+                # path (which re-derives both over every node)
+                gkey = (pod.namespace, pod.group.name)
+                j = i
+                members: list[tuple[PodInfo, int]] = []
+                while j < len(batch):
+                    p2, s2, n2 = batch[j]
+                    if (n2 is None and p2.group is not None
+                            and (p2.namespace, p2.group.name) == gkey):
+                        members.append((p2, s2))
+                        j += 1
+                    else:
+                        break
+                if default_names is None:
+                    default_names = tuple(ext.state.node_names())
+                for (p2, _), entry in zip(members, self._plan_gang_batch(
+                        members, default_names)):
+                    key2 = p2.key()
+                    self._queue.pop(key2, None)
+                    self._plans[key2] = entry
+                    self.pods_planned += 1
+                i = j
+                continue
             key = pod.key()
             self._queue.pop(key, None)
             if pod_names is not None:
@@ -476,31 +601,27 @@ class SchedulingCycle:
                 needs_answers = True  # a webhook will read the answers
             else:
                 if default_names is None:
-                    default_names = ext.state.node_names()
+                    default_names = tuple(ext.state.node_names())
                 names = default_names
                 needs_answers = False
             if self._fast_eligible(pod):
                 # the same janitor the legacy filter runs per webhook;
                 # BEFORE the staleness check — a TTL/fault rollback
-                # bumps the epoch and must force an overlay rebuild
+                # bumps the epoch and must advance/rebuild the overlay
                 ext.gang.sweep()
-                if fast_state is None or (
-                    ext.snapshots.epoch_key() != fast_state["key"]
-                ):
-                    # first fast pod, or a general-path pod mutated
-                    # reservations mid-batch: (re)pin and rebuild
-                    snap = self._pin_snapshot()
-                    fast_state = self._build_fast_state(snap, overlays)
+                fast_state = self._ensure_fast_state()
                 entry = self._plan_fast(pod, seq, names, fast_state,
                                         needs_answers)
                 if entry.assumed:
                     # commit moved the ledger epoch exactly as planned
+                    # (the overlay was patched in-place by _plan_fast)
                     fast_state["key"] = ext.snapshots.epoch_key()
             else:
                 entry = self._plan_general(pod, seq, names)
             entry.epoch_key = ext.snapshots.epoch_key()
             self._plans[key] = entry
             self.pods_planned += 1
+            i += 1
         self.cycles += 1
         self.batch_sizes.append(len(batch))
         wall = time.perf_counter() - t0
@@ -595,6 +716,139 @@ class SchedulingCycle:
                 entry.bind_error = str(e)
             return entry
 
+    # -- batched gang planning (ISSUE 10) ------------------------------------
+    def _plan_gang_batch(
+        self, members: list[tuple[PodInfo, int]], names: list[str]
+    ) -> list[PodPlan]:
+        """Plan one gang's queued (driver-admitted) members as a batch:
+        the reservation's shape candidates run through the vectorized
+        slicefit sweep ONCE (ensure_reservation, exactly as the legacy
+        first member's filter), then every member picks its node from
+        ONE ``node_availability`` pass kept current by O(1) decrements
+        — instead of the per-member general path, which re-runs filter
+        + prioritize over every node per member (O(members × nodes)).
+
+        Placement parity with the legacy path is preserved move for
+        move: the pick is argmax of the same ``score_from`` quantity
+        with the same smallest-name tie-break, candidates are the same
+        feasibility set (nodes holding ≥ chips_per_pod unassigned
+        reserved chips), binds run the REAL ``Extender.bind`` (chip
+        choice, quorum commit, ledger). Anything off the clean path —
+        preemption (pending or terminating victims), non-whole-chip
+        requests, config errors, overflow replicas — falls back to the
+        per-member general path, which IS the legacy code."""
+        from tpukube.sched.extender import ExtenderError
+
+        ext = self._ext
+        # the janitor every legacy gang filter runs (TTL/fault rollback
+        # before reservation reads); per-member re-sweeps inside
+        # ensure_reservation are cheap once the reservation exists
+        ext.gang.sweep()
+        entries: list[PodPlan] = []
+        counts: Optional[dict[str, tuple[int, int]]] = None
+        general = False  # sticky: preemption routed this gang legacy
+        batched = 0
+        with self._quiet():
+            for pod, seq in members:
+                if general:
+                    entries.append(self._general(pod, seq, names))
+                    continue
+                entry = PodPlan(pod, tuple(names), ext.clock.monotonic(),
+                                seq)
+                try:
+                    ask = ext.device_request(pod)
+                except (ExtenderError, codec.CodecError) as e:
+                    entry.error = str(e)
+                    entry.epoch_key = ext.snapshots.epoch_key()
+                    entries.append(entry)
+                    continue
+                if ask is None or ask[0] != RESOURCE_TPU:
+                    # not a whole-chip gang member (the legacy filter
+                    # raises / treats it specially): general path
+                    entries.append(self._general(pod, seq, names))
+                    continue
+                count = ask[1]
+                if ext.tenants is not None:
+                    refusal = ext.tenants.admit(pod, RESOURCE_TPU, count)
+                    if refusal is not None:
+                        entry.error = refusal
+                        entry.epoch_key = ext.snapshots.epoch_key()
+                        entries.append(entry)
+                        continue
+                ext._remember(pod)
+                try:
+                    res = ext.gang.ensure_reservation(pod, count)
+                except NoSliceError:
+                    # preemption territory: the general path plans it
+                    # (two-phase victims, deferred first bind) — and
+                    # stays authoritative for the rest of the gang
+                    general = True
+                    entries.append(self._general(pod, seq, names))
+                    continue
+                except (GangError, StateError) as e:
+                    entry.error = str(e)
+                    entry.epoch_key = ext.snapshots.epoch_key()
+                    entries.append(entry)
+                    continue
+                if (ext.gang.peek_pending_victims(res)
+                        or ext.gang.terminating_victims_of(res)):
+                    general = True
+                    entries.append(self._general(pod, seq, names))
+                    continue
+                if not ext.gang.assignable(res, count):
+                    # overflow replica of a full gang: normal placement
+                    entries.append(self._general(pod, seq, names))
+                    counts = None  # a normal bind may touch gang nodes
+                    continue
+                if counts is None:
+                    counts = ext.gang.node_availability(res)
+                cands = sorted(
+                    n for n, (a, _) in counts.items() if a >= count
+                )
+                if not cands:
+                    # no node holds enough unassigned reserved chips:
+                    # the legacy filter would answer "infeasible
+                    # everywhere" — an unschedulable entry (the driver
+                    # requeues; a webhook gets empty feasibility)
+                    entry.feasible = []
+                    entry.epoch_key = ext.snapshots.epoch_key()
+                    entries.append(entry)
+                    continue
+                # argmax of score_from with the legacy smallest-name
+                # tie-break (max over an ascending-sorted list returns
+                # the first maximal element)
+                entry.node = max(
+                    cands,
+                    key=lambda n: GangManager.score_from(counts, n),
+                )
+                try:
+                    entry.alloc = ext.bind(pod.name, pod.namespace,
+                                           pod.uid, entry.node)
+                    entry.assumed = True
+                    self.assumes += 1
+                    batched += 1
+                    ext._remember(pod)
+                    avail, total = counts[entry.node]
+                    counts[entry.node] = (avail - count, total)
+                except (ExtenderError, GangError, StateError,
+                        codec.CodecError) as e:
+                    entry.bind_error = str(e)
+                    counts = None  # uncertain state: recompute next
+                entry.epoch_key = ext.snapshots.epoch_key()
+                entries.append(entry)
+        if batched:
+            self.gang_batches += 1
+            self.gang_batch_members += batched
+        return entries
+
+    def _general(self, pod: PodInfo, seq: int,
+                 names: list[str]) -> PodPlan:
+        """_plan_general + the epoch-key stamp run_cycle's normal path
+        applies (gang-arm fallbacks must carry it identically)."""
+        entry = self._plan_general(pod, seq, names)
+        entry.epoch_key = self._ext.snapshots.epoch_key()
+        return entry
+
     # -- the fast path (single whole-chip pods, topology scoring) ------------
     def _fast_eligible(self, pod: PodInfo) -> bool:
         from tpukube.sched.extender import ExtenderError
@@ -609,17 +863,101 @@ class SchedulingCycle:
             return False  # the general path reports the schema error
         return ask is not None and ask[0] == RESOURCE_TPU and ask[1] == 1
 
-    def _build_fast_state(self, snap,
-                          overlays: dict[str, _SliceOverlay]
-                          ) -> dict[str, Any]:
-        """Per-cycle shared structures for the fast path, derived from
-        the pinned snapshot over EVERY known node (per-pod candidate
-        lists select from it at query time): slice overlays (free-chip
-        contact dicts + free sets), the vTPU-mode set, and the
-        best-node heap the driver placement loop pops from — O(nodes)
-        to build once, O(log nodes) per placement after."""
+    def _ensure_fast_state(self) -> dict[str, Any]:
+        """The persistent fast-path state, advanced to the current
+        epochs: patched O(Δ) from the snapshot cache's delta chain when
+        it covers the gap, rebuilt O(chips) otherwise (cold start,
+        structural change, log overflow). At 10k nodes the per-cycle
+        rebuild — contact-grid tolist + every node's free set — was the
+        dominant constant the O(log nodes)/pod placement path left."""
         ext = self._ext
-        overlays.clear()
+        key = ext.snapshots.epoch_key()
+        fs = self._fast_state
+        if fs is not None and fs["key"] == key:
+            return fs
+        if fs is not None:
+            deltas = ext.snapshots.deltas_between(fs["key"], key)
+            if deltas is not None and not any(d.full for d in deltas):
+                snap = self._pin_snapshot()
+                if self._patch_fast_state(fs, snap, deltas):
+                    fs["key"] = key
+                    fs["snap"] = snap
+                    self.fast_patches += 1
+                    return fs
+        snap = self._pin_snapshot()
+        fs = self._build_fast_state(snap)
+        self._fast_state = fs
+        self.fast_rebuilds += 1
+        return fs
+
+    def _patch_fast_state(self, fs: dict[str, Any], snap,
+                          deltas: list) -> bool:
+        """Advance the overlay in place by the same delta chain the
+        snapshot cache applied: explicit occupied add/remove coords
+        from the ledger stream; reserved-mask moves as the per-slice
+        set difference between the previously pinned snapshot and the
+        fresh one (gang deltas name the touched slices; the masks are
+        small). False = a slice the overlay never built appeared —
+        caller rebuilds. Net-effect application is order-insensitive:
+        every mutator fires block/unblock effects only on a membership
+        transition of the occ ∪ resv union."""
+        overlays: dict[str, _SliceOverlay] = fs["overlays"]
+        old_snap = fs["snap"]
+        touched: set[str] = set()
+        gang_slices: set[str] = set()
+        for d in deltas:
+            if d.kind == "gang":
+                gang_slices.update(d.slices)
+                continue
+            if d.slice_id is None:
+                continue  # empty ledger bump (release on a gone node)
+            ov = overlays.get(d.slice_id)
+            if ov is None:
+                return False
+            for c in d.occupied_add:
+                touched |= ov.set_occupied(c)
+            for c in d.occupied_remove:
+                touched |= ov.clear_occupied(c)
+        for sid in gang_slices:
+            ov = overlays.get(sid)
+            old = old_snap.slices.get(sid)
+            new = snap.slices.get(sid)
+            if ov is None or old is None or new is None:
+                return False
+            for c in new.reserved - old.reserved:
+                touched |= ov.set_reserved(c)
+            for c in old.reserved - new.reserved:
+                touched |= ov.clear_reserved(c)
+        heap = fs["heap"]
+        node_best = fs["node_best"]
+        for name in touched:
+            sid = fs["node_slice"].get(name)
+            if sid is None:
+                continue
+            best = overlays[sid].best_contact(name)
+            if node_best.get(name, -1) != best:
+                node_best[name] = best
+                if best >= 0:
+                    heapq.heappush(heap, (-best, name, best))
+        # lazy validation leaves stale heap entries behind; compact
+        # before they dominate (a churn drive pushes one entry per
+        # touched node per wave)
+        if len(heap) > max(1024, 4 * len(node_best)):
+            heap[:] = [(-b, n, b) for n, b in node_best.items()
+                       if b >= 0]
+            heapq.heapify(heap)
+        return True
+
+    def _build_fast_state(self, snap) -> dict[str, Any]:
+        """Shared structures for the fast path, derived from the pinned
+        snapshot over EVERY known node (per-pod candidate lists select
+        from it at query time): slice overlays (free-chip contact dicts
+        + free sets + the mutable blocked-union membership), the
+        vTPU-mode set, and the best-node heap the driver placement loop
+        pops from — O(nodes + chips) to build, O(log nodes) per
+        placement, O(Δ) to carry across cycles (_patch_fast_state)."""
+        ext = self._ext
+        overlays: dict[str, _SliceOverlay] = {}
         vtpu_nodes: set[str] = set()
         node_slice: dict[str, str] = {}
         node_best: dict[str, int] = {}
@@ -634,6 +972,8 @@ class SchedulingCycle:
             grids[sid] = ss.blocked_sweep().contact_grid().tolist()
             overlays[sid] = _SliceOverlay(
                 mesh=ss.mesh, contact={}, free_by_node={}, owner={},
+                occ=set(ss.occupied), resv=set(ss.reserved),
+                hosts=ext.state.hosts_by_coord(sid),
             )
         for name in ext.state.node_names():
             view = ext.state.node(name)
@@ -665,6 +1005,7 @@ class SchedulingCycle:
         heapq.heapify(heap)
         return {
             "key": ext.snapshots.epoch_key(),
+            "snap": snap,
             "overlays": overlays,
             "vtpu": vtpu_nodes,
             "node_slice": node_slice,
@@ -789,10 +1130,13 @@ class SchedulingCycle:
         entry.assumed = True
         self.assumes += 1
         # O(1) overlay update + best-score refresh for the few nodes
-        # the placement touched (heap entries are validated lazily)
+        # the placement touched (heap entries are validated lazily).
+        # set_occupied keeps the persistent overlay's blocked union in
+        # lockstep with the ledger commit above, so the delta chain
+        # patching the NEXT cycle starts from a consistent base.
         heap = fs["heap"]
         node_best = fs["node_best"]
-        for name in ov.block(best_node, coord):
+        for name in ov.set_occupied(coord):
             best = ov.best_contact(name)
             if node_best.get(name, -1) != best:
                 node_best[name] = best
@@ -846,6 +1190,12 @@ class SchedulingCycle:
             "plans_live": len(self._plans),
             "assumes": self.assumes,
             "assume_undos": self.assume_undos,
+            # ISSUE 10: persistent fast-state maintenance + batched
+            # gang planning — patches should dwarf rebuilds at scale
+            "fast_patches": self.fast_patches,
+            "fast_rebuilds": self.fast_rebuilds,
+            "gang_batches": self.gang_batches,
+            "gang_batch_members": self.gang_batch_members,
             "plan_hits": self.plan_hits,
             "plan_misses": self.plan_misses,
             "plan_hit_ratio": (round(self.plan_hits / lookups, 4)

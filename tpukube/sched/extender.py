@@ -125,6 +125,10 @@ class Extender:
         # cache rebuilds from the ledger and raises on divergence — the
         # runtime check behind the epoch-discipline lint (0 = off)
         self.snapshots.audit_rate = config.snapshot_audit_rate
+        # incremental snapshot maintenance (ISSUE 10): epoch bumps
+        # record SnapshotDeltas and the cache advances O(Δ); off =
+        # rebuild-every-epoch (the parity oracle)
+        self.snapshots.delta_enabled = config.snapshot_delta_enabled
         # Batched scheduling cycles (sched/cycle.py): with batch_enabled
         # the webhooks answer from a per-cycle batch plan instead of
         # re-planning per request; None (the config default) keeps the

@@ -42,7 +42,7 @@ from tpukube.core.types import (
 )
 from tpukube.obs.registry import Histogram
 from tpukube.sched import slicefit
-from tpukube.sched.snapshot import SnapshotCache, sweep_for
+from tpukube.sched.snapshot import SnapshotCache, SnapshotDelta, sweep_for
 from tpukube.sched.state import ClusterState, StateError
 
 log = logging.getLogger("tpukube.gang")
@@ -215,11 +215,30 @@ class GangManager:
         # /statusz renders all read ONE snapshot per epoch instead of
         # re-deriving grids from the ledger per call.
         self.snapshots = SnapshotCache(state, self)
+        # wire both epoch owners' delta streams into the cache's log so
+        # it can advance O(Δ) instead of rebuilding per epoch (a second
+        # GangManager on the same state re-points the sink; the orphaned
+        # cache then degrades to full rebuilds via log gaps — never to
+        # a stale snapshot)
+        state.set_delta_sink(self.snapshots)
 
     def epoch(self) -> int:
         """Monotonic mutation counter (the snapshot cache's key half)."""
         with self._lock:
             return self._epoch
+
+    def _note_delta_locked(self, slices=(), why: str = "") -> None:
+        """Record the gang-epoch bump just taken (callers hold
+        ``self._lock`` and call this right after ``self._epoch += 1``).
+        Gang deltas carry only the TOUCHED slice ids: the reserved /
+        terminating masks of those slices are re-read from this manager
+        at apply time — they are O(Δ)-small and their union semantics
+        (unassigned reservation chips ∪ terminating victims, which may
+        overlap) already live in ``reserved_coords``."""
+        self.snapshots.note(SnapshotDelta(
+            kind="gang", epoch=self._epoch,
+            slices=tuple(slices), why=why,
+        ))
 
     def _tenant_for(self, pod: PodInfo) -> str:
         """The reservation's tenant stamp; "" without a serving plane.
@@ -358,12 +377,18 @@ class GangManager:
                 entry[0], frozenset(entry[1])
             )
         self._epoch += 1
+        self._note_delta_locked(
+            slices=(entry[0],) if entry is not None else (),
+            why=f"evict+mask {pod_key}",
+        )
 
     def _rollback_locked(self, res: GangReservation) -> None:
         for pod_key in list(res.assigned):
             self._evict_and_mask_locked(pod_key, res.assigned.get(pod_key))
         self._reservations.pop(res.key, None)
         self._epoch += 1
+        self._note_delta_locked(slices=res.slice_coords,
+                                why=f"rollback {res.key}")
         self.rollbacks += 1
 
     # -- reservation -------------------------------------------------------
@@ -454,6 +479,7 @@ class GangManager:
             )
             self._reservations[key] = res
             self._epoch += 1
+            self._note_delta_locked(slices=slice_coords, why=f"reserve {key}")
             log.info(
                 "gang %s/%s reserved %d chips over %d slice(s)",
                 key[0], key[1], res.total_chips(), len(slice_coords),
@@ -517,6 +543,8 @@ class GangManager:
                 return []
             self._reservations.pop(key, None)
             self._epoch += 1
+            self._note_delta_locked(slices=res.slice_coords,
+                                    why=f"dissolve {key}")
             evicted = []
             for pod_key in list(res.assigned):
                 self._evict_and_mask_locked(pod_key,
@@ -648,6 +676,7 @@ class GangManager:
             res.committed = committed
             self._reservations[key] = res
             self._epoch += 1
+            self._note_delta_locked(slices=slice_coords, why=f"restore {key}")
             log.info(
                 "gang %s/%s restored from pod annotations: %d members, "
                 "committed=%s", namespace, group.name, len(res.assigned),
@@ -788,6 +817,7 @@ class GangManager:
             )
             self._reservations[key] = res
             self._epoch += 1
+            self._note_delta_locked(slices=parts, why=f"reserve-exact {key}")
             log.info(
                 "gang %s/%s reserved %d chips over %d slice(s) via preemption"
                 " (%d victim workload(s) pending first bind)",
@@ -840,6 +870,10 @@ class GangManager:
                         sid, frozenset(coords)
                     )
             self._epoch += 1
+            self._note_delta_locked(
+                slices={sid for sid, _ in held.values()},
+                why=f"register-terminating {res.key}",
+            )
 
     def on_victim_gone(self, pod_key: str) -> bool:
         """A terminating eviction victim's pod object is confirmed gone
@@ -849,7 +883,8 @@ class GangManager:
         with self._lock:
             # membership first, pop only on a hit: the unknown-pod path
             # mutates nothing and owes no bump (epoch-discipline lint)
-            hit = pod_key in self._terminating_coords
+            entry = self._terminating_coords.get(pod_key)
+            hit = entry is not None
             if hit:
                 self._terminating_coords.pop(pod_key, None)
                 if self._events is not None:
@@ -865,6 +900,8 @@ class GangManager:
                                       pod_key)
                 # the unmasked chips are placeable again: invalidate
                 self._epoch += 1
+                self._note_delta_locked(slices=(entry[0],),
+                                        why=f"victim-gone {pod_key}")
             for res in self._reservations.values():
                 if pod_key in res.terminating_victims:
                     res.terminating_victims.discard(pod_key)
@@ -1036,6 +1073,7 @@ class GangManager:
                 raise GangError(f"gang {res.key}: coords {bad} not reservable")
             res.record_assignment(pod_key, sid, list(coords))
             self._epoch += 1
+            self._note_delta_locked(slices=(sid,), why=f"bound {pod_key}")
             if not res.committed and len(res.assigned) >= res.group.min_member:
                 res.committed = True
                 res.commit_latency = self._clock.monotonic() - res.created
@@ -1103,6 +1141,7 @@ class GangManager:
         with self._lock:
             for res in self._reservations.values():
                 if pod_key in res.assigned:
+                    sid = res.assigned[pod_key][0]
                     res.drop_assignment(pod_key)
                     if res.committed and not res.assigned:
                         self._reservations.pop(res.key, None)
@@ -1113,6 +1152,8 @@ class GangManager:
                     # one bump AFTER the last seam of the batch (the
                     # epoch-discipline lint checks bump-follows-seam)
                     self._epoch += 1
+                    self._note_delta_locked(
+                        slices=(sid,), why=f"member-release {pod_key}")
                     return
 
     def reassign(self, pod_key: str, coords: list[TopologyCoord]) -> bool:
@@ -1136,6 +1177,11 @@ class GangManager:
                     res.slice_coords[sid] = pool
                     res.record_assignment(pod_key, sid, list(coords))
                     self._epoch += 1
+                    # net reserved change is empty (old coords leave
+                    # pool+assigned, new join both), but the note keeps
+                    # the delta chain contiguous for this bump
+                    self._note_delta_locked(
+                        slices=(sid,), why=f"reassign {pod_key}")
                     return True
         return False
 

@@ -617,21 +617,37 @@ def _find_connected(
 
 
 def largest_free_box_in(sweep: _Sweep) -> int:
-    """Volume of the largest fully-free box over a prepared sweep (full
-    shape scan against the free-box index — repeated calls on a cached
-    snapshot sweep answer from memoized origins)."""
+    """Volume of the largest fully-free box over a prepared sweep.
+
+    Feasibility is monotone in each extent (a free (a, b, c) box
+    contains a free (a, b, c-1) box), so for each (a, b) pair the
+    maximal feasible third extent is found by BINARY search —
+    O(X·Y·log Z) origin queries instead of the O(X·Y·Z) descending
+    scan, which at the 10k-node meshes (32×32×40) made every
+    fragmentation render a multi-thousand-tier sweep. Results are
+    identical to the exhaustive scan (property-tested); repeated calls
+    on a cached snapshot sweep answer from memoized origins."""
     best = 0
     X, Y, Z = sweep.mesh.dims
     for a in range(1, X + 1):
+        if a * Y * Z <= best:
+            continue
         for b in range(1, Y + 1):
             if a * b * Z <= best:
                 continue
-            for c in range(Z, 0, -1):
-                if a * b * c <= best:
-                    break
-                if len(sweep.origins((a, b, c))):
-                    best = a * b * c
-                    break
+            # smallest c that would beat the best so far; probe it
+            # first — if even that fails, no c can improve on (a, b)
+            lo = best // (a * b) + 1
+            if lo > Z or not len(sweep.origins((a, b, lo))):
+                continue
+            hi = Z
+            while lo < hi:  # largest feasible c, by bisection
+                mid = (lo + hi + 1) // 2
+                if len(sweep.origins((a, b, mid))):
+                    lo = mid
+                else:
+                    hi = mid - 1
+            best = a * b * lo
     return best
 
 

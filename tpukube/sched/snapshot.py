@@ -50,6 +50,44 @@ region exits, and the config-gated audit sentinel here
 from the ledger at runtime, raising :class:`SnapshotAuditError` on any
 divergence — so a seam the static registry misses still cannot serve
 stale placements silently.
+
+Incremental maintenance (ISSUE 10): an epoch bump used to mean a full
+O(chips) rebuild — every node view re-scanned to recapture the coord
+sets — which at 10k nodes dominates the per-cycle constant the batch
+planner left behind. Now every bump seam in ``sched/state.py`` and
+``sched/gang.py`` also records a typed :class:`SnapshotDelta` into the
+cache's bounded per-stream log (``note()``), and ``current()``
+ADVANCES the cached snapshot by applying the queued deltas instead of
+rebuilding:
+
+  * ledger deltas carry explicit per-slice occupied-chip add/remove
+    sets plus the used-share change (commit/release are the O(Δ) hot
+    seams — the 40k-chip occupied set is patched, never re-derived);
+  * gang deltas name the touched slices; the (small) reserved /
+    terminating masks of exactly those slices are re-read from the
+    live GangManager at apply time — set-delta arithmetic over the
+    union semantics of ``reserved_coords`` (unassigned reservation
+    chips ∪ terminating victims, which may overlap) would be easy to
+    get subtly wrong, and re-deriving a few-hundred-coord mask is
+    already O(Δ), so the masks use the single existing source of
+    truth. The epoch re-check after the advance keeps the torn-build
+    contract identical to ``_build``'s (see ``current()``);
+  * only the TOUCHED slices get fresh :class:`SliceSnapshot` objects
+    (their lazy sweeps / fragmentation gauges invalidate); untouched
+    slices are shared by reference and keep their warm sweep tables;
+  * structural changes — node upsert with a changed payload, slice
+    registration, ``rebuild_from_pods`` — record a ``full`` marker,
+    and a marker, a log gap (overflow), or an unknown slice falls back
+    to the full rebuild. A bump whose seam forgot to ``note()`` shows
+    up as a gap, so a missing delta degrades to a rebuild instead of a
+    stale cache.
+
+The audit sentinel cross-checks the delta math at runtime: it compares
+the (possibly delta-advanced) cached snapshot against a cold ledger
+rebuild, so a wrong delta raises :class:`SnapshotAuditError` exactly
+like a missed epoch bump. ``snapshot_delta_enabled=false`` disables
+the log and restores the rebuild-every-epoch behavior (the oracle the
+parity tests compare against).
 """
 
 from __future__ import annotations
@@ -76,6 +114,54 @@ class SnapshotAuditError(RuntimeError):
     registries in analysis/epochs.py) exists to prevent."""
 
 
+class SnapshotDelta:
+    """One epoch bump's snapshot-visible effect, recorded by the seam
+    that bumped (under its own lock, so per-stream order is bump
+    order). Two streams, keyed by which epoch the bump advanced:
+
+      * ``kind="ledger"`` (ClusterState._epoch): explicit per-slice
+        occupied-chip transitions — ``occupied_add`` are chips whose
+        used shares left zero (or that a commit claimed whole),
+        ``occupied_remove`` chips whose shares returned to zero on a
+        healthy chip — plus the used-share change feeding the slice
+        utilization. Unhealthy/broken-link changes never travel as
+        deltas: they arrive via node re-annotation, which is a ``full``
+        marker (below).
+      * ``kind="gang"`` (GangManager._epoch): the ``slices`` whose
+        reserved / terminating masks changed; the masks themselves are
+        re-read from the GangManager at apply time (they are O(Δ)-small
+        and their union semantics live in ``reserved_coords``).
+
+    ``full=True`` marks a structural change (node upsert with a changed
+    payload, slice registration) that invalidates the whole cached
+    snapshot: the advance path refuses the chain and falls back to a
+    full rebuild."""
+
+    __slots__ = ("kind", "epoch", "full", "slice_id", "occupied_add",
+                 "occupied_remove", "used_shares_delta", "slices", "why")
+
+    def __init__(self, kind: str, epoch: int, full: bool = False,
+                 slice_id: Optional[str] = None,
+                 occupied_add: tuple = (), occupied_remove: tuple = (),
+                 used_shares_delta: int = 0,
+                 slices: tuple = (), why: str = ""):
+        assert kind in ("ledger", "gang"), kind
+        self.kind = kind
+        self.epoch = epoch  # the epoch value AFTER the bump
+        self.full = full
+        self.slice_id = slice_id
+        self.occupied_add = occupied_add
+        self.occupied_remove = occupied_remove
+        self.used_shares_delta = used_shares_delta
+        self.slices = slices
+        self.why = why
+
+    def __repr__(self) -> str:  # debugging / test failure readability
+        return (f"SnapshotDelta({self.kind}@{self.epoch}"
+                f"{', FULL' if self.full else ''}"
+                f"{f', {self.why}' if self.why else ''})")
+
+
 def sweep_for(
     mesh: MeshSpec, blocked: Iterable[TopologyCoord]
 ) -> "slicefit._Sweep":
@@ -98,7 +184,7 @@ class SliceSnapshot:
 
     __slots__ = (
         "slice_id", "mesh", "occupied", "reserved", "unhealthy",
-        "terminating", "broken", "utilization",
+        "terminating", "broken", "used_shares", "total_shares",
         "_occ_sweep", "_blocked_sweep", "_frag", "_largest",
     )
 
@@ -111,7 +197,8 @@ class SliceSnapshot:
         unhealthy: frozenset[TopologyCoord],
         terminating: frozenset[TopologyCoord],
         broken: frozenset[Link],
-        utilization: float,
+        used_shares: int,
+        total_shares: int,
     ):
         self.slice_id = slice_id
         self.mesh = mesh
@@ -124,11 +211,23 @@ class SliceSnapshot:
         #: planners treat these like unhealthy: nothing frees them sooner)
         self.terminating = terminating
         self.broken = broken
-        self.utilization = utilization
+        #: allocated / total shares over healthy capacity — carried as
+        #: the two INTEGERS (not the derived float) so a ledger delta
+        #: can advance utilization in O(1); total only moves on health/
+        #: topology changes, which are full-rebuild markers
+        self.used_shares = used_shares
+        self.total_shares = total_shares
         self._occ_sweep: Optional[slicefit._Sweep] = None
         self._blocked_sweep: Optional[slicefit._Sweep] = None
         self._frag: Optional[float] = None
         self._largest: Optional[int] = None
+
+    @property
+    def utilization(self) -> float:
+        """Allocated share fraction over healthy capacity (the gang
+        layer's bin-pack signal), derived from the carried counts."""
+        return self.used_shares / self.total_shares if self.total_shares \
+            else 0.0
 
     # -- prepared sweeps ---------------------------------------------------
     def occupancy_sweep(self) -> "slicefit._Sweep":
@@ -259,16 +358,43 @@ class SnapshotCache:
     (ledger, gang) epoch pair."""
 
     REBUILD_WINDOW = 512  # rebuild-latency samples kept for quantiles
+    #: per-stream delta-log bound: must exceed the deepest epoch run
+    #: between two cache lookups (a full batch cycle of assumed
+    #: commits plus a completion wave of releases) or the advance
+    #: degrades to a full rebuild (overflow). Entries are a few dozen
+    #: bytes, so the bound is memory-cheap headroom.
+    DELTA_LOG = 16384
 
     def __init__(self, state, gang):
         self._state = state
         self._gang = gang
-        # leaf mutex: guards only the cached-snapshot slot and the
-        # counters — never held while taking the gang/ledger locks
+        # leaf mutex: guards only the cached-snapshot slot, the delta
+        # log, and the counters — never held while taking the
+        # gang/ledger locks
         self._lock = threading.Lock()
         self._snap: Optional[ClusterSnapshot] = None
+        #: cached-slot generation: bumped on EVERY write of _snap (the
+        #: epoch-discipline CFG pass proves the pairing statically —
+        #: EPOCH_REGISTRY's sched/snapshot.py entry)
+        self._snap_gen = 0
         self.rebuilds = 0
         self.hits = 0
+        # Incremental maintenance (ISSUE 10): bump seams note() typed
+        # SnapshotDeltas here; current() advances the cached snapshot
+        # by applying them instead of rebuilding O(chips). Per-stream
+        # deques — appends are ordered by the owning ledger/gang lock.
+        self.delta_enabled = True
+        self._delta_log: dict[str, deque[SnapshotDelta]] = {
+            "ledger": deque(maxlen=self.DELTA_LOG),
+            "gang": deque(maxlen=self.DELTA_LOG),
+        }
+        self.delta_applies = 0
+        self.delta_overflows = 0
+        self._delta_apply_seconds: deque[float] = deque(
+            maxlen=self.REBUILD_WINDOW
+        )
+        self.delta_apply_seconds_total = 0.0
+        self.rebuild_seconds_total = 0.0
         self._rebuild_seconds: deque[float] = deque(
             maxlen=self.REBUILD_WINDOW
         )
@@ -291,9 +417,133 @@ class SnapshotCache:
 
     def invalidate(self) -> None:
         """Drop the cached snapshot (tests and the no-cache microbench
-        baseline; production invalidation is epoch bumps, never this)."""
+        baseline; production invalidation is epoch bumps, never this).
+        With no base snapshot the next lookup is a full rebuild — the
+        delta log cannot advance from nothing."""
         with self._lock:
             self._snap = None
+            self._snap_gen += 1
+
+    # -- the delta log -------------------------------------------------------
+    def note(self, delta: SnapshotDelta) -> None:
+        """Record one bump's effect. Called by the seam that bumped,
+        under ITS lock (ledger or gang), so each stream's append order
+        is epoch order; the cache mutex stays a leaf. No-op with the
+        feature off — every epoch advance then rebuilds, the oracle
+        behavior the parity tests compare against."""
+        if not self.delta_enabled:
+            return
+        with self._lock:
+            self._delta_log[delta.kind].append(delta)
+
+    def deltas_between(
+        self, old_key: tuple[int, int], new_key: tuple[int, int]
+    ) -> Optional[list[SnapshotDelta]]:
+        """The contiguous delta chain advancing ``old_key`` to
+        ``new_key`` (per-stream epoch order; ledger first), or None
+        when the log cannot cover the range — entries dropped by the
+        bound, a bump whose seam never noted, or the feature off. The
+        chain may contain ``full`` markers; callers must treat any
+        marker as rebuild-required. Also the batch planner's feed: the
+        cycle patches its persistent fast-state overlay from the same
+        chain the snapshot advanced by."""
+        (s0, g0), (s1, g1) = old_key, new_key
+        if s1 < s0 or g1 < g0:
+            return None
+        out: list[SnapshotDelta] = []
+        with self._lock:
+            for kind, lo, hi in (("ledger", s0, s1), ("gang", g0, g1)):
+                if hi == lo:
+                    continue
+                # per-stream epochs append in strictly increasing order,
+                # so the wanted chain is a SUFFIX (minus entries newer
+                # than hi): walk from the right and stop at lo — O(Δ +
+                # newer-than-hi), never a full scan of the bounded log
+                # (this runs under the leaf mutex that note() also
+                # takes from inside the ledger/gang locks, so a full
+                # 16k-entry filter here would stall commits)
+                got = []
+                for d in reversed(self._delta_log[kind]):
+                    if d.epoch > hi:
+                        continue
+                    if d.epoch <= lo:
+                        break
+                    got.append(d)
+                if len(got) != hi - lo:
+                    return None  # gap: dropped or never noted
+                got.reverse()
+                out.extend(got)
+        return out
+
+    def _advance(self, base: ClusterSnapshot,
+                 key: tuple[int, int]) -> Optional[ClusterSnapshot]:
+        """Apply the queued deltas to ``base``, producing the snapshot
+        for ``key`` in O(Δ): only touched slices get fresh
+        SliceSnapshots (their lazy sweeps invalidate); untouched slices
+        are shared by reference. None = not coverable (gap/full/unknown
+        slice) — the caller falls back to a full rebuild. Runs OUTSIDE
+        the cache mutex; the gang-mask re-reads take the gang lock,
+        and may observe state newer than ``key`` under a lock-free
+        observer race — the caller's epoch re-check then refuses to
+        cache the result, exactly the ``_build`` torn-build contract."""
+        deltas = self.deltas_between(base.key, key)
+        if deltas is None:
+            with self._lock:
+                self.delta_overflows += 1
+            return None
+        if any(d.full for d in deltas):
+            return None  # structural change: rebuild is the only truth
+        # merge the ledger stream per slice (net add/remove against the
+        # base set: an add cancels a pending remove and vice versa)
+        occ_add: dict[str, set] = {}
+        occ_rem: dict[str, set] = {}
+        used: dict[str, int] = {}
+        gang_touched: set[str] = set()
+        for d in deltas:
+            if d.kind == "gang":
+                gang_touched.update(d.slices)
+                continue
+            sid = d.slice_id
+            if sid is None:
+                continue  # an empty ledger bump (release on a gone node)
+            add = occ_add.setdefault(sid, set())
+            rem = occ_rem.setdefault(sid, set())
+            for c in d.occupied_add:
+                rem.discard(c)
+                add.add(c)
+            for c in d.occupied_remove:
+                add.discard(c)
+                rem.add(c)
+            used[sid] = used.get(sid, 0) + d.used_shares_delta
+        touched = set(occ_add) | set(occ_rem) | set(used) | gang_touched
+        if not touched <= set(base.slices):
+            return None  # slice appeared without a full marker?!
+        slices = dict(base.slices)
+        for sid in touched:
+            old = base.slices[sid]
+            occupied = old.occupied
+            if occ_add.get(sid) or occ_rem.get(sid):
+                occupied = frozenset(
+                    (occupied - occ_rem[sid]) | occ_add[sid]
+                )
+            if sid in gang_touched:
+                reserved = frozenset(self._gang.reserved_coords(sid))
+                terminating = frozenset(
+                    self._gang.terminating_coords(sid))
+            else:
+                reserved, terminating = old.reserved, old.terminating
+            slices[sid] = SliceSnapshot(
+                slice_id=sid,
+                mesh=old.mesh,
+                occupied=occupied,
+                reserved=reserved,
+                unhealthy=old.unhealthy,
+                terminating=terminating,
+                broken=old.broken,
+                used_shares=old.used_shares + used.get(sid, 0),
+                total_shares=old.total_shares,
+            )
+        return ClusterSnapshot(key=key, slices=slices)
 
     # -- the cache ---------------------------------------------------------
     def current(self) -> ClusterSnapshot:
@@ -331,6 +581,7 @@ class SnapshotCache:
                 hit: Optional[ClusterSnapshot] = snap
             else:
                 hit = None
+            base = snap  # delta-advance base (None = cold start)
         if hit is not None:
             if count_hit and self.audit_rate > 0.0:
                 # audit OUTSIDE the leaf mutex: the rebuild takes the
@@ -340,17 +591,33 @@ class SnapshotCache:
                 self._maybe_audit(hit)
             return hit
         for _ in range(3):
-            t0 = time.perf_counter()
-            snap = self._build(key)
-            snap.build_seconds = time.perf_counter() - t0
+            snap = None
+            if (base is not None and self.delta_enabled
+                    and base.key != key):
+                t0 = time.perf_counter()
+                snap = self._advance(base, key)
+                if snap is not None:
+                    dt = time.perf_counter() - t0
+                    with self._lock:
+                        self.delta_applies += 1
+                        self._delta_apply_seconds.append(dt)
+                        self.delta_apply_seconds_total += dt
+            if snap is None:
+                t0 = time.perf_counter()
+                snap = self._build(key)
+                snap.build_seconds = time.perf_counter() - t0
+                with self._lock:
+                    self.rebuilds += 1
+                    self._rebuild_seconds.append(snap.build_seconds)
+                    self.rebuild_seconds_total += snap.build_seconds
             after = self.epoch_key()
             with self._lock:
-                self.rebuilds += 1
-                self._rebuild_seconds.append(snap.build_seconds)
                 if after == key:
                     self._snap = snap
+                    self._snap_gen += 1
                     return snap
             key = after
+            base = snap  # labeled for the missed key; advance from it
         return snap  # an observer raced mutations: serve uncached
 
     # -- audit sentinel ----------------------------------------------------
@@ -379,7 +646,8 @@ class SnapshotCache:
             raise SnapshotAuditError(
                 f"cached snapshot at epochs {snap.key} diverges from a "
                 f"ledger rebuild ({detail}) — some mutation path is "
-                f"missing an epoch bump (see analysis/epochs.py "
+                f"missing an epoch bump, or a recorded SnapshotDelta "
+                f"mis-stated its seam's effect (see analysis/epochs.py "
                 f"EPOCH_REGISTRY and the epoch-discipline lint)"
             )
 
@@ -394,6 +662,7 @@ class SnapshotCache:
                 log.warning("snapshot build: slice %s vanished: %s",
                             sid, e)
                 continue
+            used, total = self._state.slice_share_counts(sid)
             slices[sid] = SliceSnapshot(
                 slice_id=sid,
                 mesh=mesh,
@@ -402,7 +671,8 @@ class SnapshotCache:
                 unhealthy=frozenset(self._state.unhealthy_coords(sid)),
                 terminating=frozenset(self._gang.terminating_coords(sid)),
                 broken=frozenset(self._state.broken_links(sid)),
-                utilization=self._state.slice_utilization(sid),
+                used_shares=used,
+                total_shares=total,
             )
         return ClusterSnapshot(key=key, slices=slices)
 
@@ -414,6 +684,13 @@ class SnapshotCache:
         with self._lock:
             return list(self._rebuild_seconds)
 
+    def delta_apply_seconds_snapshot(self) -> list[float]:
+        """Copy of the delta-apply latency window (the /metrics
+        summary's values_fn; one sample per O(Δ) advance, however many
+        queued deltas it covered)."""
+        with self._lock:
+            return list(self._delta_apply_seconds)
+
     def stats(self) -> dict[str, Any]:
         """The /statusz document: cache counters plus the per-slice
         fragmentation numbers the snapshot makes cheap to serve.
@@ -422,12 +699,15 @@ class SnapshotCache:
         snap = self.observe()
         with self._lock:
             rebuilds, hits = self.rebuilds, self.hits
+            applies, overflows = self.delta_applies, self.delta_overflows
             checks, diverged = self.audit_checks, self.audit_divergences
             last = (self._rebuild_seconds[-1]
                     if self._rebuild_seconds else None)
         lookups = rebuilds + hits
+        advances = rebuilds + applies
         return {
             "epoch": {"ledger": snap.key[0], "gang": snap.key[1]},
+            "generation": self._snap_gen,
             "rebuilds": rebuilds,
             "hits": hits,
             "audit": {
@@ -435,6 +715,17 @@ class SnapshotCache:
                 "checks": checks,
                 "divergences": diverged,
             },
+            "delta": {
+                "enabled": self.delta_enabled,
+                "applies": applies,
+                "overflows": overflows,
+            },
+            # of the lookups that had to move the snapshot forward, the
+            # fraction the O(Δ) delta path served (vs full rebuilds) —
+            # a low rate with the feature on means overflow/structural
+            # churn is defeating the increment
+            "delta_hit_rate": (round(applies / advances, 4)
+                               if advances else None),
             "hit_rate": round(hits / lookups, 4) if lookups else None,
             "last_rebuild_s": (round(last, 6) if last is not None
                                else None),

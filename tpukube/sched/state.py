@@ -194,6 +194,36 @@ class ClusterState:
         # validity on this, so a missed bump here would serve stale
         # placements — treat any new mutation path as epoch-bumping.
         self._epoch = 0
+        # snapshot delta sink (sched/snapshot.py SnapshotCache, wired
+        # by the owning GangManager): every epoch bump pairs with a
+        # _note_delta so the cache can advance O(Δ) instead of
+        # rebuilding. A bump without a note degrades to a full rebuild
+        # (log gap), never to a stale cache.
+        self._delta_sink = None
+
+    def set_delta_sink(self, sink) -> None:
+        """Attach the snapshot cache's delta log (None detaches)."""
+        with self._lock:
+            self._delta_sink = sink
+
+    def _note_delta_locked(self, full: bool = False,
+                    slice_id: Optional[str] = None,
+                    occupied_add: tuple = (), occupied_remove: tuple = (),
+                    used_shares_delta: int = 0, why: str = "") -> None:
+        """Record the bump just taken (callers hold ``self._lock`` and
+        call this right after ``self._epoch += 1``). Import is lazy and
+        one-directional: snapshot.py never imports state."""
+        sink = self._delta_sink
+        if sink is None:
+            return
+        from tpukube.sched.snapshot import SnapshotDelta
+
+        sink.note(SnapshotDelta(
+            kind="ledger", epoch=self._epoch, full=full,
+            slice_id=slice_id, occupied_add=occupied_add,
+            occupied_remove=occupied_remove,
+            used_shares_delta=used_shares_delta, why=why,
+        ))
 
     def epoch(self) -> int:
         """Monotonic mutation counter (the snapshot cache's key half)."""
@@ -225,6 +255,10 @@ class ClusterState:
                 # slice invisible to the epoch cache (found by
                 # tpukube-lint's epoch-discipline pass)
                 self._epoch += 1
+                # a new slice is structural: the delta path cannot
+                # patch a slice the base snapshot never held
+                self._note_delta_locked(full=True,
+                                 why=f"slice {info.slice_id} registered")
             elif sl.mesh != mesh:
                 raise StateError(
                     f"node {name} reports mesh {mesh.dims} for slice "
@@ -285,6 +319,13 @@ class ClusterState:
                 view.id_weights = prev.id_weights
             self._nodes[name] = view
             self._epoch += 1
+            # a CHANGED node payload may move health, links, topology,
+            # or sharing mode — all structural for the snapshot (they
+            # shift unhealthy/broken sets and the healthy-share totals
+            # the delta math assumes constant): full-rebuild marker.
+            # The unchanged-payload early return above keeps the hot
+            # webhook resend path bump- and delta-free.
+            self._note_delta_locked(full=True, why=f"node {name} re-annotated")
         return True
 
     # -- views -------------------------------------------------------------
@@ -394,9 +435,11 @@ class ClusterState:
                 for link in view.info.bad_links
             }
 
-    def slice_utilization(self, slice_id: str) -> float:
-        """Allocated share fraction over healthy capacity of ONE slice —
-        the gang layer's bin-pack signal for slice choice."""
+    def slice_share_counts(self, slice_id: str) -> tuple[int, int]:
+        """(used, total) shares over healthy capacity of ONE slice —
+        the integer pair the snapshot carries so ledger deltas can
+        advance utilization in O(1) (total only moves on health or
+        topology changes, which are full-rebuild markers)."""
         with self._lock:
             total = used = 0
             for view in self._slice_views_locked(slice_id):
@@ -405,7 +448,13 @@ class ClusterState:
                     if chip.health is Health.HEALTHY:
                         total += n
                         used += min(n, view.used_share_count(chip.index))
-            return used / total if total else 0.0
+            return used, total
+
+    def slice_utilization(self, slice_id: str) -> float:
+        """Allocated share fraction over healthy capacity of ONE slice —
+        the gang layer's bin-pack signal for slice choice."""
+        used, total = self.slice_share_counts(slice_id)
+        return used / total if total else 0.0
 
     def allocation(self, pod_key: str) -> Optional[AllocResult]:
         with self._lock:
@@ -466,9 +515,24 @@ class ClusterState:
                     raise StateError(f"{did}: insufficient free shares")
                 adding.add(did)
                 pending_shares[index] = pending_shares.get(index, 0) + want
+            # occupied-set transitions for the snapshot delta: a chip
+            # enters `occupied` when its used shares leave zero (all
+            # committed chips are healthy — validated above — so the
+            # used-share change equals the full added weight)
+            newly_occupied = tuple(
+                view.chip(index).coord
+                for index in pending_shares
+                if view.used_share_count(index) == 0
+            )
             view.add_ids(adding)
             self._allocs[alloc.pod_key] = alloc
             self._epoch += 1
+            self._note_delta_locked(
+                slice_id=view.info.slice_id,
+                occupied_add=newly_occupied,
+                used_shares_delta=sum(pending_shares.values()),
+                why=f"commit {alloc.pod_key}",
+            )
 
     def release(self, pod_key: str) -> Optional[AllocResult]:
         """Pod gone (deleted/preempted): free its shares."""
@@ -481,9 +545,38 @@ class ClusterState:
                 return None
             self._allocs.pop(pod_key, None)
             view = self._nodes.get(alloc.node_name)
-            if view is not None:
-                view.remove_ids(alloc.device_ids)
+            if view is None:
+                # node view gone: its chips are in no slice's occupied
+                # set either — an empty delta keeps the chain whole
+                self._epoch += 1
+                self._note_delta_locked(why=f"release {pod_key} (node gone)")
+                return alloc
+            # snapshot delta: shares removed from HEALTHY chips reduce
+            # the slice's used count (unhealthy chips were never counted
+            # — nor do they leave `occupied`, which health holds)
+            used_delta = 0
+            indices: set[int] = set()
+            for did in alloc.device_ids:
+                if did not in view.used_ids:
+                    continue
+                index, _ = parse_device_id(did)
+                indices.add(index)
+                if view.chip(index).health is Health.HEALTHY:
+                    used_delta -= view.id_weights.get(did, 0)
+            view.remove_ids(alloc.device_ids)
+            freed = tuple(
+                view.chip(index).coord
+                for index in sorted(indices)
+                if view.used_share_count(index) == 0
+                and view.chip(index).health is Health.HEALTHY
+            )
             self._epoch += 1
+            self._note_delta_locked(
+                slice_id=view.info.slice_id,
+                occupied_remove=freed,
+                used_shares_delta=used_delta,
+                why=f"release {pod_key}",
+            )
             return alloc
 
     # -- restart story -----------------------------------------------------

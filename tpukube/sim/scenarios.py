@@ -12,7 +12,7 @@ from __future__ import annotations
 import json
 import time
 import urllib.request
-from typing import Any
+from typing import Any, Optional
 
 from tpukube.core.config import TpuKubeConfig, load_config
 from tpukube.core.types import PodGroup
@@ -28,6 +28,10 @@ from tpukube.sim.harness import SimCluster
 _PASSTHROUGH_KEYS = (
     "TPUKUBE_CHAOS_SEED",
     "TPUKUBE_SNAPSHOT_AUDIT_RATE",
+    # incremental snapshot deltas (ISSUE 10): the parity suite re-runs
+    # scenarios with TPUKUBE_SNAPSHOT_DELTA_ENABLED=0 (the
+    # rebuild-every-epoch oracle) asserting bit-identical placements
+    "TPUKUBE_SNAPSHOT_DELTA_ENABLED",
     "TPUKUBE_BATCH_ENABLED",
     "TPUKUBE_BATCH_MAX_PODS",
     "TPUKUBE_CYCLE_INTERVAL_SECONDS",
@@ -73,6 +77,7 @@ def run(scenario: int, config: TpuKubeConfig | None = None) -> dict[str, Any]:
         9: crash_recovery,
         10: kilonode_churn,
         11: tenant_serving,
+        12: kilonode10k_churn,
     }[scenario]
     t0 = time.perf_counter()
     result = fn(config)
@@ -655,11 +660,6 @@ def kilonode_churn(config: TpuKubeConfig | None) -> dict[str, Any]:
     or a pod count short of the target.
     """
     import os
-    from collections import deque as _deque
-
-    from tpukube.chaos import ledger_divergence
-    from tpukube.core.clock import FakeClock
-    from tpukube.obs.registry import quantile
 
     cfg = config or load_config(env=_env({
         "TPUKUBE_SIM_MESH_DIMS": "16,16,16",
@@ -668,7 +668,62 @@ def kilonode_churn(config: TpuKubeConfig | None) -> dict[str, Any]:
         "TPUKUBE_BATCH_MAX_PODS": "1024",
     }))
     total_target = int(os.environ.get("TPUKUBE_KILONODE_PODS", "100000"))
-    gang_size = 256
+    return _kilonode_drive(cfg, metric="kilonode_churn",
+                           total_target=total_target, gang_size=256)
+
+
+def kilonode10k_churn(config: TpuKubeConfig | None) -> dict[str, Any]:
+    """Scenario 12 (ISSUE 10 acceptance): the 10k-node / 40k-chip
+    churn drive — 10240 nodes over a 32x32x40 mesh (40960 chips), a
+    committed 512-member training gang placed through the batched gang
+    planner, and burst-churn waves through the batched cycles on the
+    fake clock, with the incremental snapshot path (delta advance +
+    persistent fast-state patching) carrying the per-cycle constant
+    that a full O(chips) rebuild would otherwise pay 10x over.
+
+    Reports the ISSUE 10 bench keys: ``pods_per_sec``, the plan-hit
+    ratio, and ``delta_apply_p50_ms`` vs ``rebuild_p50_ms`` — the
+    latter measured by forcing full rebuilds on the SAME loaded
+    cluster at drive end, so the speedup is apples-to-apples.
+
+    ``TPUKUBE_KILONODE10K_PODS`` scales the trace (default 40000; the
+    check.sh smoke stage runs a shorter fixed trace). Raises on: gang
+    uncommitted, ledger/store divergence, LEAKED RESERVATIONS, or a
+    pod shortfall."""
+    import os
+
+    cfg = config or load_config(env=_env({
+        "TPUKUBE_SIM_MESH_DIMS": "32,32,40",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        "TPUKUBE_BATCH_ENABLED": "1",
+        "TPUKUBE_BATCH_MAX_PODS": "2048",
+    }))
+    total_target = int(os.environ.get("TPUKUBE_KILONODE10K_PODS",
+                                      "40000"))
+    return _kilonode_drive(cfg, metric="kilonode10k_churn",
+                           total_target=total_target, gang_size=512,
+                           max_alive=8192, check_leaks=True,
+                           delta_stats=True)
+
+
+def _kilonode_drive(cfg: TpuKubeConfig, metric: str, total_target: int,
+                    gang_size: int,
+                    max_alive: Optional[int] = None,
+                    check_leaks: bool = False,
+                    delta_stats: bool = False) -> dict[str, Any]:
+    """The shared kilonode churn driver (scenarios 10 and 12): a
+    committed training gang pins a contiguous block while burst waves
+    arrive, run five simulated minutes, and complete, on the fake
+    clock through the batched cycles. ``check_leaks`` adds the
+    leaked-reservation invariant and ``delta_stats`` the ISSUE 10
+    snapshot-maintenance numbers (delta-apply p50 vs a forced full
+    rebuild p50 measured on the SAME loaded cluster at drive end)."""
+    from collections import deque as _deque
+
+    from tpukube.chaos import leaked_reservations, ledger_divergence
+    from tpukube.core.clock import FakeClock
+    from tpukube.obs.registry import quantile
+
     sample_every = 101  # full-webhook-protocol sampling cadence
     clock = FakeClock()
     t0 = time.perf_counter()
@@ -688,6 +743,13 @@ def kilonode_churn(config: TpuKubeConfig | None) -> dict[str, Any]:
         sampled = 0
 
         capacity = n_chips - gang_size
+        if max_alive is not None:
+            # cap the live burst plane below mesh capacity so the
+            # completion churn — the release-delta traffic the
+            # incremental snapshot path must keep up with — starts
+            # early even on a short smoke trace, instead of only after
+            # the whole 40k-chip mesh fills
+            capacity = min(capacity, max_alive)
         wave = min(cfg.batch_max_pods, capacity // 2)
         alive: _deque[str] = _deque()
         seq = 0
@@ -729,7 +791,7 @@ def kilonode_churn(config: TpuKubeConfig | None) -> dict[str, Any]:
             for handler, window in ext.latencies.items()
         }
         result = {
-            "metric": "kilonode_churn",
+            "metric": metric,
             "value": round(scheduled / wall, 1),
             "unit": "pods scheduled per second",
             "nodes": n_nodes,
@@ -749,7 +811,46 @@ def kilonode_churn(config: TpuKubeConfig | None) -> dict[str, Any]:
             "cycle": ext.cycle.stats() if ext.cycle is not None else None,
             "utilization_percent": round(100 * c.utilization(), 2),
         }
+        if delta_stats:
+            # the ISSUE 10 acceptance numbers: the O(Δ) delta-advance
+            # p50 against a FORCED full-rebuild p50 on the same loaded
+            # cluster (invalidate drops the cached snapshot, so the
+            # next lookup re-derives every coord set from the ledger —
+            # the pre-delta per-epoch cost)
+            snaps = ext.snapshots
+            applies = snaps.delta_apply_seconds_snapshot()
+            # total maintenance cost normalized per cycle, captured
+            # BEFORE the forced-rebuild measurement below inflates the
+            # rebuild totals (the BENCH scaling sweep's per-point key)
+            cycles = ext.cycle.cycles if ext.cycle is not None else 0
+            maintain_s = (snaps.delta_apply_seconds_total
+                          + snaps.rebuild_seconds_total)
+            rebuild_walls = []
+            for _ in range(5):
+                snaps.invalidate()
+                r0 = time.perf_counter()
+                snaps.current()
+                rebuild_walls.append(time.perf_counter() - r0)
+            delta_p50 = quantile(applies, 0.5)
+            rebuild_p50 = quantile(rebuild_walls, 0.5)
+            result["snapshot"] = {
+                "delta_applies": snaps.delta_applies,
+                "delta_overflows": snaps.delta_overflows,
+                "rebuilds": snaps.rebuilds,
+                "snapshot_ms_per_cycle": (
+                    round(1000 * maintain_s / cycles, 4) if cycles
+                    else None
+                ),
+                "delta_apply_p50_ms": round(1000 * delta_p50, 4),
+                "rebuild_p50_ms": round(1000 * rebuild_p50, 4),
+                "delta_speedup": (
+                    round(rebuild_p50 / delta_p50, 1)
+                    if delta_p50 > 0 else None
+                ),
+            }
         problems = list(div)
+        if check_leaks:
+            problems += [str(p) for p in leaked_reservations(c)]
         if not committed:
             problems.append("the kilotrain gang never committed")
         if scheduled < total_target:
@@ -757,7 +858,7 @@ def kilonode_churn(config: TpuKubeConfig | None) -> dict[str, Any]:
                 f"only {scheduled}/{total_target} pods scheduled"
             )
         if problems:
-            raise RuntimeError("scenario 10 invariants violated: "
+            raise RuntimeError(f"{metric} invariants violated: "
                                + "; ".join(problems[:5]))
         return result
 
