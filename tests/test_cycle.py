@@ -382,7 +382,9 @@ def test_queued_pods_plan_against_their_own_candidate_sets():
         # TWO-node candidate list and triggers the drain
         for i in range(2):
             ext.admit(kube.pod_from_k8s(c.make_pod(f"drv-{i}", tpu=1)))
-        restricted = ext.state.node_names()[:2]
+        # the wire carries a JSON array: node_names() itself serves a
+        # cached tuple (ISSUE 11 satellite), so listify for the body
+        restricted = list(ext.state.node_names()[:2])
         probe = c.make_pod("probe", tpu=1)
         fres = ext.handle("filter", {"Pod": probe,
                                      "NodeNames": restricted})

@@ -171,29 +171,65 @@ class _Driver:
             self.terminating.remove(victim)
         self.ext.gang.dissolve(res.key)
 
-    def op_upsert_health_flip(self):
-        """A changed payload (health flip) is a structural marker: the
-        next lookup must full-rebuild, and still match the oracle."""
-        host = self.rng.choice(sorted(self.ext.state.node_names()))
+    def _reannotate(self, host, flip_health=False, bad_links=None):
         view = self.ext.state.node(host)
-        r0 = self.ext.snapshots.rebuilds
         chips = []
         for i, c in enumerate(self.mesh.coords_of_host(host)):
             chip = ChipInfo(chip_id=f"{host}-c{i}", index=i, coord=c,
-                            hbm_bytes=self.cfg.hbm_bytes_per_chip)
-            if i == 0:
+                            hbm_bytes=self.cfg.hbm_bytes_per_chip,
+                            health=view.chip(i).health)
+            if flip_health and i == 0:
                 chip.health = (
                     Health.UNHEALTHY
                     if view.chip(0).health is Health.HEALTHY
                     else Health.HEALTHY
                 )
             chips.append(chip)
+        links = (view.info.bad_links if bad_links is None
+                 else bad_links)
         self.ext.state.upsert_node(host, codec.annotate_node(
-            NodeInfo(name=host, chips=chips, slice_id=self.sid),
+            NodeInfo(name=host, chips=chips, slice_id=self.sid,
+                     bad_links=list(links)),
             self.mesh))
+
+    def op_upsert_health_flip(self):
+        """A HEALTH-ONLY re-annotation travels as an O(chips-per-node)
+        delta (ISSUE 11 satellite): no full rebuild, and the advanced
+        snapshot still matches the oracle (checked by _assert_fresh
+        after every step — unhealthy/occupied sets AND utilization)."""
+        host = self.rng.choice(sorted(self.ext.state.node_names()))
+        r0 = self.ext.snapshots.rebuilds
+        a0 = self.ext.snapshots.delta_applies
+        self._reannotate(host, flip_health=True)
+        self.ext.snapshots.current()
+        assert self.ext.snapshots.rebuilds == r0, \
+            "a health-only re-annotation must advance as a delta, " \
+            "not force a full rebuild"
+        assert self.ext.snapshots.delta_applies == a0 + 1
+
+    def op_upsert_link_flip(self):
+        """A LINK change stays a structural marker: the next lookup
+        must full-rebuild, and still match the oracle."""
+        host = self.rng.choice(sorted(self.ext.state.node_names()))
+        view = self.ext.state.node(host)
+        coords = self.mesh.coords_of_host(host)
+        link = None
+        for c in coords:
+            for nb in self.mesh.neighbors(c):
+                link = (min(c, nb), max(c, nb))
+                break
+            if link is not None:
+                break
+        if link is None:
+            return
+        r0 = self.ext.snapshots.rebuilds
+        have = set(view.info.bad_links)
+        bad = sorted(have - {link}) if link in have else \
+            sorted(have | {link})
+        self._reannotate(host, bad_links=bad)
         self.ext.snapshots.current()
         assert self.ext.snapshots.rebuilds == r0 + 1, \
-            "structural upsert must force a full rebuild, not a delta"
+            "a link-fault re-annotation must force a full rebuild"
 
     def op_upsert_unchanged(self):
         """Identical payload: no bump, no delta, cache stays hot."""
@@ -215,6 +251,7 @@ class _Driver:
             self.op_gang_cycle,
             self.op_terminating,
             self.op_upsert_health_flip,
+            self.op_upsert_link_flip,
             self.op_upsert_unchanged,
         ])
         op()

@@ -192,6 +192,56 @@ print("multitenant smoke OK")
 PY
 
 echo
+echo "== crash-recovery smoke (scenario 13: crash-at-every-seam chaos"
+echo "   storm over the durable journal — >=8 crash/restart cycles under"
+echo "   the scenario-8 apiserver storm at snapshot_audit_rate=1.0, then"
+echo "   the 1024-node checkpoint-warm vs cold restart measurement;"
+echo "   floors from tools/perf_floor.json) =="
+JAX_PLATFORMS=cpu TPUKUBE_CHAOS_SEED=1337 TPUKUBE_SNAPSHOT_AUDIT_RATE=1.0 \
+  python - <<'PY'
+import json
+import os
+import sys
+
+floor = json.load(open("tools/perf_floor.json"))["recovery"]
+os.environ.setdefault("TPUKUBE_CRASH_CYCLES", str(floor["crash_cycles"]))
+
+import bench
+from tpukube.sim import scenarios
+
+# the scenario itself raises on invariant violations (lost committed
+# gang, ledger divergence, leaked reservations, audit divergence,
+# unbounded recovery); the floors below catch recovery-latency rot
+r = scenarios.run(13)
+print(json.dumps({
+    "crash_cycles": r["crash_cycles"], "seams": r["seams"],
+    "recovery_modes": r["recovery_modes"],
+    "recovery_s_max": r["recovery_s_max"],
+    "audit": r["snapshot_audit"], "wall_s": r["wall_s"],
+}))
+bad = []
+if r["recovery_s_max"] > floor["recovery_s_max"]:
+    bad.append(f"recovery_s_max={r['recovery_s_max']} exceeds the "
+               f"{floor['recovery_s_max']}s ceiling")
+if r["snapshot_audit"]["checks"] < 1:
+    bad.append("the audit sentinel never checked a recovered snapshot")
+# the warm-vs-cold floor runs at the fast 1024-node bench point (the
+# 10240-node >=10x acceptance number is recorded by the full bench)
+m = bench.recovery(nodes=("1024",))["1024"]
+print(json.dumps({"recovery_1024": m}))
+if m["replay_speedup"] < floor["replay_speedup_min"]:
+    bad.append(f"replay_speedup={m['replay_speedup']} below the "
+               f"{floor['replay_speedup_min']}x floor (checkpoint-warm "
+               f"restart is not beating the cold rebuild)")
+if m["warm_mode"] != "warm" or not m["warm_from_checkpoint"]:
+    bad.append(f"bench recovery did not run checkpoint-warm "
+               f"(mode={m['warm_mode']})")
+if bad:
+    sys.exit("crash-recovery smoke FAILED: " + "; ".join(bad))
+print("crash-recovery smoke OK")
+PY
+
+echo
 echo "== native asan (libtpuinfo self-test under ASan/UBSan) =="
 if command -v g++ >/dev/null 2>&1; then
   make -C tpukube/native asan

@@ -1625,8 +1625,23 @@ def rebuild_extender(extender, api, refresh=None) -> int:
             refresh.note_applied(
                 name, annotations.get(codec.ANNO_NODE_TOPOLOGY)
             )
-    pods = []
-    for p in api.list_pods():
+    pods = [annos for annos, _, _ in live_alloc_pods(api.list_pods())]
+    return extender.rebuild_from_pods(pods)
+
+
+def live_alloc_pods(
+    pods: list[dict[str, Any]],
+) -> list[tuple[dict[str, str], Optional[Any], Optional[str]]]:
+    """The restart story's lifecycle filter, shared by the legacy full
+    rebuild above and the journal recovery's reconcile pass
+    (sched/journal.py) — they must never test different sets. Returns
+    (annotations, decoded alloc or None when undecodable, pod key) for
+    every pod whose alloc annotation SHOULD be restored: live, bound,
+    non-terminal, annotation matching its binding and uid. Skips are
+    loud; an undecodable payload passes through with ``None`` so
+    ``rebuild_from_pods`` logs the decode failure itself."""
+    out: list[tuple[dict[str, str], Optional[Any], Optional[str]]] = []
+    for p in pods:
         meta = p.get("metadata") or {}
         annos = dict(meta.get("annotations") or {})
         payload = annos.get(codec.ANNO_ALLOC)
@@ -1671,8 +1686,8 @@ def rebuild_extender(extender, api, refresh=None) -> int:
                         "pod is a recreation with uid %s)",
                         key, planned.uid, pod_uid)
             continue
-        pods.append(annos)
-    return extender.rebuild_from_pods(pods)
+        out.append((annos, planned, key))
+    return out
 
 
 def pod_binder(api) -> Callable[[Any], None]:

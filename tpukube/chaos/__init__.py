@@ -23,12 +23,14 @@ from tpukube.chaos.cluster import (
     ledger_divergence,
     transient_api_error,
 )
+from tpukube.chaos.crash import CrashSchedule
 from tpukube.chaos.schedule import ChaosSpec, FaultSchedule
 
 __all__ = [
     "ChaosApiServer",
     "ChaosSimCluster",
     "ChaosSpec",
+    "CrashSchedule",
     "FaultSchedule",
     "converge",
     "leaked_reservations",

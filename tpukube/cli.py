@@ -442,20 +442,71 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
             rebuild_extender,
         )
 
+        # restart story (SURVEY §6 / ISSUE 11): reconstruct the ledger
+        # + gang reservations BEFORE serving — a freshly-restarted
+        # extender otherwise re-plans chips that are already running
+        # someone's containers. With journal_enabled the durable
+        # journal recovers O(Δ-since-checkpoint) (checkpoint + WAL
+        # replay + apiserver reconcile); a journal that cannot produce
+        # a trustworthy base falls back to the legacy O(fleet) rebuild
+        # on a FRESH extender — degraded, never wrong.
+        recovered = False
+        if extender.journal is not None:
+            from tpukube.sched.journal import (
+                JournalError,
+                recover_extender,
+            )
+
+            try:
+                rstats = recover_extender(extender, api)
+                log.warning(
+                    "journal recovery: %d allocation(s) known after "
+                    "checkpoint+replay+reconcile (%d record(s) "
+                    "replayed, %.3fs)",
+                    len(extender.state.allocations()),
+                    rstats["replayed"], rstats["recovery_s"],
+                )
+                recovered = True
+            except JournalError as e:
+                log.error("journal recovery failed (%s); falling back "
+                          "to the legacy full rebuild", e)
+                extender.journal.crash()
+                extender = Extender(cfg)
+                api = _make_apiserver(args, cfg, journal=extender.events)
         # nodeCacheCapable webhooks carry names only: without this loop,
         # health/link faults would never reach the node cache (built
         # before the rebuild so the rebuild can prime it)
         node_refresh = NodeTopologyRefreshLoop(
             extender, api, poll_seconds=cfg.health_poll_seconds
         )
-        # restart story (SURVEY §6): reconstruct the ledger + gang
-        # reservations from node/pod annotations BEFORE serving — a
-        # freshly-restarted extender otherwise re-plans chips that are
-        # already running someone's containers
-        restored = rebuild_extender(extender, api, refresh=node_refresh)
-        if restored:
-            log.warning("rebuilt %d allocation(s) from the apiserver",
-                        restored)
+        if recovered:
+            # prime the refresh loop with the recovered node payloads
+            # (its first poll must not re-dispatch 10k unchanged
+            # upsert_node decisions)
+            for name in extender.state.node_names():
+                view = extender.state.node(name)
+                if view is not None:
+                    node_refresh.note_applied(name, view.raw_payload)
+        else:
+            if extender.journal is not None:
+                # detach while the O(fleet) rebuild runs: every one of
+                # its commits would otherwise serialize a WAL record
+                # the checkpoint below immediately truncates away
+                extender.state.set_journal(None)
+                extender.gang.set_journal(None)
+            restored = rebuild_extender(extender, api,
+                                        refresh=node_refresh)
+            if restored:
+                log.warning("rebuilt %d allocation(s) from the "
+                            "apiserver", restored)
+            if extender.journal is not None:
+                # fallback rebuilds still end at a durable point so the
+                # NEXT restart recovers warm
+                extender.state.set_journal(extender.journal)
+                extender.gang.set_journal(extender.journal)
+                extender.journal.write_checkpoint_sync(
+                    extender.checkpoint_doc()
+                )
         # with bindVerb delegated here, the extender must create the real
         # Binding — kube-scheduler won't
         extender.binder = pod_binder(api)
@@ -569,7 +620,7 @@ def main_sim(argv: Optional[list[str]] = None) -> int:
         "tpukube-sim",
         "run a BASELINE config scenario against the real control-plane stack",
     )
-    p.add_argument("scenario", type=int, choices=range(1, 13),
+    p.add_argument("scenario", type=int, choices=range(1, 14),
                    help="BASELINE config number (1..5), 6 = the "
                         "steady-state churn benchmark (completions -> "
                         "release loop -> re-scheduling), 7 = fault "

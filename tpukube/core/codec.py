@@ -261,7 +261,8 @@ def node_from_annotations(
 
 # -- allocation result -----------------------------------------------------
 
-def encode_alloc(alloc: AllocResult) -> str:
+def alloc_obj(alloc: AllocResult) -> dict:
+    """The alloc payload's object form (see ``alloc_from_obj``)."""
     obj = {
         "v": SCHEMA_VERSION,
         "pod": alloc.pod_key,
@@ -275,7 +276,11 @@ def encode_alloc(alloc: AllocResult) -> str:
         # optional, not a schema bump: pre-UID decoders ignore it, and
         # pre-UID payloads decode to uid="" (name-only semantics)
         obj["uid"] = alloc.uid
-    return json.dumps(obj, separators=(",", ":"))
+    return obj
+
+
+def encode_alloc(alloc: AllocResult) -> str:
+    return json.dumps(alloc_obj(alloc), separators=(",", ":"))
 
 
 def decode_alloc(payload: str) -> AllocResult:
@@ -284,6 +289,14 @@ def decode_alloc(payload: str) -> AllocResult:
     except json.JSONDecodeError as e:
         raise CodecError(f"alloc: bad JSON: {e}") from e
     _check_version(obj, "alloc")
+    return alloc_from_obj(obj)
+
+
+def alloc_from_obj(obj: dict) -> AllocResult:
+    """An AllocResult from the alloc payload's PARSED object form —
+    the checkpoint (sched/journal.py) stores allocs as plain objects so
+    a warm restore skips ten thousand per-string ``json.loads`` calls;
+    the wire decoder above shares this construction."""
     try:
         return AllocResult(
             pod_key=_field(obj, "pod", "alloc"),

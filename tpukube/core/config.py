@@ -110,6 +110,28 @@ class TpuKubeConfig:
     # series.
     snapshot_delta_enabled: bool = True
 
+    # Durable control-plane state (sched/journal.py, ISSUE 11): with
+    # journal_enabled the extender appends every ledger/gang mutation
+    # to a CRC'd JSONL write-ahead log at journal_path (drain-thread
+    # writes — the decision lock never blocks on disk) and captures a
+    # full checkpoint every checkpoint_interval_seconds; a restarted
+    # extender then recovers O(Δ-since-checkpoint) instead of the
+    # O(fleet) rebuild_from_pods cold start. false (the default)
+    # constructs nothing: placements, /metrics exposition, and
+    # annotations stay byte-identical to the journal-less daemon.
+    journal_enabled: bool = False
+    journal_path: str = ""
+    # WAL size cap: at the cap the file rotates once to <path>.1 and a
+    # prompt checkpoint is requested so the live chain stays coverable
+    journal_max_bytes: int = 64 * 1024**2
+    checkpoint_interval_seconds: float = 60.0
+    # fsync policy: "off" flushes each drain batch (a machine crash can
+    # lose the last few records — the recovery reconcile absorbs that
+    # exactly like a torn tail); "always" fsyncs every batch (zero loss,
+    # one fsync per batch on the journal thread). Checkpoints fsync
+    # before their atomic rename under either policy.
+    journal_fsync: str = "off"
+
     # Batched scheduling cycles (sched/cycle.py SchedulingCycle): when
     # batch_enabled is true the extender admits pending pods into a
     # scheduling queue, plans placements for a whole batch against ONE
@@ -304,6 +326,26 @@ def load_config(
         )
     if cfg.batch_max_pods < 1:
         raise ValueError("batch_max_pods must be >= 1")
+    if cfg.journal_enabled and not cfg.journal_path:
+        # a journal with nowhere to write would silently provide NO
+        # durability — the operator who enabled it believes it is live
+        raise ValueError(
+            "journal_enabled requires journal_path"
+        )
+    if cfg.journal_path and not cfg.journal_enabled:
+        raise ValueError(
+            "journal_path is set but journal_enabled is false — "
+            "enable the journal or drop the path"
+        )
+    if cfg.journal_fsync not in ("off", "always"):
+        raise ValueError(
+            f"unknown journal_fsync {cfg.journal_fsync!r} "
+            f"(off | always)"
+        )
+    if cfg.journal_max_bytes < 0:
+        raise ValueError("journal_max_bytes must be >= 0 (0 = uncapped)")
+    if cfg.checkpoint_interval_seconds <= 0:
+        raise ValueError("checkpoint_interval_seconds must be positive")
     if cfg.tenancy_quotas and not cfg.tenancy_enabled:
         # quotas without the plane would be silently unenforced — an
         # operator who wrote caps believes they are live; fail loudly

@@ -140,6 +140,12 @@ def build_extender_registry(extender, reconcile=None, evictions=None,
     snapshots = getattr(extender, "snapshots", None)
     if snapshots is not None:
         _add_snapshot_metrics(reg, snapshots)
+    # durable-state journal (sched/journal.py): series render only
+    # when journal_enabled built a StateJournal — the legacy
+    # exposition stays byte-identical with the journal off
+    journal = getattr(extender, "journal", None)
+    if journal is not None:
+        _add_journal_metrics(reg, journal)
     # batched scheduling cycles (sched/cycle.py): series render only
     # when batch_enabled actually built a planner — the legacy
     # exposition stays byte-identical with batching off
@@ -319,6 +325,41 @@ def _add_snapshot_metrics(reg: Registry, snapshots) -> None:
             _slice_fn(sid, lambda ss: ss.fragmentation()))
         largest.labels(slice=sid).set_function(
             _slice_fn(sid, lambda ss: ss.largest_free_box()))
+
+
+def _add_journal_metrics(reg: Registry, journal) -> None:
+    """Durable-state journal families (sched/journal.py): WAL append
+    throughput and volume, checkpoint latency, and the recovery
+    numbers operators alarm on (a recovery_seconds sample near the
+    cold-rebuild wall means the checkpoint cadence — or the WAL bound
+    — is not keeping the replay tail short)."""
+    reg.counter(
+        "tpukube_journal_appends_total",
+        fn=lambda: journal.appends,
+        help_text="WAL records appended (one per ledger/gang mutation "
+                  "seam).")
+    reg.counter(
+        "tpukube_journal_bytes_total",
+        fn=lambda: journal.bytes_total,
+        help_text="Bytes written to the WAL (pre-rotation total).")
+    reg.summary(
+        "tpukube_checkpoint_seconds",
+        quantiles=(0.5, 0.99),
+        values_fn=journal.checkpoint_seconds_snapshot,
+        help_text="Wall time of checkpoint writes (serialize + fsync + "
+                  "atomic rename, on the journal's drain thread).")
+    reg.summary(
+        "tpukube_recovery_seconds",
+        quantiles=(0.5,),
+        values_fn=journal.recovery_seconds_snapshot,
+        help_text="Wall time of journal recoveries (checkpoint load + "
+                  "WAL replay + apiserver reconcile), one sample per "
+                  "recovery this process ran.")
+    reg.counter(
+        "tpukube_recovery_replayed_deltas_total",
+        fn=lambda: journal.replayed_total,
+        help_text="WAL records replayed by recoveries — the Δ in the "
+                  "O(Δ-since-checkpoint) restart story.")
 
 
 def _add_cycle_metrics(reg: Registry, cycle) -> None:

@@ -18,7 +18,8 @@ reason fails lint instead of silently fragmenting the journal):
   ChipUnhealthy, ChipRecovered, LinkFault, LinkRecovered,
   WatchReconnected, AllocDiverged, KubeletReregistered, BindFailed,
   CircuitOpen, CircuitClosed, RetryExhausted, DegradedMode,
-  TenantQuotaDenied, TenantAdmissionShed
+  TenantQuotaDenied, TenantAdmissionShed, CheckpointWritten,
+  JournalTruncated, RecoveryCompleted, RecoveryDiverged
 
 Dedup follows the K8s model: an event with the same (reason, object,
 message) as a live ring entry bumps that entry's ``count`` and
@@ -48,6 +49,7 @@ WARNING = "Warning"
 REASONS: tuple[str, ...] = (
     "AllocDiverged",
     "BindFailed",
+    "CheckpointWritten",
     "ChipRecovered",
     "ChipUnhealthy",
     "CircuitClosed",
@@ -57,11 +59,14 @@ REASONS: tuple[str, ...] = (
     "GangDissolved",
     "GangReserved",
     "GangRollback",
+    "JournalTruncated",
     "KubeletReregistered",
     "LinkFault",
     "LinkRecovered",
     "PreemptionExecuted",
     "PreemptionPlanned",
+    "RecoveryCompleted",
+    "RecoveryDiverged",
     "RetryExhausted",
     "TenantAdmissionShed",
     "TenantQuotaDenied",

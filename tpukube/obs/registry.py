@@ -75,6 +75,15 @@ DECLARED_SERIES: frozenset[str] = frozenset({
     "tpukube_snapshot_delta_applies_total",
     "tpukube_snapshot_delta_overflows_total",
     "tpukube_snapshot_delta_apply_seconds",
+    # extender: durable-state journal + crash recovery (sched/
+    # journal.py; series render only while journal_enabled built a
+    # StateJournal — legacy exposition stays byte-identical with the
+    # journal off)
+    "tpukube_journal_appends_total",
+    "tpukube_journal_bytes_total",
+    "tpukube_checkpoint_seconds",
+    "tpukube_recovery_seconds",
+    "tpukube_recovery_replayed_deltas_total",
     "tpukube_slice_fragmentation",
     "tpukube_slice_largest_free_box_chips",
     # extender: batched scheduling cycles (sched/cycle.py; series

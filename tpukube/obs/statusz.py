@@ -150,6 +150,13 @@ def extender_statusz(
         # a hit_rate near zero under webhook load means every cycle is
         # rebuilding (a mutation storm, or an epoch bump on a read path)
         "snapshot": extender.snapshots.stats(),
+        # durable-state journal (sched/journal.py): WAL position,
+        # checkpoint cadence, and the last recovery's stats — a
+        # last_recovery in cold-fallback mode means the journal could
+        # not produce a trustworthy base and the O(fleet) rebuild ran
+        "journal": (extender.journal.stats()
+                    if getattr(extender, "journal", None) is not None
+                    else {"enabled": False}),
         # batched scheduling cycles (sched/cycle.py): queue depth,
         # batch sizes, and the plan-hit ratio — near zero with batching
         # on means webhooks are re-planning instead of reading plans

@@ -124,9 +124,15 @@ class SnapshotDelta:
         used shares left zero (or that a commit claimed whole),
         ``occupied_remove`` chips whose shares returned to zero on a
         healthy chip — plus the used-share change feeding the slice
-        utilization. Unhealthy/broken-link changes never travel as
-        deltas: they arrive via node re-annotation, which is a ``full``
-        marker (below).
+        utilization. A HEALTH-ONLY node re-annotation (the churn shape
+        of health watches: same chips, same links, only per-chip health
+        flipped) also travels as a ledger delta — ``unhealthy_add`` /
+        ``unhealthy_remove`` plus the healthy-capacity movement in
+        ``total_shares_delta`` (and the used/occupied consequences of
+        chips entering/leaving health) — O(chips-per-node) instead of
+        the full-rebuild marker every changed payload used to cost.
+        Any OTHER payload change (links, topology, sharing mode) stays
+        a ``full`` marker (below).
       * ``kind="gang"`` (GangManager._epoch): the ``slices`` whose
         reserved / terminating masks changed; the masks themselves are
         re-read from the GangManager at apply time (they are O(Δ)-small
@@ -138,12 +144,16 @@ class SnapshotDelta:
     full rebuild."""
 
     __slots__ = ("kind", "epoch", "full", "slice_id", "occupied_add",
-                 "occupied_remove", "used_shares_delta", "slices", "why")
+                 "occupied_remove", "used_shares_delta",
+                 "unhealthy_add", "unhealthy_remove",
+                 "total_shares_delta", "slices", "why")
 
     def __init__(self, kind: str, epoch: int, full: bool = False,
                  slice_id: Optional[str] = None,
                  occupied_add: tuple = (), occupied_remove: tuple = (),
                  used_shares_delta: int = 0,
+                 unhealthy_add: tuple = (), unhealthy_remove: tuple = (),
+                 total_shares_delta: int = 0,
                  slices: tuple = (), why: str = ""):
         assert kind in ("ledger", "gang"), kind
         self.kind = kind
@@ -153,6 +163,12 @@ class SnapshotDelta:
         self.occupied_add = occupied_add
         self.occupied_remove = occupied_remove
         self.used_shares_delta = used_shares_delta
+        # health-only re-annotation stream: per-chip health transitions
+        # plus the healthy-share capacity they move (total only changes
+        # through these; every other topology change is a full marker)
+        self.unhealthy_add = unhealthy_add
+        self.unhealthy_remove = unhealthy_remove
+        self.total_shares_delta = total_shares_delta
         self.slices = slices
         self.why = why
 
@@ -424,6 +440,29 @@ class SnapshotCache:
             self._snap = None
             self._snap_gen += 1
 
+    def peek(self) -> Optional[ClusterSnapshot]:
+        """The cached snapshot IF it is current, else None — never
+        builds (checkpoint captures read through here: a capture must
+        not force an O(chips) rebuild just to decide whether a seedable
+        snapshot exists)."""
+        key = self.epoch_key()
+        with self._lock:
+            snap = self._snap
+            return snap if snap is not None and snap.key == key else None
+
+    def seed(self, snap: ClusterSnapshot) -> None:
+        """Install a checkpoint-restored snapshot as the cached slot
+        (journal recovery's warm path): the first lookups after a
+        restart HIT instead of forcing the O(chips) rebuild that would
+        eagerly materialize every lazily-restored node view. The caller
+        guarantees ``snap.key`` equals the current epoch key and that
+        the content matches the restored ledger — the audit sentinel
+        (``audit_now`` at recovery with ``snapshot_audit_rate`` > 0,
+        plus the sampled runtime audits) holds it to that."""
+        with self._lock:
+            self._snap = snap
+            self._snap_gen += 1
+
     # -- the delta log -------------------------------------------------------
     def note(self, delta: SnapshotDelta) -> None:
         """Record one bump's effect. Called by the seam that bumped,
@@ -497,8 +536,20 @@ class SnapshotCache:
         # base set: an add cancels a pending remove and vice versa)
         occ_add: dict[str, set] = {}
         occ_rem: dict[str, set] = {}
+        unh_add: dict[str, set] = {}
+        unh_rem: dict[str, set] = {}
         used: dict[str, int] = {}
+        total: dict[str, int] = {}
         gang_touched: set[str] = set()
+
+        def _merge(add: set, rem: set, adds, rems) -> None:
+            for c in adds:
+                rem.discard(c)
+                add.add(c)
+            for c in rems:
+                add.discard(c)
+                rem.add(c)
+
         for d in deltas:
             if d.kind == "gang":
                 gang_touched.update(d.slices)
@@ -506,15 +557,14 @@ class SnapshotCache:
             sid = d.slice_id
             if sid is None:
                 continue  # an empty ledger bump (release on a gone node)
-            add = occ_add.setdefault(sid, set())
-            rem = occ_rem.setdefault(sid, set())
-            for c in d.occupied_add:
-                rem.discard(c)
-                add.add(c)
-            for c in d.occupied_remove:
-                add.discard(c)
-                rem.add(c)
+            _merge(occ_add.setdefault(sid, set()),
+                   occ_rem.setdefault(sid, set()),
+                   d.occupied_add, d.occupied_remove)
+            _merge(unh_add.setdefault(sid, set()),
+                   unh_rem.setdefault(sid, set()),
+                   d.unhealthy_add, d.unhealthy_remove)
             used[sid] = used.get(sid, 0) + d.used_shares_delta
+            total[sid] = total.get(sid, 0) + d.total_shares_delta
         touched = set(occ_add) | set(occ_rem) | set(used) | gang_touched
         if not touched <= set(base.slices):
             return None  # slice appeared without a full marker?!
@@ -525,6 +575,12 @@ class SnapshotCache:
             if occ_add.get(sid) or occ_rem.get(sid):
                 occupied = frozenset(
                     (occupied - occ_rem[sid]) | occ_add[sid]
+                )
+            unhealthy = old.unhealthy
+            if unh_add.get(sid) or unh_rem.get(sid):
+                # health-only re-annotation deltas (see SnapshotDelta)
+                unhealthy = frozenset(
+                    (unhealthy - unh_rem[sid]) | unh_add[sid]
                 )
             if sid in gang_touched:
                 reserved = frozenset(self._gang.reserved_coords(sid))
@@ -537,11 +593,11 @@ class SnapshotCache:
                 mesh=old.mesh,
                 occupied=occupied,
                 reserved=reserved,
-                unhealthy=old.unhealthy,
+                unhealthy=unhealthy,
                 terminating=terminating,
                 broken=old.broken,
                 used_shares=old.used_shares + used.get(sid, 0),
-                total_shares=old.total_shares,
+                total_shares=old.total_shares + total.get(sid, 0),
             )
         return ClusterSnapshot(key=key, slices=slices)
 
@@ -621,6 +677,29 @@ class SnapshotCache:
         return snap  # an observer raced mutations: serve uncached
 
     # -- audit sentinel ----------------------------------------------------
+    def audit_now(self) -> None:
+        """One FORCED sentinel check regardless of ``audit_rate`` — the
+        journal recovery's recovered-state proof (sched/journal.py):
+        the freshly restored-and-reconciled snapshot must equal a
+        from-scratch ledger rebuild. Callers run before serving (no
+        concurrent mutations), so a moved epoch mid-check is a real
+        divergence, not a race. Raises :class:`SnapshotAuditError`."""
+        snap = self.current()
+        rebuilt = self._build(snap.key)
+        with self._lock:
+            self.audit_checks += 1
+        diffs = _audit_divergence(snap, rebuilt)
+        if diffs:
+            with self._lock:
+                self.audit_divergences += 1
+            detail = "; ".join(diffs[:4])
+            log.error("snapshot audit DIVERGENCE (forced) at epochs "
+                      "%s: %s", snap.key, detail)
+            raise SnapshotAuditError(
+                f"recovered snapshot at epochs {snap.key} diverges "
+                f"from a ledger rebuild ({detail})"
+            )
+
     def _maybe_audit(self, snap: ClusterSnapshot) -> None:
         """Sampled hit audit: rebuild from the ledger and compare.
         Raises :class:`SnapshotAuditError` on divergence — a mutation

@@ -13,8 +13,11 @@ n=1 case of a vTPU node, so one ledger covers both resources.
 
 from __future__ import annotations
 
+import json
 import logging
+import os
 import threading
+import zlib
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -27,6 +30,7 @@ from tpukube.core.types import (
     Link,
     NodeInfo,
     TopologyCoord,
+    canonical_link,
     parse_device_id,
 )
 
@@ -156,14 +160,94 @@ class NodeView:
         return out
 
 
+def _health_only_change(a: NodeInfo, b: NodeInfo) -> bool:
+    """True when the ONLY difference between two decoded node payloads
+    is per-chip health (and at least one chip flipped) — the shape the
+    snapshot can absorb as an O(chips-per-node) delta. Anything else
+    (links, coords, ids, sharing mode, HBM/core facts, source) is
+    structural and keeps the full-rebuild marker."""
+    if (a.slice_id != b.slice_id
+            or a.shares_per_chip != b.shares_per_chip
+            or a.source != b.source
+            or len(a.chips) != len(b.chips)
+            or set(a.bad_links) != set(b.bad_links)):
+        return False
+    changed = False
+    for ca, cb in zip(a.chips, b.chips):
+        if (ca.chip_id != cb.chip_id or ca.index != cb.index
+                or ca.coord != cb.coord or ca.hbm_bytes != cb.hbm_bytes
+                or ca.num_cores != cb.num_cores):
+            return False
+        changed |= ca.health is not cb.health
+    return changed
+
+
+#: Health.value -> member (enum __call__ per chip is ~10x a dict hit,
+#: and checkpoint restore runs this 40k times at 10k nodes)
+_HEALTH_BY_VALUE = {h.value: h for h in Health}
+
+
+def _node_doc(view: NodeView) -> dict:
+    """One node's checkpoint line content (sched/journal.py): the
+    DECODED view — chips, health, links, occupancy-independent facts —
+    plus the raw payload for divergence compares. Mesh lives in the
+    checkpoint head per slice, occupancy in the alloc list."""
+    info = view.info
+    return {
+        "n": info.name,
+        "slice": info.slice_id,
+        "shares": info.shares_per_chip,
+        "source": info.source,
+        "chips": [
+            [c.chip_id, c.index, list(c.coord), c.hbm_bytes,
+             c.num_cores, c.health.value]
+            for c in info.chips
+        ],
+        "bad": [[list(a), list(b)] for a, b in info.bad_links],
+        "payload": view.raw_payload,
+        "hs": view.health_summary,
+    }
+
+
+def _view_from_doc(doc: dict, mesh: MeshSpec) -> NodeView:
+    """Rebuild a NodeView from its checkpoint line (inverse of
+    ``_node_doc``; occupancy re-applies separately from the restored
+    allocations). ``mesh`` is unused today but pins the contract that
+    a node line is only meaningful under its slice's geometry."""
+    del mesh
+    chips = [
+        ChipInfo(chip_id=cid, index=i, coord=TopologyCoord(*coord),
+                 hbm_bytes=hbm, num_cores=cores,
+                 health=_HEALTH_BY_VALUE[h])
+        for cid, i, coord, hbm, cores, h in doc["chips"]
+    ]
+    info = NodeInfo(
+        name=doc["n"], chips=chips,
+        shares_per_chip=doc["shares"],
+        bad_links=[canonical_link(a, b) for a, b in doc["bad"]],
+        slice_id=doc["slice"], source=doc.get("source", ""),
+    )
+    return NodeView(info=info, raw_payload=doc["payload"],
+                    health_summary=doc.get("hs"))
+
+
 @dataclass
 class SliceView:
     """One ICI domain: its mesh geometry plus the data-driven coord->host
     map built from node annotations (host naming is a sim convention, not a
-    contract — the annotation's chip coords are the truth)."""
+    contract — the annotation's chip coords are the truth).
+
+    ``pending_hosts`` is the checkpoint restore's lazily-parsed host
+    map (a compact ``x,y,z=name;...`` blob): a warm restart must not
+    pay 40k tuple constructions up front for a map most recoveries
+    never walk — ``ClusterState._hosts_locked`` expands it on first
+    touch. ``hosts_blob`` caches the serialized form for checkpoint
+    captures, invalidated on any host-map mutation."""
 
     mesh: MeshSpec
     host_by_coord: dict[TopologyCoord, str] = field(default_factory=dict)
+    pending_hosts: Optional[str] = None
+    hosts_blob: Optional[str] = None
 
 
 class ClusterState:
@@ -200,16 +284,62 @@ class ClusterState:
         # rebuilding. A bump without a note degrades to a full rebuild
         # (log gap), never to a stale cache.
         self._delta_sink = None
+        # durable-state journal (sched/journal.py StateJournal, wired by
+        # the Extender when journal_enabled): mutation seams enqueue one
+        # typed WAL record each — enqueue only, the file write happens
+        # on the journal's drain thread, so this lock never blocks on
+        # disk. None (the default) journals nothing.
+        self._journal = None
+        # cached sorted node-name tuple, invalidated when the node SET
+        # changes (a new node registers / a checkpoint restore) — NOT on
+        # occupancy or health churn. node_names() runs per batch cycle;
+        # a fresh sorted list per call was O(fleet) per cycle at 10k
+        # nodes (ROADMAP O(fleet) item).
+        self._names_cache: Optional[tuple[str, ...]] = None
+        # LAZY checkpoint restore (sched/journal.py warm recovery):
+        # nodes not yet materialized live as positions into the open
+        # checkpoint file — name -> (abs offset, length, line crc,
+        # slice id, payload crc, payload len). _view_locked()
+        # materializes a view on first touch (an os.pread of one line
+        # plus one small parse), so restart-to-serving pays O(Δ), not
+        # O(fleet); a background warmer drains the rest off the hot
+        # path. _lazy_allocs indexes restored allocations by node so a
+        # materialized view recovers its occupancy.
+        self._lazy_index: dict[str, tuple] = {}
+        self._lazy_fd: Optional[int] = None
+        self._lazy_allocs: dict[str, list[AllocResult]] = {}
+        # set by retire(): the owning process is done with this ledger
+        # (sim crash/stop) — the background warmer must stop instead of
+        # materializing an orphan's fleet against the live one's CPU
+        self._retired = False
 
     def set_delta_sink(self, sink) -> None:
         """Attach the snapshot cache's delta log (None detaches)."""
         with self._lock:
             self._delta_sink = sink
 
+    def set_journal(self, journal) -> None:
+        """Attach the durable-state journal (None detaches — recovery
+        replays with the journal detached so replayed mutations are not
+        re-recorded)."""
+        with self._lock:
+            self._journal = journal
+
+    def _note_journal_locked(self, kind: str, data: dict) -> None:
+        """Enqueue one WAL record for the mutation just applied
+        (callers hold ``self._lock``; non-blocking — see StateJournal).
+        ``data`` must be freshly built and never mutated afterwards:
+        the journal serializes it on its drain thread."""
+        journal = self._journal
+        if journal is not None:
+            journal.note(kind, data)
+
     def _note_delta_locked(self, full: bool = False,
                     slice_id: Optional[str] = None,
                     occupied_add: tuple = (), occupied_remove: tuple = (),
-                    used_shares_delta: int = 0, why: str = "") -> None:
+                    used_shares_delta: int = 0,
+                    unhealthy_add: tuple = (), unhealthy_remove: tuple = (),
+                    total_shares_delta: int = 0, why: str = "") -> None:
         """Record the bump just taken (callers hold ``self._lock`` and
         call this right after ``self._epoch += 1``). Import is lazy and
         one-directional: snapshot.py never imports state."""
@@ -222,13 +352,166 @@ class ClusterState:
             kind="ledger", epoch=self._epoch, full=full,
             slice_id=slice_id, occupied_add=occupied_add,
             occupied_remove=occupied_remove,
-            used_shares_delta=used_shares_delta, why=why,
+            used_shares_delta=used_shares_delta,
+            unhealthy_add=unhealthy_add,
+            unhealthy_remove=unhealthy_remove,
+            total_shares_delta=total_shares_delta, why=why,
         ))
 
     def epoch(self) -> int:
         """Monotonic mutation counter (the snapshot cache's key half)."""
         with self._lock:
             return self._epoch
+
+    # -- lazy materialization (checkpoint warm restore) ---------------------
+    def _view_locked(self, name: str) -> Optional[NodeView]:
+        """The node's view, materializing it from the open checkpoint
+        file on first touch (callers hold ``self._lock``). None for
+        unknown nodes OR for a node whose checkpoint line fails its
+        CRC — the latter degrades that one node to 'unknown' (its next
+        re-annotation re-registers it) instead of crashing recovery."""
+        view = self._nodes.get(name)
+        if view is not None:
+            return view
+        entry = self._lazy_index.pop(name, None)
+        if entry is None:
+            return None
+        off, length, crc, sid, _pcrc, _plen = entry
+        try:
+            raw = os.pread(self._lazy_fd, length, off)
+        except OSError as e:
+            log.error("lazy node %s: checkpoint read failed: %s",
+                      name, e)
+            self._names_cache = None  # the node SET just shrank
+            self._drop_lazy_fd_locked()
+            return None
+        if zlib.crc32(raw) != crc:
+            log.error("lazy node %s: checkpoint line fails its CRC; "
+                      "treating the node as unknown until it "
+                      "re-annotates", name)
+            self._names_cache = None  # the node SET just shrank
+            self._drop_lazy_fd_locked()
+            return None
+        doc = json.loads(raw.decode("utf-8"))
+        mesh = self._slices[sid].mesh
+        view = _view_from_doc(doc, mesh)
+        for alloc in self._lazy_allocs.pop(name, ()):
+            # re-apply the restored occupancy exactly as the eager
+            # restore would; materialization changes NOTHING observable
+            # (the same content was reachable through the lazy doc), so
+            # no epoch moves — the seeded snapshot stays valid
+            view.add_ids(alloc.device_ids)  # tpukube: allow(epoch-discipline) materialization promotes equivalent state; nothing observable changes, so the snapshot must NOT invalidate
+        self._nodes[name] = view  # tpukube: allow(epoch-discipline) see above — cache promotion, not a mutation
+        self._drop_lazy_fd_locked()
+        return view
+
+    def _drop_lazy_fd_locked(self) -> None:
+        """Close the checkpoint fd once nothing lazy remains."""
+        if not self._lazy_index and self._lazy_fd is not None:
+            try:
+                os.close(self._lazy_fd)
+            except OSError:
+                pass
+            self._lazy_fd = None
+
+    def _materialize_slice_locked(self, slice_id: Optional[str]) -> None:
+        """Materialize every lazy node of one slice (None = all) ahead
+        of a whole-slice scan (occupied_coords and friends)."""
+        if not self._lazy_index:
+            return
+        for name in [
+            n for n, e in self._lazy_index.items()
+            if slice_id is None or e[3] == slice_id
+        ]:
+            self._view_locked(name)
+
+    def warm_pending(self, limit: int = 512) -> int:
+        """Materialize up to ``limit`` lazy nodes; returns how many
+        remain. The recovery's background warmer drains the fleet in
+        batches so the first full-fleet scan (a structural snapshot
+        rebuild, a metrics scrape) finds the work already done —
+        batched so the warmer never holds the ledger lock long."""
+        with self._lock:
+            if self._retired:
+                return 0
+            for name in list(self._lazy_index)[:limit]:
+                self._view_locked(name)
+            return len(self._lazy_index)
+
+    def retire(self) -> None:
+        """Stop background warming for good (the owner crashed or shut
+        down; an orphaned ledger must not keep materializing)."""
+        with self._lock:
+            self._retired = True
+
+    def lazy_fd_dup(self) -> Optional[int]:
+        """A dup of the open checkpoint fd while lazy nodes remain
+        (None otherwise) — checkpoint captures hand it to the journal's
+        drain thread so ``("ref", ...)`` entries stay readable even if
+        the last lazy node materializes (closing the original) before
+        the write lands. The caller owns the dup."""
+        with self._lock:
+            if self._lazy_fd is None or not self._lazy_index:
+                return None
+            return os.dup(self._lazy_fd)
+
+    def payload_matches(self, name: str, payload: str) -> bool:
+        """True when the node's stored topology payload equals
+        ``payload`` — WITHOUT materializing a lazy node (recovery's
+        reconcile compares every node; only divergent ones may cost
+        anything). Lazy entries compare by (crc32, length)."""
+        with self._lock:
+            return self._payload_matches_locked(name, payload)
+
+    def _payload_matches_locked(self, name: str, payload: str) -> bool:
+        view = self._nodes.get(name)
+        if view is not None:
+            return view.raw_payload == payload
+        entry = self._lazy_index.get(name)
+        if entry is None:
+            return False
+        raw = payload.encode("utf-8")
+        return entry[4] == zlib.crc32(raw) and entry[5] == len(raw)
+
+    def nodes_matching_payloads(
+        self, payloads: dict[str, str]
+    ) -> set[str]:
+        """The names whose stored payload equals the given one, in ONE
+        lock round-trip (the recovery reconcile compares the whole
+        fleet; 10k separate lock acquisitions were a measurable slice
+        of restart-to-serving). Lazy nodes stay lazy."""
+        with self._lock:
+            nodes = self._nodes
+            lazy = self._lazy_index
+            crc32 = zlib.crc32
+            out: set[str] = set()
+            for name, payload in payloads.items():
+                view = nodes.get(name)
+                if view is not None:
+                    if view.raw_payload == payload:
+                        out.add(name)
+                    continue
+                entry = lazy.get(name)
+                if entry is None:
+                    continue
+                raw = payload.encode("utf-8")
+                if entry[4] == crc32(raw) and entry[5] == len(raw):
+                    out.add(name)
+            return out
+
+    def _hosts_locked(self, sl: SliceView) -> dict[TopologyCoord, str]:
+        """The slice's coord->host map, expanding a checkpoint
+        restore's compact pending blob on first touch."""
+        if sl.pending_hosts is not None:
+            blob, sl.pending_hosts = sl.pending_hosts, None
+            hosts = sl.host_by_coord
+            for part in blob.split(";"):
+                if not part:
+                    continue
+                coord, _, host = part.partition("=")
+                x, y, z = coord.split(",")
+                hosts[TopologyCoord(int(x), int(y), int(z))] = host
+        return sl.host_by_coord
 
     # -- node ingestion ----------------------------------------------------
     def upsert_node(self, name: str, annotations: dict[str, str]) -> bool:
@@ -237,15 +520,44 @@ class ClusterState:
         payload = annotations.get(codec.ANNO_NODE_TOPOLOGY)
         if payload is None:
             return False
-        with self._lock:
-            prev = self._nodes.get(name)
-            if prev is not None and prev.raw_payload == payload:
-                return True  # unchanged annotation: keep the decoded view
+        if self.payload_matches(name, payload):
+            # unchanged annotation: keep the stored view (a LAZY node
+            # compares by crc+length and stays unmaterialized — the
+            # hot webhook resend path must not force the fleet in)
+            return True
         decoded = codec.node_from_annotations(name, annotations)
         if decoded is None:
             return False
         info, mesh = decoded
+        summary = None
+        raw_summary = annotations.get(codec.ANNO_HEALTH_SUMMARY)
+        if raw_summary:
+            try:
+                summary = codec.decode_health_summary(raw_summary)
+            except codec.CodecError as e:
+                # a malformed summary must not reject the topology —
+                # the rollup simply falls back to chip health
+                log.warning("node %s: undecodable health summary: %s",
+                            name, e)
         with self._lock:
+            prev = self._view_locked(name)
+            if (prev is not None
+                    and prev.info.slice_id == info.slice_id
+                    and _health_only_change(prev.info, info)):
+                # HEALTH-ONLY re-annotation (the health watch's steady
+                # churn shape): same chips, same links, same sharing
+                # mode — only per-chip health flipped. Emit an
+                # O(chips-per-node) snapshot delta instead of the
+                # full-rebuild marker a changed payload used to cost
+                # (ROADMAP O(fleet) item: at 40k chips a health flap
+                # forced a ~50ms rebuild; WAL replay of health churn
+                # degenerated to full rebuilds the same way). The
+                # coord->host map is untouched (coords identical), so
+                # the claim-validation walk and host-map rewrite of the
+                # structural path are skipped too.
+                self._apply_health_only_locked(
+                    name, prev, info, payload, summary, annotations)
+                return True
             sl = self._slices.get(info.slice_id)
             if sl is None:
                 sl = self._slices[info.slice_id] = SliceView(mesh=mesh)
@@ -287,8 +599,9 @@ class ClusterState:
                 )
             # validate EVERY claim before mutating anything: a partial
             # apply would leave phantom claims with no owner on error
+            hosts = self._hosts_locked(sl)
             for chip in info.chips:
-                claimed = sl.host_by_coord.get(chip.coord)
+                claimed = hosts.get(chip.coord)
                 if claimed is not None and claimed != name:
                     raise StateError(
                         f"nodes {claimed} and {name} both claim chip "
@@ -296,37 +609,88 @@ class ClusterState:
                     )
             if prev is not None:
                 for chip in prev.info.chips:
-                    if sl.host_by_coord.get(chip.coord) == name:
-                        del sl.host_by_coord[chip.coord]
+                    if hosts.get(chip.coord) == name:
+                        del hosts[chip.coord]
             for chip in info.chips:
-                sl.host_by_coord[chip.coord] = name
+                hosts[chip.coord] = name
+            sl.hosts_blob = None
             self._hosts_cache.pop(info.slice_id, None)
-            summary = None
-            raw_summary = annotations.get(codec.ANNO_HEALTH_SUMMARY)
-            if raw_summary:
-                try:
-                    summary = codec.decode_health_summary(raw_summary)
-                except codec.CodecError as e:
-                    # a malformed summary must not reject the topology —
-                    # the rollup simply falls back to chip health
-                    log.warning("node %s: undecodable health summary: %s",
-                                name, e)
             view = NodeView(info=info, raw_payload=payload,
                             health_summary=summary)
             if prev is not None:
                 view.used_ids = prev.used_ids
                 view.share_counts = prev.share_counts
                 view.id_weights = prev.id_weights
+            else:
+                # the node SET changed: the cached name tuple is stale
+                self._names_cache = None
             self._nodes[name] = view
             self._epoch += 1
-            # a CHANGED node payload may move health, links, topology,
-            # or sharing mode — all structural for the snapshot (they
-            # shift unhealthy/broken sets and the healthy-share totals
-            # the delta math assumes constant): full-rebuild marker.
-            # The unchanged-payload early return above keeps the hot
-            # webhook resend path bump- and delta-free.
+            # a STRUCTURALLY changed node payload may move links,
+            # topology, or sharing mode — all structural for the
+            # snapshot (they shift broken sets and the share totals the
+            # delta math assumes constant): full-rebuild marker. The
+            # unchanged-payload early return above keeps the hot
+            # webhook resend path bump- and delta-free, and the
+            # health-only path above keeps health churn O(chips/node).
             self._note_delta_locked(full=True, why=f"node {name} re-annotated")
+            self._note_journal_locked(
+                "node", {"n": name, "anno": dict(annotations)})
         return True
+
+    def _apply_health_only_locked(
+        self, name: str, prev: NodeView, info: NodeInfo, payload: str,
+        summary: Optional[dict], annotations: dict[str, str],
+    ) -> None:
+        """Apply a health-only re-annotation (see upsert_node): swap the
+        node view and emit the per-chip transition delta — occupied and
+        unhealthy set moves plus the healthy-share capacity change the
+        slice's utilization integers carry. Callers hold ``self._lock``
+        and have verified ``_health_only_change``."""
+        n = prev.shares_per_chip
+        occupied_add: list[TopologyCoord] = []
+        occupied_remove: list[TopologyCoord] = []
+        unhealthy_add: list[TopologyCoord] = []
+        unhealthy_remove: list[TopologyCoord] = []
+        used_d = total_d = 0
+        for old_chip, new_chip in zip(prev.info.chips, info.chips):
+            if old_chip.health is new_chip.health:
+                continue
+            # counted shares on this chip (slice_share_counts caps at n)
+            cnt = min(n, prev.used_share_count(new_chip.index))
+            if new_chip.health is not Health.HEALTHY:
+                unhealthy_add.append(new_chip.coord)
+                total_d -= n
+                used_d -= cnt
+                if cnt == 0:
+                    # a free chip turning sick ENTERS occupied (health
+                    # holds it); a chip with live shares was there already
+                    occupied_add.append(new_chip.coord)
+            else:
+                unhealthy_remove.append(new_chip.coord)
+                total_d += n
+                used_d += cnt
+                if cnt == 0:
+                    occupied_remove.append(new_chip.coord)
+        view = NodeView(info=info, raw_payload=payload,
+                        health_summary=summary)
+        view.used_ids = prev.used_ids
+        view.share_counts = prev.share_counts
+        view.id_weights = prev.id_weights
+        self._nodes[name] = view
+        self._epoch += 1
+        self._note_delta_locked(
+            slice_id=info.slice_id,
+            occupied_add=tuple(occupied_add),
+            occupied_remove=tuple(occupied_remove),
+            used_shares_delta=used_d,
+            unhealthy_add=tuple(unhealthy_add),
+            unhealthy_remove=tuple(unhealthy_remove),
+            total_shares_delta=total_d,
+            why=f"node {name} health re-annotated",
+        )
+        self._note_journal_locked(
+            "node", {"n": name, "anno": dict(annotations)})
 
     # -- views -------------------------------------------------------------
     @property
@@ -359,7 +723,9 @@ class ClusterState:
         """Node owning a chip coord within a slice (annotation-derived)."""
         with self._lock:
             sl = self._slices.get(slice_id)
-            return sl.host_by_coord.get(coord) if sl is not None else None
+            if sl is None:
+                return None
+            return self._hosts_locked(sl).get(coord)
 
     def hosts_by_coord(self, slice_id: str) -> dict[TopologyCoord, str]:
         """Snapshot of a slice's coord->node map — one lock round-trip for
@@ -370,22 +736,34 @@ class ClusterState:
             if cached is not None:
                 return cached
             sl = self._slices.get(slice_id)
-            snap = dict(sl.host_by_coord) if sl is not None else {}
+            snap = dict(self._hosts_locked(sl)) if sl is not None else {}
             self._hosts_cache[slice_id] = snap
             return snap
 
     def slice_of_node(self, name: str) -> Optional[str]:
         with self._lock:
             view = self._nodes.get(name)
-            return view.info.slice_id if view is not None else None
+            if view is not None:
+                return view.info.slice_id
+            entry = self._lazy_index.get(name)
+            return entry[3] if entry is not None else None
 
     def node(self, name: str) -> Optional[NodeView]:
         with self._lock:
-            return self._nodes.get(name)
+            return self._view_locked(name)
 
-    def node_names(self) -> list[str]:
+    def node_names(self) -> tuple[str, ...]:
+        """Sorted node names as a SHARED frozen tuple, cached until the
+        node set itself changes (per-cycle callers — the batch planner,
+        /healthz, statusz — must not pay an O(fleet) sort-and-copy for
+        a set that moves only when nodes register)."""
         with self._lock:
-            return sorted(self._nodes)
+            names = self._names_cache
+            if names is None:
+                names = self._names_cache = tuple(sorted(
+                    set(self._nodes) | set(self._lazy_index)
+                ))
+            return names
 
     def _slice_views_locked(self, slice_id: Optional[str]) -> list[NodeView]:
         """Node views of one slice — or of the WHOLE cluster only when it is
@@ -397,6 +775,9 @@ class ClusterState:
                 "coord sets are slice-local; pass slice_id on a "
                 f"{len(self._slices)}-slice cluster"
             )
+        # a whole-slice scan needs every view, including lazily-restored
+        # ones (the background warmer usually got here first)
+        self._materialize_slice_locked(slice_id)
         return [
             v for v in self._nodes.values()
             if slice_id is None or v.info.slice_id == slice_id
@@ -493,7 +874,7 @@ class ClusterState:
         with self._lock:
             if alloc.pod_key in self._allocs:
                 raise StateError(f"{alloc.pod_key} already has an allocation")
-            view = self._nodes.get(alloc.node_name)
+            view = self._view_locked(alloc.node_name)
             if view is None:
                 raise StateError(f"bind to unknown node {alloc.node_name}")
             n = view.shares_per_chip
@@ -533,6 +914,8 @@ class ClusterState:
                 used_shares_delta=sum(pending_shares.values()),
                 why=f"commit {alloc.pod_key}",
             )
+            self._note_journal_locked(
+                "commit", {"a": codec.encode_alloc(alloc)})
 
     def release(self, pod_key: str) -> Optional[AllocResult]:
         """Pod gone (deleted/preempted): free its shares."""
@@ -544,12 +927,13 @@ class ClusterState:
             if alloc is None:
                 return None
             self._allocs.pop(pod_key, None)
-            view = self._nodes.get(alloc.node_name)
+            view = self._view_locked(alloc.node_name)
             if view is None:
                 # node view gone: its chips are in no slice's occupied
                 # set either — an empty delta keeps the chain whole
                 self._epoch += 1
                 self._note_delta_locked(why=f"release {pod_key} (node gone)")
+                self._note_journal_locked("release", {"p": pod_key})
                 return alloc
             # snapshot delta: shares removed from HEALTHY chips reduce
             # the slice's used count (unhealthy chips were never counted
@@ -577,6 +961,7 @@ class ClusterState:
                 used_shares_delta=used_delta,
                 why=f"release {pod_key}",
             )
+            self._note_journal_locked("release", {"p": pod_key})
             return alloc
 
     # -- restart story -----------------------------------------------------
@@ -614,3 +999,141 @@ class ClusterState:
                 continue
             restored.append((annotations, alloc))
         return restored
+
+    # -- durable-state checkpoint (sched/journal.py) -------------------------
+    def checkpoint_doc(self, cache: dict) -> tuple[dict, list]:
+        """The ledger as a Checkpoint: a HEAD fragment (slice meshes,
+        compact host blobs, alloc objects + their payload signatures)
+        plus per-node LINE entries the journal writes after the head —
+        so a warm restore parses the small head eagerly and each node
+        line lazily on first touch (``_view_locked``).
+
+        ``cache`` memoizes per-node serialized lines keyed on payload
+        identity, so steady-state captures cost O(allocs + changed
+        nodes), not O(fleet). A still-LAZY node yields a ``("ref", ...)``
+        entry naming its bytes in the PREVIOUS checkpoint file — the
+        journal's drain thread copies them verbatim (this capture runs
+        under the decision lock and must not read disk). Runs under
+        ``self._lock``; serialization of changed nodes happens here (in
+        memory), disk belongs to the drain thread."""
+        node_cache = cache.setdefault("nodes", {})
+        alloc_cache = cache.setdefault("allocs", {})
+        with self._lock:
+            entries: list[tuple] = []
+            for name, view in self._nodes.items():
+                cached = node_cache.get(name)
+                if cached is not None and cached[0] is view.raw_payload:
+                    entries.append(cached[1])
+                    continue
+                line = json.dumps(_node_doc(view),
+                                  separators=(",", ":"))
+                raw_payload = view.raw_payload.encode("utf-8")
+                entry = ("line", name, line,
+                         zlib.crc32(line.encode("utf-8")),
+                         view.info.slice_id,
+                         zlib.crc32(raw_payload), len(raw_payload))
+                node_cache[name] = (view.raw_payload, entry)
+                entries.append(entry)
+            for name, le in self._lazy_index.items():
+                off, length, crc, sid, pcrc, plen = le
+                entries.append(("ref", name, off, length, crc, sid,
+                                pcrc, plen))
+            allocs = []
+            alloc_index: dict[str, tuple[int, int]] = {}
+            for key, alloc in self._allocs.items():
+                cached = alloc_cache.get(key)
+                if cached is None or cached[0] is not alloc:
+                    payload = codec.encode_alloc(alloc).encode("utf-8")
+                    cached = alloc_cache[key] = (
+                        alloc, codec.alloc_obj(alloc),
+                        (zlib.crc32(payload), len(payload)),
+                    )
+                allocs.append(cached[1])
+                alloc_index[key] = cached[2]
+            head = {
+                "epoch": self._epoch,
+                "slices": {
+                    sid: [list(sl.mesh.dims), list(sl.mesh.host_block),
+                          list(sl.mesh.torus)]
+                    for sid, sl in self._slices.items()
+                },
+                "hosts": {sid: self._hosts_blob_locked(sl)
+                          for sid, sl in self._slices.items()},
+                "allocs": allocs,
+                "alloc_index": {k: list(v)
+                                for k, v in alloc_index.items()},
+            }
+            return head, entries
+
+    def _hosts_blob_locked(self, sl: SliceView) -> str:
+        """The slice's host map as the compact checkpoint blob, cached
+        until the map mutates (a still-pending blob round-trips
+        verbatim — no expansion just to re-serialize)."""
+        if sl.pending_hosts is not None:
+            return sl.pending_hosts
+        if sl.hosts_blob is None:
+            sl.hosts_blob = ";".join(
+                f"{c[0]},{c[1]},{c[2]}={h}"
+                for c, h in sl.host_by_coord.items()
+            )
+        return sl.hosts_blob
+
+    def restore_checkpoint(self, head: dict, fd: Optional[int],
+                           node_index: dict[str, list]) -> int:
+        """Rebuild the ledger from a Checkpoint HEAD onto a fresh
+        instance (recovery's warm path): slices and allocations
+        eagerly, node views LAZILY — ``node_index`` positions each
+        node's line inside the open checkpoint file ``fd`` (ownership
+        transfers here; closed when the last lazy node materializes).
+        Unlike ``commit``, alloc application skips health validation:
+        the checkpoint recorded reality at capture time — a chip that
+        sickened later must not drop a running pod from the ledger.
+        Returns the allocations restored; raises StateError on a
+        non-fresh ledger (recovery constructs a new extender, never
+        restores over one)."""
+        with self._lock:
+            if self._nodes or self._allocs or self._lazy_index:
+                raise StateError(
+                    "restore_checkpoint requires a fresh ledger"
+                )
+            self._epoch = int(head.get("epoch", 0))
+            for sid, (dims, block, torus) in head["slices"].items():
+                self._slices[sid] = SliceView(
+                    mesh=MeshSpec(
+                        dims=tuple(int(d) for d in dims),
+                        host_block=tuple(int(b) for b in block),
+                        torus=tuple(bool(t) for t in torus),
+                    ),
+                    pending_hosts=head["hosts"].get(sid, ""),
+                )
+            self._lazy_fd = fd
+            for name, entry in node_index.items():
+                off, length, crc, sid, pcrc, plen = entry
+                self._lazy_index[name] = (off, length, crc, sid,
+                                          pcrc, plen)
+            restored = 0
+            for obj in head["allocs"]:
+                try:
+                    alloc = codec.alloc_from_obj(obj)
+                except codec.CodecError as e:
+                    log.error("checkpoint restore: undecodable alloc "
+                              "(%s)", e)
+                    continue
+                if (alloc.node_name not in self._lazy_index
+                        and alloc.node_name not in self._nodes):
+                    log.error("checkpoint restore: %s names unknown node "
+                              "%s; skipped", alloc.pod_key,
+                              alloc.node_name)
+                    continue
+                self._allocs[alloc.pod_key] = alloc
+                # occupancy re-applies at materialization (the alloc
+                # list is the occupancy's single home — node lines
+                # deliberately carry none, so the per-payload line
+                # cache never goes stale under churn)
+                self._lazy_allocs.setdefault(
+                    alloc.node_name, []).append(alloc)
+                restored += 1
+            self._names_cache = None
+            self._epoch += 1
+            self._note_delta_locked(full=True, why="checkpoint restore")
+            return restored

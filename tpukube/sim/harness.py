@@ -18,6 +18,7 @@ from __future__ import annotations
 import asyncio
 import http.client
 import json
+import logging
 import socket
 import threading
 import urllib.request
@@ -41,6 +42,8 @@ from tpukube.core.types import (
 )
 from tpukube.apiserver import EvictionExecutor, PodLifecycleReleaseLoop
 from tpukube.sched.extender import Extender, make_app
+
+log = logging.getLogger("tpukube.sim")
 
 
 class _PodStoreApi:
@@ -263,6 +266,8 @@ class SimCluster:
                 )
         self.extender = Extender(self.config, clock=self.clock)
         self.pods: dict[str, dict[str, Any]] = {}  # key -> pod object
+        # stats of the last restart_extender() recovery (None before)
+        self.last_recovery: Optional[dict[str, Any]] = None
         self._store_api = self._make_store_api()
         self._wire_extender()
         self._node_obj_cache: dict[str, dict[str, Any]] = {}
@@ -356,6 +361,9 @@ class SimCluster:
             if self.extender.trace is not None:
                 self.extender.trace.close()
             self.extender.events.close()
+            if self.extender.journal is not None:
+                self.extender.journal.close()
+                self.extender.state.retire()
         finally:
             # the process-wide threading patch must unwind even when a
             # sink close raises (full disk) — same hazard the
@@ -387,14 +395,23 @@ class SimCluster:
         if self._http is not None:
             self._http.stop()
             self._http = None
+        if self.extender.journal is not None:
+            # a real crash loses the journal's queued-but-undrained
+            # records and flushes nothing — crash() models exactly
+            # that; retiring the ledger stops its background warmer
+            # (a real crash kills threads, the sim must too)
+            self.extender.journal.crash()
+            self.extender.state.retire()
 
     def restart_extender(self) -> int:
         """Cold-start a fresh extender the way a restarted daemon does:
-        new Extender, ledger + gang reservations rebuilt from the
-        apiserver (node annotations, then live bound pods' alloc
-        annotations — apiserver.rebuild_extender), effectors re-wired,
-        HTTP serving resumed on the same port. Returns the number of
-        allocations restored."""
+        new Extender, state recovered — via the durable journal
+        (checkpoint + WAL replay + O(Δ) apiserver reconcile,
+        sched/journal.py) when journal_enabled, else the legacy full
+        rebuild from the apiserver (apiserver.rebuild_extender) —
+        effectors re-wired, HTTP serving resumed on the same port.
+        ``self.last_recovery`` carries the recovery stats. Returns the
+        number of allocations restored/known after recovery."""
         from tpukube.apiserver import rebuild_extender
 
         if self._http is not None:
@@ -402,7 +419,41 @@ class SimCluster:
                                "extender is still serving")
         self.extender = Extender(self.config, clock=self.clock)
         self._wire_extender()
-        restored = rebuild_extender(self.extender, self._store_api)
+        if self.extender.journal is not None:
+            from tpukube.sched import journal as journal_mod
+
+            try:
+                self.last_recovery = journal_mod.recover_extender(
+                    self.extender, self._store_api
+                )
+                restored = len(self.extender.state.allocations())
+            except journal_mod.JournalError as e:
+                # the journal could not produce a trustworthy base
+                # (WAL gap, undecodable checkpoint): fall back to the
+                # legacy full rebuild on a FRESH extender — the failed
+                # recovery may have half-restored state
+                log.error("journal recovery failed (%s); falling back "
+                          "to the legacy full rebuild", e)
+                self.extender.journal.crash()
+                self.extender = Extender(self.config, clock=self.clock)
+                self._wire_extender()
+                self.extender.state.set_journal(None)
+                self.extender.gang.set_journal(None)
+                restored = rebuild_extender(self.extender,
+                                            self._store_api)
+                self.extender.state.set_journal(self.extender.journal)
+                self.extender.gang.set_journal(self.extender.journal)
+                self.extender.journal.write_checkpoint_sync(
+                    self.extender.checkpoint_doc()
+                )
+                self.last_recovery = {
+                    "mode": "cold-fallback", "error": str(e),
+                    "restored_allocs": restored,
+                }
+        else:
+            restored = rebuild_extender(self.extender, self._store_api)
+            self.last_recovery = {"mode": "cold",
+                                  "restored_allocs": restored}
         # the fresh extender has ingested nothing over the webhook
         # channel yet: the next schedule() must send full node objects
         self._synced_objs = []

@@ -40,6 +40,13 @@ _PASSTHROUGH_KEYS = (
     # bit-identical placements
     "TPUKUBE_TENANCY_ENABLED",
     "TPUKUBE_TENANCY_QUOTAS",
+    # durable-state journal (ISSUE 11): the parity suite re-runs
+    # scenarios with the journal ON (a tempdir WAL) asserting
+    # bit-identical placements — persistence must never move a pod
+    "TPUKUBE_JOURNAL_ENABLED",
+    "TPUKUBE_JOURNAL_PATH",
+    "TPUKUBE_CHECKPOINT_INTERVAL_SECONDS",
+    "TPUKUBE_JOURNAL_FSYNC",
 )
 
 
@@ -78,6 +85,7 @@ def run(scenario: int, config: TpuKubeConfig | None = None) -> dict[str, Any]:
         10: kilonode_churn,
         11: tenant_serving,
         12: kilonode10k_churn,
+        13: crash_storm,
     }[scenario]
     t0 = time.perf_counter()
     result = fn(config)
@@ -1188,6 +1196,318 @@ def tenant_serving(config: TpuKubeConfig | None) -> dict[str, Any]:
             raise RuntimeError("scenario 11 invariants violated: "
                                + "; ".join(problems[:6]))
         return result
+
+
+def crash_storm(config: TpuKubeConfig | None) -> dict[str, Any]:
+    """Scenario 13 (ISSUE 11): the crash-at-every-seam chaos storm —
+    a scenario-8-style apiserver fault storm interleaved with repeated
+    extender crash/restart cycles at kilonode-ish scale (256 nodes /
+    1024 chips), with the durable-state journal carrying recovery.
+
+    Per cycle: a burst wave schedules through the batched cycles on
+    the fake clock (completion churn frees chips), the journal
+    checkpoints on its cadence, then the extender "process" dies and
+    the :class:`~tpukube.chaos.crash.CrashSchedule` mutilates the
+    journal files the way a real crash at one of the append/checkpoint
+    seams would (clean boundary, lost tail records, torn line, CRC
+    corruption, torn checkpoint). The restart recovers via checkpoint
+    + WAL replay + O(Δ) apiserver reconcile — under the SAME ongoing
+    fault storm — and the cycle's invariants run: the committed
+    training gang must still be committed, zero ledger divergence,
+    zero leaked reservations.
+
+    After the storm, checkpoint-warm recovery is timed against a cold
+    ``rebuild_extender`` on the same final state (the ``recovery``
+    perf-floor block's numbers). Raises on any invariant violation or
+    an unbounded recovery time. ``TPUKUBE_CRASH_CYCLES`` scales the
+    storm (default 8 — the acceptance minimum)."""
+    import os
+    import tempfile
+    from dataclasses import replace as _dc_replace
+
+    from tpukube.chaos import (
+        ChaosSimCluster,
+        CrashSchedule,
+        FaultSchedule,
+        converge,
+        leaked_reservations,
+        ledger_divergence,
+    )
+    from tpukube.core.clock import FakeClock
+    from tpukube.sched import kube
+
+    cycles = int(os.environ.get("TPUKUBE_CRASH_CYCLES", "8"))
+    seed = (config.chaos_seed if config is not None
+            else int(os.environ.get("TPUKUBE_CHAOS_SEED") or 0)) or 1337
+    with tempfile.TemporaryDirectory(prefix="tpukube-journal-") as td:
+        wal_path = os.path.join(td, "wal.jsonl")
+        cfg = config or load_config(env=_env({
+            "TPUKUBE_SIM_MESH_DIMS": "16,16,4",
+            "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+            "TPUKUBE_BATCH_ENABLED": "1",
+            "TPUKUBE_BATCH_MAX_PODS": "256",
+            "TPUKUBE_JOURNAL_ENABLED": "1",
+            "TPUKUBE_JOURNAL_PATH": wal_path,
+            # two fake-clock waves per checkpoint: recoveries exercise
+            # BOTH the checkpoint-restore and the WAL-tail-replay arms
+            "TPUKUBE_CHECKPOINT_INTERVAL_SECONDS": "600",
+        }))
+        if not cfg.journal_enabled:
+            raise RuntimeError(
+                "scenario 13 needs journal_enabled (a --config must "
+                "set journal_enabled + journal_path)"
+            )
+        wal_path = cfg.journal_path
+        schedule_ = FaultSchedule(seed, scenario8_storm())
+        crash_sched = CrashSchedule(seed + 7)
+        clock = FakeClock()
+        gang_size = 64
+        violations: list[str] = []
+        recovery_walls: list[float] = []
+        modes: list[str] = []
+        # audit totals ACROSS incarnations (each crash wipes the next
+        # extender's counters; the storm's proof is the sum)
+        audit_checks = audit_divergences = 0
+        with ChaosSimCluster(cfg, schedule_, clock=clock,
+                             in_process=True) as c:
+            ext = c.extender
+
+            def robust(pod, deadline_rounds: int = 60):
+                last = None
+                for _ in range(deadline_rounds):
+                    try:
+                        return c.schedule(pod)
+                    except RuntimeError as e:
+                        last = e
+                        if "degraded mode" in str(e):
+                            time.sleep(c.CIRCUIT_RESET_S)
+                        converge(c, rounds=3)
+                raise RuntimeError(f"pod never scheduled: {last}")
+
+            def committed(name: str) -> bool:
+                return any(
+                    g["committed"] for g in c.extender.gang_snapshot()
+                    if g["group"] == name
+                )
+
+            def drive(pods) -> int:
+                """Batch-drive one wave with scheduler requeue
+                semantics under chaos; unplaced pods are abandoned
+                (their objects leave the store). Returns placed."""
+                ext = c.extender
+                remaining = list(pods)
+                placed = 0
+                for _ in range(8):
+                    if not remaining:
+                        break
+                    c._sync_nodes()
+                    try:
+                        c.drain_evictions()
+                    except RuntimeError:
+                        pass  # injected fault; converge retries below
+                    for obj in remaining:
+                        ext.admit(kube.pod_from_k8s(obj))
+                    ext.plan_pending()
+                    still = []
+                    for obj in remaining:
+                        meta = obj["metadata"]
+                        key = f"{meta['namespace']}/{meta['name']}"
+                        node = ext.planned_node(key)
+                        if node is None:
+                            still.append(obj)
+                            continue
+                        bres = c._post("/bind", {
+                            "PodName": meta["name"],
+                            "PodNamespace": meta["namespace"],
+                            "PodUID": meta["uid"],
+                            "Node": node,
+                        })
+                        if bres.get("Error"):
+                            if "degraded mode" in bres["Error"]:
+                                time.sleep(c.CIRCUIT_RESET_S)
+                            still.append(obj)
+                            continue
+                        meta.setdefault("annotations", {}).update(
+                            bres.get("Annotations", {})
+                        )
+                        obj["spec"]["nodeName"] = node
+                        placed += 1
+                    remaining = still
+                    converge(c, rounds=3)
+                for obj in remaining:
+                    meta = obj["metadata"]
+                    c.pods.pop(f"{meta['namespace']}/{meta['name']}",
+                               None)
+                converge(c, rounds=3)
+                return placed
+
+            # the training gang whose commit every crash must survive
+            group = PodGroup("stormtrain", min_member=gang_size)
+            for i in range(gang_size):
+                robust(c.make_pod(f"st-{i}", tpu=1, priority=100,
+                                  group=group))
+            if not committed("stormtrain"):
+                raise RuntimeError("scenario 13: the training gang "
+                                   "never committed")
+
+            alive: list[str] = []
+            seq = 0
+            pods_placed = 0
+            for cycle in range(cycles):
+                # churn: the oldest half of the burst plane completes
+                done, alive = alive[:64], alive[64:]
+                for name in done:
+                    _complete_quiet(c, name)
+                converge(c, rounds=3)
+                wave = []
+                for _ in range(96):
+                    wave.append(c.make_pod(f"b{seq}", tpu=1))
+                    seq += 1
+                names = [obj["metadata"]["name"] for obj in wave]
+                got = drive(wave)
+                pods_placed += got
+                alive.extend(
+                    n for n in names if f"default/{n}" in c.pods
+                    and c.pods[f"default/{n}"]["spec"].get("nodeName")
+                )
+                c.advance(300.0)  # checkpoint cadence rides the clock
+
+                # the crash: process death + one journal-seam outcome
+                audit_checks += c.extender.snapshots.audit_checks
+                audit_divergences += c.extender.snapshots.audit_divergences
+                c.crash_extender()
+                seam = crash_sched.next_seam()
+                crash_sched.apply(seam, wal_path)
+                t0 = time.perf_counter()
+                c.restart_extender()
+                recovery_walls.append(time.perf_counter() - t0)
+                modes.append(c.last_recovery.get("mode", "?"))
+                converge(c, rounds=5)
+
+                # per-cycle invariants — a violation fails the storm
+                if not committed("stormtrain"):
+                    violations.append(
+                        f"cycle {cycle} ({seam}): committed gang lost")
+                div = ledger_divergence(c)
+                if div:
+                    violations.append(
+                        f"cycle {cycle} ({seam}): ledger divergence "
+                        f"{div[:2]}")
+                leaks = leaked_reservations(c)
+                if leaks:
+                    violations.append(
+                        f"cycle {cycle} ({seam}): leaked reservations "
+                        f"{leaks[:2]}")
+
+            # quiet: storm off, drain, final invariants
+            schedule_.stop()
+            converge(c)
+            robust(c.make_pod("post-storm-probe", tpu=1))
+            converge(c)
+
+            # the acceptance measurement: checkpoint-warm recovery vs
+            # a cold rebuild_extender of the SAME final state
+            ext = c.extender
+            ext.journal.write_checkpoint_sync(ext.checkpoint_doc())
+            from tpukube.apiserver import rebuild_extender
+            from tpukube.sched.extender import Extender as _Ext
+
+            # same trace/events surface as the live extender — the cold
+            # number must be the restart a journal-less daemon would
+            # actually pay, not a stripped-down one
+            cold_cfg = _dc_replace(cfg, journal_enabled=False,
+                                   journal_path="")
+            throwaway = _Ext(cold_cfg, clock=clock)
+            t0 = time.perf_counter()
+            cold_restored = rebuild_extender(throwaway, c._store_api)
+            cold_s = time.perf_counter() - t0
+            # the timing pair runs at the PRODUCTION audit setting (the
+            # sentinel's two full rebuild-compares are a test-mode
+            # cost); the ≥8 storm cycles above already proved the
+            # recovered state correct at whatever rate the run pinned
+            audit_rate = cfg.snapshot_audit_rate
+            object.__setattr__(c.config, "snapshot_audit_rate", 0.0)
+            try:
+                c.crash_extender()
+                t0 = time.perf_counter()
+                c.restart_extender()
+                warm_s = time.perf_counter() - t0
+            finally:
+                object.__setattr__(c.config, "snapshot_audit_rate",
+                                   audit_rate)
+            c.extender.snapshots.audit_rate = audit_rate
+            warm = c.last_recovery
+            converge(c)
+
+            div = ledger_divergence(c)
+            leaks = leaked_reservations(c)
+            journal_stats = c.extender.journal.stats()
+            reasons = c.extender.events.counts_by_reason()
+            recovery_walls.sort()
+            result = {
+                "metric": "crash_storm",
+                "value": len(recovery_walls),
+                "unit": "crash/restart cycles survived",
+                "crash_cycles": cycles,
+                "seams": crash_sched.chosen,
+                "recovery_modes": modes,
+                "recovery_s_max": round(recovery_walls[-1], 4),
+                "recovery_s_p50": round(
+                    recovery_walls[len(recovery_walls) // 2], 4),
+                "warm_recovery_s": round(warm_s, 4),
+                "cold_rebuild_s": round(cold_s, 4),
+                "replay_speedup": round(cold_s / warm_s, 2)
+                if warm_s > 0 else None,
+                "warm_mode": warm.get("mode"),
+                "warm_from_checkpoint": warm.get("checkpoint"),
+                "warm_replayed": warm.get("replayed"),
+                "cold_restored": cold_restored,
+                "pods_placed": pods_placed,
+                "faults_injected": schedule_.injected(),
+                "checkpoints": journal_stats["checkpoints"],
+                "wal_appends": journal_stats["appends"],
+                "wal_replayed_total": journal_stats["replayed_total"],
+                # the LAST incarnation's journal events (an extender's
+                # event ring dies with its process — that is the point)
+                "recovery_events": {
+                    k: reasons.get(k, 0)
+                    for k in ("RecoveryCompleted", "RecoveryDiverged",
+                              "JournalTruncated", "CheckpointWritten")
+                },
+                "ledger_divergence": len(div),
+                "leaked_reservations": len(leaks),
+                "snapshot_audit": {
+                    "rate": cfg.snapshot_audit_rate,
+                    "checks": audit_checks
+                    + c.extender.snapshots.audit_checks,
+                    "divergences": audit_divergences
+                    + c.extender.snapshots.audit_divergences,
+                },
+                "utilization_percent": round(100 * c.utilization(), 2),
+            }
+            problems = list(violations) + div + [str(p) for p in leaks]
+            if recovery_walls[-1] > 30.0:
+                problems.append(
+                    f"recovery took {recovery_walls[-1]:.1f}s — "
+                    f"unbounded recovery time")
+            if not warm.get("checkpoint"):
+                problems.append(
+                    "the final warm recovery did not load a checkpoint")
+            if warm.get("mode") != "warm":
+                problems.append(
+                    f"final recovery mode {warm.get('mode')!r}, "
+                    f"expected warm")
+            if len(modes) != cycles:
+                problems.append(
+                    f"{len(modes)} recoveries ran for {cycles} crash "
+                    f"cycles")
+            if reasons.get("RecoveryCompleted", 0) < 1:
+                problems.append(
+                    "the final recovery was not journaled")
+            if problems:
+                raise RuntimeError("scenario 13 invariants violated: "
+                                   + "; ".join(problems[:6]))
+            return result
 
 
 def crash_recovery(config: TpuKubeConfig | None) -> dict[str, Any]:
