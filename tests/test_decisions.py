@@ -1,0 +1,799 @@
+"""Decision provenance (ISSUE 12 tentpole): the DecisionLog ring,
+explain assembly across webhook and batch paths, cycle phase
+profiling, per-tenant burn windows, the decision-provenance lint, and
+off-is-off parity."""
+
+import json
+import urllib.error
+import urllib.request
+
+from tpukube.core.config import load_config
+from tpukube.core.types import PodGroup
+from tpukube.obs.decisions import DecisionLog, explain_doc, format_explain
+from tpukube.sim import SimCluster
+
+TENANT_LABEL = "tpu.qiniu.com/tenant"
+
+
+def _cfg(extra=None):
+    env = {
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        "TPUKUBE_DECISIONS_ENABLED": "1",
+    }
+    env.update(extra or {})
+    return load_config(env=env)
+
+
+# -- ring + sampling ---------------------------------------------------------
+
+def test_ring_bounds():
+    log = DecisionLog(capacity=8, sample_rate=1.0)
+    for i in range(50):
+        log.record(f"default/p{i}", "filter", feasible=1)
+    assert len(log.events()) == 8
+    assert log.recorded == 50
+    # oldest rotated out, newest retained
+    pods = [e["pod"] for e in log.events()]
+    assert pods == [f"default/p{i}" for i in range(42, 50)]
+    assert log.record_seconds > 0
+
+
+def test_sampling_determinism_seeded():
+    keys = [f"default/pod-{i}" for i in range(400)]
+    a = DecisionLog(sample_rate=0.5, seed=7)
+    b = DecisionLog(sample_rate=0.5, seed=7)
+    c = DecisionLog(sample_rate=0.5, seed=8)
+    picks_a = {k for k in keys if a.wants(k)}
+    picks_b = {k for k in keys if b.wants(k)}
+    picks_c = {k for k in keys if c.wants(k)}
+    # deterministic per seed: two instances agree exactly
+    assert picks_a == picks_b
+    # a rate-0.5 hash sample lands in a sane band
+    assert 100 < len(picks_a) < 300
+    # a different seed selects a different set
+    assert picks_a != picks_c
+    # edge rates
+    off = DecisionLog(sample_rate=0.0)
+    on = DecisionLog(sample_rate=1.0)
+    assert not any(off.wants(k) for k in keys)
+    assert all(on.wants(k) for k in keys)
+
+
+def test_explain_unknown_pod():
+    log = DecisionLog()
+    doc = log.explain("default/ghost")
+    assert doc["verdict"] == "unknown"
+    assert "UNKNOWN" in format_explain(doc)
+
+
+def test_explain_midflight_is_pending_not_unknown():
+    """Review regression: a pod with recorded stages but no
+    verdict-moving one yet (filter/prioritize done, bind pending) is
+    PENDING — 'no provenance recorded' above rendered why-lines would
+    deny the data it just printed."""
+    log = DecisionLog()
+    log.record("default/mid", "filter", candidates=2, feasible=2,
+               pruned={})
+    log.record("default/mid", "prioritize", nodes=2,
+               top=[["n0", 7], ["n1", 5]])
+    doc = log.explain("default/mid")
+    assert doc["verdict"] == "pending"
+    assert "PENDING" in format_explain(doc)
+
+
+# -- explain across the webhook path -----------------------------------------
+
+def test_explain_placed_webhook_path():
+    with SimCluster(_cfg()) as c:
+        node, _ = c.schedule(c.make_pod("web", tpu=1))
+        doc = c.extender.decisions.explain("default/web")
+        assert doc["verdict"] == "placed"
+        assert doc["node"] == node
+        stages = [e["stage"] for e in doc["stages"]]
+        assert "filter" in stages and "prioritize" in stages
+        assert stages[-1] == "bind"
+        # candidate pruning + top-k scores made it into the chain
+        f = next(e for e in doc["stages"] if e["stage"] == "filter")
+        assert f["feasible"] >= 1 and f["candidates"] >= f["feasible"]
+        p = next(e for e in doc["stages"] if e["stage"] == "prioritize")
+        assert p["top"] and p["top"][0][0] == node
+        text = format_explain(doc)
+        assert "PLACED" in text and node in text
+
+
+def test_explain_pending_unschedulable():
+    with SimCluster(_cfg()) as c:
+        try:
+            c.schedule(c.make_pod("huge", tpu=64))
+        except RuntimeError:
+            pass
+        doc = c.extender.decisions.explain("default/huge")
+        assert doc["verdict"] == "pending"
+        f = next(e for e in doc["stages"] if e["stage"] == "filter")
+        assert f["feasible"] == 0 and f["pruned"]
+        # the pruning reasons name why each node refused
+        assert any("wants 64 chips" in r for r in f["pruned"])
+
+
+def test_explain_denied_tenancy_quota():
+    cfg = _cfg({
+        "TPUKUBE_TENANCY_ENABLED": "1",
+        "TPUKUBE_TENANCY_QUOTAS": "a=chips:1",
+    })
+    with SimCluster(cfg) as c:
+        c.schedule(c.make_pod("a-0", tpu=1, labels={TENANT_LABEL: "a"}))
+        try:
+            c.schedule(c.make_pod("a-1", tpu=1,
+                                  labels={TENANT_LABEL: "a"}))
+            assert False, "quota breach must refuse"
+        except RuntimeError:
+            pass
+        doc = c.extender.decisions.explain("default/a-1")
+        assert doc["verdict"] == "denied"
+        t = next(e for e in doc["stages"] if e["stage"] == "tenancy")
+        assert t["verdict"] == "TenantQuotaDenied"
+        assert t["tenant"] == "a"
+        # shares at decision time ride the record
+        assert t["dominant_share"] is not None
+        # the wire refusal is chained too
+        assert any(e["stage"] == "refusal" for e in doc["stages"])
+        assert "quota" in format_explain(doc)
+
+
+def test_explain_preempted_victim():
+    with SimCluster(_cfg()) as c:
+        for i in range(4):
+            c.schedule(c.make_pod(f"low-{i}", tpu=1, priority=0))
+        group = PodGroup("g", min_member=4)
+        for i in range(4):
+            c.schedule(c.make_pod(f"g-{i}", tpu=1, priority=100,
+                                  group=group))
+        victims = [f"default/low-{i}" for i in range(4)]
+        docs = [c.extender.decisions.explain(v) for v in victims]
+        assert all(d["verdict"] == "preempted" for d in docs)
+        assert any("higher-priority" in format_explain(d) for d in docs)
+        # and the preemptor's chain shows the plan
+        gd = c.extender.decisions.explain("default/g-0")
+        assert any(e["stage"] == "preemption_plan" for e in gd["stages"])
+
+
+def test_explain_released_after_completion():
+    with SimCluster(_cfg()) as c:
+        c.schedule(c.make_pod("done", tpu=1))
+        c.complete_pod("done")
+        doc = c.extender.decisions.explain("default/done")
+        assert doc["verdict"] == "released"
+
+
+# -- explain across the batch path + phase profiling -------------------------
+
+def test_explain_batch_path_and_phases():
+    cfg = _cfg({"TPUKUBE_BATCH_ENABLED": "1"})
+    with SimCluster(cfg, in_process=True) as c:
+        pods = [c.make_pod(f"b-{i}", tpu=1) for i in range(3)]
+        placed = c.schedule_pending(pods)
+        assert len(placed) == 3
+        ext = c.extender
+        doc = ext.decisions.explain("default/b-0")
+        assert doc["verdict"] == "placed"
+        plan = next(e for e in doc["stages"]
+                    if e["stage"] == "cycle_plan")
+        assert plan["arm"] == "fast"
+        assert plan["assumed"] is True
+        assert plan["snapshot"] in ("delta", "rebuild", "cached")
+        assert plan["queue_age_s"] is not None
+        assert any(e["stage"] == "admit" for e in doc["stages"])
+        b = next(e for e in doc["stages"] if e["stage"] == "bind")
+        assert b["served_from"] == "plan"
+        # phase histogram observed pin/plan (and commit via /bind)
+        text = ext.phase_hist.render()
+        assert 'phase="pin"' in text and 'phase="plan"' in text
+        assert 'phase="commit"' in text
+        # cycle spans landed in the decision trace for the timeline
+        kinds = {e["request"].get("name") for e in ext.trace.events()
+                 if e["kind"] == "span"}
+        assert {"cycle_pin", "cycle_plan"} <= kinds
+
+
+def test_gang_batch_arm_recorded():
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        "TPUKUBE_DECISIONS_ENABLED": "1",
+        "TPUKUBE_BATCH_ENABLED": "1",
+    })
+    with SimCluster(cfg, in_process=True) as c:
+        group = PodGroup("gg", min_member=4)
+        pods = [c.make_pod(f"gg-{i}", tpu=1, priority=10, group=group)
+                for i in range(4)]
+        placed = c.schedule_pending(pods)
+        assert len(placed) == 4
+        doc = c.extender.decisions.explain("default/gg-0")
+        plan = next(e for e in doc["stages"]
+                    if e["stage"] == "cycle_plan")
+        assert plan["arm"] == "gang_batch"
+        assert any(e["stage"] == "gang_reserve"
+                   for e in doc["stages"])
+
+
+def test_cycle_queue_age_percentiles_in_stats():
+    cfg = _cfg({"TPUKUBE_BATCH_ENABLED": "1",
+                "TPUKUBE_BATCH_MAX_PODS": "1"})
+    from tpukube.core.clock import FakeClock
+    from tpukube.sched import kube
+
+    clock = FakeClock()
+    with SimCluster(cfg, clock=clock, in_process=True) as c:
+        ext = c.extender
+        c._sync_nodes()
+        # admit two pods; the 1-pod batch cap leaves one queued
+        for i in range(2):
+            ext.admit(kube.pod_from_k8s(c.make_pod(f"q-{i}", tpu=1)))
+        clock.advance(5.0)
+        stats = ext.cycle.stats()
+        assert stats["queue_depth"] == 2
+        assert stats["queue_oldest_age_s"] >= 5.0
+        assert stats["queue_age_p50_s"] >= 5.0
+        assert stats["queue_age_p99_s"] >= stats["queue_age_p50_s"]
+        # planning drains the queue but the pods are still PENDING
+        # (assumed, no bind yet): the admit stamps — and the ages —
+        # survive until an actual bind or release retires them
+        ext.plan_pending()
+        stats = ext.cycle.stats()
+        assert stats["queue_depth"] == 0
+        assert stats["queue_oldest_age_s"] >= 5.0
+        for i in range(2):
+            ext.handle("release", {"pod_key": f"default/q-{i}"})
+        assert ext.cycle.stats()["queue_oldest_age_s"] is None
+
+
+def test_pdb_refusal_recorded():
+    """Review regression: a bind refused by the PodDisruptionBudget
+    precheck must land in the provenance chain — a pod stuck behind a
+    PDB is exactly the incident explain must answer."""
+    with SimCluster(_cfg()) as c:
+        ext = c.extender
+        for i in range(4):
+            c.schedule(c.make_pod(f"low-{i}", tpu=1, priority=0))
+        ext.evict_precheck = lambda pk: False  # PDB blocks every victim
+        group = PodGroup("g", min_member=4)
+        try:
+            c.schedule(c.make_pod("g-0", tpu=1, priority=100,
+                                  group=group), retries=2)
+            assert False, "bind must be refused by the precheck"
+        except RuntimeError:
+            pass
+        doc = ext.decisions.explain("default/g-0")
+        r = next(e for e in doc["stages"] if e["stage"] == "refusal")
+        assert r["kind"] == "pdb_precheck"
+        assert "PodDisruptionBudget" in r["reason"]
+        assert "PodDisruptionBudget" in format_explain(doc)
+
+
+def test_release_clears_queued_ghost():
+    """Review regression: a pod deleted while still QUEUED must leave
+    the queue (and the queue-age stats) — a ghost entry would inflate
+    queue_oldest_age_s forever and plan chips nobody will bind."""
+    from tpukube.sched import kube
+
+    cfg = _cfg({"TPUKUBE_BATCH_ENABLED": "1",
+                "TPUKUBE_BATCH_MAX_PODS": "1"})
+    with SimCluster(cfg, in_process=True) as c:
+        ext = c.extender
+        c._sync_nodes()
+        for i in range(2):
+            ext.admit(kube.pod_from_k8s(c.make_pod(f"gh-{i}", tpu=1)))
+        assert ext.cycle.stats()["queue_depth"] == 2
+        ext.handle("release", {"pod_key": "default/gh-0"})
+        ext.handle("release", {"pod_key": "default/gh-1"})
+        s = ext.cycle.stats()
+        assert s["queue_depth"] == 0
+        assert s["queue_oldest_age_s"] is None
+        assert ext.cycle.run_pending() == 0  # nothing ghost-planned
+
+
+def test_pending_age_survives_refusal_retries():
+    """Review regression: a pod refused and retried for hours must
+    ACCUMULATE pending-admit age — per-retry resets would hide exactly
+    the starved pod the stat exists to page on. A successful bind then
+    retires the stamp."""
+    from tpukube.core.clock import FakeClock
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        "TPUKUBE_BATCH_ENABLED": "1",
+        "TPUKUBE_DECISIONS_ENABLED": "1",
+        "TPUKUBE_TENANCY_ENABLED": "1",
+        "TPUKUBE_TENANCY_QUOTAS": "a=chips:1",
+    })
+    clock = FakeClock()
+    with SimCluster(cfg, clock=clock, in_process=True) as c:
+        ext = c.extender
+        c.schedule(c.make_pod("a-0", tpu=1, labels={TENANT_LABEL: "a"}))
+        pod = c.make_pod("a-1", tpu=1, labels={TENANT_LABEL: "a"})
+        for _ in range(2):
+            try:
+                c.schedule(pod, retries=1)
+            except RuntimeError:
+                pass  # quota refusal; the scheduler would requeue
+            clock.advance(10.0)
+        try:
+            c.schedule(pod, retries=1)
+        except RuntimeError:
+            pass
+        stats = ext.cycle.stats()
+        assert stats["queue_oldest_age_s"] >= 20.0
+        # quota frees up: the pod binds and its stamp retires
+        c.complete_pod("a-0")
+        node, _ = c.schedule(pod)
+        assert node
+        assert ext.cycle.stats()["queue_oldest_age_s"] is None
+
+
+def test_effector_failure_explains_as_pending():
+    """Review regression: a bind whose apiserver effector fails is
+    undone for retry — its explain must end on a failed bind stage,
+    not read 'bound ... released' for a pod still Pending."""
+    with SimCluster(_cfg()) as c:
+        def boom(alloc):
+            raise RuntimeError("apiserver down")
+
+        c.extender.binder = boom
+        try:
+            c.schedule(c.make_pod("fx", tpu=1), retries=1)
+            assert False, "bind must fail through the effector"
+        except RuntimeError:
+            pass
+        doc = c.extender.decisions.explain("default/fx")
+        assert doc["verdict"] == "pending"
+        last = doc["stages"][-1]
+        assert last["stage"] == "bind" and last["ok"] is False
+        assert "apiserver bind failed" in last["error"]
+
+
+def test_admit_gate_refusal_stamps_pending_age():
+    """Review regression: an informer-fed pod shed at the ADMIT gate
+    (never enqueued) must still accumulate pending-admit age — the
+    starvation stats cover both refusal paths."""
+    from tpukube.core.clock import FakeClock
+    from tpukube.sched import kube
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        "TPUKUBE_BATCH_ENABLED": "1",
+        "TPUKUBE_DECISIONS_ENABLED": "1",
+        "TPUKUBE_TENANCY_ENABLED": "1",
+        "TPUKUBE_TENANCY_QUOTAS": "a=chips:1",
+    })
+    clock = FakeClock()
+    with SimCluster(cfg, clock=clock, in_process=True) as c:
+        ext = c.extender
+        c.schedule(c.make_pod("a-0", tpu=1, labels={TENANT_LABEL: "a"}))
+        over = c.make_pod("a-over", tpu=1, labels={TENANT_LABEL: "a"})
+        assert ext.admit(kube.pod_from_k8s(over)) is False  # refused
+        clock.advance(30.0)
+        assert ext.admit(kube.pod_from_k8s(over)) is False  # retried
+        stats = ext.cycle.stats()
+        assert stats["queue_depth"] == 0  # never actually enqueued
+        assert stats["queue_oldest_age_s"] >= 30.0
+        # deletion retires the stamp like any pending pod's
+        ext.handle("release", {"pod_key": "default/a-over"})
+        assert ext.cycle.stats()["queue_oldest_age_s"] is None
+
+
+def test_explain_url_with_bearer_token(tmp_path, capsys):
+    """Review regression: `tpukube-obs explain --url` must be usable
+    against an auth-configured extender (--token-file)."""
+    import socket
+
+    import pytest
+
+    from tpukube import cli
+    from tpukube.sched.extender import (
+        Extender,
+        make_app,
+        run_probe_server,
+    )
+
+    ext = Extender(_cfg())
+    ext.decisions.record("default/p", "bind", node="n", ok=True,
+                         served_from="legacy")
+    app = make_app(ext, auth_token="sekrit")
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    stop = run_probe_server(app, "127.0.0.1", port)
+    try:
+        tok = tmp_path / "tok"
+        tok.write_text("sekrit\n")
+        rc = cli.main_obs(["explain", "default/p",
+                           "--url", f"http://127.0.0.1:{port}",
+                           "--token-file", str(tok)])
+        assert rc == 0 and "PLACED" in capsys.readouterr().out
+        with pytest.raises(urllib.error.HTTPError) as e:
+            cli.main_obs(["explain", "default/p",
+                          "--url", f"http://127.0.0.1:{port}"])
+        assert e.value.code == 401
+    finally:
+        stop()
+
+
+# -- off-is-off + parity -----------------------------------------------------
+
+def test_off_is_off_exposition_and_statusz():
+    from tpukube.metrics import render_extender_metrics
+    from tpukube.obs.statusz import extender_statusz
+    from tpukube.sched.extender import Extender
+
+    off = Extender(load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    }))
+    text = render_extender_metrics(off)
+    assert "tpukube_decisions" not in text
+    assert "tpukube_cycle_phase_seconds" not in text
+    assert off.decisions is None and off.phase_hist is None
+    assert extender_statusz(off)["decisions"] == {"enabled": False}
+
+    on = Extender(_cfg())
+    text_on = render_extender_metrics(on)
+    assert "tpukube_decisions_total" in text_on
+    assert "tpukube_decisions_record_seconds_total" in text_on
+    assert "tpukube_cycle_phase_seconds_bucket" in text_on
+    # with provenance on, the only exposition difference is the new
+    # families — every legacy series (name + labels; values carry
+    # instance-local timings) renders identically
+    def shape(t):
+        return [ln.rsplit(" ", 1)[0] for ln in t.splitlines()]
+
+    legacy = [ln for ln in shape(text_on)
+              if "tpukube_decisions" not in ln
+              and "tpukube_cycle_phase_seconds" not in ln]
+    assert legacy == shape(text)
+    sz = extender_statusz(on)["decisions"]
+    assert sz["enabled"] is True and sz["sample_rate"] == 1.0
+
+
+def test_placement_parity_decisions_on_vs_off():
+    def run(enabled):
+        env = {
+            "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+            "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        }
+        if enabled:
+            env["TPUKUBE_DECISIONS_ENABLED"] = "1"
+        placements = {}
+        with SimCluster(load_config(env=env)) as c:
+            for i in range(4):
+                placements[f"p{i}"], alloc = c.schedule(
+                    c.make_pod(f"p{i}", tpu=1))
+                placements[f"p{i}-coords"] = [
+                    list(co) for co in alloc.coords]
+            group = PodGroup("g", min_member=8)
+            for i in range(8):
+                node, alloc = c.schedule(c.make_pod(
+                    f"g{i}", tpu=1, priority=50, group=group))
+                placements[f"g{i}"] = (node, [list(co)
+                                              for co in alloc.coords])
+        return placements
+
+    assert run(False) == run(True)
+
+
+# -- per-tenant burn windows on the fake clock -------------------------------
+
+def test_per_tenant_burn_window_math_on_fakeclock():
+    from tpukube.core.clock import FakeClock
+    from tpukube.obs.registry import Histogram
+    from tpukube.tenancy.core import BurnMonitor
+
+    clock = FakeClock()
+    hist = Histogram("tpukube_tenant_admission_seconds")
+    mon = BurnMonitor(clock, threshold=14.4, window=60.0)
+    mon.attach_tenant("tenant-admission-latency", hist,
+                      threshold_le="0.25", objective=0.999)
+
+    def observe(tenant, fast, slow):
+        child = hist.labels(tenant=tenant)
+        for _ in range(fast):
+            child.observe(0.01)
+        for _ in range(slow):
+            child.observe(1.0)
+
+    # tenant a: all fast; tenant b: half slow
+    observe("a", 100, 0)
+    observe("b", 50, 50)
+    clock.advance(10.0)
+    mon.evaluate()
+    assert mon.tenant_burn("a") == 0.0
+    # error ratio 0.5 over budget 0.001 = 500x
+    assert abs(mon.tenant_burn("b") - 500.0) < 1.0
+    assert mon.last_tenant_burn("b", "tenant-admission-latency") > 100
+    assert mon.last_tenant_burn("ghost", "x") == 0.0
+
+    # slide one window: burn is measured vs the A baseline — new
+    # all-fast traffic from b dilutes but keeps history in window
+    clock.advance(61.0)
+    observe("b", 100, 0)
+    burns1 = mon.evaluate()
+    assert burns1 is not None
+    tb = mon.tenant_burn("b")
+    assert tb is not None and 0 < tb < 500.0
+
+    # idle gap past two windows: per-tenant baselines reset too — no
+    # stale pseudo-window judges the morning's first burst
+    clock.advance(200.0)
+    mon.evaluate()
+    assert mon.tenant_burn("b") is None
+    # traffic resumes: a fresh window pair re-measures honestly
+    observe("b", 0, 10)
+    clock.advance(10.0)
+    mon.evaluate()
+    assert mon.tenant_burn("b") is not None
+    assert mon.tenant_burn("b") > 100
+
+
+def test_tenant_latency_series_render_with_tenancy_on():
+    from tpukube.metrics import render_extender_metrics
+    from tpukube.sched.extender import Extender
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        "TPUKUBE_TENANCY_ENABLED": "1",
+    })
+    ext = Extender(cfg)
+    ext.tenants.observe_admission("teamA", 0.01)
+    ext.tenants.observe_commit("teamA", 0.02)
+    ext.tenants.burn.evaluate()
+    text = render_extender_metrics(ext)
+    assert 'tpukube_tenant_admission_seconds_bucket{le="0.25",tenant="teamA"}' in text
+    assert 'tpukube_tenant_commit_seconds_bucket' in text
+    assert "tpukube_tenant_slo_burn" in text
+    # and tenancy-off exposition carries none of them
+    off = Extender(load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    }))
+    off_text = render_extender_metrics(off)
+    assert "tpukube_tenant_admission_seconds" not in off_text
+    assert "tpukube_tenant_slo_burn" not in off_text
+
+
+def test_shed_cites_tenant_local_burn():
+    """The promoted BurnMonitor: a shed's refusal message (and its
+    provenance record) cite the refused tenant's own admission burn,
+    not just the plane-global trigger."""
+    from tpukube.sched import kube
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        "TPUKUBE_DECISIONS_ENABLED": "1",
+        "TPUKUBE_TENANCY_ENABLED": "1",
+        "TPUKUBE_TENANCY_BURN_WINDOW_SECONDS": "60",
+    })
+    with SimCluster(cfg) as c:
+        ext = c.extender
+        plane = ext.tenants
+        # tenant a dominates the burst plane; tenant b stays under
+        for i in range(6):
+            c.schedule(c.make_pod(f"a-{i}", tpu=1,
+                                  labels={TENANT_LABEL: "a"}))
+        c.schedule(c.make_pod("b-0", tpu=1,
+                              labels={TENANT_LABEL: "b"}))
+        # burn the gang SLO: slow commits past the 2.5s threshold
+        for _ in range(40):
+            ext.gang.commit_hist.observe(10.0)
+        # give tenant a slow ADMISSIONS too, so its tenant-local burn
+        # is real — then let the monitor see both
+        for _ in range(20):
+            plane.observe_admission("a", 1.0)
+        pod = c.make_pod("a-burst", tpu=1, labels={TENANT_LABEL: "a"})
+        refusal = plane.admit(kube.pod_from_k8s(pod), "qiniu.com/tpu", 1)
+        assert refusal is not None and "admission shed" in refusal
+        assert "tenant-local admission burn" in refusal
+        doc = ext.decisions.explain("default/a-burst")
+        assert doc["verdict"] == "denied"
+        t = next(e for e in doc["stages"] if e["stage"] == "tenancy")
+        assert t["tenant_burn"] is not None and t["tenant_burn"] > 14.4
+
+
+# -- /explain route, /statusz, CLI -------------------------------------------
+
+def test_explain_route_and_cli_file_mode(tmp_path, capsys):
+    from tpukube import cli
+
+    sink = tmp_path / "decisions.jsonl"
+    cfg = _cfg({"TPUKUBE_DECISIONS_PATH": str(sink)})
+    with SimCluster(cfg) as c:
+        node, _ = c.schedule(c.make_pod("routed", tpu=1))
+        with urllib.request.urlopen(
+            f"{c.base_url}/explain?pod=default/routed", timeout=5
+        ) as r:
+            doc = json.loads(r.read())
+        assert doc["verdict"] == "placed" and doc["node"] == node
+        # bare names default the namespace
+        with urllib.request.urlopen(
+            f"{c.base_url}/explain?pod=routed", timeout=5
+        ) as r:
+            assert json.loads(r.read())["verdict"] == "placed"
+        c.extender.decisions.close()  # drain the sink
+
+    rc = cli.main_obs(["explain", "routed", "--file", str(sink)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "PLACED" in out and node in out
+    rc = cli.main_obs(["explain", "default/ghost", "--file", str(sink)])
+    out = capsys.readouterr().out
+    assert rc == 1 and "UNKNOWN" in out
+    # --json emits the raw document
+    rc = cli.main_obs(["explain", "routed", "--file", str(sink),
+                       "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["verdict"] == "placed"
+
+
+def test_explain_route_404_when_disabled():
+    import urllib.error
+
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg) as c:
+        try:
+            urllib.request.urlopen(f"{c.base_url}/explain?pod=x",
+                                   timeout=5)
+            assert False, "must 404 with provenance off"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+
+
+# -- timeline: cycle spans + junk tolerance ----------------------------------
+
+def test_timeline_cycle_spans_junk_tolerance():
+    """Satellite regression: Chrome-trace export over a capture that
+    mixes cluster-track cycle spans (no pod key), pod events, and torn
+    junk must keep the batch structure and not crash."""
+    import time as _time
+
+    from tpukube.obs import timeline
+
+    now = _time.time()
+    events = [
+        {"seq": 1, "ts": now, "kind": "span",
+         "request": {"name": "cycle_pin", "pod_key": "",
+                     "cycle": 1, "snapshot": "delta"}, "response": None},
+        {"seq": 2, "ts": now + 0.001, "kind": "span",
+         "request": {"name": "cycle_plan", "pod_key": "",
+                     "cycle": 1, "pods": 3}, "response": None},
+        {"seq": 3, "ts": now + 0.002, "kind": "span",
+         "request": {"name": "cycle_answer", "pod_key": "default/p0",
+                     "cycle": 1}, "response": None},
+        # junk a torn capture can contain
+        "garbage line", {"kind": "span"}, {"ts": "not-a-number"},
+        {"seq": 9, "ts": now + 0.003, "kind": "bind",
+         "request": {"PodName": "p0", "PodNamespace": "default"},
+         "response": {}},
+    ]
+    doc = timeline.chrome_trace(events)
+    names = {e.get("name") for e in doc["traceEvents"]}
+    assert {"cycle_pin", "cycle_plan", "cycle_answer"} <= names
+    # cycle_pin/plan live on the cluster track; cycle_answer on the pod
+    chains = timeline.span_chains(events)
+    assert chains["default/p0"] == ["cycle_answer", "bind"]
+    stats = timeline.phase_stats(events)
+    assert "cycle_answer" in stats
+
+
+# -- decision-provenance lint ------------------------------------------------
+
+VIOLATING_SEAM = '''\
+class Gate:
+    def refuse(self, pod):
+        self._emit_event("DegradedMode", "extender/filter",
+                         "failing safe")
+        return "refused"
+'''
+
+CLEAN_SEAM = '''\
+class Gate:
+    def refuse(self, pod):
+        self._emit_event("DegradedMode", "extender/filter",
+                         "failing safe")
+        if self.decisions is not None and self.decisions.wants(pod):
+            self.decisions.record(pod, "refusal", kind="degraded")
+        return "refused"
+'''
+
+DELEGATING_SEAM = '''\
+class Gate:
+    def admit(self, pod):
+        self._refuse("TenantQuotaDenied", pod, "over quota")
+        return "refused"
+
+    def _refuse(self, reason, pod, message):
+        dlog = self.decisions
+        if dlog is not None and dlog.wants(pod):
+            dlog.record(pod, "tenancy", verdict=reason)
+'''
+
+
+def _lint(tmp_path, rel, source):
+    from tpukube.analysis.base import run_all
+
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(source)
+    return [f for f in run_all([tmp_path],
+                               rules=["decision-provenance"])]
+
+
+def test_provenance_lint_fixture_pair(tmp_path):
+    bad = _lint(tmp_path, "sched/extender.py", VIOLATING_SEAM)
+    assert len(bad) == 1 and bad[0].rule == "decision-provenance"
+    assert "refusal seam" in bad[0].message
+
+
+def test_provenance_lint_clean_fixture(tmp_path):
+    assert _lint(tmp_path, "sched/extender.py", CLEAN_SEAM) == []
+
+
+def test_provenance_lint_delegation_counts(tmp_path):
+    """admit() delegating to the tenancy choke point is clean; the
+    choke point itself is a registered seam and must record."""
+    assert _lint(tmp_path, "tenancy/core.py", DELEGATING_SEAM) == []
+    # strip the record from _refuse: the registered seam now fails
+    broken = DELEGATING_SEAM.replace(
+        "        dlog = self.decisions\n"
+        "        if dlog is not None and dlog.wants(pod):\n"
+        "            dlog.record(pod, \"tenancy\", verdict=reason)\n",
+        "        pass\n",
+    )
+    bad = _lint(tmp_path, "tenancy/core.py", broken)
+    assert len(bad) == 1
+
+
+def test_provenance_lint_out_of_scope_ignored(tmp_path):
+    assert _lint(tmp_path, "workload/other.py", VIOLATING_SEAM) == []
+
+
+def test_provenance_lint_tree_clean():
+    """The real tree's refusal seams all record — zero findings, zero
+    waivers (the ISSUE 12 consistency satellite)."""
+    import tpukube
+    from tpukube.analysis.base import run_all
+
+    findings = run_all([tpukube.__path__[0]],
+                       rules=["decision-provenance"])
+    assert findings == []
+
+
+def test_lint_cli_lists_new_rule(capsys):
+    from tpukube.analysis.cli import main
+
+    assert main(["--list-rules"]) == 0
+    assert "decision-provenance" in capsys.readouterr().out
+
+
+# -- config validation -------------------------------------------------------
+
+def test_config_validation():
+    import pytest
+
+    with pytest.raises(ValueError, match="decisions_path"):
+        load_config(env={"TPUKUBE_DECISIONS_PATH": "/tmp/x.jsonl"})
+    with pytest.raises(ValueError, match="decisions_sample_rate"):
+        load_config(env={"TPUKUBE_DECISIONS_ENABLED": "1",
+                         "TPUKUBE_DECISIONS_SAMPLE_RATE": "1.5"})
+    with pytest.raises(ValueError, match="decisions_capacity"):
+        load_config(env={"TPUKUBE_DECISIONS_ENABLED": "1",
+                         "TPUKUBE_DECISIONS_CAPACITY": "0"})
+    cfg = load_config(env={"TPUKUBE_DECISIONS_ENABLED": "1",
+                           "TPUKUBE_DECISIONS_SAMPLE_RATE": "0.25"})
+    assert cfg.decisions_enabled and cfg.decisions_sample_rate == 0.25

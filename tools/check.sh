@@ -153,6 +153,39 @@ print("kilonode-10k smoke OK")
 PY
 
 echo
+echo "== decisions smoke (scenario 12 slice with decision provenance at"
+echo "   sampling 1.0 — the measured record overhead must stay under the"
+echo "   tools/perf_floor.json decisions.overhead_pct_max floor) =="
+JAX_PLATFORMS=cpu TPUKUBE_DECISIONS_ENABLED=1 \
+  TPUKUBE_DECISIONS_SAMPLE_RATE=1.0 python - <<'PY'
+import json
+import os
+import sys
+
+floor = json.load(open("tools/perf_floor.json"))["decisions"]
+os.environ["TPUKUBE_KILONODE10K_PODS"] = str(floor["pods"])
+
+from tpukube.sim import scenarios
+
+r = scenarios.run(12)
+d = r["decisions"]
+print(json.dumps({
+    "recorded": d["recorded"], "pods": d["pods"],
+    "record_seconds": d["record_seconds"],
+    "overhead_pct": d["overhead_pct"], "wall_s": r["wall_s"],
+}))
+bad = []
+if not d["recorded"]:
+    bad.append("provenance recorded nothing at sampling 1.0")
+if d["overhead_pct"] is None or d["overhead_pct"] > floor["overhead_pct_max"]:
+    bad.append(f"overhead_pct={d['overhead_pct']} exceeds the "
+               f"{floor['overhead_pct_max']}% ceiling")
+if bad:
+    sys.exit("decisions smoke FAILED: " + "; ".join(bad))
+print("decisions smoke OK")
+PY
+
+echo
 echo "== multitenant smoke (scenario 11: diurnal tenant waves + DRF"
 echo "   fairness + SLO-burn shedding under scenario-8 chaos; fixed"
 echo "   seed + fixed fault schedule — floors from tools/perf_floor.json) =="

@@ -31,6 +31,7 @@ ALL_RULES: tuple[str, ...] = (
     "exception-hygiene",
     "epoch-discipline",
     "reservation-leak",
+    "decision-provenance",
     "unused-waiver",
     "bare-waiver",
 )
@@ -114,7 +115,14 @@ class SourceFile:
 
 def _passes() -> dict[str, Callable[[SourceFile], list[Finding]]]:
     # imported lazily: the pass modules import from base
-    from tpukube.analysis import consistency, epochs, hygiene, leaks, locks
+    from tpukube.analysis import (
+        consistency,
+        epochs,
+        hygiene,
+        leaks,
+        locks,
+        provenance,
+    )
 
     return {
         "lock-discipline": locks.check_lock_discipline,
@@ -125,6 +133,7 @@ def _passes() -> dict[str, Callable[[SourceFile], list[Finding]]]:
         "exception-hygiene": hygiene.check_exceptions,
         "epoch-discipline": epochs.check_epochs,
         "reservation-leak": leaks.check_leaks,
+        "decision-provenance": provenance.check_provenance,
     }
 
 

@@ -40,6 +40,9 @@ _RULE_DOCS = {
                         "acquire to function exit reaches commit, "
                         "rollback, or a hand-off — exception edges "
                         "included (CFG dataflow)",
+    "decision-provenance": "every refusal/denial seam (tenancy gate, "
+                           "degraded gate, filter errors) records a "
+                           "DecisionRecord",
     "unused-waiver": "a waiver that suppressed zero findings is stale "
                      "and must be deleted",
     "bare-waiver": "waiver pragmas must name known rules and carry a "

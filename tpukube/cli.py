@@ -609,6 +609,8 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
         # drain the capture sinks so a post-mortem read sees every event
         if extender.trace is not None:
             extender.trace.close()
+        if extender.decisions is not None:
+            extender.decisions.close()
         extender.events.close()
     return 0
 
@@ -706,6 +708,26 @@ def main_obs(argv: Optional[list[str]] = None) -> int:
     ep.add_argument("--json", action="store_true", dest="as_json",
                     help="one JSON object per event instead of text lines")
 
+    xp = sub.add_parser(
+        "explain",
+        help="why-pending / why-here / why-denied for one pod, from "
+             "the decision-provenance layer (decisions_enabled)",
+    )
+    xp.add_argument("pod",
+                    help="pod key (namespace/name; a bare name means "
+                         "default/<name>)")
+    xsrc = xp.add_mutually_exclusive_group(required=True)
+    xsrc.add_argument("--url", default=None,
+                      help="live extender base URL (reads /explain)")
+    xsrc.add_argument("--file", default=None, metavar="JSONL",
+                      help="decisions_path JSONL sink capture to "
+                           "assemble offline")
+    xp.add_argument("--token-file", default=None, metavar="FILE",
+                    help="bearer token file for an --auth-token-file "
+                         "extender (/explain sits behind its auth)")
+    xp.add_argument("--json", action="store_true", dest="as_json",
+                    help="raw explain document instead of text")
+
     sp = sub.add_parser(
         "slo",
         help="evaluate the latency SLOs (burn rates) from /metrics",
@@ -735,6 +757,33 @@ def main_obs(argv: Optional[list[str]] = None) -> int:
             print(json.dumps(timeline.phase_stats(events), indent=2),
                   file=sys.stderr)
         return 0
+
+    if args.cmd == "explain":
+        from urllib.parse import quote
+
+        from tpukube.obs import decisions as decisions_mod
+
+        pod = args.pod if "/" in args.pod else f"default/{args.pod}"
+        if args.url:
+            url = f"{args.url}/explain?pod={quote(pod, safe='/')}"
+            req = urllib.request.Request(url)
+            if args.token_file:
+                with open(args.token_file) as f:
+                    req.add_header("Authorization",
+                                   f"Bearer {f.read().strip()}")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                doc = json.loads(r.read())
+        else:
+            doc = decisions_mod.explain_doc(
+                decisions_mod.load(args.file), pod
+            )
+        if args.as_json:
+            print(json.dumps(doc, indent=2, sort_keys=True))
+        else:
+            print(decisions_mod.format_explain(doc))
+        # composes into scripts: a pod with NO provenance (unsampled,
+        # rotated out, or provenance off) exits non-zero
+        return 0 if doc.get("stages") else 1
 
     if args.cmd == "events":
         from tpukube.obs import events as events_mod

@@ -150,6 +150,27 @@ class TpuKubeConfig:
     # raise it to coalesce arrival storms into fewer, bigger cycles)
     cycle_interval_seconds: float = 0.0
 
+    # Decision provenance (tpukube/obs/decisions.py, ISSUE 12). With
+    # decisions_enabled the extender keeps a bounded, sampled,
+    # lock-free-on-record ring of per-pod DecisionRecord stage events
+    # (admit -> queue wait -> cycle pin -> candidate pruning -> gang /
+    # preemption -> tenancy verdict -> bind), serves them on /explain
+    # + the /statusz "decisions" section + `tpukube-obs explain`, and
+    # turns on cycle phase profiling (tpukube_cycle_phase_seconds).
+    # false (the default) constructs NOTHING: no stage is built, no
+    # series renders, placements and exposition stay byte-identical.
+    decisions_enabled: bool = False
+    decisions_capacity: int = 8192
+    # fraction of pods sampled into the ring, selected by a
+    # deterministic seeded hash of the pod key — 0.01 on a kilonode
+    # fleet keeps 1% of pods FULLY explained
+    decisions_sample_rate: float = 1.0
+    decisions_seed: int = 0
+    # optional JSONL sink for `tpukube-obs explain --file` (size-capped
+    # like the trace/events sinks)
+    decisions_path: str = ""
+    decisions_sink_max_bytes: int = 64 * 1024**2
+
     # Multi-tenant serving plane (tpukube/tenancy, ISSUE 9). With
     # tenancy_enabled the extender attaches a TenantPlane: tenant ids
     # from the tenancy_label pod label (unlabeled pods belong to
@@ -346,6 +367,19 @@ def load_config(
         raise ValueError("journal_max_bytes must be >= 0 (0 = uncapped)")
     if cfg.checkpoint_interval_seconds <= 0:
         raise ValueError("checkpoint_interval_seconds must be positive")
+    if cfg.decisions_path and not cfg.decisions_enabled:
+        raise ValueError(
+            "decisions_path is set but decisions_enabled is false — "
+            "enable decision provenance or drop the path"
+        )
+    if cfg.decisions_enabled and cfg.decisions_capacity < 1:
+        raise ValueError("decisions_capacity must be >= 1 when enabled")
+    if not 0.0 <= cfg.decisions_sample_rate <= 1.0:
+        raise ValueError("decisions_sample_rate must be in [0, 1]")
+    if cfg.decisions_seed < 0 or cfg.decisions_sink_max_bytes < 0:
+        raise ValueError(
+            "decisions_seed and decisions_sink_max_bytes must be >= 0"
+        )
     if cfg.tenancy_quotas and not cfg.tenancy_enabled:
         # quotas without the plane would be silently unenforced — an
         # operator who wrote caps believes they are live; fail loudly

@@ -158,6 +158,12 @@ def build_extender_registry(extender, reconcile=None, evictions=None,
     tenants = getattr(extender, "tenants", None)
     if tenants is not None:
         _add_tenant_metrics(reg, tenants)
+    # decision provenance + cycle phase profiling (obs/decisions.py):
+    # series render only when decisions_enabled built a DecisionLog —
+    # provenance-off exposition stays byte-identical
+    decisions = getattr(extender, "decisions", None)
+    if decisions is not None:
+        _add_decision_metrics(reg, extender, decisions)
     # unified retry/circuit layer (ISSUE 4): series render only when
     # the daemon actually wired the channel objects — sim/dev
     # extenders keep the legacy exposition byte-identical
@@ -473,6 +479,12 @@ def _add_tenant_metrics(reg: Registry, tenants) -> None:
         denied_c.labels(tenant=t).set_function(
             lambda t=t: tenants.counter_snapshot()[1].get(t, 0))
 
+    # per-tenant latency histograms (tenancy v2): admission (filter)
+    # and commit (bind) walls, observed by the extender per decision —
+    # the admission family is also the per-tenant burn source
+    reg.register(tenants.admission_hist)
+    reg.register(tenants.commit_hist)
+
     burn = reg.gauge(
         "tpukube_tenancy_burn_rate",
         help_text="Last evaluated SLO burn rate per source feeding "
@@ -481,6 +493,16 @@ def _add_tenant_metrics(reg: Registry, tenants) -> None:
         burn.labels(slo=name).set_function(
             lambda n=name: tenants.burn.stats()["last_burns"].get(n)
             or 0.0)
+    tburn = reg.gauge(
+        "tpukube_tenant_slo_burn",
+        help_text="Last evaluated per-tenant windowed SLO burn — the "
+                  "tenant-local number a shed decision cites.")
+    bstats = tenants.burn.stats()
+    for tenant, burns in sorted(bstats["last_tenant_burns"].items()):
+        for slo in sorted(burns):
+            tburn.labels(tenant=tenant, slo=slo).set_function(
+                lambda t=tenant, s=slo:
+                tenants.burn.last_tenant_burn(t, s))
     reg.gauge(
         "tpukube_tenancy_shedding",
         # read-only view of the last admission-path evaluation: a
@@ -488,6 +510,27 @@ def _add_tenant_metrics(reg: Registry, tenants) -> None:
         fn=lambda: 1.0 if tenants.burn.last_page_burning() else 0.0,
         help_text="1 while SLO burn is at the page threshold and "
                   "over-share low-priority admissions are being shed.")
+
+
+def _add_decision_metrics(reg: Registry, extender, decisions) -> None:
+    """Decision-provenance families (obs/decisions.py): recording
+    volume, the measured record overhead (the scenario-12 guard's
+    numerator), and the cycle phase histogram — queue / pin / plan /
+    answer / commit wall, the attribution layer for the webhook-answer
+    p99 the O(fleet) roadmap item chases."""
+    reg.counter(
+        "tpukube_decisions_total",
+        fn=lambda: decisions.recorded,
+        help_text="Provenance stage events recorded (sampled pods "
+                  "only).")
+    reg.counter(
+        "tpukube_decisions_record_seconds_total",
+        fn=lambda: decisions.record_seconds,
+        help_text="Cumulative wall spent recording provenance — the "
+                  "measured overhead the check.sh decisions smoke "
+                  "guards against a floor.")
+    if extender.phase_hist is not None:
+        reg.register(extender.phase_hist)
 
 
 def _add_retry_metrics(reg: Registry, retriers=(), circuits=()) -> None:

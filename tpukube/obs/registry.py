@@ -97,6 +97,13 @@ DECLARED_SERIES: frozenset[str] = frozenset({
     "tpukube_cycle_batch_size",
     "tpukube_cycle_wall_seconds",
     "tpukube_cycle_queue_depth",
+    # extender: decision provenance + cycle phase profiling
+    # (tpukube/obs/decisions.py, ISSUE 12; series render only when
+    # decisions_enabled built a DecisionLog — legacy exposition stays
+    # byte-identical with provenance off)
+    "tpukube_decisions_total",
+    "tpukube_decisions_record_seconds_total",
+    "tpukube_cycle_phase_seconds",
     # extender: multi-tenant serving plane (tpukube/tenancy; series
     # render only when tenancy_enabled built a TenantPlane — legacy
     # exposition stays byte-identical with tenancy off)
@@ -109,6 +116,12 @@ DECLARED_SERIES: frozenset[str] = frozenset({
     "tpukube_tenant_quota_denials_total",
     "tpukube_tenancy_burn_rate",
     "tpukube_tenancy_shedding",
+    # tenancy v2 (ISSUE 12): per-tenant admission/commit latency
+    # histograms and the per-tenant windowed SLO burn the shedding
+    # decision cites (all render whenever tenancy is on)
+    "tpukube_tenant_admission_seconds",
+    "tpukube_tenant_commit_seconds",
+    "tpukube_tenant_slo_burn",
     # both daemons (unified retry/circuit layer, core/retry.py; series
     # render only where a Retrier/CircuitBreaker is actually wired)
     "tpukube_retry_attempts_total",

@@ -169,6 +169,12 @@ def extender_statusz(
         "tenants": (extender.tenants.stats()
                     if getattr(extender, "tenants", None) is not None
                     else {"enabled": False}),
+        # decision provenance (obs/decisions.py): ring occupancy,
+        # sample rate, and the measured record overhead — the data
+        # behind /explain and `tpukube-obs explain`
+        "decisions": (extender.decisions.stats()
+                      if getattr(extender, "decisions", None)
+                      is not None else {"enabled": False}),
     }
     events = getattr(extender, "events", None)
     if events is not None:

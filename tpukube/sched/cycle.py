@@ -278,6 +278,15 @@ class SchedulingCycle:
         # pod against another pod's candidate list would assume
         # placements onto nodes the pod may not even tolerate.
         self._queue: dict[str, tuple[PodInfo, int, Optional[tuple[str, ...]]]] = {}
+        # pod key -> scheduling-clock FIRST-admit time, for the
+        # pending-admit-age percentiles /statusz reports (the
+        # starvation signal drf_order can hide) and the per-pod queue
+        # wait the provenance layer records. The stamp survives
+        # plan-and-retry cycles — a pod shed or refused for hours must
+        # accumulate age, not reset per retry — and retires only when
+        # the pod actually binds (on_bound), releases, or its plan
+        # expires.
+        self._enqueued_at: dict[str, float] = {}
         self._plans: dict[str, PodPlan] = {}
         self._seq = 0
         self._last_drain = float("-inf")  # clock time of last full drain
@@ -313,6 +322,10 @@ class SchedulingCycle:
         informer / sim batch driver) means every known node is a
         candidate and materialized webhook answers are not expected."""
         key = pod.key()
+        # setdefault: re-deliveries AND refusal-retry re-admissions
+        # keep the FIRST admit time — resetting per retry would hide
+        # exactly the repeatedly-refused pod the age stat exists for
+        self._enqueued_at.setdefault(key, self._ext.clock.monotonic())
         if key in self._queue:
             # keep the original seq (arrival order) but the fresh
             # object and candidate set
@@ -430,7 +443,18 @@ class SchedulingCycle:
                 return mk(feasible, failed)
             self.plan_misses += 1  # planned now, not answered from cache
         if entry.error is not None:
+            dlog = ext.decisions
+            if dlog is not None and dlog.wants(key):
+                # the planned refusal the scheduler will see (the
+                # tenancy gate recorded its own verdict at plan time)
+                dlog.record(key, "refusal", kind="filter_error",
+                            reason=entry.error)
             return mk([], {}, error=entry.error)
+        # answer materialization: serving the wire lists from the plan
+        # — a dict lookup plus O(feasible) list builds (vs the legacy
+        # O(nodes) re-plan this path replaced)
+        ph = ext.phase_hist
+        a0 = time.perf_counter() if ph is not None else None
         feasible = entry.feasible
         if feasible is None:
             # driver-enqueued pod planned without materialized answers
@@ -439,9 +463,15 @@ class SchedulingCycle:
             # scheduler's pick then consumes the assumed allocation
             feasible = [entry.node] if entry.node is not None else []
         if by_name is not None:
-            return mk([by_name[n] for n in feasible if n in by_name],
-                      dict(entry.failed))
-        return mk(list(feasible), dict(entry.failed))
+            response = mk([by_name[n] for n in feasible if n in by_name],
+                          dict(entry.failed))
+        else:
+            response = mk(list(feasible), dict(entry.failed))
+        if a0 is not None:
+            ph.labels(phase="answer").observe(time.perf_counter() - a0)
+            if ext.trace is not None:
+                ext.trace.span("cycle_answer", key, cycle=self.cycles)
+        return response
 
     def prioritize_response(
         self, pod: PodInfo, names: list[str]
@@ -475,6 +505,7 @@ class SchedulingCycle:
                 self.plan_hits += 1
                 # the pod is bound for real now: retire its pending-
                 # webhook context exactly where the legacy bind does
+                # (the admit-age stamp retires via on_bound)
                 with self._ext._pending_lock:
                     self._ext._pending.pop(key, None)
                 return ("ok", entry.alloc)
@@ -491,10 +522,30 @@ class SchedulingCycle:
         self.plan_misses += 1
         return None
 
+    def note_pending(self, pod_key: str) -> None:
+        """First-admit stamp for a pod refused at the admission gate
+        WITHOUT entering the queue (Extender.admit's tenancy refusal):
+        it is still pending — the informer feed retries it — and the
+        queue-age starvation stats must count it from its first
+        attempt. Retires like any stamp (bind/release)."""
+        self._enqueued_at.setdefault(pod_key,
+                                     self._ext.clock.monotonic())
+
+    def on_bound(self, pod_key: str) -> None:
+        """A bind actually committed (plan-served or legacy path):
+        retire the first-admit stamp — the pod is no longer pending,
+        so the starvation stats must stop counting it."""
+        self._enqueued_at.pop(pod_key, None)
+
     def on_release(self, pod_key: str) -> None:
         """A recorded release arrived (pod deleted/evicted): a plan
         entry still assuming this pod must not keep counting it bound —
-        the ledger release itself already happened in the decision."""
+        the ledger release itself already happened in the decision. A
+        still-QUEUED entry leaves too: planning a deleted pod would
+        assume chips nobody will bind, and its admit time would keep
+        inflating the queue-age starvation stats forever."""
+        self._queue.pop(pod_key, None)
+        self._enqueued_at.pop(pod_key, None)
         entry = self._plans.pop(pod_key, None)
         if entry is not None and entry.assumed:
             # the alloc is already released by the decision; only the
@@ -559,6 +610,53 @@ class SchedulingCycle:
         if not batch:
             return 0
         t0 = time.perf_counter()
+        # cycle phase profiling (ISSUE 12; None = off): pin wall
+        # accumulates around the fast-state ensure; the snapshot
+        # counters before the cycle attribute this pin as a delta
+        # advance, a forced rebuild, or a cache hit in the provenance
+        # records below
+        ph = ext.phase_hist
+        dlog = ext.decisions
+        pin_s = 0.0
+        ages: list[float] = []
+        d0, r0 = ext.snapshots.delta_applies, ext.snapshots.rebuilds
+
+        def _advance() -> str:
+            # computed FRESH per record (never memoized): a batch whose
+            # first pods plan before any snapshot work honestly reads
+            # "cached", and the records after a delta advance / forced
+            # rebuild — and the end-of-cycle span — attribute it
+            if ext.snapshots.rebuilds > r0:
+                return "rebuild"
+            if ext.snapshots.delta_applies > d0:
+                return "delta"
+            return "cached"
+
+        def _note_plan(key: str, entry: PodPlan, arm: str,
+                       age: Optional[float]) -> None:
+            if dlog is None or not dlog.wants(key):
+                return
+            dlog.record(
+                key, "cycle_plan", cycle=self.cycles + 1, arm=arm,
+                node=entry.node, assumed=entry.assumed,
+                error=entry.error, bind_error=entry.bind_error,
+                queue_age_s=(round(age, 6) if age is not None else None),
+                snapshot=_advance(),
+                epoch=(list(entry.epoch_key) if entry.epoch_key
+                       else None),
+            )
+
+        def _age_of(key: str) -> Optional[float]:
+            # READ, never pop: the first-admit stamp outlives the plan
+            # so a refused-and-retried pod keeps accumulating age
+            # (on_bound/on_release/_expire_plans retire it)
+            qt = self._enqueued_at.get(key)
+            if qt is None:
+                return None
+            age = max(0.0, now - qt)
+            ages.append(age)
+            return age
+
         # ONE shared tuple for driver/informer admissions: every such
         # PodPlan stores `names` verbatim, and at 10k nodes a per-entry
         # copy is ~80KB — tuple(t) on an existing tuple is identity, so
@@ -592,10 +690,13 @@ class SchedulingCycle:
                     self._queue.pop(key2, None)
                     self._plans[key2] = entry
                     self.pods_planned += 1
+                    _note_plan(key2, entry, "gang_batch",
+                               _age_of(key2))
                 i = j
                 continue
             key = pod.key()
             self._queue.pop(key, None)
+            age = _age_of(key)
             if pod_names is not None:
                 names = list(pod_names)
                 needs_answers = True  # a webhook will read the answers
@@ -609,18 +710,26 @@ class SchedulingCycle:
                 # BEFORE the staleness check — a TTL/fault rollback
                 # bumps the epoch and must advance/rebuild the overlay
                 ext.gang.sweep()
-                fast_state = self._ensure_fast_state()
+                if ph is not None:
+                    p0 = time.perf_counter()
+                    fast_state = self._ensure_fast_state()
+                    pin_s += time.perf_counter() - p0
+                else:
+                    fast_state = self._ensure_fast_state()
                 entry = self._plan_fast(pod, seq, names, fast_state,
                                         needs_answers)
                 if entry.assumed:
                     # commit moved the ledger epoch exactly as planned
                     # (the overlay was patched in-place by _plan_fast)
                     fast_state["key"] = ext.snapshots.epoch_key()
+                arm = "fast"
             else:
                 entry = self._plan_general(pod, seq, names)
+                arm = "general"
             entry.epoch_key = ext.snapshots.epoch_key()
             self._plans[key] = entry
             self.pods_planned += 1
+            _note_plan(key, entry, arm, age)
             i += 1
         self.cycles += 1
         self.batch_sizes.append(len(batch))
@@ -628,6 +737,21 @@ class SchedulingCycle:
         self.cycle_walls.append(wall)
         self.cycle_wall_total += wall
         self.cycle_hist.observe(wall)
+        if ph is not None:
+            # additive phases: queue wait (the batch's longest), the
+            # snapshot/fast-state pin, and the planning remainder
+            if ages:
+                ph.labels(phase="queue").observe(max(ages))
+            ph.labels(phase="pin").observe(pin_s)
+            ph.labels(phase="plan").observe(max(0.0, wall - pin_s))
+            if ext.trace is not None:
+                # timeline spans (cluster track): Chrome-trace exports
+                # show the batch structure cycle by cycle
+                ext.trace.span("cycle_pin", "", cycle=self.cycles,
+                               wall_s=round(pin_s, 6),
+                               snapshot=_advance())
+                ext.trace.span("cycle_plan", "", cycle=self.cycles,
+                               pods=len(batch), wall_s=round(wall, 6))
         return len(batch)
 
     def _pin_snapshot(self):
@@ -744,6 +868,7 @@ class SchedulingCycle:
         # before reservation reads); per-member re-sweeps inside
         # ensure_reservation are cheap once the reservation exists
         ext.gang.sweep()
+        dlog = ext.decisions
         entries: list[PodPlan] = []
         counts: Optional[dict[str, tuple[int, int]]] = None
         general = False  # sticky: preemption routed this gang legacy
@@ -790,6 +915,16 @@ class SchedulingCycle:
                     entry.epoch_key = ext.snapshots.epoch_key()
                     entries.append(entry)
                     continue
+                if dlog is not None and dlog.wants(pod.key()):
+                    # the gang rendezvous leg of the provenance chain
+                    # (the legacy filter records it inline; this arm
+                    # reserves directly)
+                    dlog.record(
+                        pod.key(), "gang_reserve",
+                        gang=f"{pod.namespace}/{pod.group.name}",
+                        chips=res.total_chips(),
+                        committed=res.committed,
+                    )
                 if (ext.gang.peek_pending_victims(res)
                         or ext.gang.terminating_victims_of(res)):
                     general = True
@@ -1156,6 +1291,9 @@ class SchedulingCycle:
             ext.binds_total -= 1
             self.assume_undos += 1
             log.warning("assumed allocation for %s undone (re-plan)", key)
+        if ext.decisions is not None and ext.decisions.wants(key):
+            ext.decisions.record(key, "assume_undo",
+                                 node=entry.node)
         entry.assumed = False
         entry.alloc = None
 
@@ -1175,18 +1313,48 @@ class SchedulingCycle:
                     "releasing", key, self._ttl,
                 )
                 self._undo_assume(entry)
+            elif (self._ext.decisions is not None
+                    and self._ext.decisions.wants(key)):
+                self._ext.decisions.record(key, "plan_expired")
             self._plans.pop(key, None)
+            # the TTL horizon also retires the admit stamp: a pod whose
+            # plan expired unbound restarts its pending-age clock if it
+            # ever comes back
+            self._enqueued_at.pop(key, None)
 
     # -- observability -------------------------------------------------------
     def stats(self) -> dict[str, Any]:
         """The /statusz "cycle" section."""
+        from tpukube.obs.registry import quantile
+
         lookups = self.plan_hits + self.plan_misses
         walls = list(self.cycle_walls)
+        # pending-admit AGES, not just depth: drf_order can starve a
+        # unit indefinitely while depth looks healthy — the oldest
+        # admitted-but-never-bound age is the starvation signal, and
+        # it survives refusal retries (first-admit stamps retire only
+        # at bind/release/TTL). Snapshot with a bounded retry: /statusz
+        # scrapes read while admission threads insert (the same guard
+        # DecisionLog.events uses).
+        now = self._ext.clock.monotonic()
+        stamps: list[float] = []
+        for _ in range(5):
+            try:
+                stamps = list(self._enqueued_at.values())
+                break
+            except RuntimeError:  # dict mutated mid-iteration
+                continue
+        ages = sorted(max(0.0, now - t) for t in stamps)
         return {
             "enabled": True,
             "cycles": self.cycles,
             "pods_planned": self.pods_planned,
             "queue_depth": len(self._queue),
+            "queue_oldest_age_s": (round(ages[-1], 3) if ages else None),
+            "queue_age_p50_s": (round(quantile(ages, 0.5), 3)
+                                if ages else None),
+            "queue_age_p99_s": (round(quantile(ages, 0.99), 3)
+                                if ages else None),
             "plans_live": len(self._plans),
             "assumes": self.assumes,
             "assume_undos": self.assume_undos,

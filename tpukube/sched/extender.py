@@ -103,6 +103,36 @@ class Extender:
             path=config.events_path or None,
             max_sink_bytes=config.events_sink_max_bytes,
         )
+        # Decision provenance (obs/decisions.py, ISSUE 12): a bounded,
+        # sampled, lock-free-on-record ring of per-pod stage events —
+        # the "why did this pod land there / stay Pending / get
+        # refused" chain — served on /explain, /statusz "decisions",
+        # and `tpukube-obs explain`. None (the config default) builds
+        # nothing: no stage is constructed, no series renders, and
+        # every placement path is untouched.
+        self.decisions = None
+        # cycle phase profiling rides the same flag: queue / pin /
+        # plan / answer / commit wall per cycle, plus the webhook-
+        # answer-materialization timer that attributes the O(nodes)
+        # filter-response cost. None = no observation anywhere.
+        self.phase_hist = None
+        if config.decisions_enabled:
+            from tpukube.obs.decisions import DecisionLog
+
+            self.decisions = DecisionLog(
+                capacity=config.decisions_capacity,
+                sample_rate=config.decisions_sample_rate,
+                seed=config.decisions_seed,
+                path=config.decisions_path or None,
+                max_sink_bytes=config.decisions_sink_max_bytes,
+            )
+            self.phase_hist = Histogram(
+                "tpukube_cycle_phase_seconds",
+                buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025,
+                         0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 1.0),
+                help_text="Wall time per scheduling phase: queue wait, "
+                          "snapshot pin, batch plan, webhook-answer "
+                          "materialization, bind commit.")
         # Cluster-wide eviction bus: pods whose chips were taken back
         # (gang rollback/dissolve, preemption) and must be deleted by the
         # pod-lifecycle owner (sim harness / apiserver writer).
@@ -220,6 +250,10 @@ class Extender:
             # gang reservations carry their tenant so reserved-but-
             # unbound chips are charged to the right owner
             self.gang.tenant_of = self.tenants.tenant_of
+            # tenancy refusals (quota denial / SLO shed) record their
+            # verdict — shares and tenant-local burn at decision time
+            # — into the provenance ring (None = no recording)
+            self.tenants.decisions = self.decisions
         self.preemptions = 0   # victims evicted for higher-priority gangs
         self.binds_total = 0   # successful binds (metrics counter)
         # The bind EFFECTOR: with bindVerb configured, kube-scheduler
@@ -271,6 +305,16 @@ class Extender:
             )
         except Exception:
             log.exception("event emit failed: %s %s", reason, obj)
+
+    def _note_decision(self, pod_key: str, stage: str, **fields) -> None:
+        """Guarded provenance record — the one place the sampling gate
+        lives for the extender's cold refusal/lifecycle seams (hot
+        paths gate explicitly so unsampled pods never build kwargs).
+        The decision-provenance lint accepts this helper as a
+        recording delegate, like the tenancy plane's _refuse."""
+        dlog = self.decisions
+        if dlog is not None and dlog.wants(pod_key):
+            dlog.record(pod_key, stage, **fields)
 
     def _degraded_reason(self) -> Optional[str]:
         """The degraded gate's answer, never letting a broken gate
@@ -361,6 +405,8 @@ class Extender:
                 if refusal is not None:
                     raise ExtenderError(refusal)
             self._remember(pod)
+            dlog = self.decisions
+            wants = dlog is not None and dlog.wants(pod.key())
             res: Optional[GangReservation] = None
             if pod.group is not None:
                 if resource != RESOURCE_TPU:
@@ -388,6 +434,13 @@ class Extender:
                         gang=f"{pod.namespace}/{pod.group.name}",
                         chips=res.total_chips(), committed=res.committed,
                     )
+                if res is not None and wants:
+                    dlog.record(
+                        pod.key(), "gang_reserve",
+                        gang=f"{pod.namespace}/{pod.group.name}",
+                        chips=res.total_chips(),
+                        committed=res.committed,
+                    )
             else:
                 self.gang.sweep()
             reserved = self._reserved_by_slice() if res is None else None
@@ -395,6 +448,14 @@ class Extender:
             # node (hot: 64-member gang x 32 nodes x 64 reserved coords)
             gang_counts = (self.gang.node_availability(res)
                            if res is not None else None)
+            # the webhook-answer materialization — the O(nodes) loop
+            # that builds the wire lists. At 10k nodes THIS is the
+            # filter p99, and the phase timer finally attributes it
+            # (suppressed for plan-time internal calls, which answer
+            # no webhook).
+            at0 = (time.perf_counter()
+                   if self.phase_hist is not None
+                   and not self._suppress_latency else None)
             feasible, failed = [], {}
             for name in names:
                 if res is not None:
@@ -408,6 +469,21 @@ class Extender:
                                     else name)
                 else:
                     failed[name] = reason
+            if at0 is not None:
+                self.phase_hist.labels(phase="answer").observe(
+                    time.perf_counter() - at0
+                )
+            if wants:
+                # per-stage candidate pruning: which reason rejected
+                # how many nodes — the why-pending data
+                pruned: dict[str, int] = {}
+                for r in failed.values():
+                    pruned[r] = pruned.get(r, 0) + 1
+                dlog.record(
+                    pod.key(), "filter",
+                    candidates=len(names), feasible=len(feasible),
+                    pruned=pruned,
+                )
             return feasible, failed
         finally:
             self._observe_latency("filter", time.monotonic() - t0)
@@ -510,6 +586,12 @@ class Extender:
                             gang=f"{pod.namespace}/{pod.group.name}",
                             victims=len(victims), slices=sorted(split),
                         )
+                    self._note_decision(
+                        pod.key(), "preemption_plan",
+                        gang=f"{pod.namespace}/{pod.group.name}",
+                        victims=len(victims), slices=sorted(split),
+                        overshare_bias=sorted(overshare or {}),
+                    )
                     self._emit_event(
                         "PreemptionPlanned",
                         f"gang/{pod.namespace}/{pod.group.name}",
@@ -541,6 +623,14 @@ class Extender:
                 cost_priority_sum=plan.cost_priority_sum,
                 slices=[plan_slice],
             )
+        self._note_decision(
+            pod.key(), "preemption_plan",
+            gang=f"{pod.namespace}/{pod.group.name}",
+            victims=plan.victim_count,
+            cost_priority_sum=plan.cost_priority_sum,
+            slices=[plan_slice],
+            overshare_bias=sorted(overshare or {}),
+        )
         self._emit_event(
             "PreemptionPlanned",
             f"gang/{pod.namespace}/{pod.group.name}",
@@ -655,6 +745,12 @@ class Extender:
 
         evicted_pods = 0
         dissolved: set[tuple[str, str]] = set()
+
+        def note_preempted(pk: str) -> None:
+            # provenance: the victim's own chain must answer "where
+            # did my chips go" — not just the preemptor's
+            self._note_decision(pk, "preempted")
+
         for victim in victims:
             if victim.gang_key is not None:
                 if victim.gang_key in dissolved:
@@ -664,13 +760,17 @@ class Extender:
                 if vres is not None:
                     for pk in list(vres.assigned):
                         note_held(pk)
-                evicted_pods += len(self.gang.dissolve(victim.gang_key))
+                gone = self.gang.dissolve(victim.gang_key)
+                evicted_pods += len(gone)
+                for pk in gone:
+                    note_preempted(pk)
             else:
                 for pk in victim.pod_keys:
                     note_held(pk)
                     if self.state.release(pk) is not None:
                         self.pending_evictions.append(pk)
                         evicted_pods += 1
+                        note_preempted(pk)
                         self._emit_event(
                             "VictimEvicted", f"pod/{pk}",
                             "released and queued for eviction "
@@ -841,8 +941,10 @@ class Extender:
                 res = self.gang.reservation(pod.namespace, pod.group.name)
                 if res is not None and self.gang.assignable(res, count):
                     counts = self.gang.node_availability(res)
-                    return {n: self.gang.score_from(counts, n)
-                            for n in names}
+                    return self._record_scores(pod, {
+                        n: self.gang.score_from(counts, n)
+                        for n in names
+                    })
                 if res is None:
                     return {n: 0 for n in names}
                 # overflow replica of a full gang: fall through to normal
@@ -863,9 +965,23 @@ class Extender:
             scores: dict[str, int] = {}
             for name in names:
                 scores[name] = self._score_node(name, resource, count, sweeps, reserved)
-            return scores
+            return self._record_scores(pod, scores)
         finally:
             self._observe_latency("prioritize", time.monotonic() - t0)
+
+    def _record_scores(self, pod: PodInfo,
+                       scores: dict[str, int]) -> dict[str, int]:
+        """Provenance for the scoring decision: the top-k nodes and
+        their scores (the why-here data — which candidates the pick
+        actually beat). Pass-through when provenance is off or the pod
+        is unsampled."""
+        dlog = self.decisions
+        if dlog is not None and scores and dlog.wants(pod.key()):
+            top = sorted(scores.items(),
+                         key=lambda kv: (-kv[1], kv[0]))[:5]
+            dlog.record(pod.key(), "prioritize", nodes=len(scores),
+                        top=[[n, s] for n, s in top])
+        return scores
 
     def _score_node(
         self,
@@ -1203,8 +1319,16 @@ class Extender:
                 if ask is not None and self.tenants.admit(
                     pod, ask[0], ask[1]
                 ) is not None:
-                    return False  # refused and journaled; not enqueued
+                    # refused and journaled, not enqueued — but the pod
+                    # IS pending (the feed retries), so the starvation
+                    # stats must see its first-admit stamp: a tenant
+                    # shed for hours accumulates age here too, not
+                    # just on the webhook path
+                    self.cycle.note_pending(pod.key())
+                    return False
             self.cycle.enqueue(pod)
+            self._note_decision(pod.key(), "admit",
+                                queue_depth=self.cycle.queue_depth())
             return True
 
     def plan_pending(self) -> int:
@@ -1258,12 +1382,22 @@ class Extender:
                     "DegradedMode", "extender/filter",
                     f"failing filter requests safe: {reason}",
                 )
+                self._note_decision(
+                    pod.key(), "refusal", kind="degraded",
+                    reason=f"degraded mode: {reason}",
+                )
                 return mk([], {}, error=f"degraded mode: {reason}")
         with self._decision_lock:
             if kind == "filter":
                 pod, nodes, names = kube.parse_extender_args(body)
                 mk = (kube.filter_result if nodes is not None
                       else kube.filter_result_names)
+                # per-tenant admission latency (tenancy v2): the whole
+                # filter decision's wall, charged to the pod's tenant —
+                # the tpukube_tenant_admission_seconds histogram the
+                # per-tenant burn monitor slides its windows over
+                tt0 = (time.monotonic() if self.tenants is not None
+                       else None)
                 try:
                     if self.cycle is not None:
                         # batch mode: admit + plan (one snapshot per
@@ -1285,6 +1419,17 @@ class Extender:
                 except (ExtenderError, GangError, StateError,
                         codec.CodecError) as e:
                     response = mk([], {}, error=str(e))
+                    # the refusal the scheduler will see — tenancy
+                    # verdicts additionally recorded their own stage
+                    # at the gate
+                    self._note_decision(pod.key(), "refusal",
+                                        kind="filter_error",
+                                        reason=str(e))
+                if tt0 is not None:
+                    self.tenants.observe_admission(
+                        self.tenants.tenant_of(pod),
+                        time.monotonic() - tt0,
+                    )
             elif kind == "prioritize":
                 pod, nodes, names = kube.parse_extender_args(body)
                 scores = None
@@ -1318,6 +1463,7 @@ class Extender:
                     self.cycle.on_release(pod_key)
                 with self._pending_lock:
                     self._pending.pop(pod_key, None)
+                self._note_decision(pod_key, "release")
                 response = None
             elif kind == "victim_gone":
                 # an eviction victim's pod object is confirmed gone
@@ -1413,6 +1559,7 @@ class Extender:
         wire response reports the failure to the scheduler for a retry."""
         name, ns, uid, node = kube.parse_binding_args(body)
         key = f"{ns}/{name}"
+        bt0 = time.monotonic()
         degraded = self._degraded_reason()
         if degraded is not None:
             # same fail-safe contract as filter: refused before any
@@ -1423,17 +1570,25 @@ class Extender:
                 "DegradedMode", "extender/bind",
                 f"failing bind requests safe: {degraded}",
             )
+            self._note_decision(key, "refusal", kind="degraded",
+                                reason=f"degraded mode: {degraded}")
             return kube.binding_result(f"{key}: degraded mode: {degraded}")
         blocked = self._precheck_preemption(key)
         if blocked:
-            # refused BEFORE any mutation, so nothing is recorded (same
-            # contract as schema errors): the plan stays pending and the
-            # reservation TTLs out if the PDB never lifts — no victim is
-            # half-evicted, no gang half-binds
-            return kube.binding_result(
+            # refused BEFORE any mutation, so nothing is recorded in
+            # the TRACE (same contract as schema errors): the plan
+            # stays pending and the reservation TTLs out if the PDB
+            # never lifts — no victim is half-evicted, no gang
+            # half-binds. The refusal still lands in the provenance
+            # chain — a pod stuck behind a PDB is exactly the incident
+            # `explain` must answer.
+            reason = (
                 f"{key}: preemption plan refused — PodDisruptionBudget "
                 f"blocks eviction of {sorted(blocked)[:3]}"
             )
+            self._note_decision(key, "refusal", kind="pdb_precheck",
+                                reason=reason)
+            return kube.binding_result(reason)
         alloc = None
         gang_info = None
         with self._decision_lock:
@@ -1447,6 +1602,12 @@ class Extender:
                 planned = self.cycle.take_for_bind(key, uid, node)
                 if planned is not None:
                     self._observe_latency("bind", time.monotonic() - t0)
+                    if self.phase_hist is not None:
+                        # commit phase: consuming the plan's assumed
+                        # allocation (or its planned error) at /bind
+                        self.phase_hist.labels(phase="commit").observe(
+                            time.monotonic() - t0
+                        )
             try:
                 if planned is not None:
                     verdict, payload = planned
@@ -1479,10 +1640,31 @@ class Extender:
                 # the scheduler will retry a bind we told it failed
                 alloc = None
                 response = kube.binding_result(str(e))
+            if alloc is not None and self.cycle is not None:
+                # the pod bound (plan-served OR legacy fallback):
+                # retire its first-admit stamp so the pending-age
+                # starvation stats stop counting it
+                self.cycle.on_bound(key)
+            if self.decisions is not None and self.decisions.wants(key):
+                err = (response.get("Error")
+                       if isinstance(response, dict) else None)
+                self.decisions.record(
+                    key, "bind", node=node, ok=not err,
+                    error=err or None,
+                    served_from=("plan" if planned is not None
+                                 else "legacy"),
+                )
             if self.trace is not None:
                 self.trace.record("bind", body, response)
             if self.journal is not None:
                 self._maybe_checkpoint()
+        if self.tenants is not None and alloc is not None:
+            # per-tenant commit latency: the whole successful bind
+            # decision's wall, charged to the allocation's tenant
+            self.tenants.observe_commit(
+                self.tenants.tenant_of_alloc(alloc),
+                time.monotonic() - bt0,
+            )
         if alloc is None or self.binder is None:
             return response
         try:
@@ -1510,6 +1692,15 @@ class Extender:
                     self.gang.undo_commit(gang_info[0])
                 self.handle("release", {"pod_key": key})
                 self.binds_total -= 1  # the bind did not survive
+                # the earlier bind record said ok=True (the ledger
+                # commit succeeded); the pod is NOT bound on the
+                # cluster — without this stage its explain would read
+                # "bound ... released" for a pod Pending on retry
+                self._note_decision(
+                    key, "bind", node=node, ok=False,
+                    error=f"apiserver bind failed: {e}",
+                    served_from="effector",
+                )
             return kube.binding_result(f"{key}: apiserver bind failed: {e}")
         return response
 
@@ -1884,6 +2075,21 @@ def make_app(
             since=since,
         ))
 
+    async def explain_handler(request: web.Request) -> web.Response:
+        # behind the bearer middleware: provenance discloses placement,
+        # candidate sets, and tenant shares
+        if extender.decisions is None:
+            raise web.HTTPNotFound(
+                text="decision provenance disabled (set decisions_enabled)"
+            )
+        pod = request.query.get("pod", "")
+        if not pod:
+            raise web.HTTPBadRequest(text="pod query parameter required "
+                                          "(namespace/name)")
+        if "/" not in pod:
+            pod = f"default/{pod}"
+        return web.json_response(extender.decisions.explain(pod))
+
     async def statusz_handler(request: web.Request) -> web.Response:
         # behind the bearer middleware like /state and /trace: the
         # pending-eviction queue and reservation summary disclose
@@ -1906,6 +2112,7 @@ def make_app(
     app.router.add_get("/state/gangs", state_gangs)
     app.router.add_get("/trace", trace_handler)
     app.router.add_get("/events", events_handler)
+    app.router.add_get("/explain", explain_handler)
     app.router.add_get("/statusz", statusz_handler)
     return app
 

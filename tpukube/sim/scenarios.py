@@ -47,6 +47,12 @@ _PASSTHROUGH_KEYS = (
     "TPUKUBE_JOURNAL_PATH",
     "TPUKUBE_CHECKPOINT_INTERVAL_SECONDS",
     "TPUKUBE_JOURNAL_FSYNC",
+    # decision provenance (ISSUE 12): the check.sh decisions smoke
+    # re-runs the scenario-12 slice with sampling at 1.0 and asserts
+    # the measured record overhead stays under the perf floor
+    "TPUKUBE_DECISIONS_ENABLED",
+    "TPUKUBE_DECISIONS_SAMPLE_RATE",
+    "TPUKUBE_DECISIONS_PATH",
 )
 
 
@@ -819,6 +825,16 @@ def _kilonode_drive(cfg: TpuKubeConfig, metric: str, total_target: int,
             "cycle": ext.cycle.stats() if ext.cycle is not None else None,
             "utilization_percent": round(100 * c.utilization(), 2),
         }
+        if ext.decisions is not None:
+            # the measured-overhead guard (ISSUE 12): provenance's
+            # cumulative record wall as a fraction of the drive wall —
+            # check.sh's decisions smoke fails past the committed floor
+            ds = ext.decisions.stats()
+            result["decisions"] = {
+                **ds,
+                "overhead_pct": (round(100.0 * ds["record_seconds"]
+                                       / wall, 3) if wall else None),
+            }
         if delta_stats:
             # the ISSUE 10 acceptance numbers: the O(Δ) delta-advance
             # p50 against a FORCED full-rebuild p50 on the same loaded
@@ -928,6 +944,10 @@ def tenant_serving(config: TpuKubeConfig | None) -> dict[str, Any]:
         # window wide enough that a wave gap is not an "idle reset"
         # (BurnMonitor resets past two windows of silence)
         "TPUKUBE_TENANCY_BURN_WINDOW_SECONDS": "3600",
+        # decision provenance on (ISSUE 12 acceptance): a shed pod's
+        # explain output must name the burning SLO and the tenant's
+        # share — asserted below against the actual sheds
+        "TPUKUBE_DECISIONS_ENABLED": "1",
     }))
     waves = int(os.environ.get("TPUKUBE_TENANCY_WAVES", "8"))
     steady = [w for w in (2, 3, 4) if w < waves]
@@ -1162,6 +1182,46 @@ def tenant_serving(config: TpuKubeConfig | None) -> dict[str, Any]:
             "tenants": stats["tenants"],
         }
         problems = list(violations) + [str(p) for p in leaks] + div
+        # ISSUE 12 acceptance: a shed pod's decision provenance must
+        # answer why-denied naming the burning SLO and the tenant's
+        # share (the explain layer's whole point — refusals are never
+        # silent in it)
+        if ext.decisions is not None and shed_total:
+            # a shed pod may schedule in a later retry round once the
+            # burn subsides; the assertion wants one whose FINAL state
+            # is the refusal — scan newest-first for it
+            doc = None
+            for ev in reversed(
+                ext.events.events(reason="TenantAdmissionShed")
+            ):
+                shed_key = ev["object"].split("pod/", 1)[1]
+                cand = ext.decisions.explain(shed_key)
+                if cand["verdict"] == "denied":
+                    doc = cand
+                    break
+            text = json.dumps(doc) if doc is not None else ""
+            slo_named = any(
+                name in text for name in
+                ("gang-schedule-latency", "bind-webhook-latency",
+                 "tenant-admission-latency")
+            )
+            result["explain_shed"] = {
+                "pod": doc["pod"] if doc is not None else None,
+                "verdict": doc["verdict"] if doc is not None else None,
+                "slo_named": slo_named,
+            }
+            if doc is None:
+                problems.append(
+                    "no shed pod explains as 'denied' — refusals are "
+                    "missing from the provenance ring"
+                )
+            elif not slo_named or "burst_share" not in text:
+                problems.append(
+                    f"shed pod {doc['pod']}'s explain names no "
+                    f"burning SLO / tenant share"
+                )
+        if ext.decisions is not None:
+            result["decisions"] = ext.decisions.stats()
         if ratio_samples and max(ratio_samples) > 2.0:
             problems.append(
                 f"steady-state share ratio {max(ratio_samples):.3f} > 2.0"

@@ -72,6 +72,7 @@ from tpukube.core.types import (
 )
 from tpukube.device.tpu import ENV_KUBE_TENANT
 from tpukube.obs import slo as slo_mod
+from tpukube.obs.registry import Histogram
 
 log = logging.getLogger("tpukube.tenancy")
 
@@ -317,6 +318,29 @@ class TenantLedger:
         return _UsageSnapshot(key, usage, cap_chips, cap_hbm, vtpu_shares)
 
 
+def _hist_totals_by_tenant(hist, threshold_le: str) -> dict[
+        str, tuple[float, float]]:
+    """tenant -> (good, total) over one histogram's rendered
+    ``_bucket`` samples, keyed by the ``tenant`` label — the
+    per-tenant twin of :func:`_hist_totals` (ISSUE 12 tenancy v2:
+    the BurnMonitor slides one window pair per tenant over these)."""
+    out: dict[str, list[float]] = {}
+    for name, labels, value in hist.samples():
+        if not name.endswith("_bucket"):
+            continue
+        labels = labels or {}
+        tenant = labels.get("tenant")
+        if tenant is None:
+            continue
+        le = labels.get("le")
+        acc = out.setdefault(tenant, [0.0, 0.0])
+        if le == threshold_le:
+            acc[0] += value
+        elif le == "+Inf":
+            acc[1] += value
+    return {t: (g, tot) for t, (g, tot) in out.items()}
+
+
 def _hist_totals(hist, threshold_le: str,
                  match: dict[str, str]) -> tuple[float, float]:
     """(good, total) over one histogram's rendered ``_bucket`` samples,
@@ -374,13 +398,25 @@ class BurnMonitor:
         self.threshold = threshold
         self.window = window
         self._sources: list[_BurnSource] = []
+        # per-tenant sources (ISSUE 12 tenancy v2): each evaluates one
+        # (good, total) window pair PER tenant-labeled child, sliding
+        # on the same A/B baselines and clock as the global sources —
+        # so a shed can cite the refused tenant's own burn, not just
+        # the plane-global one
+        self._tenant_sources: list[_BurnSource] = []
         self._lock = threading.Lock()
         # name -> (good, total) at the older (A) and newer (B)
         # baselines; only B's timestamp drives the sliding
         self._a: dict[str, tuple[float, float]] = {}
         self._b: dict[str, tuple[float, float]] = {}
+        # (name, tenant) -> (good, total): the per-tenant baselines
+        self._ta: dict[tuple[str, str], tuple[float, float]] = {}
+        self._tb: dict[tuple[str, str], tuple[float, float]] = {}
         self._b_t = clock.monotonic()
         self.last_burns: dict[str, Optional[float]] = {}
+        # tenant -> {slo name -> last windowed burn} (read-only views:
+        # tenant_burn(), the tpukube_tenant_slo_burn gauge, /statusz)
+        self.last_tenant_burns: dict[str, dict[str, Optional[float]]] = {}
         # one verdict per clock instant: kilonode-scale sims run whole
         # drains at a single fake-clock tick, and every admission in a
         # drain must see one consistent verdict without re-scanning
@@ -392,6 +428,15 @@ class BurnMonitor:
                objective: float, match=None) -> None:
         self._sources.append(
             _BurnSource(name, hist, threshold_le, objective, match)
+        )
+
+    def attach_tenant(self, name: str, hist, threshold_le: str,
+                      objective: float) -> None:
+        """Attach a tenant-labeled histogram as a PER-TENANT burn
+        source: every tenant child gets its own sliding window pair
+        and its own burn in ``last_tenant_burns``."""
+        self._tenant_sources.append(
+            _BurnSource(name, hist, threshold_le, objective, None)
         )
 
     def attach_default_slos(self, hists: dict[str, Any]) -> None:
@@ -406,19 +451,28 @@ class BurnMonitor:
 
     def evaluate(self) -> dict[str, Optional[float]]:
         """Current burn per source over the sliding window; slides the
-        baselines as a side effect."""
+        baselines (global AND per-tenant — one clock, one window pair
+        policy) as a side effect."""
         now = self._clock.monotonic()
         totals = {
             s.name: _hist_totals(s.hist, s.threshold_le, s.match)
             for s in self._sources
         }
+        tenant_totals: dict[tuple[str, str], tuple[float, float]] = {}
+        for s in self._tenant_sources:
+            for tenant, gt in _hist_totals_by_tenant(
+                    s.hist, s.threshold_le).items():
+                tenant_totals[(s.name, tenant)] = gt
         with self._lock:
             if now - self._b_t >= 2 * self.window:
                 # idle gap past the window contract: reset instead of
                 # judging a giant stale pseudo-window (see class doc)
                 self._a = totals
                 self._b, self._b_t = totals, now
+                self._ta = tenant_totals
+                self._tb = dict(tenant_totals)
                 self.last_burns = {s.name: None for s in self._sources}
+                self.last_tenant_burns = {}
                 return dict(self.last_burns)
             burns: dict[str, Optional[float]] = {}
             for s in self._sources:
@@ -427,10 +481,21 @@ class BurnMonitor:
                 burns[s.name] = slo_mod.burn_rate(
                     good - bg, total - bt, s.objective
                 )
+            objectives = {s.name: s.objective
+                          for s in self._tenant_sources}
+            tburns: dict[str, dict[str, Optional[float]]] = {}
+            for (name, tenant), (good, total) in tenant_totals.items():
+                bg, bt = self._ta.get((name, tenant), (0.0, 0.0))
+                tburns.setdefault(tenant, {})[name] = slo_mod.burn_rate(
+                    good - bg, total - bt, objectives[name]
+                )
             if now - self._b_t >= self.window:
                 self._a = self._b
                 self._b, self._b_t = totals, now
+                self._ta = self._tb
+                self._tb = dict(tenant_totals)
             self.last_burns = burns
+            self.last_tenant_burns = tburns
             return burns
 
     def page_burning(self) -> Optional[str]:
@@ -456,6 +521,25 @@ class BurnMonitor:
             self._verdict_t, self._verdict = now, verdict
         return verdict
 
+    def tenant_burn(self, tenant: str) -> Optional[float]:
+        """The tenant's WORST last-evaluated burn across the per-tenant
+        sources (None = no traffic / no per-tenant source). Read-only —
+        the admission path's page_burning() evaluation already slid the
+        windows this reads."""
+        with self._lock:
+            burns = self.last_tenant_burns.get(tenant)
+            if not burns:
+                return None
+            vals = [b for b in burns.values() if b is not None]
+            return max(vals) if vals else None
+
+    def last_tenant_burn(self, tenant: str, slo: str) -> float:
+        """One (tenant, slo) cell of the last evaluation, 0.0 when
+        unknown — the tpukube_tenant_slo_burn gauge's pull callback."""
+        with self._lock:
+            return (self.last_tenant_burns.get(tenant) or {}).get(
+                slo) or 0.0
+
     def last_page_burning(self) -> bool:
         """Read-only view of the LAST evaluation — the metrics/statusz
         renderers must never slide the admission windows themselves."""
@@ -471,7 +555,13 @@ class BurnMonitor:
                 "threshold": self.threshold,
                 "window_seconds": self.window,
                 "sources": [s.name for s in self._sources],
+                "tenant_sources": [s.name
+                                   for s in self._tenant_sources],
                 "last_burns": dict(self.last_burns),
+                "last_tenant_burns": {
+                    t: dict(b)
+                    for t, b in self.last_tenant_burns.items()
+                },
             }
 
 
@@ -498,6 +588,29 @@ class TenantPlane:
             threshold=config.tenancy_burn_threshold,
             window=config.tenancy_burn_window_seconds,
         )
+        # per-tenant latency histograms (ISSUE 12 tenancy v2): the
+        # extender observes each filter decision's wall into
+        # admission_hist{tenant} and each successful bind's into
+        # commit_hist{tenant}; both render whenever tenancy is on
+        # (tpukube_tenant_admission_seconds / _commit_seconds), and
+        # the admission one doubles as the per-tenant burn source —
+        # so a shed can cite the refused tenant's OWN burn
+        self.admission_hist = Histogram(
+            "tpukube_tenant_admission_seconds",
+            help_text="Admission (filter) decision wall per tenant; "
+                      "the per-tenant SLO-burn source.")
+        self.commit_hist = Histogram(
+            "tpukube_tenant_commit_seconds",
+            help_text="Successful bind decision wall per tenant.")
+        self.burn.attach_tenant(
+            "tenant-admission-latency", self.admission_hist,
+            threshold_le="0.25", objective=0.999,
+        )
+        # decision-provenance hook (obs/decisions.py): the Extender
+        # wires its DecisionLog here so every refusal's verdict —
+        # shares and tenant-local burn at decision time — lands in the
+        # refused pod's provenance chain. None = no recording.
+        self.decisions = None
         self._lock = threading.Lock()  # leaf: counters only
         self.sheds: dict[str, int] = {}
         self.quota_denials: dict[str, int] = {}
@@ -508,6 +621,13 @@ class TenantPlane:
 
     def tenant_of_alloc(self, alloc) -> str:
         return self.ledger.tenant_of_alloc(alloc)
+
+    # -- per-tenant latency (the burn monitor's windows slide on these) ------
+    def observe_admission(self, tenant: str, seconds: float) -> None:
+        self.admission_hist.labels(tenant=tenant).observe(seconds)
+
+    def observe_commit(self, tenant: str, seconds: float) -> None:
+        self.commit_hist.labels(tenant=tenant).observe(seconds)
 
     def known_tenants(self) -> list[str]:
         with self._lock:
@@ -606,11 +726,18 @@ class TenantPlane:
                 share = snap.burst_share(tenant)
                 mean = snap.mean_burst_share()
                 if mean > 0 and share > OVER_SHARE_MARGIN * mean:
+                    # the shed cites the TENANT-LOCAL burn alongside
+                    # the plane-global trigger: "your own admissions
+                    # are burning Nx" is the answer the refused tenant
+                    # actually disputes (None = tenant idle so far)
+                    tburn = self.burn.tenant_burn(tenant)
                     reason = (
                         f"tenant {tenant}: admission shed — {burning}; "
                         f"burst share {share:.4f} above "
                         f"{OVER_SHARE_MARGIN:g}x the population mean "
                         f"{mean:.4f}"
+                        + (f"; tenant-local admission burn {tburn:.1f}x"
+                           if tburn is not None else "")
                     )
                     self._refuse("TenantAdmissionShed", self.sheds,
                                  tenant, pod, reason)
@@ -621,6 +748,24 @@ class TenantPlane:
                 pod: PodInfo, message: str) -> None:
         with self._lock:
             counter[tenant] = counter.get(tenant, 0) + 1
+        dlog = self.decisions
+        if dlog is not None and dlog.wants(pod.key()):
+            # the tenancy verdict, with the shares and tenant-local
+            # burn AT DECISION TIME — `tpukube-obs explain` renders
+            # this as the why-denied line (decision-provenance lint
+            # holds every refusal seam to recording one of these)
+            try:
+                snap = self.ledger.usage()
+                dlog.record(
+                    pod.key(), "tenancy", verdict=reason,
+                    tenant=tenant, message=message,
+                    dominant_share=round(snap.dominant_share(tenant), 6),
+                    burst_share=round(snap.burst_share(tenant), 6),
+                    tenant_burn=self.burn.tenant_burn(tenant),
+                )
+            except Exception:
+                log.exception("decision record failed: %s %s",
+                              reason, pod.key())
         if self._events is None:
             return
         try:

@@ -308,6 +308,7 @@ def replay(
         # sinks!) — the replayed extender is a scratch instance
         extender = Extender(_dc_replace(
             cfg, trace_capacity=0, trace_path="", events_path="",
+            decisions_path="",
         ))
     divergences: list[Divergence] = []
 
