@@ -431,6 +431,92 @@ def kilonode_scaling() -> dict:
     return out
 
 
+def shard_scaling() -> dict:
+    """ISSUE 13 acceptance: the replica-count scaling sweep — the SAME
+    fleet (4 ICI slices of 16x16x40: 40,960 chips / 10,240 nodes, the
+    scenario-12 operating point) and the same churn trace, planned by
+    N = 1, 2, 4 planner replicas with plan-served filter answers.
+    Records pods/s per replica count so BENCH_r07 shows the sharded
+    curve against the single-planner ceiling. NOTE the N=1 point is
+    the plain UNSHARDED planner on this fleet (the harness builds no
+    router at planner_replicas=1 — that is the parity design), so the
+    N>1 deltas include the whole router tax, not just replica-count
+    scaling; and all points share ONE process and one GIL, so the
+    sweep measures per-replica structure effects, not parallelism
+    (ROADMAP: sharding v2). ``TPUKUBE_SHARD_SWEEP_PODS`` scales the
+    trace (default 24000)."""
+    import os
+
+    from tpukube.core.config import load_config as _load
+    from tpukube.core.mesh import MeshSpec
+    from tpukube.sim import scenarios
+
+    pods = int(os.environ.get("TPUKUBE_SHARD_SWEEP_PODS", "24000"))
+    out: dict = {}
+    for n in (1, 2, 4):
+        cfg = _load(env={
+            "TPUKUBE_SIM_MESH_DIMS": "16,16,40",
+            "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+            "TPUKUBE_BATCH_ENABLED": "1",
+            "TPUKUBE_BATCH_MAX_PODS": "2048",
+            "TPUKUBE_FILTER_FROM_PLAN": "1",
+            "TPUKUBE_PLANNER_REPLICAS": str(n),
+        })
+        mesh = cfg.sim_mesh()
+        slices = {
+            f"s{i:02d}": MeshSpec(dims=mesh.dims,
+                                  host_block=mesh.host_block,
+                                  torus=mesh.torus)
+            for i in range(4)
+        }
+        r = scenarios._kilonode_drive(
+            cfg, metric=f"shard_n{n}", total_target=pods,
+            gang_size=512, max_alive=8192, check_leaks=True,
+            slices=slices, include_setup=False,
+        )
+        out[str(n)] = {
+            "nodes": r["nodes"],
+            "chips": r["chips"],
+            "pods_total": r["pods_total"],
+            "wall_s": r["wall_s"],
+            "setup_s": r.get("setup_s"),
+            "pods_per_sec": r["pods_per_sec"],
+            "plan_ms_per_pod": r["cycle"]["plan_ms_per_pod"],
+            "webhook_p99_ms": r["webhook_p99_ms"],
+            "utilization_percent": r["utilization_percent"],
+        }
+    base = out["1"]["pods_per_sec"]
+    for n in ("2", "4"):
+        out[n]["speedup_vs_n1"] = (round(out[n]["pods_per_sec"] / base, 2)
+                                   if base else None)
+    return out
+
+
+def kilonode100k() -> dict:
+    """ISSUE 13 acceptance: scenario 14 — the 100k-node sharded drive
+    (10 slices x 32x32x40 behind 4 planner replicas). ``setup_s`` is
+    the one-time fleet ingest, excluded from the throughput wall.
+    ``TPUKUBE_KILONODE100K_PODS``/``TPUKUBE_SHARD_SLICES`` scale it."""
+    from tpukube.sim import scenarios
+
+    r = scenarios.run(14)
+    return {
+        "nodes": r["nodes"],
+        "chips": r["chips"],
+        "pods_total": r["pods_total"],
+        "wall_s": r["wall_s"],
+        "setup_s": r.get("setup_s"),
+        "pods_per_sec": r["pods_per_sec"],
+        "time_compression": r["time_compression"],
+        "webhook_p99_ms": r["webhook_p99_ms"],
+        "plan_ms_per_pod": r["cycle"]["plan_ms_per_pod"],
+        "plan_hit_ratio": r["cycle"]["plan_hit_ratio"],
+        "replicas": r["shard"]["replicas"],
+        "rendezvous": r["shard"]["rendezvous"],
+        "utilization_percent": r["utilization_percent"],
+    }
+
+
 def run() -> dict:
     from tpukube.sim import scenarios
 
@@ -454,6 +540,8 @@ def run() -> dict:
     result["kilonode"] = kilonode()
     result["kilonode10k"] = kilonode10k()
     result["kilonode_scaling"] = kilonode_scaling()
+    result["shard_scaling"] = shard_scaling()
+    result["kilonode100k"] = kilonode100k()
     result["recovery"] = recovery()
     return result
 

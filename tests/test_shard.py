@@ -1,0 +1,711 @@
+"""ISSUE 13: slice-partitioned control plane — router, two-phase DCN
+rendezvous, replica chaos, plan-served filter answers, and the
+incremental per-slice occupied sets.
+
+The acceptance gates:
+  * N=1 sharded path byte-identical to the unsharded planner (the
+    router delegates verbatim — proven end to end on real webhook
+    bodies);
+  * rendezvous commit / abort-on-timeout / duplicate-prepare
+    idempotency;
+  * replica kill and partition mid-gang-commit converge via
+    rebuild_from_pods with zero reservation leaks (audit green).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tpukube.chaos import leaked_reservations, ledger_divergence
+from tpukube.core import codec
+from tpukube.core.clock import FakeClock
+from tpukube.core.config import load_config
+from tpukube.core.mesh import MeshSpec
+from tpukube.core.types import PodGroup
+from tpukube.sched.shard import ShardRouter
+from tpukube.sim.harness import SimCluster
+
+
+def two_slices() -> dict[str, MeshSpec]:
+    return {
+        "s0": MeshSpec(dims=(2, 2, 2), host_block=(2, 2, 1),
+                       torus=(False, False, False)),
+        "s1": MeshSpec(dims=(2, 2, 2), host_block=(2, 2, 1),
+                       torus=(False, False, False)),
+    }
+
+
+def sharded_config(n: int = 2, **extra: str):
+    env = {
+        "TPUKUBE_PLANNER_REPLICAS": str(n),
+        "TPUKUBE_BATCH_ENABLED": "1",
+        **extra,
+    }
+    return load_config(env=env)
+
+
+def fill_slices(c: SimCluster) -> None:
+    """Commit one 4-member gang into each slice so no slice can hold
+    an 8-chip gang whole — the shape that forces a rendezvous."""
+    for g in ("fill-a", "fill-b"):
+        grp = PodGroup(g, min_member=4)
+        for i in range(4):
+            c.schedule(c.make_pod(f"{g}-{i}", tpu=1, group=grp))
+
+
+def settle(c: SimCluster, rounds: int = 4) -> None:
+    for _ in range(rounds):
+        c.drain_evictions()
+        c._lifecycle.check_once()
+        c.extender.sweep()
+
+
+# -- N=1 parity gate ---------------------------------------------------------
+
+def test_n1_router_is_byte_identical_to_unsharded():
+    """Every webhook response from a planner_replicas=1 router equals
+    the plain Extender's, byte for byte, over a mixed workload (single
+    pods, a gang, a release, node re-sends)."""
+    from tpukube.sched.extender import Extender
+
+    cfg = load_config(env={"TPUKUBE_BATCH_ENABLED": "1"})
+    mesh = MeshSpec(dims=(2, 2, 2), host_block=(2, 2, 1),
+                    torus=(False, False, False))
+    from tpukube.core.types import ChipInfo, NodeInfo
+
+    def node_objs():
+        out = []
+        for host in mesh.all_hosts():
+            chips = [
+                ChipInfo(chip_id=f"{host}-chip-{i}", index=i,
+                         coord=coord, hbm_bytes=1 << 30, num_cores=2)
+                for i, coord in enumerate(mesh.coords_of_host(host))
+            ]
+            info = NodeInfo(name=host, chips=chips, shares_per_chip=1,
+                            slice_id="slice-0")
+            out.append({"metadata": {
+                "name": host,
+                "annotations": codec.annotate_node(info, mesh),
+            }})
+        return out
+
+    def pod_obj(name, group=None):
+        annotations = {}
+        if group is not None:
+            annotations.update(codec.pod_group_annotations(group))
+        return {
+            "metadata": {"name": name, "namespace": "default",
+                         "uid": f"uid-{name}",
+                         "annotations": annotations, "labels": {}},
+            "spec": {"priority": 0, "containers": [
+                {"name": "main",
+                 "resources": {"requests": {"qiniu.com/tpu": "1"}}},
+            ]},
+        }
+
+    def drive(target) -> list[str]:
+        responses = []
+        nodes = node_objs()
+        grp = PodGroup("parity-gang", min_member=2)
+        workload = [pod_obj("solo-0"), pod_obj("solo-1"),
+                    pod_obj("pg-0", grp), pod_obj("pg-1", grp)]
+        for pod in workload:
+            body = {"Pod": pod, "Nodes": {"Items": nodes}}
+            fres = target.handle("filter", body)
+            responses.append(json.dumps(fres, sort_keys=True))
+            feasible = fres["NodeNames"]
+            pres = target.handle("prioritize", {
+                "Pod": pod, "NodeNames": feasible,
+            })
+            responses.append(json.dumps(pres, sort_keys=True))
+            scores = {e["Host"]: e["Score"] for e in pres}
+            best = max(sorted(scores), key=lambda h: scores[h])
+            bres = target.handle("bind", {
+                "PodName": pod["metadata"]["name"],
+                "PodNamespace": "default",
+                "PodUID": pod["metadata"]["uid"],
+                "Node": best,
+            })
+            responses.append(json.dumps(bres, sort_keys=True))
+        target.handle("release", {"pod_key": "default/solo-0"})
+        responses.append(json.dumps(
+            target.gang_snapshot(), sort_keys=True))
+        responses.append(json.dumps(
+            target.alloc_snapshot(), sort_keys=True))
+        return responses
+
+    plain = drive(Extender(cfg))
+    routed = drive(ShardRouter(cfg))
+    assert plain == routed
+
+
+def test_router_n1_delegates_to_sole_extender():
+    cfg = load_config(env={})
+    router = ShardRouter(cfg)
+    assert router._sole is router.replicas[0].extender
+    # the eviction bus is the sole replica's own deque
+    assert router.pending_evictions is \
+        router.replicas[0].extender.pending_evictions
+
+
+# -- two-phase rendezvous ----------------------------------------------------
+
+def test_rendezvous_commit_and_global_env():
+    cfg = sharded_config()
+    with SimCluster(cfg, slices=two_slices(), in_process=True) as c:
+        fill_slices(c)
+        gd = PodGroup("dcn", min_member=8, allow_dcn=True)
+        for i in range(8):
+            c.schedule(c.make_pod(f"dcn-{i}", tpu=1, group=gd))
+        rz = c.extender.statusz()["rendezvous"]
+        assert rz["prepared"] == 1 and rz["committed"] == 1
+        assert rz["aborted"] == 0
+        live = rz["live"][0]
+        assert live["committed"] is True
+        assert live["parts"] == {"r0": {"s0": 4}, "r1": {"s1": 4}}
+        # both local parts committed by their LOCAL quorum
+        parts = [g for g in c.extender.gang_snapshot()
+                 if g["group"] == "dcn"]
+        assert len(parts) == 2 and all(g["committed"] for g in parts)
+        assert sorted(g["min_member"] for g in parts) == [4, 4]
+        # the pod annotation's gang env is GLOBALIZED: every member
+        # sees the full multislice topology, not just its part
+        from tpukube.device.tpu import (
+            ENV_GANG_NUM_SLICES,
+            ENV_GANG_SLICE_INDEX,
+            ENV_GANG_SLICES,
+        )
+
+        indices = set()
+        for i in range(8):
+            pod = c.pods[f"default/dcn-{i}"]
+            alloc = codec.decode_alloc(
+                pod["metadata"]["annotations"][codec.ANNO_ALLOC]
+            )
+            assert alloc.env[ENV_GANG_NUM_SLICES] == "2"
+            assert alloc.env[ENV_GANG_SLICES] == "s0,s1"
+            indices.add(alloc.env[ENV_GANG_SLICE_INDEX])
+        assert indices == {"0", "1"}
+        assert leaked_reservations(c) == []
+        assert ledger_divergence(c) == []
+
+
+def test_rendezvous_prefers_single_replica_fit():
+    """A DCN-capable gang that FITS one replica whole never pays the
+    rendezvous — ICI-contiguous placement stays the first choice."""
+    cfg = sharded_config()
+    with SimCluster(cfg, slices=two_slices(), in_process=True) as c:
+        gd = PodGroup("easy", min_member=8, allow_dcn=True)
+        for i in range(8):
+            c.schedule(c.make_pod(f"easy-{i}", tpu=1, group=gd))
+        rz = c.extender.statusz()["rendezvous"]
+        assert rz["prepared"] == 0
+        gangs = [g for g in c.extender.gang_snapshot()
+                 if g["group"] == "easy"]
+        assert len(gangs) == 1 and gangs[0]["committed"]
+        assert not gangs[0]["spans_dcn"]
+
+
+def test_batch_dcn_commit_is_eager_then_kill_survives():
+    """The batch driver binds every member in one drive: the
+    rendezvous must read committed at the LAST BIND, not at the next
+    janitor sweep — a replica killed in that window must not have its
+    fully-committed gang aborted as 'part lost pre-commit'."""
+    cfg = sharded_config(2, TPUKUBE_SNAPSHOT_AUDIT_RATE="1.0")
+    with SimCluster(cfg, slices=two_slices(), in_process=True) as c:
+        for g in ("fill-a", "fill-b"):
+            grp = PodGroup(g, min_member=4)
+            c.schedule_pending([
+                c.make_pod(f"{g}-{i}", tpu=1, group=grp)
+                for i in range(4)
+            ])
+        gd = PodGroup("dcn", min_member=8, allow_dcn=True)
+        c.schedule_pending([
+            c.make_pod(f"d-{i}", tpu=1, group=gd) for i in range(8)
+        ])
+        rz = c.extender.statusz()["rendezvous"]
+        assert rz["committed"] == 1 and rz["live"][0]["committed"]
+        # kill a participant IMMEDIATELY (no sweep ran in between):
+        # the committed gang survives, nothing is dissolved
+        c.crash_replica(1)
+        assert c.extender.sweep() == []
+        restored = c.restart_replica(1)
+        assert restored == 8  # fill-b + its committed dcn part
+        parts = [g for g in c.extender.gang_snapshot()
+                 if g["group"] == "dcn"]
+        assert len(parts) == 2 and all(g["committed"] for g in parts)
+        settle(c)
+        assert leaked_reservations(c) == []
+        assert ledger_divergence(c) == []
+
+
+def test_duplicate_prepare_is_idempotent():
+    cfg = sharded_config()
+    with SimCluster(cfg, slices=two_slices(), in_process=True) as c:
+        fill_slices(c)
+        gd = PodGroup("dcn", min_member=8, allow_dcn=True)
+        # first member reserves through the rendezvous
+        c.schedule(c.make_pod("dcn-0", tpu=1, group=gd))
+        router = c.extender
+        res_before = [
+            rep.extender.gang.reservation("default", "dcn")
+            for rep in router.replicas
+        ]
+        assert all(r is not None for r in res_before)
+        # a duplicate filter for the same member (scheduler retry /
+        # informer re-delivery) must not re-prepare: the SAME local
+        # reservation objects stand and the prepared counter is flat
+        from tpukube.sched import kube
+
+        pod = c.pods["default/dcn-0"]
+        router.handle("filter", {
+            "Pod": pod,
+            "NodeNames": list(router.state.node_names()),
+        })
+        res_after = [
+            rep.extender.gang.reservation("default", "dcn")
+            for rep in router.replicas
+        ]
+        assert all(a is b for a, b in zip(res_before, res_after))
+        assert router.rendezvous_prepared == 1
+        # gang-level duplicate prepare: reserve_exact_split for an
+        # existing key returns the existing reservation verbatim
+        rep = router.replicas[0]
+        existing = rep.extender.gang.reservation("default", "dcn")
+        from dataclasses import replace as dc_replace
+
+        local_pod = dc_replace(
+            kube.pod_from_k8s(pod),
+            group=PodGroup(name="dcn",
+                           min_member=existing.group.min_member,
+                           allow_dcn=True),
+        )
+        again = rep.extender.gang.reserve_exact_split(
+            local_pod, 1,
+            {sid: sorted(cs)
+             for sid, cs in existing.slice_coords.items()},
+        )
+        assert again is existing
+
+
+def test_rendezvous_abort_on_timeout():
+    """Members never bind: each part's local TTL sweep rolls its
+    reservation back and the janitor aborts the rest — zero leaks."""
+    clock = FakeClock()
+    cfg = sharded_config()
+    with SimCluster(cfg, slices=two_slices(), in_process=True,
+                    clock=clock) as c:
+        fill_slices(c)
+        gd = PodGroup("dcn", min_member=8, allow_dcn=True)
+        # filter only (no bind): both parts reserved, nothing assigned
+        pod = c.make_pod("dcn-0", tpu=1, group=gd)
+        c._sync_nodes()
+        fres = c.extender.handle("filter", {
+            "Pod": pod,
+            "NodeNames": list(c.extender.state.node_names()),
+        })
+        assert not fres.get("Error")
+        assert c.extender.statusz()["rendezvous"]["prepared"] == 1
+        clock.advance(cfg.reservation_ttl_seconds + 1)
+        aborted = c.extender.sweep()
+        assert ("default", "dcn") in aborted
+        for rep in c.extender.replicas:
+            assert rep.extender.gang.reservation("default", "dcn") \
+                is None
+        settle(c)
+        assert leaked_reservations(c) == []
+        assert ledger_divergence(c) == []
+
+
+def test_rendezvous_abort_dissolves_bound_members():
+    """A part lost before commit (TTL on one side while the other
+    holds bound members) kills the WHOLE gang: bound members are
+    evicted through the shared bus — all-or-nothing in death."""
+    clock = FakeClock()
+    cfg = sharded_config()
+    with SimCluster(cfg, slices=two_slices(), in_process=True,
+                    clock=clock) as c:
+        fill_slices(c)
+        gd = PodGroup("dcn", min_member=8, allow_dcn=True)
+        # bind three members (they land on the first part)
+        for i in range(3):
+            c.schedule(c.make_pod(f"dcn-{i}", tpu=1, group=gd))
+        bound = [k for k, p in c.pods.items()
+                 if k.startswith("default/dcn-")
+                 and (p.get("spec") or {}).get("nodeName")]
+        assert len(bound) == 3
+        clock.advance(cfg.reservation_ttl_seconds + 1)
+        aborted = c.extender.sweep()
+        assert ("default", "dcn") in aborted
+        settle(c)
+        # every member's pod is gone (evicted), nothing reserved
+        for k in bound:
+            assert k not in c.pods
+        assert all(
+            rep.extender.gang.reservation("default", "dcn") is None
+            for rep in c.extender.replicas
+        )
+        assert leaked_reservations(c) == []
+        assert ledger_divergence(c) == []
+
+
+# -- replica chaos (kill / partition mid-gang-commit) ------------------------
+
+def chaos_cluster(clock=None):
+    cfg = sharded_config(2, TPUKUBE_SNAPSHOT_AUDIT_RATE="1.0")
+    return SimCluster(cfg, slices=two_slices(), in_process=True,
+                      clock=clock)
+
+
+def test_replica_kill_mid_commit_converges_zero_leaks():
+    from tpukube.chaos import replica_crash_recover
+
+    clock = FakeClock()
+    with chaos_cluster(clock) as c:
+        fill_slices(c)
+        gd = PodGroup("dcn", min_member=8, allow_dcn=True)
+        for i in range(3):  # mid-commit: 3 of 8 bound
+            c.schedule(c.make_pod(f"dcn-{i}", tpu=1, group=gd))
+        report = replica_crash_recover(c, 1)
+        assert ["default", "dcn"] in report["rendezvous_aborted"]
+        # the fill gang on s1 survives the crash (rebuilt from pods)
+        assert report["restored_allocs"] == 4
+        gangs = {g["group"]: g for g in c.extender.gang_snapshot()}
+        assert gangs["fill-b"]["committed"]
+        assert "dcn" not in gangs
+        assert report["leaked_reservations"] == 0
+        assert report["ledger_divergence"] == 0
+        assert report["audit"]["divergences"] == 0
+        # the plane keeps scheduling after recovery
+        node, _ = c.schedule(c.make_pod("after", tpu=1))
+        assert node
+
+
+def test_replica_kill_after_commit_restores_part():
+    """A participant killed AFTER the rendezvous committed restores
+    its part by the LOCAL quorum — the committed gang survives."""
+    with chaos_cluster() as c:
+        fill_slices(c)
+        gd = PodGroup("dcn", min_member=8, allow_dcn=True)
+        for i in range(8):
+            c.schedule(c.make_pod(f"dcn-{i}", tpu=1, group=gd))
+        c.crash_replica(1)
+        c.extender.sweep()
+        restored = c.restart_replica(1)
+        assert restored == 8  # fill-b (4) + its dcn part (4)
+        parts = [g for g in c.extender.gang_snapshot()
+                 if g["group"] == "dcn"]
+        assert len(parts) == 2 and all(g["committed"] for g in parts)
+        rz = c.extender.statusz()["rendezvous"]
+        assert rz["live"] and rz["live"][0]["committed"]
+        settle(c)
+        assert leaked_reservations(c) == []
+        assert ledger_divergence(c) == []
+        assert c.extender.audit_stats()["divergences"] == 0
+
+
+def test_replica_partition_mid_commit_heals_clean():
+    """Partition (state survives, unreachable) mid-commit: the
+    janitor aborts the rendezvous; the healed replica's leftover part
+    — even a locally-complete one — is dissolved on heal, so no gang
+    fragment resurrects."""
+    clock = FakeClock()
+    with chaos_cluster(clock) as c:
+        fill_slices(c)
+        gd = PodGroup("dcn", min_member=8, allow_dcn=True)
+        # bind part 0 (r0's 4 members) COMPLETELY, none of part 1:
+        # r0's part is locally committed, the rendezvous is not
+        for i in range(4):
+            c.schedule(c.make_pod(f"dcn-{i}", tpu=1, group=gd))
+        part0 = [g for g in c.extender.gang_snapshot()
+                 if g["group"] == "dcn" and g["committed"]]
+        assert len(part0) == 1  # r0's part committed locally
+        c.partition_replica(0)
+        aborted = c.extender.sweep()
+        assert ("default", "dcn") in aborted
+        c.heal_replica(0)
+        settle(c)
+        # the locally-committed fragment did NOT survive the heal
+        assert all(
+            rep.extender.gang.reservation("default", "dcn") is None
+            for rep in c.extender.replicas
+        )
+        assert all(k not in c.pods
+                   for k in [f"default/dcn-{i}" for i in range(4)])
+        assert leaked_reservations(c) == []
+        assert ledger_divergence(c) == []
+        assert c.extender.audit_stats()["divergences"] == 0
+        node, _ = c.schedule(c.make_pod("after", tpu=1))
+        assert node
+
+
+def test_killed_replica_ledger_not_served():
+    """A KILLED replica's in-memory ledger died with the process: the
+    federated views must show its pods ledger-absent until the warm
+    restart (a partitioned replica's state, by contrast, is real and
+    stays served)."""
+    with chaos_cluster() as c:
+        fill_slices(c)
+        before = len(c.extender.state.allocations())
+        assert before == 8
+        c.crash_replica(1)
+        assert len(c.extender.state.allocations()) == 4
+        assert all(g["group"] == "fill-a"
+                   for g in c.extender.gang_snapshot())
+        c.restart_replica(1)
+        assert len(c.extender.state.allocations()) == 8
+        # partition: state survives and IS served
+        c.partition_replica(0)
+        assert len(c.extender.state.allocations()) == 8
+        c.heal_replica(0)
+
+
+def test_aborted_rendezvous_name_reuse_not_sentenced():
+    """A gang re-created with the SAME name after an abort — while the
+    partitioned replica is still down — must not be dissolved when
+    that replica later heals: the abort sentence is scoped to the
+    replicas that were unreachable, not to the gang name."""
+    clock = FakeClock()
+    with chaos_cluster(clock) as c:
+        fill_slices(c)
+        gd = PodGroup("dcn", min_member=8, allow_dcn=True)
+        for i in range(2):
+            c.schedule(c.make_pod(f"dcn-{i}", tpu=1, group=gd))
+        c.partition_replica(1)
+        assert ("default", "dcn") in c.extender.sweep()
+        settle(c)
+        # re-create the same-named gang while r1 is still down: it
+        # must fit whole on r0 (free the fill gang there first)
+        for i in range(4):
+            c.complete_pod(f"fill-a-{i}")
+        gd2 = PodGroup("dcn", min_member=4, allow_dcn=True)
+        for i in range(4):
+            c.schedule(c.make_pod(f"re-{i}", tpu=1, group=gd2))
+        # pre-heal: the partitioned replica's stale fragment is still
+        # SERVED (its state is real until heal) — the new gang is the
+        # one committed entry
+        committed = [g for g in c.extender.gang_snapshot()
+                     if g["group"] == "dcn" and g["committed"]]
+        assert len(committed) == 1
+        # heal: r1's stale fragment dies, r0's LIVE gang survives
+        c.heal_replica(1)
+        settle(c)
+        gangs = [g for g in c.extender.gang_snapshot()
+                 if g["group"] == "dcn"]
+        assert len(gangs) == 1 and gangs[0]["committed"]
+        assert all(f"default/re-{i}" in c.pods for i in range(4))
+        assert leaked_reservations(c) == []
+        assert ledger_divergence(c) == []
+
+
+def test_malformed_request_reports_schema_error():
+    """A pod asking for BOTH resources must get the schema error from
+    a replica, byte-for-byte like the unsharded planner — never a
+    silent feasible-everywhere answer."""
+    cfg = sharded_config()
+    with SimCluster(cfg, slices=two_slices(), in_process=True) as c:
+        c.schedule(c.make_pod("warm", tpu=1))
+        pod = c.make_pod("bad", tpu=1, vtpu=1)
+        fres = c.extender.handle("filter", {
+            "Pod": pod,
+            "NodeNames": list(c.extender.state.node_names()),
+        })
+        assert "requests both" in fres["Error"]
+        assert fres["NodeNames"] == []
+
+
+def test_partitioned_replica_binds_fail_retryably():
+    with chaos_cluster() as c:
+        # route a pod to each replica first so the maps are warm
+        c.schedule(c.make_pod("warm-0", tpu=1))
+        router = c.extender
+        router.partition_replica(1)
+        # a bind landing on the dead replica's node fails with a
+        # retryable error, not an exception
+        name = next(n for n, i in router._node_replica.items()
+                    if i == 1)
+        out = router.handle("bind", {
+            "PodName": "ghost", "PodNamespace": "default",
+            "PodUID": "", "Node": name,
+        })
+        assert "unavailable" in out["Error"]
+        # non-gang pods spill over to the alive replica
+        node, _ = c.schedule(c.make_pod("spill", tpu=1))
+        assert router._node_replica[node] == 0
+
+
+# -- routing -----------------------------------------------------------------
+
+def test_nongang_spillover_when_primary_full():
+    cfg = sharded_config()
+    with SimCluster(cfg, slices=two_slices(), in_process=True) as c:
+        placed = []
+        for i in range(16):  # exactly the fleet's capacity
+            node, _ = c.schedule(c.make_pod(f"p-{i}", tpu=1))
+            placed.append(node)
+        # both replicas' slices filled — the hash alone cannot have
+        # sent every pod to its own-half only
+        assert {n.split("-")[0] for n in placed} == {"s0", "s1"}
+        with pytest.raises(RuntimeError):
+            c.schedule(c.make_pod("p-overflow", tpu=1), retries=2)
+        assert leaked_reservations(c) == []
+        assert ledger_divergence(c) == []
+
+
+def test_gang_reroutes_after_transient_full_fleet():
+    """A gang that fit NOWHERE (error answer) must not stay pinned to
+    the replica that owned the error: once capacity frees anywhere,
+    the retry re-probes the fleet and reserves there."""
+    cfg = sharded_config()
+    with SimCluster(cfg, slices=two_slices(), in_process=True) as c:
+        placed = {}
+        for i in range(16):  # fill the whole fleet
+            node, _ = c.schedule(c.make_pod(f"p-{i}", tpu=1))
+            placed[f"p-{i}"] = node
+        g = PodGroup("late", min_member=4)
+        with pytest.raises(RuntimeError):
+            c.schedule(c.make_pod("late-0", tpu=1, group=g), retries=2)
+        # free one replica's slice entirely
+        for name, node in placed.items():
+            if node.startswith("s1"):
+                c.delete_pod(name)
+        for j in range(4):
+            c.schedule(c.make_pod(f"late-{j}", tpu=1, group=g))
+        gangs = {x["group"]: x for x in c.extender.gang_snapshot()}
+        assert gangs["late"]["committed"]
+        assert leaked_reservations(c) == []
+
+
+def test_release_routes_and_frees():
+    cfg = sharded_config()
+    with SimCluster(cfg, slices=two_slices(), in_process=True) as c:
+        for i in range(4):
+            c.schedule(c.make_pod(f"p-{i}", tpu=1))
+        before = len(c.extender.state.allocations())
+        c.delete_pod("p-0")
+        assert len(c.extender.state.allocations()) == before - 1
+        assert c.extender.state.allocation("default/p-0") is None
+
+
+def test_statusz_and_metrics_render():
+    cfg = sharded_config()
+    with SimCluster(cfg, slices=two_slices(), in_process=True) as c:
+        c.schedule(c.make_pod("p-0", tpu=1))
+        doc = c.extender.statusz()
+        assert {r["replica"] for r in doc["replicas"]} == {"r0", "r1"}
+        assert doc["slice_assignment"] == {"s0": "r0", "s1": "r1"}
+        from tpukube.metrics import render_router_metrics
+
+        text = render_router_metrics(c.extender)
+        assert "tpukube_router_replicas 2" in text
+        assert 'tpukube_replica_nodes{replica="r0"}' in text
+
+
+# -- filter answers from the plan (ISSUE 13 satellite) ------------------------
+
+def test_filter_from_plan_parity_and_minimal_answer():
+    """With filter_from_plan, webhook placements are identical but the
+    feasibility answer is the planned node alone — the O(nodes)
+    materialization is gone."""
+    base_env = {"TPUKUBE_BATCH_ENABLED": "1"}
+    placements: dict[str, dict[str, str]] = {}
+    for mode, extra in (
+        ("full", {}),
+        ("plan", {"TPUKUBE_FILTER_FROM_PLAN": "1"}),
+    ):
+        cfg = load_config(env={**base_env, **extra})
+        mesh = MeshSpec(dims=(4, 4, 1), host_block=(2, 2, 1),
+                        torus=(False, False, False))
+        with SimCluster(cfg, mesh=mesh, in_process=True) as c:
+            got = {}
+            grp = PodGroup("pg", min_member=2)
+            for i in range(4):
+                node, _ = c.schedule(c.make_pod(f"s-{i}", tpu=1))
+                got[f"s-{i}"] = node
+            for i in range(2):
+                node, _ = c.schedule(
+                    c.make_pod(f"g-{i}", tpu=1, group=grp))
+                got[f"g-{i}"] = node
+            placements[mode] = got
+            if mode == "plan":
+                # the wire answer is minimal: one feasible node
+                pod = c.make_pod("probe", tpu=1)
+                fres = c.extender.handle("filter", {
+                    "Pod": pod,
+                    "NodeNames": list(c.extender.state.node_names()),
+                })
+                assert len(fres["NodeNames"]) == 1
+                assert fres["FailedNodes"] == {}
+    assert placements["full"] == placements["plan"]
+
+
+def test_filter_from_plan_requires_batching():
+    with pytest.raises(ValueError, match="filter_from_plan"):
+        load_config(env={"TPUKUBE_FILTER_FROM_PLAN": "1"})
+
+
+# -- incremental occupied sets (ISSUE 13 satellite) ---------------------------
+
+def test_incremental_occupied_matches_walk_through_lifecycle():
+    cfg = load_config(env={})
+    mesh = MeshSpec(dims=(2, 2, 2), host_block=(2, 2, 1),
+                    torus=(False, False, False))
+    with SimCluster(cfg, mesh=mesh) as c:
+        st = c.extender.state
+
+        def check():
+            sid = st.slice_ids()[0]
+            assert st.occupied_coords(sid) == \
+                st.walk_occupied_coords(sid)
+
+        c.schedule(c.make_pod("a", tpu=1))
+        check()
+        c.schedule(c.make_pod("b", tpu=2))
+        check()
+        # health flip (health-only re-annotation path)
+        c.inject_fault("host-0-0-0", 1)
+        c.schedule(c.make_pod("c", tpu=1))
+        check()
+        c.inject_fault("host-0-0-0", 1, healthy=True)
+        c.schedule(c.make_pod("d", tpu=1))
+        check()
+        # release
+        c.delete_pod("a")
+        check()
+        # structural re-annotation (link fault changes bad_links)
+        c.inject_link_fault((0, 0, 0), (0, 0, 1))
+        c.schedule(c.make_pod("e", tpu=1))
+        check()
+
+
+def test_scenario14_smoke(monkeypatch):
+    """tpukube-sim 14 at tier-1 scale: 2 tiny slices behind 2 planner
+    replicas, full invariants (the scenario raises on leaks,
+    divergence, shortfall, or a dead replica)."""
+    monkeypatch.setenv("TPUKUBE_SHARD_SLICES", "2")
+    monkeypatch.setenv("TPUKUBE_SIM_MESH_DIMS", "4,4,4")
+    monkeypatch.setenv("TPUKUBE_PLANNER_REPLICAS", "2")
+    monkeypatch.setenv("TPUKUBE_KILONODE100K_PODS", "400")
+    from tpukube.sim import scenarios
+
+    r = scenarios.run(14)
+    assert r["scenario"] == 14
+    assert r["pods_total"] >= 400
+    assert r["ledger_divergence"] == 0
+    assert r["gang_committed"]
+    assert len(r["shard"]["replicas"]) == 2
+    assert all(x["alive"] for x in r["shard"]["replicas"])
+    assert set(r["shard"]["slice_assignment"].values()) == {"r0", "r1"}
+
+
+def test_config_validation_replicas():
+    with pytest.raises(ValueError, match="planner_replicas"):
+        load_config(env={"TPUKUBE_PLANNER_REPLICAS": "0"})
+    with pytest.raises(ValueError, match="shard-aware"):
+        load_config(env={
+            "TPUKUBE_PLANNER_REPLICAS": "2",
+            "TPUKUBE_TENANCY_ENABLED": "1",
+            "TPUKUBE_TENANCY_QUOTAS": "a=chips:4",
+        })

@@ -275,6 +275,44 @@ print("crash-recovery smoke OK")
 PY
 
 echo
+echo "== shard smoke (scenario 14 at smoke scale: 4 slices / 1024"
+echo "   nodes behind 2 planner replicas + plan-served filter answers;"
+echo "   zero leaks + both replicas alive enforced by the scenario,"
+echo "   throughput floor from tools/perf_floor.json) =="
+JAX_PLATFORMS=cpu TPUKUBE_SHARD_SLICES=4 TPUKUBE_SIM_MESH_DIMS=8,8,16 \
+  TPUKUBE_PLANNER_REPLICAS=2 python - <<'PY'
+import json
+import os
+import sys
+
+floor = json.load(open("tools/perf_floor.json"))["shard"]
+os.environ.setdefault("TPUKUBE_KILONODE100K_PODS", str(floor["pods"]))
+
+from tpukube.sim import scenarios
+
+# the scenario itself raises on invariant violations (gang uncommitted,
+# ledger divergence, leaked reservations, dead replica, pod shortfall)
+r = scenarios.run(14)
+print(json.dumps({
+    "pods_total": r["pods_total"], "wall_s": r["wall_s"],
+    "setup_s": r.get("setup_s"),
+    "pods_per_sec": r["pods_per_sec"],
+    "plan_ms_per_pod": r["cycle"]["plan_ms_per_pod"],
+    "replicas": [x["replica"] for x in r["shard"]["replicas"]],
+    "slice_assignment": r["shard"]["slice_assignment"],
+}))
+bad = []
+if r["pods_per_sec"] < floor["pods_per_sec_min"]:
+    bad.append(f"pods_per_sec={r['pods_per_sec']} below the "
+               f"{floor['pods_per_sec_min']}/s floor")
+if len(r["shard"]["replicas"]) != 2:
+    bad.append("expected 2 planner replicas")
+if bad:
+    sys.exit("shard smoke FAILED: " + "; ".join(bad))
+print("shard smoke OK")
+PY
+
+echo
 echo "== native asan (libtpuinfo self-test under ASan/UBSan) =="
 if command -v g++ >/dev/null 2>&1; then
   make -C tpukube/native asan

@@ -270,6 +270,7 @@ GUARDED_ATTRS = {
     ("sched/state.py", "ClusterState"): {
         "_nodes": "_lock", "_slices": "_lock", "_allocs": "_lock",
         "_hosts_cache": "_lock", "_epoch": "_lock",
+        "_occ_cache": "_lock",
     },
     ("sched/gang.py", "GangManager"): {
         "_reservations": "_lock", "_terminating_coords": "_lock",

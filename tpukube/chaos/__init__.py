@@ -13,6 +13,14 @@ order, so a scenario replays the same fault sequence for the same seed
 (`tpukube-sim 8|9`) drive this end to end and assert the recovery
 invariants: zero leaked gang reservations and zero ledger/apiserver
 divergence after the dust settles.
+
+Sharded-plane chaos (ISSUE 13): on a ``planner_replicas > 1`` cluster,
+``replica_crash_recover`` kills ONE planner replica mid-flight — e.g.
+mid-gang-commit of a two-phase DCN rendezvous — drives the router's
+all-or-nothing abort, cold-restarts the replica via
+``rebuild_from_pods``, and reports the zero-leak convergence the
+acceptance asserts; ``SimCluster.partition_replica``/``heal_replica``
+cover the partition half (tests/test_shard.py).
 """
 
 from tpukube.chaos.api import ChaosApiServer
@@ -21,6 +29,7 @@ from tpukube.chaos.cluster import (
     converge,
     leaked_reservations,
     ledger_divergence,
+    replica_crash_recover,
     transient_api_error,
 )
 from tpukube.chaos.crash import CrashSchedule
@@ -35,5 +44,6 @@ __all__ = [
     "converge",
     "leaked_reservations",
     "ledger_divergence",
+    "replica_crash_recover",
     "transient_api_error",
 ]

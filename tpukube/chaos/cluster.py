@@ -176,6 +176,32 @@ def ledger_divergence(cluster: SimCluster) -> list[str]:
     return problems
 
 
+def replica_crash_recover(cluster: SimCluster, idx: int,
+                          rounds: int = 50) -> dict[str, Any]:
+    """ISSUE 13 replica chaos: kill ONE planner replica of a sharded
+    cluster mid-flight, let the router's rendezvous janitor abort any
+    uncommitted rendezvous holding a part there, converge the
+    effectors, cold-restart the replica via ``rebuild_from_pods``,
+    and converge again. Returns a report with the aborted rendezvous
+    keys, allocations restored, and the post-recovery leak/divergence
+    counts — the zero-leak acceptance the caller asserts on."""
+    cluster.crash_replica(idx)
+    aborted = cluster.extender.sweep()
+    converge(cluster, rounds=rounds)
+    restored = cluster.restart_replica(idx)
+    converge(cluster, rounds=rounds)
+    cluster.extender.sweep()
+    converge(cluster, rounds=rounds)
+    return {
+        "replica": idx,
+        "rendezvous_aborted": [list(k) for k in aborted],
+        "restored_allocs": restored,
+        "leaked_reservations": len(leaked_reservations(cluster)),
+        "ledger_divergence": len(ledger_divergence(cluster)),
+        "audit": cluster.extender.audit_stats(),
+    }
+
+
 def converge(cluster: SimCluster, rounds: int = 50) -> int:
     """Drive the effector loops until quiet (or ``rounds``): evictions
     drained + confirmed, lifecycle resynced. Returns rounds used. Loop

@@ -394,6 +394,20 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
     args = p.parse_args(argv)
     cfg = _setup(args)
 
+    if cfg.planner_replicas > 1:
+        # the slice-partitioned plane (sched/shard.py) deploys as ONE
+        # extender daemon per replica — each with its slice set and
+        # journal segment — behind the routing contract the in-process
+        # ShardRouter defines; a single daemon asked to be N replicas
+        # would shard nothing (one process, one GIL, one failure
+        # domain). See README "Sharded control plane".
+        p.error(
+            "planner_replicas > 1 is a deployment topology, not a "
+            "daemon flag: run one tpukube-extender per replica (the "
+            "in-process ShardRouter serves the sim/bench plane — "
+            "`tpukube-sim 14`)"
+        )
+
     ssl_ctx = None
     if args.tls_cert or args.tls_key:
         import ssl
@@ -622,7 +636,7 @@ def main_sim(argv: Optional[list[str]] = None) -> int:
         "tpukube-sim",
         "run a BASELINE config scenario against the real control-plane stack",
     )
-    p.add_argument("scenario", type=int, choices=range(1, 14),
+    p.add_argument("scenario", type=int, choices=range(1, 15),
                    help="BASELINE config number (1..5), 6 = the "
                         "steady-state churn benchmark (completions -> "
                         "release loop -> re-scheduling), 7 = fault "

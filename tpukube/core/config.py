@@ -149,6 +149,32 @@ class TpuKubeConfig:
     # that misses the plan — the latency-first default; kilonode sims
     # raise it to coalesce arrival storms into fewer, bigger cycles)
     cycle_interval_seconds: float = 0.0
+    # Answer /filter and /prioritize webhooks FROM the cycle plan
+    # (sched/cycle.py, ISSUE 13 satellite): the feasibility answer is
+    # the planned node alone instead of the materialized O(nodes)
+    # per-node verdict list that was the 10k-node filter p99
+    # (BENCH_r06: ~49ms webhook answer vs a 0.25ms/pod planner). The
+    # PLACEMENT is unchanged — the scheduler picks from a one-node
+    # feasible set exactly the node the full answer's max-score
+    # tie-break would pick — but the wire response no longer names
+    # every infeasible node's reason, so the default stays off (full
+    # answers) and the kilonode scenarios/bench turn it on. Requires
+    # batch_enabled (there is no plan to answer from otherwise).
+    filter_from_plan: bool = False
+
+    # Slice-partitioned control plane (sched/shard.py, ISSUE 13
+    # tentpole): >1 runs N planner replicas behind an in-process
+    # ShardRouter — each replica a full Extender owning a disjoint ICI
+    # slice set (its own ledger, gang manager, snapshot cache,
+    # scheduling queue, and journal segment at <journal_path>.r<i>);
+    # the router routes pods by slice affinity and coordinates a
+    # two-phase rendezvous (reserve-on-each-replica, then
+    # commit-or-abort) for DCN-spanning gangs. 1 (the default) builds
+    # no router anywhere — the single-planner path is untouched. The
+    # in-process router serves the sim/bench plane; production runs
+    # one extender process per replica behind the same routing
+    # contract (see README "Sharded control plane").
+    planner_replicas: int = 1
 
     # Decision provenance (tpukube/obs/decisions.py, ISSUE 12). With
     # decisions_enabled the extender keeps a bounded, sampled,
@@ -408,5 +434,23 @@ def load_config(
     if cfg.cycle_interval_seconds < 0:
         raise ValueError(
             "cycle_interval_seconds must be >= 0 (0 = plan on demand)"
+        )
+    if cfg.filter_from_plan and not cfg.batch_enabled:
+        raise ValueError(
+            "filter_from_plan requires batch_enabled — without the "
+            "batch planner there is no cycle plan to answer from"
+        )
+    if cfg.planner_replicas < 1:
+        raise ValueError("planner_replicas must be >= 1")
+    if cfg.planner_replicas > 1 and cfg.tenancy_quotas:
+        # each replica's TenantLedger sees only its own slice set, so a
+        # cluster-wide chip cap split across N replicas would silently
+        # enforce N x the written quota — refuse at load rather than
+        # under-enforce (same contract as quotas-without-the-plane)
+        raise ValueError(
+            "tenancy_quotas with planner_replicas > 1 is not yet "
+            "shard-aware (each replica would enforce the full cap "
+            "against its own slices) — run quotas unsharded, or drop "
+            "them for the sharded plane"
         )
     return cfg

@@ -48,9 +48,10 @@ from tpukube.obs.registry import (
 
 __all__ = [
     "quantile", "MetricsServer", "build_extender_registry",
-    "build_plugin_registry", "build_syncer_registry",
+    "build_plugin_registry", "build_router_registry",
+    "build_syncer_registry",
     "render_extender_metrics", "render_plugin_metrics",
-    "render_syncer_metrics",
+    "render_router_metrics", "render_syncer_metrics",
 ]
 
 
@@ -190,6 +191,59 @@ def render_extender_metrics(extender, reconcile=None, evictions=None,
         extender, reconcile=reconcile, evictions=evictions,
         node_refresh=node_refresh, lifecycle=lifecycle,
     ).render()
+
+
+def build_router_registry(router) -> Registry:
+    """Registry for a :class:`~tpukube.sched.shard.ShardRouter`
+    (planner_replicas > 1, ISSUE 13): router topology, routed volume,
+    the two-phase rendezvous ledger, and one summary row per replica —
+    the per-replica observability leg of the sharded plane. In a real
+    deployment each replica additionally serves its own full
+    ``render_extender_metrics`` exposition from its own listener; the
+    router-level series are the cross-shard rollup."""
+    reg = Registry()
+    reg.gauge("tpukube_router_replicas",
+              fn=lambda: len(router.replicas))
+    rdv = reg.counter("tpukube_router_rendezvous_total")
+    rdv.labels(outcome="prepared").set_function(
+        lambda: router.rendezvous_prepared)
+    rdv.labels(outcome="committed").set_function(
+        lambda: router.rendezvous_committed)
+    rdv.labels(outcome="aborted").set_function(
+        lambda: router.rendezvous_aborted)
+    up = reg.gauge("tpukube_replica_up")
+    nodes = reg.gauge("tpukube_replica_nodes")
+    slices = reg.gauge("tpukube_replica_slices")
+    allocs = reg.gauge("tpukube_replica_allocs")
+    routed = reg.counter("tpukube_replica_pods_routed_total")
+    binds = reg.counter("tpukube_replica_binds_total")
+    util = reg.gauge("tpukube_replica_utilization")
+    depth = reg.gauge("tpukube_replica_queue_depth")
+    for rep in router.replicas:
+        name = rep.name
+        up.labels(replica=name).set_function(
+            lambda r=rep: 1.0 if r.alive else 0.0)
+        nodes.labels(replica=name).set_function(
+            lambda r=rep: len(r.extender.state.node_names()))
+        slices.labels(replica=name).set_function(
+            lambda r=rep: len(r.extender.state.slice_ids()))
+        allocs.labels(replica=name).set_function(
+            lambda r=rep: len(r.extender.state.allocations()))
+        routed.labels(replica=name).set_function(
+            lambda r=rep: r.pods_routed)
+        binds.labels(replica=name).set_function(
+            lambda r=rep: r.extender.binds_total)
+        util.labels(replica=name).set_function(
+            lambda r=rep: router.state_utilization_of(r))
+        depth.labels(replica=name).set_function(
+            lambda r=rep: (r.extender.cycle.queue_depth()
+                           if r.extender.cycle is not None else 0))
+    return reg
+
+
+def render_router_metrics(router) -> str:
+    """Prometheus text for a ShardRouter — see build_router_registry."""
+    return build_router_registry(router).render()
 
 
 def build_plugin_registry(server, health=None, kubelet_watch=None,

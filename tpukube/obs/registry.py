@@ -122,6 +122,21 @@ DECLARED_SERIES: frozenset[str] = frozenset({
     "tpukube_tenant_admission_seconds",
     "tpukube_tenant_commit_seconds",
     "tpukube_tenant_slo_burn",
+    # sharded control plane (sched/shard.py, ISSUE 13; series render
+    # only from tpukube.metrics.render_router_metrics on a
+    # planner_replicas > 1 plane — single-planner exposition is
+    # untouched): router topology, routing volume, the two-phase
+    # rendezvous ledger, and one summary row per replica
+    "tpukube_router_replicas",
+    "tpukube_router_rendezvous_total",
+    "tpukube_replica_up",
+    "tpukube_replica_nodes",
+    "tpukube_replica_slices",
+    "tpukube_replica_allocs",
+    "tpukube_replica_pods_routed_total",
+    "tpukube_replica_binds_total",
+    "tpukube_replica_utilization",
+    "tpukube_replica_queue_depth",
     # both daemons (unified retry/circuit layer, core/retry.py; series
     # render only where a Retrier/CircuitBreaker is actually wired)
     "tpukube_retry_attempts_total",

@@ -192,6 +192,26 @@ def extender_statusz(
     return out
 
 
+def router_statusz(router) -> dict[str, Any]:
+    """The sharded control plane's /statusz document (ISSUE 13):
+    slice→replica assignment, per-replica summary rows (liveness,
+    nodes, allocs, queue depth, snapshot counters), and the two-phase
+    rendezvous ledger. Each replica's FULL ``extender_statusz`` stays
+    its own listener's document in a real deployment; this is the
+    cross-shard rollup the router serves."""
+    return {
+        "time": time.time(),
+        "sharded": True,
+        **router.statusz(),
+        "pending_evictions": len(router.pending_evictions),
+        "rendezvous_counters": {
+            "prepared": router.rendezvous_prepared,
+            "committed": router.rendezvous_committed,
+            "aborted": router.rendezvous_aborted,
+        },
+    }
+
+
 def plugin_statusz(
     server, device=None, health=None, kubelet_watch=None, intent_watch=None,
     sampler=None, events=None,

@@ -269,6 +269,13 @@ class SchedulingCycle:
         self._max_pods = config.batch_max_pods
         self._interval = config.cycle_interval_seconds
         self._ttl = config.reservation_ttl_seconds
+        # ISSUE 13 satellite: answer /filter (and /prioritize) FROM the
+        # plan — the feasible set is the planned node alone, so the
+        # webhook answer stops materializing the O(nodes) per-node
+        # verdict list that was the 10k-node filter p99. Placement is
+        # unchanged: the one offered node IS the max-score smallest-name
+        # pick the full answer would have led the scheduler to.
+        self._filter_from_plan = config.filter_from_plan
         # scheduling queue: pod key -> (PodInfo, enqueue seq, the
         # webhook's candidate node names or None for driver/informer
         # admissions). Insertion order is the arrival order; the cycle
@@ -457,9 +464,11 @@ class SchedulingCycle:
         a0 = time.perf_counter() if ph is not None else None
         feasible = entry.feasible
         if feasible is None:
-            # driver-enqueued pod planned without materialized answers
-            # (its webhooks were not expected): the planned node alone
-            # is a correct — if minimal — feasibility answer, and the
+            # planned without materialized answers — a driver-enqueued
+            # pod whose webhooks were not expected, or any pod under
+            # filter_from_plan (ISSUE 13: the O(nodes) answer build was
+            # the 10k-node filter p99): the planned node alone is a
+            # correct — if minimal — feasibility answer, and the
             # scheduler's pick then consumes the assumed allocation
             feasible = [entry.node] if entry.node is not None else []
         if by_name is not None:
@@ -480,9 +489,22 @@ class SchedulingCycle:
         the plan cannot answer (the caller falls back to the legacy
         path and counts a miss)."""
         entry = self._plans.get(pod.key())
-        if (entry is None or entry.uid != pod.uid or entry.error is not None
-                or not self._entry_current(entry)
-                or not all(n in entry.scores for n in names)):
+        if (entry is None or entry.uid != pod.uid
+                or entry.error is not None
+                or not self._entry_current(entry)):
+            self.plan_misses += 1
+            return None
+        if not all(n in entry.scores for n in names):
+            if self._filter_from_plan and entry.node is not None:
+                # plan-served answers carry no materialized score map;
+                # the planned node wins outright (it is the only node
+                # the plan-served filter offered — extra names can only
+                # come from another extender's merge and lose)
+                from tpukube.sched.extender import MAX_SCORE
+
+                self.plan_hits += 1
+                return {n: (MAX_SCORE if n == entry.node else 0)
+                        for n in names}
             self.plan_misses += 1
             return None
         self.plan_hits += 1
@@ -699,7 +721,11 @@ class SchedulingCycle:
             age = _age_of(key)
             if pod_names is not None:
                 names = list(pod_names)
-                needs_answers = True  # a webhook will read the answers
+                # a webhook will read the answers — unless plan-served
+                # filter answers are on, in which case the planned node
+                # alone answers and the O(nodes) materialization is the
+                # cost this mode exists to kill
+                needs_answers = not self._filter_from_plan
             else:
                 if default_names is None:
                     default_names = tuple(ext.state.node_names())

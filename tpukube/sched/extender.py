@@ -73,6 +73,7 @@ class Extender:
         state: Optional[ClusterState] = None,
         trace: Optional["DecisionTrace"] = None,
         clock=None,
+        eviction_sink: Optional[deque] = None,
     ):
         from tpukube.core.clock import SYSTEM
 
@@ -136,7 +137,12 @@ class Extender:
         # Cluster-wide eviction bus: pods whose chips were taken back
         # (gang rollback/dissolve, preemption) and must be deleted by the
         # pod-lifecycle owner (sim harness / apiserver writer).
-        self.pending_evictions: deque[str] = deque()
+        # ``eviction_sink`` lets the sharded router (sched/shard.py)
+        # hand all replicas ONE shared bus so a single EvictionExecutor
+        # drains every replica's victims.
+        self.pending_evictions: deque[str] = (
+            eviction_sink if eviction_sink is not None else deque()
+        )
         self.gang = GangManager(
             self.state,
             ttl_seconds=config.reservation_ttl_seconds,

@@ -1,0 +1,1516 @@
+"""Slice-partitioned control plane (ISSUE 13 tentpole).
+
+BENCH_r06 showed the single planner process as the throughput ceiling:
+one ``ClusterState``/``GangManager`` owns the whole fleet, so scenario
+12 tops out around 1,650 pods/s at 10,240 nodes — the same
+single-extender-webhook shape PAPER.md §1 identifies as KubeGPU's
+scaling limit. ICI slices are already the natural partition unit
+(snapshots, ``SnapshotDelta`` chains, fragmentation gauges, locks, and
+the tenancy ledger are all per-slice), so this module partitions the
+control plane BY SLICE:
+
+  * :class:`PlannerReplica` — one shard: a full
+    :class:`~tpukube.sched.extender.Extender` owning a DISJOINT slice
+    set, with its own ledger, gang manager, snapshot/delta chain,
+    scheduling queue, and journal segment (``<journal_path>.r<i>``).
+  * :class:`ShardRouter` — the thin routing layer in front of the N
+    replicas. It speaks the same decision surface as a single Extender
+    (``handle``/``admit``/``plan_pending``/``planned_node``/...), so
+    the sim harness, the apiserver loops, and the chaos checkers run
+    against either unchanged. Nodes route by the slice id in their
+    topology annotation; pods route by slice affinity (their gang's
+    home replica, their allocation's owner, or a stable hash with
+    capacity spillover); binds route by the target node's owner.
+
+Parity gate: with ``planner_replicas == 1`` every router entry point
+delegates VERBATIM to the sole replica's Extender — the N=1 sharded
+path is byte-identical to the unsharded planner by construction
+(tests/test_shard.py proves it end to end).
+
+Two-phase rendezvous for DCN-spanning gangs
+-------------------------------------------
+
+A gang confined to one replica's slices reserves and commits locally,
+exactly as today. A gang that fits NO single replica — and opted in to
+DCN spanning (``PodGroup.allow_dcn``) — goes through a rendezvous
+coordinated by the router on behalf of the initiating (home) replica,
+built on the existing ``gang.py`` reservation/epoch machinery:
+
+  1. PLAN: the router asks every alive replica's epoch-cached snapshot
+     for its largest contiguous free boxes (one box per slice, each a
+     multiple of chips_per_pod — the same greedy
+     ``_plan_dcn_split`` shape, spread across replicas).
+  2. PREPARE: each participant replica reserves its part through
+     ``GangManager.reserve_exact_split`` under its own locks, with a
+     LOCAL group whose ``min_member`` is the part's member count — so
+     the part commits by its own quorum and sweeps by its own TTL.
+     A duplicate prepare is idempotent (``reserve_exact_split``
+     returns the existing reservation for the key), and a prepare that
+     loses a race (box re-occupied) raises without touching anything.
+  3. COMMIT-OR-ABORT: all prepares landed → the rendezvous is
+     recorded and member pods fan out to participants with unassigned
+     room; any prepare failed → every prepared part is dropped
+     (``drop_reservation`` — no members yet, nothing to evict). After
+     that, the rendezvous janitor (:meth:`ShardRouter.sweep`) keeps
+     the all-or-nothing contract: if ANY uncommitted part disappears —
+     TTL expiry, chip/link fault rollback, a replica killed or
+     partitioned mid-commit — the surviving parts are dissolved
+     (members evicted through the shared eviction bus), exactly the
+     death a single-planner gang rollback dies.
+
+The PR 6 reservation-leak prover and the snapshot-audit sentinel keep
+holding: every reservation mutation goes through the proven
+``gang.py`` seams, and each replica audits its own snapshot chain.
+
+Production shape: this in-process router serves the sim/bench plane;
+a real deployment runs one extender process per replica (each
+configured with its slice set and journal segment) behind the same
+routing contract, with the router as the stateless webhook front —
+its maps are re-derivable from node annotations and the replicas'
+reservations (see ``rebuild_from_pods``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import zlib
+from collections import deque
+from dataclasses import replace as dc_replace
+from typing import Any, Optional
+
+from tpukube.core import codec
+from tpukube.core.config import TpuKubeConfig
+from tpukube.core.types import PodGroup, PodInfo, TopologyCoord
+from tpukube.sched import kube, slicefit
+from tpukube.sched.extender import Extender, ExtenderError
+from tpukube.sched.gang import GangError
+from tpukube.sched.state import StateError
+
+log = logging.getLogger("tpukube.shard")
+
+
+class ShardError(RuntimeError):
+    pass
+
+
+class PlannerReplica:
+    """One shard of the control plane: index + its Extender + liveness.
+    ``alive=False`` models a partitioned OR killed replica — the
+    router stops routing to it and the rendezvous janitor treats its
+    uncommitted parts as lost. ``killed=True`` additionally marks the
+    in-memory state as GONE (process death): the federated read views
+    must not serve the corpse's ledger — a dead shard's pods are
+    ledger-absent until the warm restart, and the chaos invariants
+    must see exactly that."""
+
+    __slots__ = ("index", "extender", "alive", "killed", "pods_routed")
+
+    def __init__(self, index: int, extender: Extender):
+        self.index = index
+        self.extender = extender
+        self.alive = True
+        self.killed = False
+        self.pods_routed = 0
+
+    @property
+    def name(self) -> str:
+        return f"r{self.index}"
+
+
+class _Rendezvous:
+    """Router-side record of one DCN gang's prepared parts."""
+
+    __slots__ = ("key", "parts", "local_min", "created", "committed",
+                 "member_target")
+
+    def __init__(self, key: tuple[str, str],
+                 parts: dict[int, dict[str, list[TopologyCoord]]],
+                 local_min: dict[int, int], created: float):
+        self.key = key
+        #: replica index -> {slice id -> reserved coords}
+        self.parts = parts
+        #: replica index -> that part's member quorum
+        self.local_min = local_min
+        self.created = created
+        self.committed = False
+        #: pod key -> its part's replica index: STICKY member routing,
+        #: capped per part at local_min — the driver path admits every
+        #: member before any binds, so ``assignable`` cannot spread
+        #: them; the router must (and a member's filter, prioritize,
+        #: and bind must all land on the same part)
+        self.member_target: dict[str, int] = {}
+
+
+class _FederatedState:
+    """Read-only ledger view over every replica (the surface the
+    apiserver loops and chaos checkers consume: ``allocations``,
+    ``allocation``, ``utilization``, ``node_names``). Mutations never
+    come through here — they route via ``ShardRouter.handle``. A
+    KILLED replica's state is excluded: its in-memory ledger died
+    with the process, and serving the corpse would let the chaos
+    invariants false-negative on exactly the divergence a dead shard
+    creates (a partitioned replica's state, by contrast, is real and
+    still served)."""
+
+    def __init__(self, router: "ShardRouter"):
+        self._router = router
+
+    def _live(self) -> list[PlannerReplica]:
+        return [r for r in self._router.replicas if not r.killed]
+
+    def allocations(self) -> list:
+        return [
+            a
+            for rep in self._live()
+            for a in rep.extender.state.allocations()
+        ]
+
+    def allocation(self, pod_key: str):
+        for rep in self._live():
+            a = rep.extender.state.allocation(pod_key)
+            if a is not None:
+                return a
+        return None
+
+    def priority_of(self, pod_key: str) -> int:
+        a = self.allocation(pod_key)
+        return a.priority if a is not None else 0
+
+    def node(self, name: str):
+        idx = self._router._node_replica.get(name)
+        reps = (
+            [self._router.replicas[idx]] if idx is not None
+            else self._router.replicas
+        )
+        for rep in reps:
+            if rep.killed:
+                continue
+            view = rep.extender.state.node(name)
+            if view is not None:
+                return view
+        return None
+
+    def node_names(self) -> tuple[str, ...]:
+        out: list[str] = []
+        for rep in self._live():
+            out.extend(rep.extender.state.node_names())
+        return tuple(sorted(out))
+
+    def slice_ids(self) -> list[str]:
+        out: list[str] = []
+        for rep in self._live():
+            out.extend(rep.extender.state.slice_ids())
+        return sorted(out)
+
+    def utilization(self) -> float:
+        used = total = 0
+        for rep in self._live():
+            st = rep.extender.state
+            for sid in st.slice_ids():
+                u, t = st.slice_share_counts(sid)
+                used += u
+                total += t
+        return used / total if total else 0.0
+
+    def retire(self) -> None:
+        for rep in self._router.replicas:
+            rep.extender.state.retire()
+
+
+class _RouterCycle:
+    """Aggregated batch-planner stats in the shape scenario drivers
+    read (``extender.cycle.stats()``)."""
+
+    def __init__(self, router: "ShardRouter"):
+        self._router = router
+
+    def _cycles(self) -> list:
+        return [
+            rep.extender.cycle
+            for rep in self._router.replicas
+            if rep.extender.cycle is not None
+        ]
+
+    @property
+    def cycles(self) -> int:
+        return sum(c.cycles for c in self._cycles())
+
+    def stats(self) -> dict[str, Any]:
+        per = [c.stats() for c in self._cycles()]
+        if not per:
+            return {"enabled": False}
+        summed = {
+            k: sum(p[k] for p in per)
+            for k in (
+                "cycles", "pods_planned", "queue_depth", "plans_live",
+                "assumes", "assume_undos", "fast_patches",
+                "fast_rebuilds", "gang_batches", "gang_batch_members",
+                "plan_hits", "plan_misses",
+            )
+        }
+        lookups = summed["plan_hits"] + summed["plan_misses"]
+        wall_total = sum(
+            c.cycle_wall_total for c in self._cycles()
+        )
+        summed.update({
+            "enabled": True,
+            "replicas": len(per),
+            "plan_hit_ratio": (round(summed["plan_hits"] / lookups, 4)
+                               if lookups else None),
+            "plan_ms_per_pod": (
+                round(1000 * wall_total / summed["pods_planned"], 4)
+                if summed["pods_planned"] else None
+            ),
+            "per_replica": {
+                self._router.replicas[i].name: {
+                    "pods_planned": p["pods_planned"],
+                    "cycles": p["cycles"],
+                    "plan_ms_per_pod": p["plan_ms_per_pod"],
+                }
+                for i, p in enumerate(per)
+            },
+        })
+        return summed
+
+
+class _MergedEvents:
+    """Event-journal rollup over the replicas (scenario result code
+    reads ``counts_by_reason``; the harness calls ``close``)."""
+
+    def __init__(self, router: "ShardRouter"):
+        self._router = router
+
+    def counts_by_reason(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for rep in self._router.replicas:
+            for reason, n in rep.extender.events.counts_by_reason().items():
+                out[reason] = out.get(reason, 0) + n
+        return out
+
+    def emit(self, *args, **kwargs) -> None:
+        # router-level events land on replica 0's journal (the
+        # rendezvous coordinator's channel)
+        self._router.replicas[0].extender.events.emit(*args, **kwargs)
+
+    def close(self) -> None:
+        for rep in self._router.replicas:
+            rep.extender.events.close()
+
+
+class ShardRouter:
+    """N planner replicas behind one decision surface (see module
+    docstring). With ``planner_replicas == 1`` every entry point
+    delegates verbatim to the sole Extender — the parity gate."""
+
+    def __init__(self, config: TpuKubeConfig, clock=None):
+        n = config.planner_replicas
+        if n < 1:
+            raise ShardError("planner_replicas must be >= 1")
+        self.config = config
+        from tpukube.core.clock import SYSTEM
+
+        self.clock = clock if clock is not None else SYSTEM
+        #: ONE eviction bus across replicas, so the harness's / the
+        #: daemon's single EvictionExecutor drains every shard's
+        #: rollback and preemption victims
+        self.pending_evictions: deque[str] = deque()
+        self.replicas: list[PlannerReplica] = []
+        self._replica_cfgs: list[TpuKubeConfig] = []
+        for i in range(n):
+            rcfg = config
+            if n > 1 and config.journal_enabled:
+                # per-replica journal segment: each shard's WAL +
+                # checkpoints cover exactly its own slice partition
+                rcfg = dc_replace(
+                    config, journal_path=f"{config.journal_path}.r{i}"
+                )
+            self._replica_cfgs.append(rcfg)
+            self.replicas.append(PlannerReplica(i, Extender(
+                rcfg, clock=clock,
+                eviction_sink=self.pending_evictions,
+            )))
+        self._n = n
+        # N=1 parity gate: every entry point delegates VERBATIM to the
+        # sole replica's Extender (same objects, same code path)
+        self._sole = self.replicas[0].extender if n == 1 else None
+        # router maps only (replica state lives behind each replica's
+        # own locks; this leaf lock never nests around them on the
+        # mutation path — routing reads replica state lock-free
+        # through the epoch-cached snapshots)
+        self._lock = threading.RLock()
+        self._slice_replica: dict[str, int] = {}
+        self._node_replica: dict[str, int] = {}
+        self._pod_replica: dict[str, int] = {}
+        self._gang_replica: dict[tuple[str, str], int] = {}
+        self._dcn: dict[tuple[str, str], _Rendezvous] = {}
+        # driver-admitted pods whose owner replica found them
+        # unschedulable: attempt counts rotate the next admit to the
+        # following replica (the webhook path spills over inline; the
+        # admit path has no answer to spill on). Entries retire at
+        # bind/release.
+        self._pod_attempts: dict[str, int] = {}
+        # last scheduling-clock instant the rendezvous janitor ran
+        # from the gang-routing path (throttle; see _route_gang)
+        self._swept_at: Optional[float] = None
+        # rendezvous aborted while participants were unreachable:
+        # key -> the replica indices that could NOT be dissolved at
+        # abort time. A healed/restarted participant still on the list
+        # has its leftover fragment dissolved (even a locally-committed
+        # one — death is all-or-nothing), then leaves the list; the
+        # key retires when the list empties. Scoping the sentence to
+        # the EXACT unreachable replicas means a same-named gang
+        # re-created meanwhile on other replicas is never touched.
+        self._aborted_dcn: dict[tuple[str, str], set[int]] = {}
+        # counters (per-replica metrics/statusz)
+        self.rendezvous_prepared = 0
+        self.rendezvous_committed = 0
+        self.rendezvous_aborted = 0
+        self.state = _FederatedState(self)
+        self.cycle = (_RouterCycle(self)
+                      if config.batch_enabled else None)
+        self.events = _MergedEvents(self)
+        self.trace = None
+        self.journal = None
+        self.decisions = None
+
+    # -- Extender-surface passthroughs --------------------------------------
+    @property
+    def evict_precheck(self):
+        return self.replicas[0].extender.evict_precheck
+
+    @evict_precheck.setter
+    def evict_precheck(self, fn) -> None:
+        for rep in self.replicas:
+            rep.extender.evict_precheck = fn
+
+    @property
+    def binder(self):
+        return self.replicas[0].extender.binder
+
+    @binder.setter
+    def binder(self, fn) -> None:
+        for rep in self.replicas:
+            rep.extender.binder = fn
+
+    @property
+    def degraded_gate(self):
+        return self.replicas[0].extender.degraded_gate
+
+    @degraded_gate.setter
+    def degraded_gate(self, fn) -> None:
+        for rep in self.replicas:
+            rep.extender.degraded_gate = fn
+
+    @property
+    def latencies(self) -> dict[str, list[float]]:
+        """Merged webhook-latency windows (quantile feeds)."""
+        out: dict[str, list[float]] = {}
+        for rep in self.replicas:
+            for handler, window in rep.extender.latencies.items():
+                out.setdefault(handler, []).extend(window)
+        return out
+
+    @property
+    def preemptions(self) -> int:
+        return sum(r.extender.preemptions for r in self.replicas)
+
+    @property
+    def binds_total(self) -> int:
+        return sum(r.extender.binds_total for r in self.replicas)
+
+    def gang_snapshot(self) -> list[dict[str, Any]]:
+        out: list[dict[str, Any]] = []
+        for rep in self.replicas:
+            if rep.killed:
+                continue  # a dead shard's reservations died with it
+            out.extend(rep.extender.gang_snapshot())
+        return sorted(out, key=lambda g: (g["namespace"], g["group"]))
+
+    def alloc_snapshot(self) -> list[dict[str, Any]]:
+        out: list[dict[str, Any]] = []
+        for rep in self.replicas:
+            if rep.killed:
+                continue
+            out.extend(rep.extender.alloc_snapshot())
+        return sorted(out, key=lambda a: a["pod"])
+
+    def audit_stats(self) -> dict[str, Any]:
+        """Summed snapshot-audit sentinel counters across replicas."""
+        rate = max(
+            (r.extender.snapshots.audit_rate for r in self.replicas),
+            default=0.0,
+        )
+        return {
+            "rate": rate,
+            "checks": sum(r.extender.snapshots.audit_checks
+                          for r in self.replicas),
+            "divergences": sum(r.extender.snapshots.audit_divergences
+                               for r in self.replicas),
+        }
+
+    def statusz(self) -> dict[str, Any]:
+        """The router's /statusz section: topology + rendezvous state +
+        one summary row per replica (the per-replica observability leg
+        of the sharded plane; each replica's full extender_statusz
+        stays available on its own listener in a real deployment)."""
+        with self._lock:
+            rendezvous = [
+                {
+                    "gang": f"{key[0]}/{key[1]}",
+                    "committed": rdv.committed,
+                    "parts": {
+                        self.replicas[idx].name: {
+                            sid: len(coords)
+                            for sid, coords in parts.items()
+                        }
+                        for idx, parts in rdv.parts.items()
+                    },
+                }
+                for key, rdv in sorted(self._dcn.items())
+            ]
+            slice_map = {
+                sid: self.replicas[idx].name
+                for sid, idx in sorted(self._slice_replica.items())
+            }
+        per_replica = []
+        for rep in self.replicas:
+            ext = rep.extender
+            st = ext.state
+            used = total = 0
+            for sid in st.slice_ids():
+                u, t = st.slice_share_counts(sid)
+                used += u
+                total += t
+            per_replica.append({
+                "replica": rep.name,
+                "alive": rep.alive,
+                "slices": st.slice_ids(),
+                "nodes": len(st.node_names()),
+                "allocs": len(st.allocations()),
+                "pods_routed": rep.pods_routed,
+                "binds_total": ext.binds_total,
+                "utilization": round(used / total, 4) if total else 0.0,
+                "queue_depth": (ext.cycle.queue_depth()
+                                if ext.cycle is not None else 0),
+                "snapshot_hits": ext.snapshots.hits,
+                "snapshot_rebuilds": ext.snapshots.rebuilds,
+            })
+        return {
+            "replicas": per_replica,
+            "slice_assignment": slice_map,
+            "rendezvous": {
+                "live": rendezvous,
+                "prepared": self.rendezvous_prepared,
+                "committed": self.rendezvous_committed,
+                "aborted": self.rendezvous_aborted,
+            },
+        }
+
+    # -- slice / node / pod assignment --------------------------------------
+    def _slice_of_payload(self, annotations: dict[str, str]) -> Optional[str]:
+        payload = annotations.get(codec.ANNO_NODE_TOPOLOGY)
+        if not payload:
+            return None
+        try:
+            obj = json.loads(payload)
+        except (TypeError, ValueError):
+            return None
+        sid = obj.get("slice")
+        return sid if isinstance(sid, str) and sid else None
+
+    def _assign_slice_locked(self, sid: str) -> int:
+        """Deterministic least-loaded slice→replica assignment: a new
+        slice goes to the replica owning the fewest slices (ties break
+        on index), so a fleet whose slices register in sorted order —
+        the sim and any annotation-synced cluster — balances exactly.
+        Recorded in the router map; a production deployment pins the
+        same assignment in per-replica config."""
+        idx = self._slice_replica.get(sid)
+        if idx is None:
+            counts = [0] * self._n
+            for i in self._slice_replica.values():
+                counts[i] += 1
+            idx = min(range(self._n), key=lambda i: (counts[i], i))
+            self._slice_replica[sid] = idx
+            log.info("slice %s assigned to replica %s", sid,
+                     self.replicas[idx].name)
+        return idx
+
+    def _replica_for_node(
+        self, name: str, annotations: Optional[dict[str, str]] = None
+    ) -> Optional[int]:
+        with self._lock:
+            idx = self._node_replica.get(name)
+            if idx is not None:
+                return idx
+            if annotations is None:
+                return None
+            sid = self._slice_of_payload(annotations)
+            if sid is None:
+                return None
+            idx = self._assign_slice_locked(sid)
+            self._node_replica[name] = idx
+            return idx
+
+    def _alive(self) -> list[PlannerReplica]:
+        return [r for r in self.replicas if r.alive]
+
+    def _hash_replica(self, pod_key: str) -> int:
+        return zlib.crc32(pod_key.encode("utf-8")) % self._n
+
+    def _pick_pod_replica(self, pod_key: str,
+                          attempts: Optional[int] = None) -> int:
+        """Stable hash with liveness fallback: the hash spreads the
+        burst plane uniformly; a dead primary falls over to the next
+        alive index. Spillover on a FULL primary: the webhook path
+        retries the other replicas inline (filter answers), the admit
+        path rotates by the pod's recorded failed-plan attempts
+        (pass ``attempts`` pre-read to save a lock round-trip on the
+        driver hot path — there is ONE rotation policy, not two)."""
+        if attempts is None:
+            with self._lock:
+                attempts = self._pod_attempts.get(pod_key, 0)
+        primary = self._hash_replica(pod_key) + attempts
+        for off in range(self._n):
+            idx = (primary + off) % self._n
+            if self.replicas[idx].alive:
+                return idx
+        raise ShardError("no alive planner replica")
+
+    # -- node partitioning for webhook bodies --------------------------------
+    def _partition_nodes(
+        self, nodes: list[dict[str, Any]]
+    ) -> dict[int, list[dict[str, Any]]]:
+        """Split a raw-node webhook body per owning replica (unknown
+        names — nodes never annotated — are dropped from every part).
+        Only the RAW mode partitions: a replica must never ingest
+        another shard's node objects. Names-only bodies forward
+        verbatim — the target replica answers its own nodes and
+        reports the rest infeasible, which is both correct and O(1)
+        under plan-served filter answers (re-partitioning 10k names
+        per webhook was a measured router tax)."""
+        parts: dict[int, list[dict[str, Any]]] = {}
+        for obj in nodes:
+            name, annotations = kube.node_name_and_annotations(obj)
+            idx = self._replica_for_node(name, annotations)
+            if idx is None:
+                continue
+            parts.setdefault(idx, []).append(obj)
+        return parts
+
+    # -- gang routing + two-phase rendezvous ---------------------------------
+    def _gang_chips(self, pod: PodInfo) -> Optional[tuple[int, int]]:
+        """(chips_per_pod, total chips) for a gang pod, None when the
+        request is malformed (the home replica reports the schema
+        error exactly as the unsharded path would)."""
+        try:
+            ask = Extender.device_request(pod)
+        except ExtenderError:
+            return None  # the routed replica reports the schema error
+        if ask is None or pod.group is None:
+            return None
+        return ask[1], ask[1] * pod.group.min_member
+
+    def _replica_fits_gang(self, rep: PlannerReplica, pod: PodInfo,
+                           total: int) -> bool:
+        """Can this replica host the gang ICI-contiguously in ONE of
+        its slices? Same search ``ensure_reservation`` runs — against
+        the replica's epoch-cached snapshot, so the sweep this builds
+        is the sweep the reservation reuses."""
+        snap = rep.extender.snapshots.current()
+        shape = pod.group.shape if pod.group is not None else None
+        for sid in snap.slice_ids():
+            ss = snap.slice(sid)
+            if ss.blocked_free_chips < total:
+                continue
+            coords = slicefit.find_slice_in(
+                ss.blocked_sweep(),
+                count=None if shape is not None else total,
+                shape=shape,
+                broken=ss.broken,
+            )
+            if coords is not None:
+                return True
+        return False
+
+    def _route_gang(self, pod: PodInfo) -> int:
+        """The gang pod's target replica: its rendezvous participant
+        with room, its established home, or — for a new gang — the
+        first replica that fits it whole; a gang that fits nowhere and
+        opted into DCN gets the two-phase rendezvous. Falls back to
+        the emptiest alive replica so error answers (config mistakes,
+        genuinely unschedulable gangs) come from a deterministic
+        place."""
+        assert pod.group is not None
+        key = (pod.namespace, pod.group.name)
+        # the janitor runs at most once per scheduling-clock instant:
+        # a 512-member gang admitted in one batch (one FakeClock tick,
+        # one webhook burst) must not pay 512 full rendezvous sweeps —
+        # plan_pending() additionally sweeps once per drive
+        now = self.clock.monotonic()
+        if now != self._swept_at:
+            self._swept_at = now
+            self.sweep()
+        with self._lock:
+            rdv = self._dcn.get(key)
+        if rdv is not None:
+            idx = self._rendezvous_member_target(rdv, pod)
+            if idx is not None:
+                return idx
+            # every part full: overflow replica — any participant
+            # answers it as a normal pod (assignable() is False there)
+            for idx in rdv.parts:
+                if self.replicas[idx].alive:
+                    return idx
+        with self._lock:
+            home = self._gang_replica.get(key)
+        if home is not None and self.replicas[home].alive \
+                and self.replicas[home].extender.gang.reservation(
+                    *key) is not None:
+            # sticky only while the home actually HOLDS a reservation:
+            # a gang that transiently fit nowhere must re-probe the
+            # whole fleet (and the rendezvous) on every retry, not
+            # stay pinned to whichever replica owned the error answer
+            return home
+        ask = self._gang_chips(pod)
+        ranked = sorted(
+            self._alive(),
+            key=lambda r: (self.state_utilization_of(r), r.index),
+        )
+        if not ranked:
+            raise ShardError("no alive planner replica")
+        if home is not None and self.replicas[home].alive:
+            # prefer the previous home when it still fits — re-probing
+            # must not flip a mid-reserve gang between replicas
+            ranked.sort(key=lambda r: r.index != home)
+        if ask is not None:
+            cpp, total = ask
+            for rep in ranked:
+                if self._replica_fits_gang(rep, pod, total):
+                    with self._lock:
+                        self._gang_replica[key] = rep.index
+                    return rep.index
+            if pod.group.allow_dcn and pod.group.shape is None \
+                    and self._n > 1:
+                rdv = self._prepare_rendezvous(pod, cpp, total)
+                if rdv is not None:
+                    idx = self._rendezvous_member_target(rdv, pod)
+                    if idx is not None:
+                        return idx
+        # nothing fits anywhere (or the request is malformed): the
+        # emptiest replica owns the error answer; NOT recorded as a
+        # sticky home — the next retry re-probes a changed fleet
+        return ranked[0].index
+
+    def state_utilization_of(self, rep: PlannerReplica) -> float:
+        """One replica's used-share fraction off its cached snapshot
+        (O(slices) — never a ledger walk on the routing path)."""
+        snap = rep.extender.snapshots.current()
+        used = total = 0
+        for sid in snap.slice_ids():
+            ss = snap.slice(sid)
+            used += ss.used_shares
+            total += ss.total_shares
+        return used / total if total else 0.0
+
+    def _rendezvous_member_target(
+        self, rdv: _Rendezvous, pod: PodInfo
+    ) -> Optional[int]:
+        """The participant replica this member filters, scores, AND
+        binds on: sticky per pod (every webhook of one member must
+        land on the part holding its chips), parts filling in
+        replica-index order, each capped at its local quorum — the
+        driver path admits every member before any binds, so the
+        reservation's own room cannot spread them."""
+        with self._lock:
+            idx = rdv.member_target.get(pod.key())
+            if idx is not None and self.replicas[idx].alive:
+                return idx
+            routed: dict[int, int] = {}
+            for i in rdv.member_target.values():
+                routed[i] = routed.get(i, 0) + 1
+            for i in sorted(rdv.parts):
+                if not self.replicas[i].alive:
+                    continue
+                if routed.get(i, 0) < rdv.local_min.get(i, 0):
+                    rdv.member_target[pod.key()] = i
+                    return i
+        return None
+
+    def _prepare_rendezvous(
+        self, pod: PodInfo, cpp: int, total: int
+    ) -> Optional[_Rendezvous]:
+        """Phases 1+2 of the rendezvous (see module docstring): plan
+        per-replica contiguous parts greedily, PREPARE each part as a
+        local reservation, and commit the rendezvous record — or abort
+        every prepared part on the first failure. None = the fleet
+        cannot cover the gang; the caller serves the home replica's
+        no-slice error and the scheduler retries later."""
+        assert pod.group is not None
+        key = (pod.namespace, pod.group.name)
+        # PLAN: greedy over (replica, slice) by emptiness — one box per
+        # slice, each a multiple of chips_per_pod, largest first (the
+        # cross-replica mirror of GangManager._plan_dcn_split)
+        candidates: list[tuple[float, str, int, Any]] = []
+        for rep in self._alive():
+            snap = rep.extender.snapshots.current()
+            for sid in snap.slice_ids():
+                ss = snap.slice(sid)
+                candidates.append((ss.utilization, sid, rep.index, ss))
+        candidates.sort(key=lambda c: (c[0], c[1]))
+        parts: dict[int, dict[str, list[TopologyCoord]]] = {}
+        remaining = total
+        for _, sid, idx, ss in candidates:
+            if remaining == 0:
+                break
+            vol = min(remaining, (ss.blocked_free_chips // cpp) * cpp)
+            while vol >= cpp:
+                coords = slicefit.find_slice_in(
+                    ss.blocked_sweep(), count=vol, broken=ss.broken
+                )
+                if coords is not None:
+                    parts.setdefault(idx, {})[sid] = list(coords)
+                    remaining -= len(coords)
+                    break
+                vol -= cpp
+        if remaining != 0 or len(parts) < 2:
+            # len(parts) < 2 cannot happen when every single replica
+            # already failed the whole-gang fit — defensive: a
+            # one-replica "rendezvous" is just that replica's own
+            # _plan_dcn_split, which its ensure_reservation will run
+            return None
+        # PREPARE each part under its replica's own locks; roll back
+        # every prepared part on the first failure (no members have
+        # bound, so drop_reservation — not dissolve — is the abort)
+        prepared: list[int] = []
+        local_min: dict[int, int] = {}
+        for idx in sorted(parts):
+            rep = self.replicas[idx]
+            members = sum(len(c) for c in parts[idx].values()) // cpp
+            local_min[idx] = members
+            local_pod = dc_replace(pod, group=PodGroup(
+                name=pod.group.name, min_member=members,
+                shape=None, allow_dcn=True,
+            ))
+            try:
+                rep.extender.gang.reserve_exact_split(
+                    local_pod, cpp, parts[idx]
+                )
+            except Exception as e:
+                # any prepare failure aborts every prepared part (no
+                # members have bound, so drop — not dissolve); only
+                # the EXPECTED races (box re-occupied, slice gone)
+                # degrade to "retry next cycle" — anything else is a
+                # bug and re-raises after the abort
+                log.warning(
+                    "rendezvous %s/%s: prepare on %s failed (%s); "
+                    "aborting %d prepared part(s)",
+                    key[0], key[1], rep.name, e, len(prepared),
+                )
+                for pidx in prepared:
+                    self.replicas[pidx].extender.gang.drop_reservation(
+                        key
+                    )
+                with self._lock:
+                    self.rendezvous_aborted += 1
+                if not isinstance(e, (GangError, StateError)):
+                    raise
+                return None
+            prepared.append(idx)
+        rdv = _Rendezvous(key, parts, local_min,
+                          created=self.clock.monotonic())
+        with self._lock:
+            self._dcn[key] = rdv
+            self.rendezvous_prepared += 1
+        self.events.emit(
+            "GangReserved", obj=f"gang/{key[0]}/{key[1]}",
+            message=(
+                f"two-phase rendezvous prepared: {total} chips over "
+                f"{sum(len(p) for p in parts.values())} slice part(s) "
+                f"on {len(parts)} replica(s)"
+            ),
+        )
+        log.info(
+            "rendezvous %s/%s prepared: %d chips over replicas %s",
+            key[0], key[1], total,
+            {self.replicas[i].name: sorted(p) for i, p in parts.items()},
+        )
+        return rdv
+
+    def sweep(self) -> list[tuple[str, str]]:
+        """The rendezvous janitor (phase 3's abort half), run at the
+        top of every gang routing and every batch drive: sweep each
+        participant's local TTL/fault janitor, then enforce
+        all-or-nothing — an uncommitted rendezvous that lost ANY part
+        (TTL rollback, fault, replica killed/partitioned) dissolves
+        its surviving parts, evicting their bound members through the
+        shared eviction bus. A COMMITTED rendezvous tolerates a dead
+        replica: its part is durable in pod annotations and restores
+        with the replica. Returns the aborted gang keys."""
+        aborted: list[tuple[str, str]] = []
+        with self._lock:
+            live = list(self._dcn.items())
+        for key, rdv in live:
+            held: list[tuple[int, Any]] = []
+            lost = False
+            for idx in rdv.parts:
+                rep = self.replicas[idx]
+                if not rep.alive:
+                    if not rdv.committed:
+                        lost = True
+                    continue
+                rep.extender.gang.sweep()
+                res = rep.extender.gang.reservation(*key)
+                if res is None:
+                    lost = True
+                else:
+                    held.append((idx, res))
+            if not rdv.committed and held and not lost \
+                    and all(res.committed for _, res in held) \
+                    and len(held) == len(rdv.parts):
+                self._check_rendezvous_commit(rdv)
+                continue
+            if lost and not rdv.committed:
+                for idx, _res in held:
+                    self.replicas[idx].extender.gang.dissolve(key)
+                unreachable = {
+                    idx for idx in rdv.parts
+                    if not self.replicas[idx].alive
+                }
+                with self._lock:
+                    self._dcn.pop(key, None)
+                    self._gang_replica.pop(key, None)
+                    if unreachable:
+                        self._aborted_dcn.setdefault(
+                            key, set()).update(unreachable)
+                    self.rendezvous_aborted += 1
+                aborted.append(key)
+                self.events.emit(
+                    "GangRollback", obj=f"gang/{key[0]}/{key[1]}",
+                    message=(
+                        "rendezvous aborted: a part was lost before "
+                        "commit (TTL/fault/replica down); surviving "
+                        "parts dissolved all-or-nothing"
+                    ), type="Warning",
+                )
+                log.warning("rendezvous %s/%s aborted (part lost "
+                            "pre-commit)", key[0], key[1])
+            elif not held and rdv.committed and all(
+                self.replicas[idx].alive for idx in rdv.parts
+            ):
+                # every part released naturally (members finished):
+                # the rendezvous record retires
+                with self._lock:
+                    self._dcn.pop(key, None)
+                    self._gang_replica.pop(key, None)
+        # retire gang-home entries whose reservation is gone (the gang
+        # completed or rolled back): routing already re-probes on a
+        # missing reservation, so this is purely the memory bound —
+        # unbounded unique gang names must not grow the map forever
+        with self._lock:
+            homes = [(k, i) for k, i in self._gang_replica.items()
+                     if k not in self._dcn]
+        for key, idx in homes:
+            rep = self.replicas[idx]
+            if rep.alive \
+                    and rep.extender.gang.reservation(*key) is None:
+                with self._lock:
+                    if self._gang_replica.get(key) == idx \
+                            and key not in self._dcn:
+                        self._gang_replica.pop(key, None)
+        return aborted
+
+    # -- the decision surface -------------------------------------------------
+    def handle(self, kind: str, body: Any) -> Any:
+        if self._sole is not None:
+            return self._sole.handle(kind, body)
+        if kind in ("filter", "prioritize"):
+            return self._handle_scoring(kind, body)
+        if kind == "bind":
+            return self._handle_bind(body)
+        if kind == "release":
+            return self._handle_release(body)
+        if kind == "victim_gone":
+            cleared = False
+            for rep in self._alive():
+                out = rep.extender.handle(kind, body)
+                cleared = cleared or bool(out.get("cleared"))
+            return {"cleared": cleared}
+        if kind == "reconcile":
+            changed = False
+            for rep in self._alive():
+                if rep.extender.state.allocation(body["pod_key"]) is None:
+                    continue
+                out = rep.extender.handle(kind, body)
+                changed = changed or bool(out.get("changed"))
+            return {"changed": changed}
+        if kind == "upsert_node":
+            idx = self._replica_for_node(
+                body["name"], dict(body.get("annotations") or {})
+            )
+            if idx is None:
+                return {"ours": False}
+            if not self.replicas[idx].alive:
+                return {"error": f"replica {self.replicas[idx].name} "
+                                 f"unavailable"}
+            return self.replicas[idx].extender.handle(kind, body)
+        raise ValueError(f"unknown decision kind {kind!r}")
+
+    def _handle_release(self, body: Any) -> Any:
+        pod_key = body["pod_key"]
+        with self._lock:
+            idx = self._pod_replica.pop(pod_key, None)
+            self._pod_attempts.pop(pod_key, None)
+        targets = (
+            [self.replicas[idx]] if idx is not None
+            else list(self.replicas)
+        )
+        for rep in targets:
+            if not rep.alive:
+                # a dead replica's release is lost exactly like a real
+                # crashed daemon's: the restart rebuild (killed) or the
+                # post-heal lifecycle resync (partitioned) re-converges
+                # against the pod store
+                continue
+            rep.extender.handle("release", {"pod_key": pod_key})
+        return None
+
+    def _handle_scoring(self, kind: str, body: Any) -> Any:
+        pod, nodes, names = kube.parse_extender_args(body)
+        parts: Optional[dict[int, list]] = None
+        if nodes is not None:
+            parts = self._partition_nodes(nodes)
+            # every owning replica ingests its node objects NOW (the
+            # webhook is how topology reaches the caches; only the
+            # target replica gets the scoring call, but a later
+            # spillover to another replica must find its nodes known).
+            # payload_matches makes the unchanged-resend case cheap.
+            for idx, pnodes in parts.items():
+                rep = self.replicas[idx]
+                if not rep.alive:
+                    continue
+                for obj in pnodes:
+                    name, annotations = kube.node_name_and_annotations(
+                        obj
+                    )
+                    try:
+                        rep.extender.state.upsert_node(name, annotations)
+                    except Exception:
+                        log.exception("node %s rejected by %s at "
+                                      "ingest", name, rep.name)
+        bad_ask = False
+        try:
+            ask = Extender.device_request(pod)
+        except ExtenderError:
+            # malformed request (e.g. both TPU and vTPU asked): MUST
+            # route to a replica so its handler reports the schema
+            # error exactly like the unsharded planner — the non-TPU
+            # fast exit below would silently answer it feasible
+            # everywhere
+            ask = None
+            bad_ask = True
+        if ask is None and pod.group is None and not bad_ask:
+            # non-TPU pod: feasible everywhere, tracked nowhere — no
+            # replica needs to see it (matches the unsharded fast exit)
+            if kind == "prioritize":
+                return kube.host_priority_list(
+                    {n: 0 for n in (names or [])}
+                )
+            if nodes is not None:
+                return kube.filter_result(list(nodes), {})
+            return kube.filter_result_names(list(names or []), {})
+        if pod.group is not None:
+            idx = self._route_gang(pod)
+        else:
+            with self._lock:
+                idx = self._pod_replica.get(pod.key())
+            if idx is None or not self.replicas[idx].alive:
+                idx = self._pick_pod_replica(pod.key())
+        return self._score_on(kind, body, pod, parts, idx)
+
+    @staticmethod
+    def _sub_body(body: Any, parts: Optional[dict[int, list]],
+                  idx: int) -> dict:
+        """The body replica ``idx`` sees: its own node objects in raw
+        mode; the verbatim body otherwise (a names-only replica
+        answers foreign names infeasible on its own — correct, and
+        O(1) under plan-served answers)."""
+        if parts is None:
+            return body
+        sub = dict(body)
+        sub["Nodes"] = {"Items": parts.get(idx, [])}
+        sub.pop("NodeNames", None)
+        return sub
+
+    def _score_on(self, kind: str, body: Any, pod: PodInfo,
+                  parts: Optional[dict[int, list]], idx: int) -> Any:
+        """Forward a filter/prioritize to replica ``idx``. For a
+        non-gang filter, spill over to the other alive replicas
+        (emptiest first) when the target answers nothing feasible —
+        slice affinity routes, the fleet answers. Nodes on other
+        shards simply stay out of the feasible set (the upstream
+        protocol prunes whatever the answer omits)."""
+        def spill_order():
+            # built lazily: the common primary-feasible case must not
+            # pay O(replicas x slices) utilization reads per webhook
+            yield idx
+            if kind != "filter" or pod.group is not None:
+                return
+            for r in sorted(
+                self._alive(),
+                key=lambda r: (self.state_utilization_of(r), r.index),
+            ):
+                if r.index != idx:
+                    yield r.index
+
+        last_out: Any = None
+        for i in spill_order():
+            rep = self.replicas[i]
+            if not rep.alive or (parts is not None and i not in parts):
+                continue
+            out = rep.extender.handle(
+                kind, self._sub_body(body, parts, i)
+            )
+            if kind == "prioritize":
+                return out  # scores for the target's own nodes
+            feasible_names = out.get("NodeNames") or []
+            last_out = out
+            if feasible_names and not out.get("Error"):
+                with self._lock:
+                    self._pod_replica[pod.key()] = i
+                rep.pods_routed += 1
+                return out
+        if last_out is not None:
+            return last_out
+        if kind == "prioritize":
+            return kube.host_priority_list({})
+        mk = (kube.filter_result if parts is not None
+              else kube.filter_result_names)
+        return mk([], {}, error="no alive planner replica owns any "
+                                "offered node")
+
+    def _handle_bind(self, body: Any) -> Any:
+        name, ns, uid, node = kube.parse_binding_args(body)
+        key = f"{ns}/{name}"
+        with self._lock:
+            idx = self._node_replica.get(node)
+            if idx is None:
+                idx = self._pod_replica.get(key)
+        if idx is None:
+            return kube.binding_result(
+                f"{key}: node {node} is owned by no planner replica"
+            )
+        rep = self.replicas[idx]
+        if not rep.alive:
+            return kube.binding_result(
+                f"{key}: replica {rep.name} unavailable (partitioned "
+                f"or restarting); scheduler will retry"
+            )
+        out = rep.extender.handle("bind", body)
+        if isinstance(out, dict) and not out.get("Error"):
+            with self._lock:
+                self._pod_replica[key] = idx
+                self._pod_attempts.pop(key, None)
+                rdv = next(
+                    (r for r in self._dcn.values()
+                     if key in r.member_target), None,
+                )
+            if rdv is not None:
+                self._globalize_gang_env(out, rdv)
+                # EAGER commit check at the bind that may have closed
+                # the last part's quorum: waiting for the next janitor
+                # sweep leaves a window where a replica killed after
+                # the final bind reads as "part lost pre-commit" and
+                # the janitor dissolves a fully-committed gang
+                self._check_rendezvous_commit(rdv)
+        return out
+
+    def _check_rendezvous_commit(self, rdv: _Rendezvous) -> None:
+        """Flip the rendezvous to committed the moment every part's
+        local reservation is committed (idempotent; also run by the
+        janitor sweep for the webhook-paced path)."""
+        if rdv.committed:
+            return
+        for idx in rdv.parts:
+            rep = self.replicas[idx]
+            if not rep.alive:
+                return
+            res = rep.extender.gang.reservation(*rdv.key)
+            if res is None or not res.committed:
+                return
+        rdv.committed = True
+        with self._lock:
+            self.rendezvous_committed += 1
+        self.events.emit(
+            "GangCommitted", obj=f"gang/{rdv.key[0]}/{rdv.key[1]}",
+            message=(f"rendezvous committed: all {len(rdv.parts)} "
+                     f"parts assembled"),
+        )
+
+    def _globalize_gang_env(self, out: dict, rdv: _Rendezvous) -> None:
+        """A rendezvous member's bind answer carries the TPU_KUBE_GANG_*
+        env of its LOCAL part (the replica only knows its own slices);
+        rewrite the annotation to the GLOBAL rendezvous topology so the
+        in-pod runtime forms the full multislice collective — the same
+        contract a single-planner DCN gang's bind stamps."""
+        from tpukube.device.tpu import (
+            ENV_GANG_NUM_SLICES,
+            ENV_GANG_SLICE_INDEX,
+            ENV_GANG_SLICES,
+        )
+
+        payload = (out.get("Annotations") or {}).get(codec.ANNO_ALLOC)
+        if not payload:
+            return
+        try:
+            alloc = codec.decode_alloc(payload)
+        except codec.CodecError:
+            return
+        # the pod's OWN slice comes from its local index into the
+        # part's local slice list — a part may span several slices,
+        # so the first local slice is NOT every member's slice
+        local_sids = [s for s in
+                      alloc.env.get(ENV_GANG_SLICES, "").split(",") if s]
+        try:
+            local_idx = int(alloc.env.get(ENV_GANG_SLICE_INDEX, ""))
+            local_sid = local_sids[local_idx]
+        except (ValueError, IndexError):
+            return
+        sids = sorted({
+            sid for parts in rdv.parts.values() for sid in parts
+        })
+        if local_sid not in sids:
+            return
+        env = dict(alloc.env)
+        env[ENV_GANG_NUM_SLICES] = str(len(sids))
+        env[ENV_GANG_SLICES] = ",".join(sids)
+        env[ENV_GANG_SLICE_INDEX] = str(sids.index(local_sid))
+        out["Annotations"][codec.ANNO_ALLOC] = codec.encode_alloc(
+            dc_replace(alloc, env=env)
+        )
+
+    # -- batch-driver surface -------------------------------------------------
+    def admit(self, pod: PodInfo) -> bool:
+        if self._sole is not None:
+            return self._sole.admit(pod)
+        key = pod.key()
+        if pod.group is not None:
+            idx = self._route_gang(pod)
+        else:
+            # one lock round-trip for the whole routing read (this is
+            # the per-pod driver hot path)
+            with self._lock:
+                idx = self._pod_replica.get(key)
+                attempts = self._pod_attempts.get(key, 0)
+            if idx is None or not self.replicas[idx].alive:
+                idx = self._pick_pod_replica(key, attempts)
+        rep = self.replicas[idx]
+        if not rep.alive:
+            return False
+        ok = rep.extender.admit(pod)
+        if ok:
+            with self._lock:
+                self._pod_replica[key] = idx
+            rep.pods_routed += 1
+        return ok
+
+    def plan_pending(self) -> int:
+        if self._sole is not None:
+            return self._sole.plan_pending()
+        self.sweep()
+        return sum(
+            rep.extender.plan_pending() for rep in self._alive()
+        )
+
+    def planned_node(self, pod_key: str) -> Optional[str]:
+        if self._sole is not None:
+            return self._sole.planned_node(pod_key)
+        with self._lock:
+            idx = self._pod_replica.get(pod_key)
+        if idx is not None and self.replicas[idx].alive:
+            node = self.replicas[idx].extender.planned_node(pod_key)
+            if node is not None:
+                return node
+            # plan failed or expired on the owner: release the
+            # affinity and bump the attempt count so the next admit
+            # rotates to another replica instead of re-queuing on the
+            # same full shard forever
+            with self._lock:
+                if self._pod_replica.get(pod_key) == idx:
+                    self._pod_replica.pop(pod_key, None)
+                self._pod_attempts[pod_key] = \
+                    self._pod_attempts.get(pod_key, 0) + 1
+            return None
+        for rep in self._alive():
+            node = rep.extender.planned_node(pod_key)
+            if node is not None:
+                return node
+        return None
+
+    def release(self, pod_key: str) -> None:
+        self.handle("release", {"pod_key": pod_key})
+
+    # -- restart / recovery ---------------------------------------------------
+    def rebuild_from_pods(self, pods: list[dict[str, str]]) -> int:
+        """Cold rebuild across the partition: pods route to the
+        replica owning their bound node; the pod-group annotations of
+        a COMMITTED DCN-rendezvous gang (members spanning >1 replica,
+        quorum present) are rewritten to each part's LOCAL member
+        count so every part restores committed-verbatim — the
+        rendezvous record itself is then re-registered. A PARTIAL
+        DCN gang restores with its original annotations, so each part
+        rolls its members back: all-or-nothing in death, exactly the
+        single-planner restore contract."""
+        if self._sole is not None:
+            return self._sole.rebuild_from_pods(pods)
+        by_replica: dict[int, list[dict[str, str]]] = {}
+        gangs: dict[tuple[str, str], list[tuple[int, dict, Any]]] = {}
+        skipped = 0
+        for annotations in pods:
+            payload = annotations.get(codec.ANNO_ALLOC)
+            if not payload:
+                continue
+            try:
+                alloc = codec.decode_alloc(payload)
+            except codec.CodecError:
+                skipped += 1
+                continue
+            idx = self._replica_for_node(alloc.node_name)
+            if idx is None:
+                log.error("rebuild: %s bound to unmapped node %s; "
+                          "skipped", alloc.pod_key, alloc.node_name)
+                skipped += 1
+                continue
+            by_replica.setdefault(idx, []).append(annotations)
+            try:
+                group = codec.pod_group_from_annotations(annotations)
+            except codec.CodecError:
+                group = None
+            if group is not None:
+                ns = alloc.pod_key.split("/", 1)[0]
+                gangs.setdefault((ns, group.name), []).append(
+                    (idx, annotations, group)
+                )
+        rewrites: dict[tuple[str, str], dict[int, int]] = {}
+        for key, members in gangs.items():
+            replicas_of = {idx for idx, _, _ in members}
+            group = members[0][2]
+            if len(replicas_of) > 1 and len(members) >= group.min_member:
+                # committed DCN gang: each part restores by its LOCAL
+                # quorum (the full min_member would read as partial
+                # everywhere and roll a healthy gang back)
+                counts: dict[int, int] = {}
+                for idx, _, _ in members:
+                    counts[idx] = counts.get(idx, 0) + 1
+                rewrites[key] = counts
+                for idx, annotations, g in members:
+                    annotations.update(codec.pod_group_annotations(
+                        PodGroup(name=g.name,
+                                 min_member=counts[idx],
+                                 shape=None, allow_dcn=True)
+                    ))
+        restored = 0
+        for idx, plist in sorted(by_replica.items()):
+            restored += self.replicas[idx].extender.rebuild_from_pods(
+                plist
+            )
+            with self._lock:
+                for annotations in plist:
+                    payload = annotations.get(codec.ANNO_ALLOC)
+                    if payload:
+                        try:
+                            alloc = codec.decode_alloc(payload)
+                        except codec.CodecError:
+                            continue
+                        self._pod_replica[alloc.pod_key] = idx
+        for key, counts in rewrites.items():
+            parts: dict[int, dict[str, list[TopologyCoord]]] = {}
+            for idx in counts:
+                res = self.replicas[idx].extender.gang.reservation(*key)
+                if res is not None:
+                    parts[idx] = {
+                        sid: sorted(coords)
+                        for sid, coords in res.slice_coords.items()
+                    }
+            if len(parts) > 1:
+                rdv = _Rendezvous(
+                    key, parts,
+                    {idx: counts[idx] for idx in parts},
+                    created=self.clock.monotonic(),
+                )
+                rdv.committed = True
+                with self._lock:
+                    self._dcn[key] = rdv
+        return restored
+
+    def replica_pods(self, idx: int,
+                     pods: dict[str, dict[str, Any]]) -> list[dict]:
+        """The pod store entries bound to replica ``idx``'s nodes (the
+        harness's per-replica restart feed)."""
+        out = []
+        with self._lock:
+            owned = {n for n, i in self._node_replica.items()
+                     if i == idx}
+        for pod in pods.values():
+            node = (pod.get("spec") or {}).get("nodeName")
+            if node in owned:
+                out.append(pod)
+        return out
+
+    def kill_replica(self, idx: int) -> None:
+        """Model replica process death: everything in-memory on the
+        shard — ledger, reservations, queue, plans — is gone; nothing
+        is flushed. The router keeps routing around it, the federated
+        read views stop serving the corpse's ledger (``killed``), and
+        the rendezvous janitor aborts any uncommitted rendezvous
+        holding a part there."""
+        rep = self.replicas[idx]
+        rep.alive = False
+        rep.killed = True
+        if rep.extender.journal is not None:
+            rep.extender.journal.crash()
+        rep.extender.state.retire()
+
+    def partition_replica(self, idx: int) -> None:
+        """Model a network partition: the replica's state survives but
+        the router cannot reach it — scoring/bind answers route
+        around or fail retryably, and an uncommitted rendezvous part
+        there counts as lost (all-or-nothing abort)."""
+        self.replicas[idx].alive = False
+
+    def heal_replica(self, idx: int) -> None:
+        """End a partition: the replica serves again with the state it
+        kept — MINUS any fragment of a rendezvous the janitor aborted
+        while THIS replica was unreachable (a locally-complete part of
+        a dead gang must die all-or-nothing, not resurrect as a
+        fragment). The sentence is scoped to the exact replicas that
+        were unreachable at abort time, so a same-named gang
+        re-created meanwhile on other replicas is never touched.
+        Other reservations resolve through the normal janitors."""
+        rep = self.replicas[idx]
+        rep.alive = True
+        self._settle_aborted_parts(idx)
+
+    def _settle_aborted_parts(self, idx: int) -> None:
+        """Dissolve replica ``idx``'s leftover fragments of rendezvous
+        aborted while it was unreachable, and retire it from every
+        pending sentence (heal AND restart both come through here —
+        either way the replica's state is now reconciled)."""
+        rep = self.replicas[idx]
+        with self._lock:
+            owed = [key for key, pending in self._aborted_dcn.items()
+                    if idx in pending]
+        for key in owed:
+            if rep.extender.gang.reservation(*key) is not None:
+                log.warning(
+                    "replica %s returned holding part of aborted "
+                    "rendezvous %s/%s; dissolving", rep.name, *key,
+                )
+                rep.extender.gang.dissolve(key)
+        with self._lock:
+            for key in owed:
+                pending = self._aborted_dcn.get(key)
+                if pending is not None:
+                    pending.discard(idx)
+                    if not pending:
+                        self._aborted_dcn.pop(key, None)
+
+    def restart_replica(
+        self, idx: int,
+        node_annotations: list[tuple[str, dict[str, str]]],
+        pods: list[dict[str, str]],
+    ) -> int:
+        """Cold-restart one killed replica the way a restarted shard
+        daemon would: a fresh Extender, its nodes re-ingested, its
+        ledger + gang reservations rebuilt from pod annotations
+        (``rebuild_from_pods``), with live-rendezvous parts restored
+        by their LOCAL quorum. Returns allocations restored."""
+        old = self.replicas[idx]
+        ext = Extender(
+            self._replica_cfgs[idx], clock=self.clock,
+            eviction_sink=self.pending_evictions,
+        )
+        # every externally-wired hook survives the restart (a fresh
+        # daemon would be re-wired by its main; the router plays that
+        # role here) — dropping the degraded gate would let ONE
+        # restarted shard bind while the rest of the plane refuses
+        ext.evict_precheck = old.extender.evict_precheck
+        ext.binder = old.extender.binder
+        ext.degraded_gate = old.extender.degraded_gate
+        self.replicas[idx] = PlannerReplica(idx, ext)
+        rep = self.replicas[idx]
+        for name, annotations in node_annotations:
+            out = ext.handle("upsert_node", {
+                "name": name, "annotations": annotations,
+            })
+            if isinstance(out, dict) and out.get("error"):
+                log.error("restart r%d: node %s rejected: %s",
+                          idx, name, out["error"])
+        with self._lock:
+            live_rdv = {
+                key: rdv for key, rdv in self._dcn.items()
+                if idx in rdv.parts
+            }
+        plist: list[dict[str, str]] = []
+        for annotations in pods:
+            annotations = dict(annotations)
+            try:
+                group = codec.pod_group_from_annotations(annotations)
+            except codec.CodecError:
+                group = None
+            if group is not None:
+                # the rendezvous key is (namespace, group): an
+                # unrelated same-named gang in ANOTHER namespace must
+                # not have its quorum rewritten
+                ns = None
+                payload = annotations.get(codec.ANNO_ALLOC)
+                if payload:
+                    try:
+                        ns = codec.decode_alloc(payload).pod_key.split(
+                            "/", 1)[0]
+                    except codec.CodecError:
+                        ns = None
+                rdv = (live_rdv.get((ns, group.name))
+                       if ns is not None else None)
+                if rdv is not None:
+                    # this member belongs to a live rendezvous:
+                    # restore its part by the LOCAL quorum
+                    annotations.update(codec.pod_group_annotations(
+                        PodGroup(name=group.name,
+                                 min_member=rdv.local_min[idx],
+                                 shape=None, allow_dcn=True)
+                    ))
+            plist.append(annotations)
+        restored = ext.rebuild_from_pods(plist)
+        with self._lock:
+            for annotations in plist:
+                payload = annotations.get(codec.ANNO_ALLOC)
+                if payload:
+                    try:
+                        alloc = codec.decode_alloc(payload)
+                    except codec.CodecError:
+                        continue
+                    self._pod_replica[alloc.pod_key] = idx
+        rep.alive = True
+        # a restored fragment of a rendezvous aborted while this
+        # replica was down dies here (and the replica leaves the
+        # pending sentence); then reconcile the rendezvous records
+        # against what actually restored (an uncommitted part that
+        # could not re-complete rolled back inside restore(); the
+        # janitor then aborts the survivors — all-or-nothing)
+        self._settle_aborted_parts(idx)
+        self.sweep()
+        return restored
+
+    def shutdown(self) -> None:
+        """Close every replica's sinks (harness stop path)."""
+        for rep in self.replicas:
+            ext = rep.extender
+            if ext.trace is not None:
+                ext.trace.close()
+            ext.events.close()
+            if ext.journal is not None:
+                ext.journal.close()
+                ext.state.retire()

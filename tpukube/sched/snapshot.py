@@ -685,7 +685,7 @@ class SnapshotCache:
         concurrent mutations), so a moved epoch mid-check is a real
         divergence, not a race. Raises :class:`SnapshotAuditError`."""
         snap = self.current()
-        rebuilt = self._build(snap.key)
+        rebuilt = self._build(snap.key, audit=True)
         with self._lock:
             self.audit_checks += 1
         diffs = _audit_divergence(snap, rebuilt)
@@ -710,7 +710,7 @@ class SnapshotCache:
         if (self.audit_rate < 1.0
                 and self._audit_rng.random() >= self.audit_rate):
             return
-        rebuilt = self._build(snap.key)
+        rebuilt = self._build(snap.key, audit=True)
         if self.epoch_key() != snap.key:
             return  # raced a mutation: the cached epochs moved mid-audit
         with self._lock:
@@ -730,7 +730,8 @@ class SnapshotCache:
                 f"EPOCH_REGISTRY and the epoch-discipline lint)"
             )
 
-    def _build(self, key: tuple[int, int]) -> ClusterSnapshot:
+    def _build(self, key: tuple[int, int],
+               audit: bool = False) -> ClusterSnapshot:
         slices: dict[str, SliceSnapshot] = {}
         for sid in self._state.slice_ids():
             try:
@@ -742,10 +743,17 @@ class SnapshotCache:
                             sid, e)
                 continue
             used, total = self._state.slice_share_counts(sid)
+            # audit builds bypass the ledger's incremental occupied
+            # cache (walk_occupied_coords): the sentinel exists to
+            # catch seams that forgot their bookkeeping, so it must
+            # re-derive from the node views, never from a set that the
+            # same seams maintain
+            occupied = (self._state.walk_occupied_coords(sid) if audit
+                        else self._state.occupied_coords(sid))
             slices[sid] = SliceSnapshot(
                 slice_id=sid,
                 mesh=mesh,
-                occupied=frozenset(self._state.occupied_coords(sid)),
+                occupied=frozenset(occupied),
                 reserved=frozenset(self._gang.reserved_coords(sid)),
                 unhealthy=frozenset(self._state.unhealthy_coords(sid)),
                 terminating=frozenset(self._gang.terminating_coords(sid)),

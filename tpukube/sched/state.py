@@ -312,6 +312,20 @@ class ClusterState:
         # (sim crash/stop) — the background warmer must stop instead of
         # materializing an orphan's fleet against the live one's CPU
         self._retired = False
+        # Per-slice occupied-coord sets, maintained INCREMENTALLY at
+        # the same seams the snapshot deltas fire from (commit /
+        # release / health-only re-annotation / structural upsert), so
+        # a forced structural rebuild stops walking every view of the
+        # slice (ROADMAP O(fleet) item; at 10k nodes the walk was the
+        # rebuild's dominant term). A slice absent from the dict is
+        # UNSEEDED: the first occupied_coords() call derives the set
+        # with the full walk (materializing any lazy nodes of the
+        # slice, which pins the invariant that later lazy
+        # materializations only happen in unseeded slices) and seeds
+        # it. The audit sentinel deliberately bypasses this cache
+        # (walk_occupied_coords) so a seam that forgot BOTH its delta
+        # and its incremental update still cannot hide from the audit.
+        self._occ_cache: dict[str, set[TopologyCoord]] = {}
 
     def set_delta_sink(self, sink) -> None:
         """Attach the snapshot cache's delta log (None detaches)."""
@@ -625,6 +639,22 @@ class ClusterState:
                 # the node SET changed: the cached name tuple is stale
                 self._names_cache = None
             self._nodes[name] = view
+            # incremental occupied maintenance for the structural path:
+            # ONE node's old contribution leaves, its new one enters —
+            # O(chips-per-node), so the full-rebuild the marker below
+            # forces stops walking every OTHER view of the slice
+            occ_old = tuple(
+                c.coord for c in prev.info.chips
+                if c.health is not Health.HEALTHY
+                or prev.used_share_count(c.index) > 0
+            ) if prev is not None else ()
+            occ_new = tuple(
+                c.coord for c in info.chips
+                if c.health is not Health.HEALTHY
+                or view.used_share_count(c.index) > 0
+            )
+            self._occ_apply_locked(info.slice_id, add=occ_new,
+                                   remove=occ_old)
             self._epoch += 1
             # a STRUCTURALLY changed node payload may move links,
             # topology, or sharing mode — all structural for the
@@ -678,6 +708,8 @@ class ClusterState:
         view.share_counts = prev.share_counts
         view.id_weights = prev.id_weights
         self._nodes[name] = view
+        self._occ_apply_locked(info.slice_id, add=tuple(occupied_add),
+                               remove=tuple(occupied_remove))
         self._epoch += 1
         self._note_delta_locked(
             slice_id=info.slice_id,
@@ -783,19 +815,68 @@ class ClusterState:
             if slice_id is None or v.info.slice_id == slice_id
         ]
 
+    def _occ_apply_locked(self, slice_id: str,
+                          add: tuple = (), remove: tuple = ()) -> None:
+        """Advance the slice's incremental occupied set by the same
+        transition tuples the snapshot delta for this seam carries
+        (callers hold ``self._lock``). Unseeded slices stay unseeded —
+        the first reader pays the walk once."""
+        cached = self._occ_cache.get(slice_id)
+        if cached is None:
+            return
+        cached.difference_update(remove)
+        cached.update(add)
+
+    def _walk_occupied_locked(
+        self, slice_id: Optional[str]
+    ) -> set[TopologyCoord]:
+        """Derive a slice's occupied set the original way: walk every
+        view (callers hold ``self._lock``)."""
+        out: set[TopologyCoord] = set()
+        for view in self._slice_views_locked(slice_id):
+            for chip in view.info.chips:
+                if (
+                    chip.health is not Health.HEALTHY
+                    or view.used_share_count(chip.index) > 0
+                ):
+                    out.add(chip.coord)
+        return out
+
+    def walk_occupied_coords(
+        self, slice_id: Optional[str] = None
+    ) -> set[TopologyCoord]:
+        """``occupied_coords`` WITHOUT the incremental cache — the
+        audit sentinel's independent derivation (sched/snapshot.py
+        audit builds): a seam that forgot both its snapshot delta and
+        its incremental occupied update must still diverge loudly
+        against a ground-truth walk, so the audit never reads the very
+        cache it is meant to check."""
+        with self._lock:
+            return self._walk_occupied_locked(slice_id)
+
     def occupied_coords(self, slice_id: Optional[str] = None) -> set[TopologyCoord]:
         """Coords unusable for a whole-chip/gang placement: any chip with
-        used shares, plus unhealthy chips."""
+        used shares, plus unhealthy chips. Served from the per-slice
+        incremental set (seeded by one walk, then advanced at every
+        mutation seam) — the returned set is the caller's copy."""
         with self._lock:
-            out: set[TopologyCoord] = set()
-            for view in self._slice_views_locked(slice_id):
-                for chip in view.info.chips:
-                    if (
-                        chip.health is not Health.HEALTHY
-                        or view.used_share_count(chip.index) > 0
-                    ):
-                        out.add(chip.coord)
-            return out
+            sid = slice_id
+            if sid is None:
+                # the no-argument form serves single-slice clusters and
+                # raises on ambiguity (matching _slice_views_locked)
+                if len(self._slices) > 1:
+                    raise StateError(
+                        "coord sets are slice-local; pass slice_id on "
+                        f"a {len(self._slices)}-slice cluster"
+                    )
+                if not self._slices:
+                    return set()
+                sid = next(iter(self._slices))
+            cached = self._occ_cache.get(sid)
+            if cached is None:
+                cached = self._walk_occupied_locked(sid)
+                self._occ_cache[sid] = cached
+            return set(cached)
 
     def unhealthy_coords(self, slice_id: Optional[str] = None) -> set[TopologyCoord]:
         with self._lock:
@@ -907,6 +988,7 @@ class ClusterState:
             )
             view.add_ids(adding)
             self._allocs[alloc.pod_key] = alloc
+            self._occ_apply_locked(view.info.slice_id, add=newly_occupied)
             self._epoch += 1
             self._note_delta_locked(
                 slice_id=view.info.slice_id,
@@ -954,6 +1036,7 @@ class ClusterState:
                 if view.used_share_count(index) == 0
                 and view.chip(index).health is Health.HEALTHY
             )
+            self._occ_apply_locked(view.info.slice_id, remove=freed)
             self._epoch += 1
             self._note_delta_locked(
                 slice_id=view.info.slice_id,

@@ -264,13 +264,25 @@ class SimCluster:
                     name=name, chips=chips, shares_per_chip=shares,
                     slice_id=sid,
                 )
-        self.extender = Extender(self.config, clock=self.clock)
+        if self.config.planner_replicas > 1:
+            # Slice-partitioned control plane (sched/shard.py): N full
+            # planner replicas behind the router, each owning a
+            # disjoint slice set. The router speaks the Extender
+            # decision surface, so everything downstream (effectors,
+            # schedulers, chaos checkers) runs unchanged.
+            from tpukube.sched.shard import ShardRouter
+
+            self.extender: Any = ShardRouter(self.config,
+                                             clock=self.clock)
+        else:
+            self.extender = Extender(self.config, clock=self.clock)
         self.pods: dict[str, dict[str, Any]] = {}  # key -> pod object
         # stats of the last restart_extender() recovery (None before)
         self.last_recovery: Optional[dict[str, Any]] = None
         self._store_api = self._make_store_api()
         self._wire_extender()
         self._node_obj_cache: dict[str, dict[str, Any]] = {}
+        self._node_objs_list: Optional[list[dict[str, Any]]] = None
         self._synced_objs: list[dict[str, Any]] = []  # see _extender_node_args
         self._port = _free_port()
         self._http: Optional[_AppThread] = None
@@ -331,6 +343,13 @@ class SimCluster:
     def start(self) -> None:
         if self._in_process:
             return  # webhooks dispatch straight into Extender.handle
+        if self.config.planner_replicas > 1:
+            raise RuntimeError(
+                "a sharded SimCluster (planner_replicas > 1) runs "
+                "in_process=True — the in-process router is the "
+                "sim/bench plane; production replicas serve as "
+                "separate extender daemons"
+            )
         try:
             self._http = _AppThread(make_app(self.extender), "127.0.0.1",
                                     self._port)
@@ -358,12 +377,16 @@ class SimCluster:
             # sink writes drain on a background thread (trace.JsonlSink);
             # closing here is what makes "read the capture after the with
             # block" deterministic for tests and scenario code
-            if self.extender.trace is not None:
-                self.extender.trace.close()
-            self.extender.events.close()
-            if self.extender.journal is not None:
-                self.extender.journal.close()
-                self.extender.state.retire()
+            shutdown = getattr(self.extender, "shutdown", None)
+            if shutdown is not None:
+                shutdown()  # ShardRouter: closes every replica's sinks
+            else:
+                if self.extender.trace is not None:
+                    self.extender.trace.close()
+                self.extender.events.close()
+                if self.extender.journal is not None:
+                    self.extender.journal.close()
+                    self.extender.state.retire()
         finally:
             # the process-wide threading patch must unwind even when a
             # sink close raises (full disk) — same hazard the
@@ -381,6 +404,54 @@ class SimCluster:
     def __exit__(self, *exc) -> None:
         self.stop()
 
+    # -- replica chaos (sharded plane; ISSUE 13) -----------------------------
+    def _router(self):
+        from tpukube.sched.shard import ShardRouter
+
+        if not isinstance(self.extender, ShardRouter):
+            raise RuntimeError(
+                "replica chaos needs a sharded cluster "
+                "(planner_replicas > 1)"
+            )
+        return self.extender
+
+    def crash_replica(self, idx: int) -> None:
+        """Kill ONE planner replica: its in-memory shard state —
+        ledger, reservations, queue, plans — is gone, nothing flushed;
+        the router keeps serving around it and the rendezvous janitor
+        aborts any uncommitted rendezvous holding a part there."""
+        self._router().kill_replica(idx)
+
+    def partition_replica(self, idx: int) -> None:
+        """Partition ONE replica away from the router (state survives,
+        unreachable); ``heal_replica`` ends the partition."""
+        self._router().partition_replica(idx)
+
+    def heal_replica(self, idx: int) -> None:
+        self._router().heal_replica(idx)
+
+    def restart_replica(self, idx: int) -> int:
+        """Cold-restart a killed replica the way a restarted shard
+        daemon does: fresh Extender, its node subset re-ingested, its
+        ledger + gangs rebuilt from the pod store's annotations
+        (``rebuild_from_pods`` — the convergence path the chaos
+        acceptance asserts). Returns allocations restored."""
+        from tpukube.apiserver import live_alloc_pods
+
+        router = self._router()
+        node_annos = [
+            (obj["metadata"]["name"], obj["metadata"]["annotations"])
+            for obj in self.node_objects()
+            if router._node_replica.get(obj["metadata"]["name"]) == idx
+        ]
+        # the SAME lifecycle filter every restart path applies:
+        # terminal-phase pods' annotation residue must not be restored
+        pods = [
+            annotations for annotations, _alloc, _key in
+            live_alloc_pods(router.replica_pods(idx, self.pods))
+        ]
+        return router.restart_replica(idx, node_annos, pods)
+
     # -- crash / cold restart (chaos scenario 9) -----------------------------
     def crash_extender(self) -> None:
         """Simulate extender process death mid-flight: the HTTP
@@ -388,6 +459,11 @@ class SimCluster:
         state — ledger, gang reservations, pending webhook context,
         queued evictions — is gone. Nothing is flushed or unwound;
         that is the point."""
+        if self.config.planner_replicas > 1:
+            raise RuntimeError(
+                "sharded cluster: crash/restart individual replicas "
+                "(crash_replica/restart_replica), not the whole plane"
+            )
         conn = getattr(self._tls, "conn", None)
         if conn is not None:
             conn.close()
@@ -466,12 +542,19 @@ class SimCluster:
     # -- kube-object minting -----------------------------------------------
     def _invalidate_node(self, name: str) -> None:
         self._node_obj_cache.pop(name, None)
+        self._node_objs_list = None
 
     def node_objects(self) -> list[dict[str, Any]]:
         """Node API objects as kube-scheduler would send them. Encoded
         annotations are cached per node (schedule() resends every node on
         every webhook; re-encoding 32 nodes per cycle dominated the sim's
-        own overhead) — fault injection invalidates the touched node."""
+        own overhead) — fault injection invalidates the touched node.
+        The assembled LIST is cached too: re-sorting 10k node names per
+        sampled webhook was the kilonode drives' dominant harness term
+        (the measured 'filter p99' was mostly this sort)."""
+        cached = getattr(self, "_node_objs_list", None)
+        if cached is not None:
+            return cached
         out = []
         for name, info in sorted(self.nodes.items()):
             obj = self._node_obj_cache.get(name)
@@ -486,6 +569,7 @@ class SimCluster:
                 }
                 self._node_obj_cache[name] = obj
             out.append(obj)
+        self._node_objs_list = out
         return out
 
     def _extender_node_args(

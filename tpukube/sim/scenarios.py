@@ -53,6 +53,10 @@ _PASSTHROUGH_KEYS = (
     "TPUKUBE_DECISIONS_ENABLED",
     "TPUKUBE_DECISIONS_SAMPLE_RATE",
     "TPUKUBE_DECISIONS_PATH",
+    # sharded control plane (ISSUE 13): check.sh's shard smoke and the
+    # bench replica sweep pin replica count + plan-served answers
+    "TPUKUBE_PLANNER_REPLICAS",
+    "TPUKUBE_FILTER_FROM_PLAN",
 )
 
 
@@ -92,6 +96,7 @@ def run(scenario: int, config: TpuKubeConfig | None = None) -> dict[str, Any]:
         11: tenant_serving,
         12: kilonode10k_churn,
         13: crash_storm,
+        14: kilonode_sharded,
     }[scenario]
     t0 = time.perf_counter()
     result = fn(config)
@@ -720,18 +725,86 @@ def kilonode10k_churn(config: TpuKubeConfig | None) -> dict[str, Any]:
                            delta_stats=True)
 
 
+def kilonode_sharded(config: TpuKubeConfig | None) -> dict[str, Any]:
+    """Scenario 14 (ISSUE 13 acceptance): the 100k-node sharded drive —
+    ``TPUKUBE_SHARD_SLICES`` (default 10) ICI slices of
+    ``TPUKUBE_SIM_MESH_DIMS`` (default 32x32x40, i.e. 10,240 nodes /
+    40,960 chips each: ~102k nodes / ~410k chips total), partitioned
+    across ``TPUKUBE_PLANNER_REPLICAS`` (default 4) planner replicas
+    behind the ShardRouter, burst-churned through the batched cycles
+    on the fake clock with plan-served filter answers
+    (filter_from_plan). The committed training gang routes whole to
+    one replica (ICI-contiguous placement stays first choice); the
+    webhook-sampled pods measure real p99s through the router.
+
+    The measured wall EXCLUDES fleet minting + the one-time node
+    ingest (reported separately as ``setup_s``): at 100k nodes the
+    annotation encode/decode is a fixed startup cost, not the
+    steady-state throughput the scenario records. Raises on: gang
+    uncommitted, ledger/store divergence, leaked reservations, a dead
+    replica, or a pod shortfall. ``TPUKUBE_KILONODE100K_PODS`` scales
+    the trace (default 40000; check.sh's shard smoke runs a much
+    smaller fleet via the env knobs)."""
+    import os
+
+    from tpukube.core.mesh import MeshSpec
+
+    cfg = config or load_config(env=_env({
+        "TPUKUBE_SIM_MESH_DIMS": os.environ.get(
+            "TPUKUBE_SIM_MESH_DIMS", "32,32,40"),
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        "TPUKUBE_BATCH_ENABLED": "1",
+        "TPUKUBE_BATCH_MAX_PODS": "2048",
+        "TPUKUBE_FILTER_FROM_PLAN": "1",
+        "TPUKUBE_PLANNER_REPLICAS": os.environ.get(
+            "TPUKUBE_PLANNER_REPLICAS", "4"),
+    }))
+    n_slices = int(os.environ.get("TPUKUBE_SHARD_SLICES", "10"))
+    mesh = cfg.sim_mesh()
+    slices = {
+        f"s{i:02d}": MeshSpec(dims=mesh.dims,
+                              host_block=mesh.host_block,
+                              torus=mesh.torus)
+        for i in range(n_slices)
+    }
+    total_target = int(os.environ.get("TPUKUBE_KILONODE100K_PODS",
+                                      "40000"))
+    total_chips = n_slices * mesh.num_chips
+    result = _kilonode_drive(
+        cfg, metric="kilonode_sharded", total_target=total_target,
+        gang_size=min(512, total_chips // 8),
+        max_alive=8192, check_leaks=True,
+        slices=slices, include_setup=False,
+    )
+    problems = []
+    if any(not r["alive"] for r in result["shard"]["replicas"]):
+        problems.append("a planner replica died during the drive")
+    if problems:
+        raise RuntimeError("kilonode_sharded invariants violated: "
+                           + "; ".join(problems))
+    return result
+
+
 def _kilonode_drive(cfg: TpuKubeConfig, metric: str, total_target: int,
                     gang_size: int,
                     max_alive: Optional[int] = None,
                     check_leaks: bool = False,
-                    delta_stats: bool = False) -> dict[str, Any]:
-    """The shared kilonode churn driver (scenarios 10 and 12): a
+                    delta_stats: bool = False,
+                    slices: Optional[dict] = None,
+                    include_setup: bool = True) -> dict[str, Any]:
+    """The shared kilonode churn driver (scenarios 10, 12, and 14): a
     committed training gang pins a contiguous block while burst waves
     arrive, run five simulated minutes, and complete, on the fake
     clock through the batched cycles. ``check_leaks`` adds the
     leaked-reservation invariant and ``delta_stats`` the ISSUE 10
     snapshot-maintenance numbers (delta-apply p50 vs a forced full
-    rebuild p50 measured on the SAME loaded cluster at drive end)."""
+    rebuild p50 measured on the SAME loaded cluster at drive end).
+    ``slices`` drives a multi-slice fleet (the sharded scenario's
+    shape; the extender is then the ShardRouter when
+    planner_replicas > 1), and ``include_setup=False`` excludes fleet
+    minting + the initial node sync from the measured wall — at 100k
+    nodes the one-time annotation encode/decode would otherwise
+    swamp the steady-state number the scenario exists to record."""
     from collections import deque as _deque
 
     from tpukube.chaos import leaked_reservations, ledger_divergence
@@ -741,7 +814,13 @@ def _kilonode_drive(cfg: TpuKubeConfig, metric: str, total_target: int,
     sample_every = 101  # full-webhook-protocol sampling cadence
     clock = FakeClock()
     t0 = time.perf_counter()
-    with SimCluster(cfg, clock=clock, in_process=True) as c:
+    with SimCluster(cfg, clock=clock, in_process=True,
+                    slices=slices) as c:
+        setup_s = None
+        if not include_setup:
+            c._sync_nodes()  # the one-time node ingest, off the clock
+            setup_s = round(time.perf_counter() - t0, 3)
+            t0 = time.perf_counter()
         n_nodes = len(c.nodes)
         n_chips = sum(m.num_chips for m in c.slices.values())
 
@@ -825,6 +904,23 @@ def _kilonode_drive(cfg: TpuKubeConfig, metric: str, total_target: int,
             "cycle": ext.cycle.stats() if ext.cycle is not None else None,
             "utilization_percent": round(100 * c.utilization(), 2),
         }
+        if setup_s is not None:
+            result["setup_s"] = setup_s
+        statusz = getattr(ext, "statusz", None)
+        if statusz is not None:
+            # sharded plane: the router topology + rendezvous ledger +
+            # per-replica summary rows ride the result
+            doc = statusz()
+            result["shard"] = {
+                "replicas": [
+                    {k: r[k] for k in ("replica", "alive", "nodes",
+                                       "allocs", "pods_routed",
+                                       "binds_total", "utilization")}
+                    for r in doc["replicas"]
+                ],
+                "slice_assignment": doc["slice_assignment"],
+                "rendezvous": doc["rendezvous"],
+            }
         if ext.decisions is not None:
             # the measured-overhead guard (ISSUE 12): provenance's
             # cumulative record wall as a fraction of the drive wall —
