@@ -1,0 +1,525 @@
+"""ISSUE 14: process-parallel sharded control plane — one planner
+daemon per replica behind the async webhook router.
+
+The acceptance gates covered here:
+  * process-mode N=1 placements identical to the in-process router on
+    mixed workloads (whole-chip, multi-chip, vTPU, gangs, preemption);
+  * replica-daemon kill mid-rendezvous-commit over the REAL transport
+    (janitor all-or-nothing death still holds, leak-free convergence);
+  * health-check-driven dead-marking + warm restart of a killed worker
+    process;
+  * config validation for the new knobs;
+plus the satellites:
+  * incremental unhealthy/broken/share-count ledger caches property-
+    tested against the ground-truth walks across the full lifecycle;
+  * the harness's NodesCached sampled-webhook bodies parity-checked
+    against the protocol-faithful names body.
+
+Worker daemons are real subprocesses; tests that need them skip
+gracefully where spawning is unavailable.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from tpukube.chaos import leaked_reservations, ledger_divergence
+from tpukube.core import codec
+from tpukube.core.clock import FakeClock
+from tpukube.core.config import load_config
+from tpukube.core.mesh import MeshSpec
+from tpukube.core.types import PodGroup
+from tpukube.sim.harness import SimCluster
+
+
+def can_spawn_workers() -> bool:
+    from tpukube.sched.shard import ShardError, SubprocessTransport
+
+    try:
+        probe = SubprocessTransport(0, load_config(env={}),
+                                    fake_clock=False)
+        probe.close()
+        return True
+    except (ShardError, OSError):
+        return False
+
+
+needs_workers = pytest.mark.skipif(
+    not can_spawn_workers(),
+    reason="cannot spawn shard-worker subprocesses here",
+)
+
+
+def proc_config(n: int, **extra: str):
+    return load_config(env={
+        "TPUKUBE_PLANNER_REPLICAS": str(n),
+        "TPUKUBE_SHARD_TRANSPORT": "subprocess",
+        "TPUKUBE_BATCH_ENABLED": "1",
+        **extra,
+    })
+
+
+def two_slices(dims=(2, 2, 2)) -> dict[str, MeshSpec]:
+    return {
+        sid: MeshSpec(dims=dims, host_block=(2, 2, 1),
+                      torus=(False, False, False))
+        for sid in ("s0", "s1")
+    }
+
+
+# -- config validation -------------------------------------------------------
+
+def test_config_validation_shard_transport():
+    assert load_config(env={}).shard_transport == "inprocess"
+    cfg = load_config(env={"TPUKUBE_SHARD_TRANSPORT": "subprocess"})
+    assert cfg.shard_transport == "subprocess"
+    with pytest.raises(ValueError, match="shard_transport"):
+        load_config(env={"TPUKUBE_SHARD_TRANSPORT": "carrier-pigeon"})
+
+
+def test_pod_to_k8s_roundtrip():
+    """The subprocess transport ships PodInfo as v1.Pod dicts; the
+    round-trip through pod_from_k8s must preserve everything the
+    planner reasons on — including the gang group, which rides the
+    annotations."""
+    from tpukube.sched import kube
+
+    grp = PodGroup("rt-gang", min_member=4, allow_dcn=True)
+    pod = kube.pod_from_k8s({
+        "metadata": {"name": "rt", "namespace": "ns1", "uid": "u-9",
+                     "annotations": codec.pod_group_annotations(grp),
+                     "labels": {"team": "a"}},
+        "spec": {"priority": 7, "containers": [
+            {"name": "main",
+             "resources": {"requests": {"qiniu.com/tpu": "2"}}},
+        ]},
+    })
+    back = kube.pod_from_k8s(kube.pod_to_k8s(pod))
+    assert back.key() == pod.key()
+    assert back.uid == pod.uid
+    assert back.priority == pod.priority
+    assert back.labels == pod.labels
+    assert back.requests() == pod.requests()
+    assert back.group is not None
+    assert (back.group.name, back.group.min_member,
+            back.group.allow_dcn) == ("rt-gang", 4, True)
+
+
+# -- process-mode N=1 placement parity ---------------------------------------
+
+def _mixed_workload(c: SimCluster) -> dict[str, tuple[str, tuple]]:
+    """Drive the mixed workload through the per-pod webhook protocol
+    and return pod key -> (node, sorted device ids)."""
+    placements: dict[str, tuple[str, tuple]] = {}
+
+    def put(pod):
+        node, alloc = c.schedule(pod)
+        placements[alloc.pod_key] = (node, tuple(sorted(alloc.device_ids)))
+
+    put(c.make_pod("solo-0", tpu=1))
+    put(c.make_pod("multi-0", tpu=2))
+    put(c.make_pod("vt-0", vtpu=1))
+    grp = PodGroup("pg", min_member=2)
+    for i in range(2):
+        put(c.make_pod(f"pg-{i}", tpu=1, group=grp, priority=10))
+    # fill the rest of the mesh with cheap pods, then preempt with a
+    # high-priority gang that needs a contiguous block
+    filler = []
+    for i in range(8):
+        name = f"fill-{i}"
+        try:
+            put(c.make_pod(name, tpu=1, priority=0))
+            filler.append(name)
+        except RuntimeError:
+            c.pods.pop(f"default/{name}", None)
+            break
+    pre = PodGroup("pre", min_member=2)
+    for i in range(2):
+        put(c.make_pod(f"pre-{i}", tpu=1, group=pre, priority=100))
+    c.complete_pod("solo-0")
+    put(c.make_pod("solo-1", tpu=1))
+    return placements
+
+
+@needs_workers
+def test_process_n1_placement_parity():
+    """N=1 over the subprocess transport places the mixed workload
+    (gangs, preemption, vTPU) exactly as the in-process plane does:
+    the transport changes the wire, never the computation."""
+    results = {}
+    for transport in ("inprocess", "subprocess"):
+        cfg = load_config(env={
+            "TPUKUBE_PLANNER_REPLICAS": "1",
+            "TPUKUBE_SHARD_TRANSPORT": transport,
+            "TPUKUBE_BATCH_ENABLED": "1",
+        })
+        mesh = MeshSpec(dims=(4, 4, 1), host_block=(2, 2, 1),
+                        torus=(False, False, False))
+        with SimCluster(cfg, mesh=mesh, vtpu_nodes={"host-1-0-0"},
+                        in_process=True) as c:
+            results[transport] = _mixed_workload(c)
+            assert ledger_divergence(c) == []
+    assert results["subprocess"] == results["inprocess"]
+
+
+@needs_workers
+def test_process_batch_driver_and_zero_divergence():
+    """The batched driver surface (admit_many / planned_many /
+    bind_many) over two worker daemons: every pod lands, ledger and
+    store agree, and the per-replica transport telemetry is live."""
+    clock = FakeClock()
+    with SimCluster(proc_config(2), clock=clock, in_process=True,
+                    slices=two_slices()) as c:
+        pods = [c.make_pod(f"b{i}", tpu=1) for i in range(12)]
+        placed = c.schedule_pending(pods)
+        assert len(placed) == 12
+        assert ledger_divergence(c) == []
+        doc = c.extender.statusz()
+        assert doc["transport"]["mode"] == "subprocess"
+        assert all(r["requests"] > 0
+                   for r in doc["transport"]["replicas"])
+        # both shards actually planned work
+        assert all(r["allocs"] > 0 for r in doc["replicas"])
+
+
+@needs_workers
+def test_process_release_and_eviction_pull():
+    """Worker-side releases (batched through release_many) free chips,
+    and a worker-side gang rollback's victims surface on the router's
+    shared eviction bus via pull_evictions."""
+    clock = FakeClock()
+    cfg = proc_config(2)
+    with SimCluster(cfg, clock=clock, in_process=True,
+                    slices=two_slices()) as c:
+        pods = [c.make_pod(f"r{i}", tpu=1) for i in range(8)]
+        c.schedule_pending(pods)
+        before = c.utilization()
+        assert before > 0
+        for i in range(8):
+            c.pods.pop(f"default/r{i}")
+        c._lifecycle.check_once()
+        assert c.utilization() == 0.0
+        assert ledger_divergence(c) == []
+        # half-assemble a gang, then let its TTL expire: the worker's
+        # janitor rolls it back and evicts the bound member — which
+        # must reach the ROUTER's eviction bus
+        grp = PodGroup("half", min_member=8)
+        c.schedule(c.make_pod("half-0", tpu=1, group=grp))
+        c.advance(cfg.reservation_ttl_seconds + 1)
+        c.extender.sweep()
+        c.extender.pull_evictions()
+        assert "default/half-0" in c.extender.pending_evictions
+        c.drain_evictions()
+        for _ in range(4):
+            c._lifecycle.check_once()
+            c.extender.sweep()
+            c.extender.pull_evictions()
+            c.drain_evictions()
+        assert leaked_reservations(c) == []
+        assert ledger_divergence(c) == []
+
+
+# -- replica-daemon death over the real transport ----------------------------
+
+def _span_both_replicas(c: SimCluster) -> None:
+    """Commit one 4-member gang into each slice so no single replica
+    can hold an 8-chip gang whole — the rendezvous shape (gang routing
+    spreads the fillers emptiest-replica-first)."""
+    for g in ("fill-a", "fill-b"):
+        grp = PodGroup(g, min_member=4)
+        for i in range(4):
+            c.schedule(c.make_pod(f"{g}-{i}", tpu=1, group=grp))
+
+
+@needs_workers
+def test_worker_kill_mid_rendezvous_commit_converges():
+    """SIGKILL one worker DAEMON after a rendezvous part bound a
+    member but before the gang committed: the health check marks the
+    replica dead, the janitor dissolves the surviving parts
+    all-or-nothing, the plane converges leak-free, and a warm restart
+    rebuilds the shard from pod annotations — with every surviving
+    replica's snapshot audited against its ledger
+    (snapshot_audit_rate=1.0, the acceptance setting)."""
+    clock = FakeClock()
+    cfg = proc_config(2, TPUKUBE_SNAPSHOT_AUDIT_RATE="1.0")
+    with SimCluster(cfg, clock=clock, in_process=True,
+                    slices=two_slices()) as c:
+        _span_both_replicas(c)
+        grp = PodGroup("dcn", min_member=8, allow_dcn=True)
+        # bind a few members (not the quorum): rendezvous prepared,
+        # parts uncommitted
+        for i in range(3):
+            c.schedule(c.make_pod(f"dcn-{i}", tpu=1, group=grp,
+                                  priority=50))
+        router = c.extender
+        assert ("default", "dcn") in router._dcn
+        assert not router._dcn[("default", "dcn")].committed
+        # REAL process death: SIGKILL the daemon out from under the
+        # router (not a modeled flag — the transport discovers it)
+        victim = next(idx for idx, rdv
+                      in [(i, None) for i in (0, 1)]
+                      if router._dcn[("default", "dcn")]
+                      .parts.get(idx) is not None)
+        router.replicas[victim].transport._proc.kill()
+        router.replicas[victim].transport._proc.wait(timeout=10)
+        clock.advance(1.0)
+        router.health_check()
+        assert router.replicas[victim].killed
+        aborted = router.sweep()
+        assert ("default", "dcn") in aborted
+        # converge: the surviving part's members are evicted (nothing
+        # leaks); members bound to the DEAD shard's nodes converge
+        # through the restart below, exactly the chaos helper's order
+        for _ in range(6):
+            c._lifecycle.check_once()
+            router.pull_evictions()
+            c.drain_evictions()
+            router.sweep()
+        assert leaked_reservations(c) == []
+        # warm restart: fresh daemon, nodes re-ingested, ledger rebuilt
+        # — the aborted rendezvous' restored fragment dies
+        # all-or-nothing inside restart (the pending sentence)
+        restored = c.restart_replica(victim)
+        assert router.replicas[victim].alive
+        assert restored >= 0
+        for _ in range(6):
+            c._lifecycle.check_once()
+            router.pull_evictions()
+            c.drain_evictions()
+            router.sweep()
+        assert leaked_reservations(c) == []
+        assert ledger_divergence(c) == []
+        # the audit sentinel ran over the real transport and found
+        # every surviving snapshot faithful to its ledger
+        audit = router.audit_stats()
+        assert audit["checks"] > 0
+        assert audit["divergences"] == 0
+        # the restarted shard serves placements again
+        pod = c.make_pod("after-restart", tpu=1)
+        node, _alloc = c.schedule(pod)
+        assert node
+
+
+@needs_workers
+def test_health_check_dead_marking_and_warm_restart():
+    """A worker daemon that dies between drives is found by the
+    router's health check (crash_replica semantics: excluded from the
+    federated views) and a warm restart restores its allocations from
+    the pod store."""
+    clock = FakeClock()
+    with SimCluster(proc_config(2), clock=clock, in_process=True,
+                    slices=two_slices()) as c:
+        placed = c.schedule_pending(
+            [c.make_pod(f"p{i}", tpu=1) for i in range(8)]
+        )
+        router = c.extender
+        victims = {idx for idx in (0, 1)
+                   if router.replicas[idx].transport.summary()["allocs"]}
+        victim = sorted(victims)[0]
+        held = router.replicas[victim].transport.summary()["allocs"]
+        router.replicas[victim].transport._proc.kill()
+        router.replicas[victim].transport._proc.wait(timeout=10)
+        # advance the ROUTER clock only (not the fan-out, which would
+        # discover the corpse inline through its own transport error):
+        # the next health check must find the dead daemon itself
+        clock.advance(1.0)
+        assert router.health_check() == 1
+        rep = router.replicas[victim]
+        assert rep.killed and not rep.alive
+        # the corpse's ledger is excluded from the federated view
+        assert len(router.state.allocations()) == len(placed) - held
+        restored = c.restart_replica(victim)
+        assert restored == held
+        assert len(router.state.allocations()) == len(placed)
+        assert ledger_divergence(c) == []
+
+
+@needs_workers
+def test_transport_failure_marks_dead_inline():
+    """A connection failure DURING a call (not just a failed health
+    probe) marks the replica dead through on_down — the router routes
+    around it without waiting for the next health check."""
+    clock = FakeClock()
+    with SimCluster(proc_config(2), clock=clock, in_process=True,
+                    slices=two_slices()) as c:
+        router = c.extender
+        router.replicas[1].transport._proc.kill()
+        router.replicas[1].transport._proc.wait(timeout=10)
+        with pytest.raises(Exception):
+            # direct transport call: the failure surfaces AND trips
+            # the dead-marking callback
+            router.replicas[1].transport.summary()
+        assert router.replicas[1].killed
+        # the plane still schedules on the survivor
+        node, _ = c.schedule(c.make_pod("survivor", tpu=1))
+        assert node.startswith("s0-") or node.startswith("s1-")
+
+
+# -- fan-out concurrency ------------------------------------------------------
+
+@needs_workers
+def test_fan_out_overlaps_across_replicas():
+    """Calls to DISTINCT replicas genuinely overlap in time (the
+    multi-core lever): two workers each advancing a FakeClock while
+    the router fans out must finish in roughly one round-trip, not
+    two. Wall-clock based but I/O-bound, so it holds on any machine —
+    including single-core CI, where CPU-bound scaling cannot show."""
+    import time as time_mod
+
+    clock = FakeClock()
+    with SimCluster(proc_config(2), clock=clock, in_process=True,
+                    slices=two_slices()) as c:
+        router = c.extender
+        # warm the connections
+        router._fan_out(router.replicas,
+                        lambda rep: rep.transport.healthz())
+
+        slow = 0.3
+
+        def stall(rep):
+            # one slow request per replica, through each replica's own
+            # ordered connection
+            t0 = time_mod.perf_counter()
+            rep.transport._request("POST", "/worker/stall",
+                                   {"seconds": slow})
+            return time_mod.perf_counter() - t0
+
+        t0 = time_mod.perf_counter()
+        out = router._fan_out(router.replicas, stall)
+        wall = time_mod.perf_counter() - t0
+        assert len(out) == 2
+        # serial would be >= 2*slow; concurrent ~= slow (+ slack)
+        assert wall < 1.7 * slow, f"fan-out serialized: {wall:.3f}s"
+
+
+# -- satellite: incremental ledger caches vs ground-truth walks ---------------
+
+def test_aux_caches_match_walk_through_lifecycle():
+    """unhealthy_coords / broken_links / slice_share_counts served
+    from the incremental caches equal the ground-truth walks after
+    EVERY mutation across a random lifecycle (commits, releases,
+    health flips, link faults, structural re-annotations)."""
+    cfg = load_config(env={})
+    mesh = MeshSpec(dims=(4, 4, 2), host_block=(2, 2, 1),
+                    torus=(False, False, False))
+    rng = random.Random(1414)
+    with SimCluster(cfg, mesh=mesh, in_process=True) as c:
+        st = c.extender.state
+        sid = cfg.slice_id
+
+        def check():
+            # force-seed through the cached accessors, then compare
+            # against the independent walks
+            assert st.unhealthy_coords(sid) == \
+                st.walk_unhealthy_coords(sid)
+            assert st.broken_links(sid) == st.walk_broken_links(sid)
+            assert st.slice_share_counts(sid) == \
+                st.walk_slice_share_counts(sid)
+
+        c._sync_nodes()
+        check()
+        alive: list[str] = []
+        links = [(c1, c2) for c1 in mesh.all_coords()
+                 for c2 in mesh.neighbors(c1) if c1 < c2]
+        faulted: list[tuple] = []
+        sick: list[tuple[str, int]] = []
+        for step in range(60):
+            op = rng.random()
+            if op < 0.35:
+                name = f"pp-{step}"
+                try:
+                    c.schedule(c.make_pod(name, tpu=1))
+                    alive.append(name)
+                except RuntimeError:
+                    c.pods.pop(f"default/{name}", None)
+            elif op < 0.55 and alive:
+                c.complete_pod(alive.pop(rng.randrange(len(alive))))
+            elif op < 0.7:
+                node = rng.choice(sorted(c.nodes))
+                chip = rng.randrange(4)
+                if (node, chip) in sick:
+                    c.inject_fault(node, chip, healthy=True)
+                    sick.remove((node, chip))
+                else:
+                    c.inject_fault(node, chip, healthy=False)
+                    sick.append((node, chip))
+                c._sync_nodes()
+            else:
+                if faulted and rng.random() < 0.5:
+                    a, b = faulted.pop(rng.randrange(len(faulted)))
+                    c.inject_link_fault(a, b, up=True)
+                else:
+                    a, b = rng.choice(links)
+                    c.inject_link_fault(a, b, up=False)
+                    if (a, b) not in faulted:
+                        faulted.append((a, b))
+                c._sync_nodes()
+            check()
+        assert ledger_divergence(c) == []
+
+
+def test_aux_caches_unseeded_until_read():
+    """The caches stay unseeded until first read (mutation seams on an
+    unseeded slice are no-ops, matching _occ_cache's contract)."""
+    from tpukube.sched.state import ClusterState
+
+    st = ClusterState()
+    assert st._unhealthy_cache == {}
+    assert st._broken_cache == {}
+    assert st._share_cache == {}
+
+
+# -- satellite: NodesCached sampled-webhook bodies ---------------------------
+
+def test_nodes_cached_body_parity():
+    """The NodesCached webhook body places pods exactly as the
+    protocol-faithful names body, on both the plain extender and the
+    in-process sharded router — and after the first full send the
+    harness's body really is O(1)."""
+    mesh = MeshSpec(dims=(4, 4, 1), host_block=(2, 2, 1),
+                    torus=(False, False, False))
+
+    def run(cached: bool, replicas: int = 1):
+        cfg = load_config(env={
+            "TPUKUBE_BATCH_ENABLED": "1",
+            "TPUKUBE_PLANNER_REPLICAS": str(replicas),
+        })
+        out = {}
+        with SimCluster(cfg, mesh=mesh if replicas == 1 else None,
+                        slices=(None if replicas == 1 else {
+                            "s0": mesh, "s1": mesh,
+                        }),
+                        in_process=True,
+                        cached_node_body=cached) as c:
+            grp = PodGroup("ncg", min_member=2)
+            workload = ([("w0", {}), ("w1", {"tpu": 2})]
+                        + [(f"g{i}", {"group": grp}) for i in range(2)]
+                        + [("w2", {})])
+            for name, kw in workload:
+                kw = dict(kw)
+                kw.setdefault("tpu", 1)
+                node, alloc = c.schedule(c.make_pod(name, **kw))
+                out[name] = (node, tuple(sorted(alloc.device_ids)))
+            if cached:
+                args, pending = c._extender_node_args()
+                assert pending is None and args == {"NodesCached": True}
+            assert ledger_divergence(c) == []
+        return out
+
+    assert run(cached=True) == run(cached=False)
+    assert run(cached=True, replicas=2) == run(cached=False,
+                                               replicas=2)
+
+
+def test_nodes_cached_body_rejected_without_pod():
+    from tpukube.sched import kube
+
+    with pytest.raises(kube.KubeSchemaError):
+        kube.parse_extender_args({"NodesCached": True})
+    pod, nodes, names = kube.parse_extender_args({
+        "Pod": {"metadata": {"name": "x"}, "spec": {}},
+        "NodesCached": True,
+    })
+    assert nodes is None and names is None

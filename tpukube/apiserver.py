@@ -1265,6 +1265,14 @@ class PodLifecycleReleaseLoop(_WatchLoop):
         if evictions is not None:
             evictions.attach_watch_confirmer(self)
         self.released = 0  # lifecycle releases applied (tests/metrics)
+        # resync release batching (ISSUE 14): while set, _release
+        # defers its dispatch into this buffer and _resync_from flushes
+        # ONE extender.release_many call — against a process-mode
+        # ShardRouter that is one fanned-out round-trip per replica
+        # instead of one per released pod (a churn wave releases
+        # thousands). None = dispatch inline (watch events, plain
+        # extenders).
+        self._release_buffer: Optional[list[str]] = None
 
     def watch_alive(self) -> bool:
         """True while DELETED events can actually flow (the executor's
@@ -1294,7 +1302,10 @@ class PodLifecycleReleaseLoop(_WatchLoop):
             log.info("lifecycle signal for %s ignored: uid %s is not the "
                      "ledger's %s", pod_key, uid, alloc.uid)
             return False
-        self._extender.handle("release", {"pod_key": pod_key})
+        if self._release_buffer is not None:
+            self._release_buffer.append(pod_key)
+        else:
+            self._extender.handle("release", {"pod_key": pod_key})
         self.released += 1
         log.info("released %s (%s)", pod_key, why)
         return True
@@ -1319,6 +1330,17 @@ class PodLifecycleReleaseLoop(_WatchLoop):
     def _resync_from(self, pods: list[dict[str, Any]]) -> bool:
         """Reconcile against an already-fetched pod list (the shared
         PodInformer fetches once for all its children)."""
+        release_many = getattr(self._extender, "release_many", None)
+        if release_many is not None:
+            self._release_buffer = []
+        try:
+            return self._resync_scan(pods)
+        finally:
+            buffer, self._release_buffer = self._release_buffer, None
+            if buffer:
+                release_many(buffer)
+
+    def _resync_scan(self, pods: list[dict[str, Any]]) -> bool:
         present: dict[str, str] = {}  # key -> listed uid
         changed = False
         for pod in pods:

@@ -403,9 +403,10 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
         # domain). See README "Sharded control plane".
         p.error(
             "planner_replicas > 1 is a deployment topology, not a "
-            "daemon flag: run one tpukube-extender per replica (the "
-            "in-process ShardRouter serves the sim/bench plane — "
-            "`tpukube-sim 14`)"
+            "daemon flag: run one `tpukube-shard-worker` per replica "
+            "behind the router webhook front (deploy/README's "
+            "multi-daemon sketch; the in-process ShardRouter serves "
+            "the sim/bench plane — `tpukube-sim 14`)"
         )
 
     ssl_ctx = None
@@ -627,6 +628,19 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
             extender.decisions.close()
         extender.events.close()
     return 0
+
+
+# -- tpukube shard-worker ----------------------------------------------------
+
+def main_shard_worker(argv: Optional[list[str]] = None) -> int:
+    """One planner replica of the process-parallel sharded control
+    plane (sched/shardworker.py): a plain extender daemon serving the
+    webhook app plus the /worker/* transport routes. The ShardRouter's
+    subprocess transport spawns these; production runs one per replica
+    behind the router webhook front."""
+    from tpukube.sched.shardworker import main_worker
+
+    return main_worker(argv)
 
 
 # -- tpukube-sim -------------------------------------------------------------
@@ -1017,6 +1031,7 @@ if __name__ == "__main__":  # python -m tpukube.cli <tool> ...
     tools = {
         "plugin": main_plugin,
         "extender": main_extender,
+        "shard-worker": main_shard_worker,
         "sim": main_sim,
         "ctl": main_ctl,
         "obs": main_obs,

@@ -175,6 +175,22 @@ class TpuKubeConfig:
     # one extender process per replica behind the same routing
     # contract (see README "Sharded control plane").
     planner_replicas: int = 1
+    # How the router reaches its planner replicas (ISSUE 14):
+    #   inprocess   — replicas are Extender objects in the router's
+    #                 process (PR 13's plane: deterministic, one GIL —
+    #                 the tier-1 parity oracle).
+    #   subprocess  — one planner DAEMON per replica: the router
+    #                 spawns `tpukube.cli shard-worker` processes and
+    #                 fans webhook bodies out over HTTP (concurrent
+    #                 across replicas, ordered per replica), so N
+    #                 replicas plan on N cores. Replica death is
+    #                 detected by health checks / transport failures
+    #                 and handled with crash_replica semantics (warm
+    #                 restart via rebuild). bench.shard_scaling's
+    #                 process sweep and scenario 14's process mode run
+    #                 this; production runs the same worker daemon
+    #                 shape under its own supervisor.
+    shard_transport: str = "inprocess"
 
     # Decision provenance (tpukube/obs/decisions.py, ISSUE 12). With
     # decisions_enabled the extender keeps a bounded, sampled,
@@ -442,6 +458,11 @@ def load_config(
         )
     if cfg.planner_replicas < 1:
         raise ValueError("planner_replicas must be >= 1")
+    if cfg.shard_transport not in ("inprocess", "subprocess"):
+        raise ValueError(
+            f"unknown shard_transport {cfg.shard_transport!r} "
+            f"(inprocess | subprocess)"
+        )
     if cfg.planner_replicas > 1 and cfg.tenancy_quotas:
         # each replica's TenantLedger sees only its own slice set, so a
         # cluster-wide chip cap split across N replicas would silently

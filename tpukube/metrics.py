@@ -219,25 +219,73 @@ def build_router_registry(router) -> Registry:
     binds = reg.counter("tpukube_replica_binds_total")
     util = reg.gauge("tpukube_replica_utilization")
     depth = reg.gauge("tpukube_replica_queue_depth")
+
+    # one summary read per replica feeds the whole row, memoized for
+    # this REGISTRY's lifetime: render_router_metrics builds a fresh
+    # registry per scrape, so each scrape reads each replica once —
+    # not once per gauge (6 HTTP round-trips per replica per scrape in
+    # process mode). A dead/unreachable replica renders zeros (its
+    # liveness gauge carries the signal).
+    summary_memo: dict[int, dict] = {}
+
+    def _summary(rep) -> dict:
+        from tpukube.sched.shard import ReplicaUnavailable
+
+        cached = summary_memo.get(rep.index)
+        if cached is not None:
+            return cached
+        if rep.killed:
+            doc = {}
+        else:
+            try:
+                doc = rep.transport.summary()
+            except ReplicaUnavailable:
+                doc = {}
+        summary_memo[rep.index] = doc
+        return doc
+
     for rep in router.replicas:
         name = rep.name
         up.labels(replica=name).set_function(
             lambda r=rep: 1.0 if r.alive else 0.0)
         nodes.labels(replica=name).set_function(
-            lambda r=rep: len(r.extender.state.node_names()))
+            lambda r=rep: _summary(r).get("nodes", 0))
         slices.labels(replica=name).set_function(
-            lambda r=rep: len(r.extender.state.slice_ids()))
+            lambda r=rep: len(_summary(r).get("slices", ())))
         allocs.labels(replica=name).set_function(
-            lambda r=rep: len(r.extender.state.allocations()))
+            lambda r=rep: _summary(r).get("allocs", 0))
         routed.labels(replica=name).set_function(
             lambda r=rep: r.pods_routed)
         binds.labels(replica=name).set_function(
-            lambda r=rep: r.extender.binds_total)
+            lambda r=rep: _summary(r).get("binds_total", 0))
         util.labels(replica=name).set_function(
-            lambda r=rep: router.state_utilization_of(r))
+            lambda r=rep: _summary(r).get("utilization", 0.0))
         depth.labels(replica=name).set_function(
-            lambda r=rep: (r.extender.cycle.queue_depth()
-                           if r.extender.cycle is not None else 0))
+            lambda r=rep: _summary(r).get("queue_depth", 0))
+    if getattr(router, "mode", "inprocess") == "subprocess":
+        # transport telemetry (ISSUE 14): rendered ONLY in process
+        # mode — the in-process router has no wire to measure, and its
+        # exposition stays byte-identical to PR 13's
+        rtt = reg.summary(
+            "tpukube_replica_rtt_seconds",
+            help_text="Router->replica request round-trip time over "
+                      "the subprocess transport, per replica.")
+        checks = reg.counter(
+            "tpukube_replica_health_checks_total",
+            help_text="Replica health checks run by the router "
+                      "(subprocess transport).")
+        fails = reg.counter(
+            "tpukube_replica_health_check_failures_total",
+            help_text="Health checks that failed and marked the "
+                      "replica dead (crash_replica semantics).")
+        for rep in router.replicas:
+            name = rep.name
+            rtt.labels(lambda r=rep: r.transport.rtt_snapshot(),
+                       replica=name)
+            checks.labels(replica=name).set_function(
+                lambda r=rep: r.transport.health_checks)
+            fails.labels(replica=name).set_function(
+                lambda r=rep: r.transport.health_failures)
     return reg
 
 

@@ -137,6 +137,12 @@ DECLARED_SERIES: frozenset[str] = frozenset({
     "tpukube_replica_binds_total",
     "tpukube_replica_utilization",
     "tpukube_replica_queue_depth",
+    # process-mode transport telemetry (ISSUE 14): per-replica wire
+    # RTT + router health-check counters, rendered ONLY when the
+    # router runs the subprocess transport
+    "tpukube_replica_rtt_seconds",
+    "tpukube_replica_health_checks_total",
+    "tpukube_replica_health_check_failures_total",
     # both daemons (unified retry/circuit layer, core/retry.py; series
     # render only where a Retrier/CircuitBreaker is actually wired)
     "tpukube_retry_attempts_total",

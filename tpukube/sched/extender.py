@@ -1396,6 +1396,11 @@ class Extender:
         with self._decision_lock:
             if kind == "filter":
                 pod, nodes, names = kube.parse_extender_args(body)
+                if nodes is None and names is None:
+                    # NodesCached body: the candidate set is every node
+                    # this planner knows (the cached tuple — no O(nodes)
+                    # list rebuild per webhook)
+                    names = self.state.node_names()
                 mk = (kube.filter_result if nodes is not None
                       else kube.filter_result_names)
                 # per-tenant admission latency (tenancy v2): the whole
@@ -1438,6 +1443,8 @@ class Extender:
                     )
             elif kind == "prioritize":
                 pod, nodes, names = kube.parse_extender_args(body)
+                if nodes is None and names is None:
+                    names = self.state.node_names()  # NodesCached body
                 scores = None
                 if self.cycle is not None:
                     if nodes is not None:
@@ -1984,7 +1991,7 @@ class Extender:
 def make_app(
     extender: Extender, reconcile=None, evictions=None,
     node_refresh=None, lifecycle=None, auth_token: Optional[str] = None,
-    informer=None,
+    informer=None, client_max_size: Optional[int] = None,
 ) -> web.Application:
     """``reconcile``/``evictions``/``node_refresh``/``lifecycle`` are the
     daemon's loops, exported on /metrics when present; ``informer`` is
@@ -2000,8 +2007,14 @@ def make_app(
     non-disclosing.) Transport security/mTLS is the TLS layer's job —
     cli.main_extender builds the SSLContext; this is the
     application-level check that also protects plain-HTTP dev setups and
-    defends in depth behind TLS."""
-    app = web.Application()
+    defends in depth behind TLS.
+
+    ``client_max_size`` overrides aiohttp's 1 MiB request-body cap —
+    the shard worker's batched transport routes (a whole fleet's
+    upsert, a wave of admits) legitimately exceed it; None keeps the
+    aiohttp default for the standalone daemon."""
+    app = (web.Application(client_max_size=client_max_size)
+           if client_max_size is not None else web.Application())
 
     if auth_token:
         expected = f"Bearer {auth_token}".encode()

@@ -72,17 +72,25 @@ reservations (see ``rebuild_from_pods``).
 
 from __future__ import annotations
 
+import http.client
 import json
 import logging
+import os
+import socket
+import subprocess
+import sys
+import tempfile
 import threading
+import time
 import zlib
 from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import replace as dc_replace
 from typing import Any, Optional
 
 from tpukube.core import codec
 from tpukube.core.config import TpuKubeConfig
-from tpukube.core.types import PodGroup, PodInfo, TopologyCoord
+from tpukube.core.types import AllocResult, PodGroup, PodInfo, TopologyCoord
 from tpukube.sched import kube, slicefit
 from tpukube.sched.extender import Extender, ExtenderError
 from tpukube.sched.gang import GangError
@@ -95,24 +103,694 @@ class ShardError(RuntimeError):
     pass
 
 
+class ReplicaUnavailable(ShardError):
+    """A replica transport call could not reach its daemon (connection
+    refused/reset, timeout). The router treats the replica as dead —
+    the same semantics as ``crash_replica`` — and routes around it."""
+
+
+# -- replica-side helpers ----------------------------------------------------
+#
+# The decision surface one planner replica serves, shared VERBATIM by
+# the in-process transport (direct calls) and the subprocess worker's
+# HTTP routes (sched/shardworker.py): whatever transport carries the
+# request, the replica-side computation is this one code path.
+
+def replica_gauges(extender: Extender) -> dict[str, dict[str, Any]]:
+    """Per-slice capacity gauges off the replica's EPOCH-CACHED
+    snapshot — O(slices), no ledger walk, no sweep probe. The router's
+    rendezvous PLAN phase and its routing order feed on these instead
+    of serializing full fit probes over the wire (``largest_free_box``
+    is cached on the snapshot; it can only OVER-estimate the blocked
+    sweep's contiguity, so a gauge-based pre-filter never skips a
+    replica the full probe would have accepted)."""
+    snap = extender.snapshots.current()
+    out: dict[str, dict[str, Any]] = {}
+    for sid in snap.slice_ids():
+        ss = snap.slice(sid)
+        out[sid] = {
+            "largest_free_box": ss.largest_free_box(),
+            "free_chips": ss.blocked_free_chips,
+            "used_shares": ss.used_shares,
+            "total_shares": ss.total_shares,
+            "utilization": ss.utilization,
+            "fragmentation": ss.fragmentation(),
+        }
+    return out
+
+
+def gang_fit_probe(extender: Extender, pod: PodInfo, total: int) -> bool:
+    """Can this replica host the gang ICI-contiguously in ONE of its
+    slices? The same search ``ensure_reservation`` runs — against the
+    replica's epoch-cached snapshot, so the sweep this builds is the
+    sweep the reservation reuses."""
+    snap = extender.snapshots.current()
+    shape = pod.group.shape if pod.group is not None else None
+    for sid in snap.slice_ids():
+        ss = snap.slice(sid)
+        if ss.blocked_free_chips < total:
+            continue
+        coords = slicefit.find_slice_in(
+            ss.blocked_sweep(),
+            count=None if shape is not None else total,
+            shape=shape,
+            broken=ss.broken,
+        )
+        if coords is not None:
+            return True
+    return False
+
+
+def gang_prepare_part(
+    extender: Extender, pod: PodInfo, cpp: int, volumes: dict[str, int],
+) -> dict[str, list[TopologyCoord]]:
+    """One replica's PREPARE leg of the two-phase rendezvous: find one
+    contiguous free box per requested slice (shrinking by chips_per_pod
+    when fragmentation beat the router's gauge-planned volume) and
+    reserve them through ``reserve_exact_split`` with a LOCAL-quorum
+    group. Returns {slice id -> reserved coords}; raises GangError when
+    the replica cannot cover any of the request (nothing reserved — the
+    router aborts the rendezvous). A duplicate prepare is idempotent:
+    an existing reservation for the key answers with its own parts."""
+    assert pod.group is not None
+    existing = extender.gang.reservation(pod.namespace, pod.group.name)
+    if existing is not None:
+        return {sid: sorted(coords)
+                for sid, coords in existing.slice_coords.items()}
+    snap = extender.snapshots.current()
+    parts: dict[str, list[TopologyCoord]] = {}
+    got = 0
+    for sid in sorted(volumes):
+        try:
+            ss = snap.slice(sid)
+        except KeyError:
+            continue  # slice vanished since the gauge read: race
+        vol = min(volumes[sid], (ss.blocked_free_chips // cpp) * cpp)
+        while vol >= cpp:
+            coords = slicefit.find_slice_in(
+                ss.blocked_sweep(), count=vol, broken=ss.broken
+            )
+            if coords is not None:
+                parts[sid] = list(coords)
+                got += len(coords)
+                break
+            vol -= cpp
+    if got == 0:
+        raise GangError(
+            f"gang {pod.namespace}/{pod.group.name}: no contiguous part "
+            f"available (gauges raced an occupancy change); retry"
+        )
+    members = got // cpp
+    local_pod = dc_replace(pod, group=PodGroup(
+        name=pod.group.name, min_member=members,
+        shape=None, allow_dcn=True,
+    ))
+    extender.gang.reserve_exact_split(local_pod, cpp, parts)
+    return parts
+
+
+def replica_summary(extender: Extender) -> dict[str, Any]:
+    """One replica's rollup row: ledger/queue/gang counters plus the
+    merged-observability feeds (latency windows, event counts, cycle
+    stats) the router aggregates across the shard set."""
+    st = extender.state
+    share_counts: dict[str, list[int]] = {}
+    used = total = 0
+    for sid in st.slice_ids():
+        u, t = st.slice_share_counts(sid)
+        share_counts[sid] = [u, t]
+        used += u
+        total += t
+    cycle = extender.cycle
+    cycle_stats = None
+    if cycle is not None:
+        cycle_stats = dict(cycle.stats())
+        cycle_stats["cycle_wall_total"] = cycle.cycle_wall_total
+    return {
+        "slices": st.slice_ids(),
+        "nodes": len(st.node_names()),
+        "allocs": len(st.allocations()),
+        "share_counts": share_counts,
+        "used_shares": used,
+        "total_shares": total,
+        "utilization": used / total if total else 0.0,
+        "binds_total": extender.binds_total,
+        "preemptions": extender.preemptions,
+        "queue_depth": cycle.queue_depth() if cycle is not None else 0,
+        "snapshot_hits": extender.snapshots.hits,
+        "snapshot_rebuilds": extender.snapshots.rebuilds,
+        "audit": {
+            "rate": extender.snapshots.audit_rate,
+            "checks": extender.snapshots.audit_checks,
+            "divergences": extender.snapshots.audit_divergences,
+        },
+        "events": extender.events.counts_by_reason(),
+        "cycle": cycle_stats,
+        "latencies": {h: list(w)
+                      for h, w in extender.latencies.items()},
+    }
+
+
+# -- replica transports ------------------------------------------------------
+
+class InProcessTransport:
+    """The parity oracle: the replica is a live Extender object in this
+    process, every call a direct method dispatch. This is the transport
+    PR 13 shipped — deterministic, single-GIL — and stays the tier-1
+    path; the subprocess transport below carries the identical surface
+    over the extender webhook/HTTP contract."""
+
+    mode = "inprocess"
+
+    def __init__(self, extender: Extender):
+        self.extender = extender
+
+    # decision surface ------------------------------------------------------
+    def handle(self, kind: str, body: Any) -> Any:
+        return self.extender.handle(kind, body)
+
+    def upsert_nodes(self, items: list[dict[str, Any]]) -> list[Any]:
+        return [self.extender.handle("upsert_node", it) for it in items]
+
+    def admit_many(self, pods: list[PodInfo]) -> list[bool]:
+        return [self.extender.admit(p) for p in pods]
+
+    def plan_pending(self) -> int:
+        return self.extender.plan_pending()
+
+    def planned_nodes(self, keys: list[str]) -> dict[str, Optional[str]]:
+        return {k: self.extender.planned_node(k) for k in keys}
+
+    def bind_many(self, bodies: list[dict]) -> list[dict]:
+        return [self.extender.handle("bind", b) for b in bodies]
+
+    def release_many(self, pod_keys: list[str]) -> None:
+        for key in pod_keys:
+            self.extender.handle("release", {"pod_key": key})
+
+    # gang / rendezvous surface ---------------------------------------------
+    def gauges(self) -> dict[str, dict[str, Any]]:
+        return replica_gauges(self.extender)
+
+    def gang_fit(self, pod: PodInfo, total: int) -> bool:
+        return gang_fit_probe(self.extender, pod, total)
+
+    def gang_prepare(self, pod: PodInfo, cpp: int,
+                     volumes: dict[str, int]) -> dict[str, list]:
+        return gang_prepare_part(self.extender, pod, cpp, volumes)
+
+    def gang_drop(self, key: tuple[str, str]) -> None:
+        self.extender.gang.drop_reservation(key)
+
+    def gang_dissolve(self, key: tuple[str, str]) -> None:
+        self.extender.gang.dissolve(key)
+
+    def gang_reservation(self, key: tuple[str, str]) -> Optional[dict]:
+        res = self.extender.gang.reservation(*key)
+        if res is None:
+            return None
+        return {
+            "committed": res.committed,
+            "slices": {sid: sorted(coords)
+                       for sid, coords in res.slice_coords.items()},
+        }
+
+    def gang_sweep(self) -> None:
+        self.extender.gang.sweep()
+
+    # read views ------------------------------------------------------------
+    def allocations(self) -> list[AllocResult]:
+        return self.extender.state.allocations()
+
+    def allocation(self, pod_key: str) -> Optional[AllocResult]:
+        return self.extender.state.allocation(pod_key)
+
+    def node(self, name: str):
+        return self.extender.state.node(name)
+
+    def node_names(self) -> tuple[str, ...]:
+        return self.extender.state.node_names()
+
+    def slice_ids(self) -> list[str]:
+        return self.extender.state.slice_ids()
+
+    def gang_snapshot(self) -> list[dict[str, Any]]:
+        return self.extender.gang_snapshot()
+
+    def alloc_snapshot(self) -> list[dict[str, Any]]:
+        return self.extender.alloc_snapshot()
+
+    def summary(self) -> dict[str, Any]:
+        return replica_summary(self.extender)
+
+    def latencies(self) -> dict[str, list[float]]:
+        return {h: list(w) for h, w in self.extender.latencies.items()}
+
+    def counts_by_reason(self) -> dict[str, int]:
+        return self.extender.events.counts_by_reason()
+
+    def events_emit(self, *args, **kwargs) -> None:
+        self.extender.events.emit(*args, **kwargs)
+
+    # lifecycle -------------------------------------------------------------
+    def rebuild_from_pods(self, pods: list[dict[str, str]]) -> int:
+        return self.extender.rebuild_from_pods(pods)
+
+    def drain_evictions(self) -> list[str]:
+        # the in-process replicas share the router's eviction deque
+        # (eviction_sink) — there is nothing replica-local to pull
+        return []
+
+    def advance(self, seconds: float) -> None:
+        pass  # shares the router process's clock
+
+    def healthz(self) -> bool:
+        return True
+
+    def set_evict_precheck(self, fn) -> None:
+        self.extender.evict_precheck = fn
+
+    def set_binder(self, fn) -> None:
+        self.extender.binder = fn
+
+    def set_degraded_gate(self, fn) -> None:
+        self.extender.degraded_gate = fn
+
+    def kill(self) -> None:
+        # process death is modeled by the router (journal crash +
+        # ledger retire); nothing transport-level to tear down
+        pass
+
+    def close(self) -> None:
+        ext = self.extender
+        if ext.trace is not None:
+            ext.trace.close()
+        ext.events.close()
+        if ext.journal is not None:
+            ext.journal.close()
+            ext.state.retire()
+
+
+class SubprocessTransport:
+    """One planner daemon per replica: spawns a ``tpukube-shard-worker``
+    subprocess (an Extender behind the standard webhook app plus the
+    /worker/* routes of sched/shardworker.py) and speaks the same
+    transport surface over HTTP. Requests on ONE replica are ordered
+    (a single kept-alive connection behind a lock — binds and
+    rendezvous prepares arrive in call order); the ROUTER fans calls
+    out to distinct replicas concurrently, which is where the
+    multi-core speedup lives. A connection failure marks the replica
+    dead through ``on_down`` — exactly ``crash_replica`` semantics."""
+
+    mode = "subprocess"
+    #: no live Extender object in this process (tests and the router's
+    #: in-process-only seams check for None)
+    extender = None
+
+    SPAWN_TIMEOUT_S = 30.0
+    RTT_WINDOW = 1024
+
+    def __init__(self, index: int, config: TpuKubeConfig,
+                 fake_clock: bool, on_down=None):
+        self.index = index
+        self.on_down = on_down
+        self.down = False
+        self.health_checks = 0
+        self.health_failures = 0
+        self.rtt_window: deque[float] = deque(maxlen=self.RTT_WINDOW)
+        self.rtt_sum = 0.0
+        self.rtt_count = 0
+        self._lock = threading.Lock()
+        self._conn: Optional[http.client.HTTPConnection] = None
+        self._port = _free_port()
+        self._cfg_path = self._write_config(config)
+        cmd = [sys.executable, "-m", "tpukube.cli", "shard-worker",
+               "--config", self._cfg_path,
+               "--port", str(self._port)]
+        if fake_clock:
+            cmd.append("--fake-clock")
+        # scrub TPUKUBE_* so the resolved per-replica YAML is the ONE
+        # config source — an inherited TPUKUBE_PLANNER_REPLICAS=4 must
+        # not make each worker try to be a router itself
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith("TPUKUBE_")}
+        self._proc = subprocess.Popen(
+            cmd, env=env, stdin=subprocess.DEVNULL,
+            stdout=subprocess.DEVNULL,
+        )
+        self._wait_ready()
+
+    def _write_config(self, config: TpuKubeConfig) -> str:
+        import dataclasses
+
+        import yaml
+
+        doc = {
+            k: (list(v) if isinstance(v, tuple) else v)
+            for k, v in dataclasses.asdict(config).items()
+        }
+        # the worker IS one planner: never a router, never recursive
+        doc["planner_replicas"] = 1
+        doc["shard_transport"] = "inprocess"
+        fd, path = tempfile.mkstemp(prefix=f"tpukube-r{self.index}-",
+                                    suffix=".yaml")
+        with os.fdopen(fd, "w") as f:
+            yaml.safe_dump(doc, f)
+        return path
+
+    def _wait_ready(self) -> None:
+        deadline = time.monotonic() + self.SPAWN_TIMEOUT_S
+        while time.monotonic() < deadline:
+            if self._proc.poll() is not None:
+                raise ShardError(
+                    f"shard worker r{self.index} exited with "
+                    f"{self._proc.returncode} before serving"
+                )
+            try:
+                if self.healthz(timeout=1.0):
+                    # the spawn-wait probes are EXPECTED to fail until
+                    # the daemon serves: they are not health signal
+                    self.health_checks = 0
+                    self.health_failures = 0
+                    return
+            except ReplicaUnavailable:
+                pass
+            time.sleep(0.05)
+        self.kill()
+        raise ShardError(
+            f"shard worker r{self.index} did not serve /healthz "
+            f"within {self.SPAWN_TIMEOUT_S}s"
+        )
+
+    # -- wire ---------------------------------------------------------------
+    def _request(self, method: str, path: str,
+                 body: Optional[dict] = None, timeout: float = 60.0,
+                 mark_down: bool = True) -> Any:
+        payload = (json.dumps(body).encode("utf-8")
+                   if body is not None else None)
+        t0 = time.perf_counter()
+        with self._lock:
+            if self.down:
+                raise ReplicaUnavailable(
+                    f"replica r{self.index} is down"
+                )
+            try:
+                conn = self._conn
+                if conn is None:
+                    conn = self._conn = http.client.HTTPConnection(
+                        "127.0.0.1", self._port, timeout=timeout
+                    )
+                elif conn.sock is not None:
+                    # the kept-alive socket's timeout is pinned at
+                    # connect time: re-arm it PER REQUEST, or a quick
+                    # health probe's 2s budget would cap every later
+                    # heavy call (a 10k-node upsert, a 2k-pod plan)
+                    # and read as replica death
+                    conn.sock.settimeout(timeout)
+                headers = {"Content-Type": "application/json"} \
+                    if payload is not None else {}
+                conn.request(method, path, body=payload, headers=headers)
+                resp = conn.getresponse()
+                raw = resp.read()
+            except (http.client.HTTPException, OSError) as e:
+                if self._conn is not None:
+                    self._conn.close()
+                    self._conn = None
+                if mark_down:
+                    self._mark_down_locked(e)
+                raise ReplicaUnavailable(
+                    f"replica r{self.index} unreachable: {e}"
+                ) from e
+            dt = time.perf_counter() - t0
+            self.rtt_window.append(dt)
+            self.rtt_sum += dt
+            self.rtt_count += 1
+        if resp.status >= 400:
+            raise ShardError(
+                f"replica r{self.index} {path}: HTTP {resp.status}: "
+                f"{raw.decode(errors='replace')[:200]}"
+            )
+        return json.loads(raw) if raw else None
+
+    def _mark_down_locked(self, err: Exception) -> None:
+        if not self.down:
+            self.down = True
+            log.error("replica r%d transport failed (%s); marking the "
+                      "replica dead", self.index, err)
+            if self.on_down is not None:
+                self.on_down(self.index)
+
+    # decision surface ------------------------------------------------------
+    def handle(self, kind: str, body: Any) -> Any:
+        out = self._request("POST", "/worker/handle",
+                            {"kind": kind, "body": body})
+        if isinstance(out, dict) and "schema_error" in out:
+            # re-raise the exception type the in-process dispatch would
+            # have propagated — the HTTP layer above maps it to 400
+            raise kube.KubeSchemaError(out["schema_error"])
+        return out
+
+    def upsert_nodes(self, items: list[dict[str, Any]]) -> list[Any]:
+        return self._request("POST", "/worker/upsert",
+                             {"items": items})["results"]
+
+    def admit_many(self, pods: list[PodInfo]) -> list[bool]:
+        return self._request("POST", "/worker/admit", {
+            "pods": [kube.pod_to_k8s(p) for p in pods],
+        })["admitted"]
+
+    def plan_pending(self) -> int:
+        return self._request("POST", "/worker/plan", {})["planned"]
+
+    def planned_nodes(self, keys: list[str]) -> dict[str, Optional[str]]:
+        return self._request("POST", "/worker/planned",
+                             {"keys": list(keys)})["nodes"]
+
+    def bind_many(self, bodies: list[dict]) -> list[dict]:
+        return self._request("POST", "/worker/bind",
+                             {"bodies": bodies})["results"]
+
+    def release_many(self, pod_keys: list[str]) -> None:
+        self._request("POST", "/worker/release",
+                      {"keys": list(pod_keys)})
+
+    # gang / rendezvous surface ---------------------------------------------
+    def gauges(self) -> dict[str, dict[str, Any]]:
+        return self._request("GET", "/worker/gauges")["slices"]
+
+    def _gang(self, op: str, **kw) -> Any:
+        out = self._request("POST", "/worker/gang", {"op": op, **kw})
+        err = out.get("error")
+        if err:
+            # the worker maps expected races (box re-occupied, slice
+            # gone) to typed errors so the router degrades exactly as
+            # the in-process prepare would
+            if out.get("kind") == "state":
+                raise StateError(err)
+            raise GangError(err)
+        return out
+
+    def gang_fit(self, pod: PodInfo, total: int) -> bool:
+        return self._gang("fit", pod=kube.pod_to_k8s(pod),
+                          total=total)["fits"]
+
+    def gang_prepare(self, pod: PodInfo, cpp: int,
+                     volumes: dict[str, int]) -> dict[str, list]:
+        out = self._gang("prepare", pod=kube.pod_to_k8s(pod), cpp=cpp,
+                         volumes=volumes)
+        return {
+            sid: [TopologyCoord.of(c) for c in coords]
+            for sid, coords in out["parts"].items()
+        }
+
+    def gang_drop(self, key: tuple[str, str]) -> None:
+        self._gang("drop", namespace=key[0], name=key[1])
+
+    def gang_dissolve(self, key: tuple[str, str]) -> None:
+        self._gang("dissolve", namespace=key[0], name=key[1])
+
+    def gang_reservation(self, key: tuple[str, str]) -> Optional[dict]:
+        out = self._gang("reservation", namespace=key[0],
+                         name=key[1])["reservation"]
+        if out is None:
+            return None
+        out["slices"] = {
+            sid: [TopologyCoord.of(c) for c in coords]
+            for sid, coords in (out.get("slices") or {}).items()
+        }
+        return out
+
+    def gang_sweep(self) -> None:
+        self._gang("sweep")
+
+    # read views ------------------------------------------------------------
+    def allocations(self) -> list[AllocResult]:
+        out = self._request("GET", "/worker/allocs")["allocs"]
+        allocs = []
+        for obj in out:
+            try:
+                allocs.append(codec.alloc_from_obj(obj))
+            except codec.CodecError as e:
+                log.error("replica r%d sent an undecodable alloc: %s",
+                          self.index, e)
+        return allocs
+
+    def allocation(self, pod_key: str) -> Optional[AllocResult]:
+        from urllib.parse import quote
+
+        out = self._request(
+            "GET", f"/worker/alloc?pod={quote(pod_key, safe='')}"
+        )["alloc"]
+        if out is None:
+            return None
+        try:
+            return codec.alloc_from_obj(out)
+        except codec.CodecError as e:
+            log.error("replica r%d sent an undecodable alloc for %s: "
+                      "%s", self.index, pod_key, e)
+            return None
+
+    def node(self, name: str):
+        # NodeView objects do not cross the process boundary; router
+        # callers needing node payloads read them from the pod/node
+        # store, not from a remote replica's in-memory view
+        return None
+
+    def node_names(self) -> tuple[str, ...]:
+        return tuple(self._request("GET", "/worker/nodes")["names"])
+
+    def slice_ids(self) -> list[str]:
+        return list(self._request("GET", "/worker/summary")["slices"])
+
+    def gang_snapshot(self) -> list[dict[str, Any]]:
+        return self._request("GET", "/state/gangs")
+
+    def alloc_snapshot(self) -> list[dict[str, Any]]:
+        return self._request("GET", "/state/allocs")
+
+    def summary(self) -> dict[str, Any]:
+        return self._request("GET", "/worker/summary")
+
+    def latencies(self) -> dict[str, list[float]]:
+        return self._request("GET", "/worker/summary")["latencies"]
+
+    def counts_by_reason(self) -> dict[str, int]:
+        return self._request("GET", "/worker/summary")["events"]
+
+    def events_emit(self, reason: str, obj: str = "", message: str = "",
+                    **kwargs) -> None:
+        self._request("POST", "/worker/emit", {
+            "reason": reason, "obj": obj, "message": message, **kwargs,
+        })
+
+    # lifecycle -------------------------------------------------------------
+    def rebuild_from_pods(self, pods: list[dict[str, str]]) -> int:
+        return self._request("POST", "/worker/rebuild",
+                             {"pods": pods})["restored"]
+
+    def drain_evictions(self) -> list[str]:
+        return self._request("POST", "/worker/evictions", {})["pods"]
+
+    def advance(self, seconds: float) -> None:
+        self._request("POST", "/worker/advance", {"seconds": seconds})
+
+    def healthz(self, timeout: float = 2.0) -> bool:
+        self.health_checks += 1
+        try:
+            out = self._request("GET", "/healthz", timeout=timeout,
+                                mark_down=False)
+        except (ReplicaUnavailable, ShardError):
+            self.health_failures += 1
+            raise ReplicaUnavailable(
+                f"replica r{self.index}: health check failed"
+            ) from None
+        return bool(out.get("ok"))
+
+    def set_evict_precheck(self, fn) -> None:
+        # the worker daemon owns its own apiserver wiring; the sim
+        # worker runs precheck-less (no PDBs), matching the harness's
+        # trivially-true precheck
+        pass
+
+    def set_binder(self, fn) -> None:
+        pass  # the router process applies bind annotations (sim store)
+
+    def set_degraded_gate(self, fn) -> None:
+        pass  # a real worker daemon wires its own circuit -> gate
+
+    def rtt_snapshot(self) -> list[float]:
+        with self._lock:
+            return list(self.rtt_window)
+
+    def kill(self) -> None:
+        """SIGKILL — process death, nothing flushed (the chaos story's
+        crash_replica over a real process)."""
+        with self._lock:
+            self.down = True
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None
+        if self._proc.poll() is None:
+            self._proc.kill()
+        self._proc.wait(timeout=10)
+        self._cleanup_config()
+
+    def close(self) -> None:
+        """Graceful stop (harness shutdown)."""
+        with self._lock:
+            self.down = True
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None
+        if self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+                self._proc.wait(timeout=10)
+        self._cleanup_config()
+
+    def _cleanup_config(self) -> None:
+        try:
+            os.unlink(self._cfg_path)
+        except OSError:
+            pass  # already removed (double close) — nothing to clean
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 class PlannerReplica:
-    """One shard of the control plane: index + its Extender + liveness.
+    """One shard of the control plane: index + its transport + liveness.
     ``alive=False`` models a partitioned OR killed replica — the
     router stops routing to it and the rendezvous janitor treats its
     uncommitted parts as lost. ``killed=True`` additionally marks the
     in-memory state as GONE (process death): the federated read views
     must not serve the corpse's ledger — a dead shard's pods are
     ledger-absent until the warm restart, and the chaos invariants
-    must see exactly that."""
+    must see exactly that. ``transport`` is the replica's decision
+    surface: an :class:`InProcessTransport` (a live Extender in this
+    process — the parity oracle) or a :class:`SubprocessTransport`
+    (one planner daemon per replica over the webhook HTTP contract)."""
 
-    __slots__ = ("index", "extender", "alive", "killed", "pods_routed")
+    __slots__ = ("index", "transport", "alive", "killed", "pods_routed")
 
-    def __init__(self, index: int, extender: Extender):
+    def __init__(self, index: int, transport):
         self.index = index
-        self.extender = extender
+        self.transport = transport
         self.alive = True
         self.killed = False
         self.pods_routed = 0
+
+    @property
+    def extender(self) -> Optional[Extender]:
+        """The replica's in-process Extender (None for a subprocess
+        replica — its extender lives in the worker daemon)."""
+        return self.transport.extender
 
     @property
     def name(self) -> str:
@@ -161,15 +839,38 @@ class _FederatedState:
         return [r for r in self._router.replicas if not r.killed]
 
     def allocations(self) -> list:
-        return [
-            a
-            for rep in self._live()
-            for a in rep.extender.state.allocations()
-        ]
+        # fanned out: in process mode each replica serializes its own
+        # ledger concurrently (the lifecycle resync reads this every
+        # churn wave — serial fetches would re-serialize the whole
+        # fleet through one connection at a time)
+        results = self._router._fan_out(
+            self._live(), lambda rep: rep.transport.allocations()
+        )
+        out: list = []
+        for allocs in results.values():
+            out.extend(allocs)
+        return out
 
     def allocation(self, pod_key: str):
-        for rep in self._live():
-            a = rep.extender.state.allocation(pod_key)
+        if self._router.mode == "subprocess":
+            # bind answers prime this cache; a hit saves the lifecycle
+            # loop one HTTP read per released pod (stale-yes is safe:
+            # the routed release on an already-released pod is a no-op)
+            cached = self._router._alloc_cache.get(pod_key)
+            if cached is not None:
+                return cached
+        # the router's pod->replica affinity answers most lookups with
+        # one targeted read; an unmapped key scans the live set
+        idx = self._router._pod_replica.get(pod_key)
+        reps = ([self._router.replicas[idx]] if idx is not None
+                else self._live())
+        for rep in reps:
+            if rep.killed:
+                continue
+            try:
+                a = rep.transport.allocation(pod_key)
+            except ReplicaUnavailable:
+                continue
             if a is not None:
                 return a
         return None
@@ -187,7 +888,7 @@ class _FederatedState:
         for rep in reps:
             if rep.killed:
                 continue
-            view = rep.extender.state.node(name)
+            view = rep.transport.node(name)
             if view is not None:
                 return view
         return None
@@ -195,28 +896,37 @@ class _FederatedState:
     def node_names(self) -> tuple[str, ...]:
         out: list[str] = []
         for rep in self._live():
-            out.extend(rep.extender.state.node_names())
+            try:
+                out.extend(rep.transport.node_names())
+            except ReplicaUnavailable:
+                continue
         return tuple(sorted(out))
 
     def slice_ids(self) -> list[str]:
         out: list[str] = []
         for rep in self._live():
-            out.extend(rep.extender.state.slice_ids())
+            try:
+                out.extend(rep.transport.slice_ids())
+            except ReplicaUnavailable:
+                continue
         return sorted(out)
 
     def utilization(self) -> float:
         used = total = 0
         for rep in self._live():
-            st = rep.extender.state
-            for sid in st.slice_ids():
-                u, t = st.slice_share_counts(sid)
-                used += u
-                total += t
+            try:
+                s = rep.transport.summary()
+            except ReplicaUnavailable:
+                continue
+            used += s["used_shares"]
+            total += s["total_shares"]
         return used / total if total else 0.0
 
     def retire(self) -> None:
         for rep in self._router.replicas:
-            rep.extender.state.retire()
+            ext = rep.extender
+            if ext is not None:
+                ext.state.retire()
 
 
 class _RouterCycle:
@@ -226,19 +936,26 @@ class _RouterCycle:
     def __init__(self, router: "ShardRouter"):
         self._router = router
 
-    def _cycles(self) -> list:
-        return [
-            rep.extender.cycle
-            for rep in self._router.replicas
-            if rep.extender.cycle is not None
-        ]
+    def _stats_rows(self) -> list[tuple[str, dict[str, Any]]]:
+        out = []
+        for rep in self._router.replicas:
+            if rep.killed:
+                continue
+            try:
+                s = rep.transport.summary().get("cycle")
+            except ReplicaUnavailable:
+                continue
+            if s is not None:
+                out.append((rep.name, s))
+        return out
 
     @property
     def cycles(self) -> int:
-        return sum(c.cycles for c in self._cycles())
+        return sum(p["cycles"] for _, p in self._stats_rows())
 
     def stats(self) -> dict[str, Any]:
-        per = [c.stats() for c in self._cycles()]
+        rows = self._stats_rows()
+        per = [p for _, p in rows]
         if not per:
             return {"enabled": False}
         summed = {
@@ -251,9 +968,7 @@ class _RouterCycle:
             )
         }
         lookups = summed["plan_hits"] + summed["plan_misses"]
-        wall_total = sum(
-            c.cycle_wall_total for c in self._cycles()
-        )
+        wall_total = sum(p["cycle_wall_total"] for p in per)
         summed.update({
             "enabled": True,
             "replicas": len(per),
@@ -264,12 +979,12 @@ class _RouterCycle:
                 if summed["pods_planned"] else None
             ),
             "per_replica": {
-                self._router.replicas[i].name: {
+                name: {
                     "pods_planned": p["pods_planned"],
                     "cycles": p["cycles"],
                     "plan_ms_per_pod": p["plan_ms_per_pod"],
                 }
-                for i, p in enumerate(per)
+                for name, p in rows
             },
         })
         return summed
@@ -285,18 +1000,31 @@ class _MergedEvents:
     def counts_by_reason(self) -> dict[str, int]:
         out: dict[str, int] = {}
         for rep in self._router.replicas:
-            for reason, n in rep.extender.events.counts_by_reason().items():
+            if rep.killed:
+                continue
+            try:
+                counts = rep.transport.counts_by_reason()
+            except ReplicaUnavailable:
+                continue
+            for reason, n in counts.items():
                 out[reason] = out.get(reason, 0) + n
         return out
 
     def emit(self, *args, **kwargs) -> None:
         # router-level events land on replica 0's journal (the
         # rendezvous coordinator's channel)
-        self._router.replicas[0].extender.events.emit(*args, **kwargs)
+        try:
+            self._router.replicas[0].transport.events_emit(*args,
+                                                           **kwargs)
+        except ReplicaUnavailable:
+            log.warning("router event %s lost: replica r0 unreachable",
+                        args[0] if args else kwargs.get("reason"))
 
     def close(self) -> None:
         for rep in self._router.replicas:
-            rep.extender.events.close()
+            ext = rep.extender
+            if ext is not None:
+                ext.events.close()
 
 
 class ShardRouter:
@@ -309,15 +1037,19 @@ class ShardRouter:
         if n < 1:
             raise ShardError("planner_replicas must be >= 1")
         self.config = config
+        self.mode = config.shard_transport
         from tpukube.core.clock import SYSTEM
 
         self.clock = clock if clock is not None else SYSTEM
-        #: ONE eviction bus across replicas, so the harness's / the
-        #: daemon's single EvictionExecutor drains every shard's
-        #: rollback and preemption victims
+        #: ONE eviction bus across replicas: in-process replicas share
+        #: it directly (eviction_sink); subprocess replicas queue
+        #: locally and the router pulls (pull_evictions) — either way
+        #: the harness's / the daemon's single EvictionExecutor drains
+        #: every shard's rollback and preemption victims here
         self.pending_evictions: deque[str] = deque()
         self.replicas: list[PlannerReplica] = []
         self._replica_cfgs: list[TpuKubeConfig] = []
+        fake_clock = hasattr(self.clock, "advance")
         for i in range(n):
             rcfg = config
             if n > 1 and config.journal_enabled:
@@ -327,14 +1059,35 @@ class ShardRouter:
                     config, journal_path=f"{config.journal_path}.r{i}"
                 )
             self._replica_cfgs.append(rcfg)
-            self.replicas.append(PlannerReplica(i, Extender(
-                rcfg, clock=clock,
-                eviction_sink=self.pending_evictions,
-            )))
+            self.replicas.append(PlannerReplica(
+                i, self._make_transport(i, rcfg, fake_clock)
+            ))
         self._n = n
+        # fan-out pool for the subprocess mode: calls to DISTINCT
+        # replicas run concurrently (one planner process per core —
+        # the multi-core speedup); each replica's own connection lock
+        # keeps its binds/prepares ordered. None in-process: the
+        # in-process replicas share one GIL, so a pool would only add
+        # switch overhead to the deterministic tier-1 path.
+        self._pool = (ThreadPoolExecutor(
+            max_workers=n, thread_name_prefix="tpukube-shard-fanout",
+        ) if self.mode == "subprocess" else None)
+        self._inflight = 0
+        self.health_checks_total = 0
+        self.health_failures_total = 0
+        self._health_checked_at: Optional[float] = None
+        # pod key -> last bound AllocResult, decoded from bind answers
+        # (subprocess mode only): lets the federated allocation() serve
+        # the lifecycle loop's per-release existence checks without an
+        # HTTP read per pod. Advisory — the divergence checkers read
+        # allocations() straight from the replicas.
+        self._alloc_cache: dict[str, AllocResult] = {}
         # N=1 parity gate: every entry point delegates VERBATIM to the
-        # sole replica's Extender (same objects, same code path)
-        self._sole = self.replicas[0].extender if n == 1 else None
+        # sole replica's Extender (same objects, same code path). Only
+        # the in-process transport has an extender in this process —
+        # an N=1 SUBPROCESS router routes normally, over the wire.
+        self._sole = (self.replicas[0].extender
+                      if n == 1 and self.mode == "inprocess" else None)
         # router maps only (replica state lives behind each replica's
         # own locks; this leaf lock never nests around them on the
         # mutation path — routing reads replica state lock-free
@@ -363,6 +1116,19 @@ class ShardRouter:
         # the EXACT unreachable replicas means a same-named gang
         # re-created meanwhile on other replicas is never touched.
         self._aborted_dcn: dict[tuple[str, str], set[int]] = {}
+        # replica index -> (clock instant, gauges): the subprocess
+        # routing pre-filter's per-instant memo (see _gauges_of)
+        self._gauge_cache: dict[int, tuple[float, dict]] = {}
+        # (replica, gang key) -> (clock instant, fit/reservation
+        # answer): the subprocess gang-routing memo. A 512-member gang
+        # admitted in one burst (one clock instant) must not pay one
+        # fit probe + one reservation read PER MEMBER over the wire;
+        # staleness within an instant only defers a gang one retry —
+        # the reservation itself is taken under the replica's locks.
+        self._fit_cache: dict[tuple[int, tuple[str, str]],
+                              tuple[float, bool]] = {}
+        self._rsv_cache: dict[tuple[int, tuple[str, str]],
+                              tuple[float, Optional[dict]]] = {}
         # counters (per-replica metrics/statusz)
         self.rendezvous_prepared = 0
         self.rendezvous_committed = 0
@@ -375,57 +1141,147 @@ class ShardRouter:
         self.journal = None
         self.decisions = None
 
+    def _make_transport(self, index: int, rcfg: TpuKubeConfig,
+                        fake_clock: bool):
+        if self.mode == "subprocess":
+            return SubprocessTransport(
+                index, rcfg, fake_clock=fake_clock,
+                on_down=self._on_transport_down,
+            )
+        return InProcessTransport(Extender(
+            rcfg, clock=self.clock,
+            eviction_sink=self.pending_evictions,
+        ))
+
+    def _on_transport_down(self, idx: int) -> None:
+        """A transport-level connection failure: the daemon is gone (or
+        unreachable) mid-call. Mark the replica dead with the SAME
+        semantics as ``crash_replica`` — excluded from the federated
+        views, rendezvous parts treated as lost by the janitor, warm
+        restart via ``restart_replica``."""
+        rep = self.replicas[idx]
+        if rep.alive or not rep.killed:
+            rep.alive = False
+            rep.killed = True
+            self._drop_dead_alloc_cache(idx)
+            log.error("replica %s marked dead (transport failure)",
+                      rep.name)
+
+    def _drop_dead_alloc_cache(self, idx: int) -> None:
+        """Purge the dead replica's entries from the bind-answer alloc
+        cache: the federated ``allocation()`` must stop serving the
+        corpse's ledger the moment ``allocations()`` does (the
+        dead-shard invariant the chaos checkers assert). Restart
+        re-primes the survivors from the pod annotations."""
+        with self._lock:
+            dead = [k for k, i in self._pod_replica.items()
+                    if i == idx]
+            for key in dead:
+                self._alloc_cache.pop(key, None)
+
+    def _fan_out(self, reps: list[PlannerReplica], fn) -> dict[int, Any]:
+        """Run ``fn(rep)`` for each replica — concurrently in
+        subprocess mode (the multi-core fan-out), serially in-process
+        (one GIL; a pool would only reorder the deterministic tier-1
+        path). A replica that dies mid-call is skipped; its death is
+        already recorded by the transport's ``on_down``."""
+        out: dict[int, Any] = {}
+        if self._pool is not None and len(reps) > 1:
+            with self._lock:
+                self._inflight += 1
+            try:
+                futures = {rep.index: self._pool.submit(fn, rep)
+                           for rep in reps}
+                for idx, fut in futures.items():
+                    try:
+                        out[idx] = fut.result()
+                    except ReplicaUnavailable:
+                        continue
+            finally:
+                with self._lock:
+                    self._inflight -= 1
+            return out
+        for rep in reps:
+            try:
+                out[rep.index] = fn(rep)
+            except ReplicaUnavailable:
+                continue
+        return out
+
     # -- Extender-surface passthroughs --------------------------------------
     @property
     def evict_precheck(self):
-        return self.replicas[0].extender.evict_precheck
+        ext = self.replicas[0].extender
+        return ext.evict_precheck if ext is not None else None
 
     @evict_precheck.setter
     def evict_precheck(self, fn) -> None:
         for rep in self.replicas:
-            rep.extender.evict_precheck = fn
+            rep.transport.set_evict_precheck(fn)
 
     @property
     def binder(self):
-        return self.replicas[0].extender.binder
+        ext = self.replicas[0].extender
+        return ext.binder if ext is not None else None
 
     @binder.setter
     def binder(self, fn) -> None:
         for rep in self.replicas:
-            rep.extender.binder = fn
+            rep.transport.set_binder(fn)
 
     @property
     def degraded_gate(self):
-        return self.replicas[0].extender.degraded_gate
+        ext = self.replicas[0].extender
+        return ext.degraded_gate if ext is not None else None
 
     @degraded_gate.setter
     def degraded_gate(self, fn) -> None:
         for rep in self.replicas:
-            rep.extender.degraded_gate = fn
+            rep.transport.set_degraded_gate(fn)
 
     @property
     def latencies(self) -> dict[str, list[float]]:
         """Merged webhook-latency windows (quantile feeds)."""
         out: dict[str, list[float]] = {}
         for rep in self.replicas:
-            for handler, window in rep.extender.latencies.items():
+            if rep.killed:
+                continue
+            try:
+                windows = rep.transport.latencies()
+            except ReplicaUnavailable:
+                continue
+            for handler, window in windows.items():
                 out.setdefault(handler, []).extend(window)
         return out
 
+    def _summed(self, field: str) -> int:
+        total = 0
+        for rep in self.replicas:
+            if rep.killed:
+                continue
+            try:
+                total += rep.transport.summary()[field]
+            except ReplicaUnavailable:
+                continue
+        return total
+
     @property
     def preemptions(self) -> int:
-        return sum(r.extender.preemptions for r in self.replicas)
+        return self._summed("preemptions")
 
     @property
     def binds_total(self) -> int:
-        return sum(r.extender.binds_total for r in self.replicas)
+        return self._summed("binds_total")
 
     def gang_snapshot(self) -> list[dict[str, Any]]:
         out: list[dict[str, Any]] = []
         for rep in self.replicas:
             if rep.killed:
                 continue  # a dead shard's reservations died with it
-            out.extend(rep.extender.gang_snapshot())
+            try:
+                out.extend(rep.transport.gang_snapshot())
+            except ReplicaUnavailable:
+                continue
         return sorted(out, key=lambda g: (g["namespace"], g["group"]))
 
     def alloc_snapshot(self) -> list[dict[str, Any]]:
@@ -433,21 +1289,26 @@ class ShardRouter:
         for rep in self.replicas:
             if rep.killed:
                 continue
-            out.extend(rep.extender.alloc_snapshot())
+            try:
+                out.extend(rep.transport.alloc_snapshot())
+            except ReplicaUnavailable:
+                continue
         return sorted(out, key=lambda a: a["pod"])
 
     def audit_stats(self) -> dict[str, Any]:
         """Summed snapshot-audit sentinel counters across replicas."""
-        rate = max(
-            (r.extender.snapshots.audit_rate for r in self.replicas),
-            default=0.0,
-        )
+        rows = []
+        for rep in self.replicas:
+            if rep.killed:
+                continue
+            try:
+                rows.append(rep.transport.summary()["audit"])
+            except ReplicaUnavailable:
+                continue
         return {
-            "rate": rate,
-            "checks": sum(r.extender.snapshots.audit_checks
-                          for r in self.replicas),
-            "divergences": sum(r.extender.snapshots.audit_divergences
-                               for r in self.replicas),
+            "rate": max((r["rate"] for r in rows), default=0.0),
+            "checks": sum(r["checks"] for r in rows),
+            "divergences": sum(r["divergences"] for r in rows),
         }
 
     def statusz(self) -> dict[str, Any]:
@@ -476,28 +1337,37 @@ class ShardRouter:
             }
         per_replica = []
         for rep in self.replicas:
-            ext = rep.extender
-            st = ext.state
-            used = total = 0
-            for sid in st.slice_ids():
-                u, t = st.slice_share_counts(sid)
-                used += u
-                total += t
-            per_replica.append({
+            row = {
                 "replica": rep.name,
                 "alive": rep.alive,
-                "slices": st.slice_ids(),
-                "nodes": len(st.node_names()),
-                "allocs": len(st.allocations()),
                 "pods_routed": rep.pods_routed,
-                "binds_total": ext.binds_total,
-                "utilization": round(used / total, 4) if total else 0.0,
-                "queue_depth": (ext.cycle.queue_depth()
-                                if ext.cycle is not None else 0),
-                "snapshot_hits": ext.snapshots.hits,
-                "snapshot_rebuilds": ext.snapshots.rebuilds,
-            })
-        return {
+            }
+            summary = None
+            if not rep.killed:
+                try:
+                    summary = rep.transport.summary()
+                except ReplicaUnavailable:
+                    summary = None
+            if summary is None:
+                # a dead daemon's ledger died with it: render the row
+                # with liveness only, exactly what an operator sees
+                row.update({"slices": [], "nodes": 0, "allocs": 0,
+                            "binds_total": 0, "utilization": 0.0,
+                            "queue_depth": 0, "snapshot_hits": 0,
+                            "snapshot_rebuilds": 0})
+            else:
+                row.update({
+                    "slices": summary["slices"],
+                    "nodes": summary["nodes"],
+                    "allocs": summary["allocs"],
+                    "binds_total": summary["binds_total"],
+                    "utilization": round(summary["utilization"], 4),
+                    "queue_depth": summary["queue_depth"],
+                    "snapshot_hits": summary["snapshot_hits"],
+                    "snapshot_rebuilds": summary["snapshot_rebuilds"],
+                })
+            per_replica.append(row)
+        doc = {
             "replicas": per_replica,
             "slice_assignment": slice_map,
             "rendezvous": {
@@ -506,7 +1376,39 @@ class ShardRouter:
                 "committed": self.rendezvous_committed,
                 "aborted": self.rendezvous_aborted,
             },
+            "transport": self.transport_statusz(),
         }
+        return doc
+
+    def transport_statusz(self) -> dict[str, Any]:
+        """The router's transport section: mode, in-flight fan-outs,
+        and per-replica link liveness/RTT — the observability leg the
+        process mode adds (satellite of ISSUE 14). In-process mode
+        reports the mode alone: there is no wire to measure."""
+        from tpukube.obs.registry import quantile
+
+        out: dict[str, Any] = {"mode": self.mode}
+        if self.mode != "subprocess":
+            return out
+        with self._lock:
+            out["in_flight_fanouts"] = self._inflight
+        out["health_checks"] = self.health_checks_total
+        out["health_failures"] = self.health_failures_total
+        rows = []
+        for rep in self.replicas:
+            tr = rep.transport
+            rtts = tr.rtt_snapshot()
+            rows.append({
+                "replica": rep.name,
+                "alive": rep.alive,
+                "rtt_p50_ms": round(1000 * quantile(rtts, 0.5), 3),
+                "rtt_p99_ms": round(1000 * quantile(rtts, 0.99), 3),
+                "requests": tr.rtt_count,
+                "health_checks": tr.health_checks,
+                "health_failures": tr.health_failures,
+            })
+        out["replicas"] = rows
+        return out
 
     # -- slice / node / pod assignment --------------------------------------
     def _slice_of_payload(self, annotations: dict[str, str]) -> Optional[str]:
@@ -613,27 +1515,56 @@ class ShardRouter:
             return None
         return ask[1], ask[1] * pod.group.min_member
 
+    def _gauges_of(self, rep: PlannerReplica) -> dict[str, dict]:
+        """The replica's per-slice capacity gauges. In-process: a
+        direct cached-snapshot read (O(slices), free). Subprocess: one
+        GET, memoized per scheduling-clock instant — a 512-member gang
+        admitted in one batch must not pay 512xN gauge round-trips;
+        the full fit probe stays authoritative, so gauge staleness
+        within one instant can only defer a gang one retry."""
+        if rep.transport.mode == "inprocess":
+            return rep.transport.gauges()
+        now = self.clock.monotonic()
+        with self._lock:
+            ent = self._gauge_cache.get(rep.index)
+            if ent is not None and ent[0] == now:
+                return ent[1]
+        gauges = rep.transport.gauges()
+        with self._lock:
+            self._gauge_cache[rep.index] = (now, gauges)
+        return gauges
+
     def _replica_fits_gang(self, rep: PlannerReplica, pod: PodInfo,
                            total: int) -> bool:
         """Can this replica host the gang ICI-contiguously in ONE of
-        its slices? Same search ``ensure_reservation`` runs — against
-        the replica's epoch-cached snapshot, so the sweep this builds
-        is the sweep the reservation reuses."""
-        snap = rep.extender.snapshots.current()
-        shape = pod.group.shape if pod.group is not None else None
-        for sid in snap.slice_ids():
-            ss = snap.slice(sid)
-            if ss.blocked_free_chips < total:
-                continue
-            coords = slicefit.find_slice_in(
-                ss.blocked_sweep(),
-                count=None if shape is not None else total,
-                shape=shape,
-                broken=ss.broken,
-            )
-            if coords is not None:
-                return True
-        return False
+        its slices? The cheap largest-free-box gauge (cached on the
+        replica's snapshot) pre-filters: it can only over-estimate the
+        blocked sweep's contiguity, so a replica it rejects cannot fit
+        the gang and the full probe — a sweep, and in process mode a
+        round-trip — never runs there. The probe itself is the same
+        search ``ensure_reservation`` runs, against the replica's
+        epoch-cached snapshot."""
+        key = (pod.namespace,
+               pod.group.name if pod.group is not None else pod.name)
+        if rep.transport.mode == "subprocess":
+            now = self.clock.monotonic()
+            with self._lock:
+                ent = self._fit_cache.get((rep.index, key))
+            if ent is not None and ent[0] == now:
+                return ent[1]
+        try:
+            gauges = self._gauges_of(rep)
+            if all(g["largest_free_box"] < total
+                   for g in gauges.values()):
+                fits = False
+            else:
+                fits = rep.transport.gang_fit(pod, total)
+        except ReplicaUnavailable:
+            return False
+        if rep.transport.mode == "subprocess":
+            with self._lock:
+                self._fit_cache[(rep.index, key)] = (now, fits)
+        return fits
 
     def _route_gang(self, pod: PodInfo) -> int:
         """The gang pod's target replica: its rendezvous participant
@@ -652,6 +1583,11 @@ class ShardRouter:
         now = self.clock.monotonic()
         if now != self._swept_at:
             self._swept_at = now
+            with self._lock:
+                # the per-instant routing memos expire with the instant
+                self._fit_cache.clear()
+                self._rsv_cache.clear()
+                self._gauge_cache.clear()
             self.sweep()
         with self._lock:
             rdv = self._dcn.get(key)
@@ -667,8 +1603,8 @@ class ShardRouter:
         with self._lock:
             home = self._gang_replica.get(key)
         if home is not None and self.replicas[home].alive \
-                and self.replicas[home].extender.gang.reservation(
-                    *key) is not None:
+                and self._reservation_routed(self.replicas[home],
+                                             key) is not None:
             # sticky only while the home actually HOLDS a reservation:
             # a gang that transiently fit nowhere must re-probe the
             # whole fleet (and the rendezvous) on every retry, not
@@ -691,6 +1627,11 @@ class ShardRouter:
                 if self._replica_fits_gang(rep, pod, total):
                     with self._lock:
                         self._gang_replica[key] = rep.index
+                        # the pick is about to consume capacity there:
+                        # the NEXT gang routed within this clock
+                        # instant must rank against fresh gauges, not
+                        # this pick's pre-image
+                        self._gauge_cache.pop(rep.index, None)
                     return rep.index
             if pod.group.allow_dcn and pod.group.shape is None \
                     and self._n > 1:
@@ -706,14 +1647,47 @@ class ShardRouter:
 
     def state_utilization_of(self, rep: PlannerReplica) -> float:
         """One replica's used-share fraction off its cached snapshot
-        (O(slices) — never a ledger walk on the routing path)."""
-        snap = rep.extender.snapshots.current()
-        used = total = 0
-        for sid in snap.slice_ids():
-            ss = snap.slice(sid)
-            used += ss.used_shares
-            total += ss.total_shares
+        gauges (O(slices) — never a ledger walk, and in process mode
+        at most one round-trip per clock instant)."""
+        try:
+            gauges = self._gauges_of(rep)
+        except ReplicaUnavailable:
+            return 1.0  # unreachable sorts last in emptiest-first orders
+        used = sum(g["used_shares"] for g in gauges.values())
+        total = sum(g["total_shares"] for g in gauges.values())
         return used / total if total else 0.0
+
+    def _reservation_of(self, rep: PlannerReplica,
+                        key: tuple[str, str]) -> Optional[dict]:
+        """The replica's reservation record for a gang key (None when
+        absent OR when the replica is unreachable — an unreachable
+        replica's reservation is exactly as lost as a crashed one's).
+        Always a FRESH read: the janitor and the eager commit check
+        must see reservation state as of now, never a routing memo."""
+        try:
+            return rep.transport.gang_reservation(key)
+        except ReplicaUnavailable:
+            return None
+
+    def _reservation_routed(self, rep: PlannerReplica,
+                            key: tuple[str, str]) -> Optional[dict]:
+        """The ROUTING path's reservation read, memoized per scheduling
+        clock instant over the wire (see _fit_cache): a gang burst's
+        members must not pay one reservation round-trip each. A stale
+        None only re-ranks through the (also memoized) fit probe to
+        the same home; a stale record re-routes a member one retry
+        late — both settle within the next instant."""
+        if rep.transport.mode != "subprocess":
+            return self._reservation_of(rep, key)
+        now = self.clock.monotonic()
+        with self._lock:
+            ent = self._rsv_cache.get((rep.index, key))
+        if ent is not None and ent[0] == now:
+            return ent[1]
+        res = self._reservation_of(rep, key)
+        with self._lock:
+            self._rsv_cache[(rep.index, key)] = (now, res)
+        return res
 
     def _rendezvous_member_target(
         self, rdv: _Rendezvous, pod: PodInfo
@@ -752,73 +1726,88 @@ class ShardRouter:
         key = (pod.namespace, pod.group.name)
         # PLAN: greedy over (replica, slice) by emptiness — one box per
         # slice, each a multiple of chips_per_pod, largest first (the
-        # cross-replica mirror of GangManager._plan_dcn_split)
-        candidates: list[tuple[float, str, int, Any]] = []
+        # cross-replica mirror of GangManager._plan_dcn_split). The
+        # plan reads ONLY the cheap per-replica gauges (largest free
+        # box / utilization, cached on each replica's snapshot): no
+        # full fit probe — a sweep, and in process mode a round-trip —
+        # serializes across N replicas here. The gauge bounds each
+        # slice's one-box part; the PREPARE leg re-derives the exact
+        # coords on the owning replica and shrinks on races.
+        candidates: list[tuple[float, str, int, int]] = []
         for rep in self._alive():
-            snap = rep.extender.snapshots.current()
-            for sid in snap.slice_ids():
-                ss = snap.slice(sid)
-                candidates.append((ss.utilization, sid, rep.index, ss))
+            try:
+                gauges = self._gauges_of(rep)
+            except ReplicaUnavailable:
+                continue
+            for sid, g in gauges.items():
+                box = (g["largest_free_box"] // cpp) * cpp
+                if box >= cpp:
+                    candidates.append(
+                        (g["utilization"], sid, rep.index, box)
+                    )
         candidates.sort(key=lambda c: (c[0], c[1]))
-        parts: dict[int, dict[str, list[TopologyCoord]]] = {}
+        volumes: dict[int, dict[str, int]] = {}
         remaining = total
-        for _, sid, idx, ss in candidates:
+        for _, sid, idx, box in candidates:
             if remaining == 0:
                 break
-            vol = min(remaining, (ss.blocked_free_chips // cpp) * cpp)
-            while vol >= cpp:
-                coords = slicefit.find_slice_in(
-                    ss.blocked_sweep(), count=vol, broken=ss.broken
-                )
-                if coords is not None:
-                    parts.setdefault(idx, {})[sid] = list(coords)
-                    remaining -= len(coords)
-                    break
-                vol -= cpp
-        if remaining != 0 or len(parts) < 2:
-            # len(parts) < 2 cannot happen when every single replica
+            vol = min(remaining, box)
+            if vol >= cpp:
+                volumes.setdefault(idx, {})[sid] = vol
+                remaining -= vol
+        if remaining != 0 or len(volumes) < 2:
+            # len(volumes) < 2 cannot happen when every single replica
             # already failed the whole-gang fit — defensive: a
             # one-replica "rendezvous" is just that replica's own
             # _plan_dcn_split, which its ensure_reservation will run
             return None
-        # PREPARE each part under its replica's own locks; roll back
-        # every prepared part on the first failure (no members have
-        # bound, so drop_reservation — not dissolve — is the abort)
+        # PREPARE each part under its replica's own locks (ordered per
+        # replica — the transport contract); roll back every prepared
+        # part on the first failure or on a gauge-raced shortfall (no
+        # members have bound, so drop_reservation — not dissolve — is
+        # the abort)
         prepared: list[int] = []
+        parts: dict[int, dict[str, list[TopologyCoord]]] = {}
         local_min: dict[int, int] = {}
-        for idx in sorted(parts):
+        got_total = 0
+        failure: Optional[str] = None
+        for idx in sorted(volumes):
             rep = self.replicas[idx]
-            members = sum(len(c) for c in parts[idx].values()) // cpp
-            local_min[idx] = members
-            local_pod = dc_replace(pod, group=PodGroup(
-                name=pod.group.name, min_member=members,
-                shape=None, allow_dcn=True,
-            ))
             try:
-                rep.extender.gang.reserve_exact_split(
-                    local_pod, cpp, parts[idx]
-                )
+                got = rep.transport.gang_prepare(pod, cpp, volumes[idx])
             except Exception as e:
-                # any prepare failure aborts every prepared part (no
-                # members have bound, so drop — not dissolve); only
-                # the EXPECTED races (box re-occupied, slice gone)
-                # degrade to "retry next cycle" — anything else is a
-                # bug and re-raises after the abort
+                # any prepare failure aborts every prepared part; only
+                # the EXPECTED races (box re-occupied, slice gone,
+                # replica died mid-prepare) degrade to "retry next
+                # cycle" — anything else is a bug and re-raises after
+                # the abort
                 log.warning(
                     "rendezvous %s/%s: prepare on %s failed (%s); "
                     "aborting %d prepared part(s)",
                     key[0], key[1], rep.name, e, len(prepared),
                 )
-                for pidx in prepared:
-                    self.replicas[pidx].extender.gang.drop_reservation(
-                        key
-                    )
-                with self._lock:
-                    self.rendezvous_aborted += 1
-                if not isinstance(e, (GangError, StateError)):
+                self._abort_prepared(key, prepared)
+                if not isinstance(
+                    e, (GangError, StateError, ReplicaUnavailable)
+                ):
                     raise
                 return None
+            parts[idx] = got
+            members = sum(len(c) for c in got.values()) // cpp
+            local_min[idx] = members
+            got_total += members * cpp
             prepared.append(idx)
+        if got_total != total:
+            # a gauge over-estimated and the owning replica came up
+            # short: all-or-nothing — drop what was reserved, let the
+            # scheduler retry against the changed fleet
+            log.warning(
+                "rendezvous %s/%s: prepared %d of %d chips (gauges "
+                "raced occupancy); aborting", key[0], key[1],
+                got_total, total,
+            )
+            self._abort_prepared(key, prepared)
+            return None
         rdv = _Rendezvous(key, parts, local_min,
                           created=self.clock.monotonic())
         with self._lock:
@@ -839,6 +1828,21 @@ class ShardRouter:
         )
         return rdv
 
+    def _abort_prepared(self, key: tuple[str, str],
+                        prepared: list[int]) -> None:
+        """Drop every prepared (member-less) part of an aborted
+        rendezvous prepare and count the abort."""
+        for pidx in prepared:
+            try:
+                self.replicas[pidx].transport.gang_drop(key)
+            except ReplicaUnavailable:
+                # the replica died holding a member-less reservation:
+                # its TTL janitor (or the restart rebuild, which finds
+                # no bound members) retires it — nothing leaks
+                continue
+        with self._lock:
+            self.rendezvous_aborted += 1
+
     def sweep(self) -> list[tuple[str, str]]:
         """The rendezvous janitor (phase 3's abort half), run at the
         top of every gang routing and every batch drive: sweep each
@@ -849,6 +1853,20 @@ class ShardRouter:
         shared eviction bus. A COMMITTED rendezvous tolerates a dead
         replica: its part is durable in pod annotations and restores
         with the replica. Returns the aborted gang keys."""
+        if self.mode == "subprocess":
+            # the process-mode janitor legs: detect dead daemons (a
+            # failed health check = crash_replica semantics), run every
+            # worker's own gang TTL janitor (in-process replicas sweep
+            # inside their webhook handling; a worker daemon between
+            # webhooks must be swept from here or an expired
+            # reservation would linger until its next request), then
+            # pull the replica-local eviction queues — INCLUDING any
+            # victims those sweeps just rolled back — onto the shared
+            # bus
+            self.health_check()
+            self._fan_out(self._alive(),
+                          lambda rep: rep.transport.gang_sweep())
+            self.pull_evictions()
         aborted: list[tuple[str, str]] = []
         with self._lock:
             live = list(self._dcn.items())
@@ -861,20 +1879,29 @@ class ShardRouter:
                     if not rdv.committed:
                         lost = True
                     continue
-                rep.extender.gang.sweep()
-                res = rep.extender.gang.reservation(*key)
+                try:
+                    rep.transport.gang_sweep()
+                    res = rep.transport.gang_reservation(key)
+                except ReplicaUnavailable:
+                    # died mid-sweep: same as not alive above
+                    if not rdv.committed:
+                        lost = True
+                    continue
                 if res is None:
                     lost = True
                 else:
                     held.append((idx, res))
             if not rdv.committed and held and not lost \
-                    and all(res.committed for _, res in held) \
+                    and all(res["committed"] for _, res in held) \
                     and len(held) == len(rdv.parts):
                 self._check_rendezvous_commit(rdv)
                 continue
             if lost and not rdv.committed:
                 for idx, _res in held:
-                    self.replicas[idx].extender.gang.dissolve(key)
+                    try:
+                        self.replicas[idx].transport.gang_dissolve(key)
+                    except ReplicaUnavailable:
+                        continue  # now unreachable: settled on return
                 unreachable = {
                     idx for idx in rdv.parts
                     if not self.replicas[idx].alive
@@ -915,12 +1942,91 @@ class ShardRouter:
         for key, idx in homes:
             rep = self.replicas[idx]
             if rep.alive \
-                    and rep.extender.gang.reservation(*key) is None:
+                    and self._reservation_of(rep, key) is None:
                 with self._lock:
                     if self._gang_replica.get(key) == idx \
                             and key not in self._dcn:
                         self._gang_replica.pop(key, None)
         return aborted
+
+    # -- process-mode janitors ----------------------------------------------
+    def health_check(self) -> int:
+        """Health-check the subprocess replica set (throttled to once
+        per scheduling-clock instant — sweep() runs this at the top of
+        every drive and every gang routing). A replica that fails its
+        check is marked DEAD with ``crash_replica`` semantics: routed
+        around, excluded from the federated views, its uncommitted
+        rendezvous parts aborted by the janitor, warm restart via
+        ``restart_replica``. Returns how many replicas failed."""
+        if self.mode != "subprocess":
+            return 0
+        now = self.clock.monotonic()
+        with self._lock:
+            if self._health_checked_at == now:
+                return 0
+            self._health_checked_at = now
+        failed = 0
+        for rep in self.replicas:
+            if not rep.alive:
+                continue
+            self.health_checks_total += 1
+            try:
+                ok = rep.transport.healthz()
+            except ReplicaUnavailable:
+                ok = False
+            if not ok:
+                failed += 1
+                self.health_failures_total += 1
+                log.error("replica %s failed its health check; marking "
+                          "dead (crash_replica semantics)", rep.name)
+                self._mark_replica_dead(rep.index)
+        return failed
+
+    def _mark_replica_dead(self, idx: int) -> None:
+        """A subprocess replica's daemon is gone/unreachable: its
+        in-memory state is unreachable exactly like a killed process's
+        — dead, not merely partitioned (a partition is an explicit
+        chaos injection; the health checker cannot tell a hung daemon
+        from a dead one and must fail to the safe side: rebuild)."""
+        rep = self.replicas[idx]
+        rep.alive = False
+        rep.killed = True
+        self._drop_dead_alloc_cache(idx)
+
+    def pull_evictions(self) -> int:
+        """Drain each subprocess replica's local eviction queue onto
+        the router's shared bus (in-process replicas write the shared
+        deque directly — nothing to pull). The harness's
+        drain_evictions and the sweep janitor both run this, so a
+        worker-side rollback's victims surface within the round."""
+        if self.mode != "subprocess":
+            return 0
+        pulled = 0
+        results = self._fan_out(
+            self._alive(), lambda rep: rep.transport.drain_evictions()
+        )
+        for pods in results.values():
+            for pod_key in pods:
+                self.pending_evictions.append(pod_key)
+                pulled += 1
+        return pulled
+
+    def advance_replicas(self, seconds: float) -> None:
+        """Fan a FakeClock advance out to every subprocess worker so
+        scheduling-semantic time (TTL sweeps, pending expiry) moves in
+        lockstep with the router's clock; no-op in-process (shared
+        clock object). Simulated time passes EVERYWHERE: a PARTITIONED
+        replica still gets the advance (in-process, a partitioned
+        replica shares the router's clock — its TTLs keep aging; the
+        partition is a routing fiction, not a time freeze), only a
+        KILLED process is skipped (gone; its restart re-stamps
+        reservations against its fresh clock)."""
+        if self.mode != "subprocess":
+            return
+        self._fan_out(
+            [r for r in self.replicas if not r.killed],
+            lambda rep: rep.transport.advance(seconds),
+        )
 
     # -- the decision surface -------------------------------------------------
     def handle(self, kind: str, body: Any) -> Any:
@@ -935,15 +2041,22 @@ class ShardRouter:
         if kind == "victim_gone":
             cleared = False
             for rep in self._alive():
-                out = rep.extender.handle(kind, body)
+                try:
+                    out = rep.transport.handle(kind, body)
+                except ReplicaUnavailable:
+                    continue
                 cleared = cleared or bool(out.get("cleared"))
             return {"cleared": cleared}
         if kind == "reconcile":
             changed = False
             for rep in self._alive():
-                if rep.extender.state.allocation(body["pod_key"]) is None:
+                try:
+                    if rep.transport.allocation(
+                            body["pod_key"]) is None:
+                        continue
+                    out = rep.transport.handle(kind, body)
+                except ReplicaUnavailable:
                     continue
-                out = rep.extender.handle(kind, body)
                 changed = changed or bool(out.get("changed"))
             return {"changed": changed}
         if kind == "upsert_node":
@@ -955,8 +2068,56 @@ class ShardRouter:
             if not self.replicas[idx].alive:
                 return {"error": f"replica {self.replicas[idx].name} "
                                  f"unavailable"}
-            return self.replicas[idx].extender.handle(kind, body)
+            try:
+                return self.replicas[idx].transport.handle(kind, body)
+            except ReplicaUnavailable:
+                return {"error": f"replica "
+                                 f"{self.replicas[idx].name} died "
+                                 f"mid-upsert"}
         raise ValueError(f"unknown decision kind {kind!r}")
+
+    def upsert_nodes_many(
+        self, items: list[dict[str, Any]]
+    ) -> list[Any]:
+        """Batched node ingest: route each {name, annotations} item to
+        its owning replica and fan the per-replica batches out
+        concurrently — the harness's node sync pays one round-trip per
+        replica instead of one per node (at 10k nodes the per-node
+        round-trips dominated process-mode setup)."""
+        if self._sole is not None:
+            return [self._sole.handle("upsert_node", it) for it in items]
+        order: dict[int, list[int]] = {}
+        results: list[Any] = [None] * len(items)
+        for pos, item in enumerate(items):
+            idx = self._replica_for_node(
+                item["name"], dict(item.get("annotations") or {})
+            )
+            if idx is None:
+                results[pos] = {"ours": False}
+                continue
+            if not self.replicas[idx].alive:
+                results[pos] = {
+                    "error": f"replica {self.replicas[idx].name} "
+                             f"unavailable"
+                }
+                continue
+            order.setdefault(idx, []).append(pos)
+        out = self._fan_out(
+            [self.replicas[i] for i in order],
+            lambda rep: rep.transport.upsert_nodes(
+                [items[p] for p in order[rep.index]]
+            ),
+        )
+        for idx, positions in order.items():
+            per = out.get(idx)
+            for j, pos in enumerate(positions):
+                if per is None:  # died mid-batch
+                    results[pos] = {
+                        "error": f"replica r{idx} died mid-upsert"
+                    }
+                else:
+                    results[pos] = per[j]
+        return results
 
     def _handle_release(self, body: Any) -> Any:
         pod_key = body["pod_key"]
@@ -974,7 +2135,12 @@ class ShardRouter:
                 # post-heal lifecycle resync (partitioned) re-converges
                 # against the pod store
                 continue
-            rep.extender.handle("release", {"pod_key": pod_key})
+            try:
+                rep.transport.handle("release", {"pod_key": pod_key})
+            except ReplicaUnavailable:
+                continue  # died mid-release: same lost-release contract
+        with self._lock:
+            self._alloc_cache.pop(pod_key, None)
         return None
 
     def _handle_scoring(self, kind: str, body: Any) -> Any:
@@ -991,15 +2157,23 @@ class ShardRouter:
                 rep = self.replicas[idx]
                 if not rep.alive:
                     continue
+                items = []
                 for obj in pnodes:
                     name, annotations = kube.node_name_and_annotations(
                         obj
                     )
-                    try:
-                        rep.extender.state.upsert_node(name, annotations)
-                    except Exception:
-                        log.exception("node %s rejected by %s at "
-                                      "ingest", name, rep.name)
+                    items.append({"name": name,
+                                  "annotations": annotations})
+                try:
+                    for item, out in zip(items,
+                                         rep.transport.upsert_nodes(
+                                             items)):
+                        if isinstance(out, dict) and out.get("error"):
+                            log.error("node %s rejected by %s at "
+                                      "ingest: %s", item["name"],
+                                      rep.name, out["error"])
+                except ReplicaUnavailable:
+                    continue  # marked dead; scoring routes around it
         bad_ask = False
         try:
             ask = Extender.device_request(pod)
@@ -1014,6 +2188,10 @@ class ShardRouter:
         if ask is None and pod.group is None and not bad_ask:
             # non-TPU pod: feasible everywhere, tracked nowhere — no
             # replica needs to see it (matches the unsharded fast exit)
+            if names is None and nodes is None:
+                # NodesCached body: expand from the federated cache,
+                # exactly as the unsharded handler expands from its own
+                names = list(self.state.node_names())
             if kind == "prioritize":
                 return kube.host_priority_list(
                     {n: 0 for n in (names or [])}
@@ -1070,9 +2248,12 @@ class ShardRouter:
             rep = self.replicas[i]
             if not rep.alive or (parts is not None and i not in parts):
                 continue
-            out = rep.extender.handle(
-                kind, self._sub_body(body, parts, i)
-            )
+            try:
+                out = rep.transport.handle(
+                    kind, self._sub_body(body, parts, i)
+                )
+            except ReplicaUnavailable:
+                continue  # died mid-score: spill to the next replica
             if kind == "prioritize":
                 return out  # scores for the target's own nodes
             feasible_names = out.get("NodeNames") or []
@@ -1091,7 +2272,10 @@ class ShardRouter:
         return mk([], {}, error="no alive planner replica owns any "
                                 "offered node")
 
-    def _handle_bind(self, body: Any) -> Any:
+    def _bind_target(self, body: Any) -> tuple[str, Optional[int],
+                                               Optional[dict]]:
+        """Resolve a bind body to (pod key, owning replica index,
+        inline error response). Exactly one of the last two is set."""
         name, ns, uid, node = kube.parse_binding_args(body)
         key = f"{ns}/{name}"
         with self._lock:
@@ -1099,16 +2283,23 @@ class ShardRouter:
             if idx is None:
                 idx = self._pod_replica.get(key)
         if idx is None:
-            return kube.binding_result(
+            return key, None, kube.binding_result(
                 f"{key}: node {node} is owned by no planner replica"
             )
         rep = self.replicas[idx]
         if not rep.alive:
-            return kube.binding_result(
+            return key, None, kube.binding_result(
                 f"{key}: replica {rep.name} unavailable (partitioned "
                 f"or restarting); scheduler will retry"
             )
-        out = rep.extender.handle("bind", body)
+        return key, idx, None
+
+    def _after_bind(self, key: str, idx: int, out: Any) -> Any:
+        """Post-bind bookkeeping for one replica answer: record the
+        pod's affinity, retire its rotation counter, globalize a
+        rendezvous member's gang env, and run the eager commit check
+        (a replica killed right after the final bind must not read as
+        'part lost pre-commit')."""
         if isinstance(out, dict) and not out.get("Error"):
             with self._lock:
                 self._pod_replica[key] = idx
@@ -1117,6 +2308,22 @@ class ShardRouter:
                     (r for r in self._dcn.values()
                      if key in r.member_target), None,
                 )
+            if self.mode == "subprocess":
+                payload = (out.get("Annotations") or {}).get(
+                    codec.ANNO_ALLOC)
+                if payload:
+                    try:
+                        alloc = codec.decode_alloc(payload)
+                    except codec.CodecError:
+                        alloc = None
+                    if alloc is not None:
+                        # the federated allocation() fast path: the
+                        # lifecycle loop's per-release existence check
+                        # answers locally instead of one HTTP read per
+                        # released pod (advisory — divergence checks
+                        # read the replicas' own ledgers)
+                        with self._lock:
+                            self._alloc_cache[key] = alloc
             if rdv is not None:
                 self._globalize_gang_env(out, rdv)
                 # EAGER commit check at the bind that may have closed
@@ -1126,6 +2333,59 @@ class ShardRouter:
                 # the janitor dissolves a fully-committed gang
                 self._check_rendezvous_commit(rdv)
         return out
+
+    def _handle_bind(self, body: Any) -> Any:
+        key, idx, err = self._bind_target(body)
+        if err is not None:
+            return err
+        try:
+            out = self.replicas[idx].transport.handle("bind", body)
+        except ReplicaUnavailable:
+            return kube.binding_result(
+                f"{key}: replica {self.replicas[idx].name} died "
+                f"mid-bind; scheduler will retry"
+            )
+        return self._after_bind(key, idx, out)
+
+    def bind_many(self, bodies: list[dict]) -> list[dict]:
+        """Batched binds for the driver path: group by owning replica,
+        fan the per-replica batches out concurrently (each replica's
+        connection keeps ITS binds ordered), then run the same
+        post-bind bookkeeping per answer. Answer order matches input
+        order. The per-pod webhook path (``handle('bind', ...)``)
+        stays untouched — this is how the process mode keeps the
+        commit step off the per-pod round-trip ledger."""
+        if self._sole is not None:
+            return [self._sole.handle("bind", b) for b in bodies]
+        results: list[Optional[dict]] = [None] * len(bodies)
+        order: dict[int, list[int]] = {}
+        keys: list[Optional[str]] = [None] * len(bodies)
+        for pos, body in enumerate(bodies):
+            key, idx, err = self._bind_target(body)
+            keys[pos] = key
+            if err is not None:
+                results[pos] = err
+                continue
+            order.setdefault(idx, []).append(pos)
+        out = self._fan_out(
+            [self.replicas[i] for i in order],
+            lambda rep: rep.transport.bind_many(
+                [bodies[p] for p in order[rep.index]]
+            ),
+        )
+        for idx, positions in order.items():
+            per = out.get(idx)
+            for j, pos in enumerate(positions):
+                if per is None:
+                    results[pos] = kube.binding_result(
+                        f"{keys[pos]}: replica r{idx} died mid-bind; "
+                        f"scheduler will retry"
+                    )
+                else:
+                    results[pos] = self._after_bind(
+                        keys[pos], idx, per[j]
+                    )
+        return results
 
     def _check_rendezvous_commit(self, rdv: _Rendezvous) -> None:
         """Flip the rendezvous to committed the moment every part's
@@ -1137,8 +2397,8 @@ class ShardRouter:
             rep = self.replicas[idx]
             if not rep.alive:
                 return
-            res = rep.extender.gang.reservation(*rdv.key)
-            if res is None or not res.committed:
+            res = self._reservation_of(rep, rdv.key)
+            if res is None or not res["committed"]:
                 return
         rdv.committed = True
         with self._lock:
@@ -1192,65 +2452,166 @@ class ShardRouter:
         )
 
     # -- batch-driver surface -------------------------------------------------
+    def _route_pod(self, pod: PodInfo) -> int:
+        """The target replica for one driver-admitted pod."""
+        key = pod.key()
+        if pod.group is not None:
+            return self._route_gang(pod)
+        # one lock round-trip for the whole routing read (this is
+        # the per-pod driver hot path)
+        with self._lock:
+            idx = self._pod_replica.get(key)
+            attempts = self._pod_attempts.get(key, 0)
+        if idx is None or not self.replicas[idx].alive:
+            idx = self._pick_pod_replica(key, attempts)
+        return idx
+
     def admit(self, pod: PodInfo) -> bool:
         if self._sole is not None:
             return self._sole.admit(pod)
-        key = pod.key()
-        if pod.group is not None:
-            idx = self._route_gang(pod)
-        else:
-            # one lock round-trip for the whole routing read (this is
-            # the per-pod driver hot path)
-            with self._lock:
-                idx = self._pod_replica.get(key)
-                attempts = self._pod_attempts.get(key, 0)
-            if idx is None or not self.replicas[idx].alive:
-                idx = self._pick_pod_replica(key, attempts)
-        rep = self.replicas[idx]
-        if not rep.alive:
-            return False
-        ok = rep.extender.admit(pod)
-        if ok:
-            with self._lock:
-                self._pod_replica[key] = idx
-            rep.pods_routed += 1
-        return ok
+        return self.admit_many([pod])[0]
+
+    def admit_many(self, pods: list[PodInfo]) -> list[bool]:
+        """Batched admissions: route every pod, then fan ONE admit call
+        per target replica out concurrently. Result order matches the
+        input. This is the driver hot path the process mode lives on —
+        per-pod round-trips would hand the router tax the whole
+        multi-core win back."""
+        if self._sole is not None:
+            return [self._sole.admit(p) for p in pods]
+        results: list[bool] = [False] * len(pods)
+        order: dict[int, list[int]] = {}
+        for pos, pod in enumerate(pods):
+            idx = self._route_pod(pod)
+            if not self.replicas[idx].alive:
+                continue
+            order.setdefault(idx, []).append(pos)
+        out = self._fan_out(
+            [self.replicas[i] for i in order],
+            lambda rep: rep.transport.admit_many(
+                [pods[p] for p in order[rep.index]]
+            ),
+        )
+        for idx, positions in order.items():
+            per = out.get(idx)
+            if per is None:
+                continue  # replica died mid-admit: pods re-admit later
+            rep = self.replicas[idx]
+            for j, pos in enumerate(positions):
+                ok = bool(per[j])
+                results[pos] = ok
+                if ok:
+                    with self._lock:
+                        self._pod_replica[pods[pos].key()] = idx
+                    rep.pods_routed += 1
+        return results
 
     def plan_pending(self) -> int:
+        """Drive every replica's batch planner. In process mode the N
+        plan calls fan out CONCURRENTLY — one planner process per core
+        actually planning in parallel, the throughput lever the
+        in-process sweep could never pull (one GIL)."""
         if self._sole is not None:
             return self._sole.plan_pending()
         self.sweep()
-        return sum(
-            rep.extender.plan_pending() for rep in self._alive()
+        out = self._fan_out(
+            self._alive(), lambda rep: rep.transport.plan_pending()
         )
+        return sum(out.values())
+
+    def _planned_miss(self, pod_key: str, idx: int) -> None:
+        """Plan failed or expired on the owner: release the affinity
+        and bump the attempt count so the next admit rotates to
+        another replica instead of re-queuing on the same full shard
+        forever."""
+        with self._lock:
+            if self._pod_replica.get(pod_key) == idx:
+                self._pod_replica.pop(pod_key, None)
+            self._pod_attempts[pod_key] = \
+                self._pod_attempts.get(pod_key, 0) + 1
 
     def planned_node(self, pod_key: str) -> Optional[str]:
         if self._sole is not None:
             return self._sole.planned_node(pod_key)
+        return self.planned_many([pod_key])[pod_key]
+
+    def planned_many(
+        self, pod_keys: list[str]
+    ) -> dict[str, Optional[str]]:
+        """Batched plan queries: keys with a recorded replica affinity
+        resolve in one call per replica (fanned out concurrently);
+        unmapped keys scan the live set. Misses run the same
+        rotation bookkeeping as ``planned_node``."""
+        if self._sole is not None:
+            return {k: self._sole.planned_node(k) for k in pod_keys}
+        results: dict[str, Optional[str]] = {}
+        order: dict[int, list[str]] = {}
+        unmapped: list[str] = []
         with self._lock:
-            idx = self._pod_replica.get(pod_key)
-        if idx is not None and self.replicas[idx].alive:
-            node = self.replicas[idx].extender.planned_node(pod_key)
-            if node is not None:
-                return node
-            # plan failed or expired on the owner: release the
-            # affinity and bump the attempt count so the next admit
-            # rotates to another replica instead of re-queuing on the
-            # same full shard forever
-            with self._lock:
-                if self._pod_replica.get(pod_key) == idx:
-                    self._pod_replica.pop(pod_key, None)
-                self._pod_attempts[pod_key] = \
-                    self._pod_attempts.get(pod_key, 0) + 1
-            return None
-        for rep in self._alive():
-            node = rep.extender.planned_node(pod_key)
-            if node is not None:
-                return node
-        return None
+            affinity = {k: self._pod_replica.get(k) for k in pod_keys}
+        for key in pod_keys:
+            idx = affinity[key]
+            if idx is not None and self.replicas[idx].alive:
+                order.setdefault(idx, []).append(key)
+            else:
+                unmapped.append(key)
+        out = self._fan_out(
+            [self.replicas[i] for i in order],
+            lambda rep: rep.transport.planned_nodes(order[rep.index]),
+        )
+        for idx, keys in order.items():
+            per = out.get(idx)
+            for key in keys:
+                node = per.get(key) if per is not None else None
+                results[key] = node
+                if node is None:
+                    self._planned_miss(key, idx)
+        if unmapped:
+            for key in unmapped:
+                results[key] = None
+            scan = self._fan_out(
+                self._alive(),
+                lambda rep: rep.transport.planned_nodes(unmapped),
+            )
+            for nodes in scan.values():
+                for key, node in nodes.items():
+                    if node is not None and results.get(key) is None:
+                        results[key] = node
+        return results
 
     def release(self, pod_key: str) -> None:
         self.handle("release", {"pod_key": pod_key})
+
+    def release_many(self, pod_keys: list[str]) -> None:
+        """Batched releases (the lifecycle loop's resync flush): keys
+        group by recorded pod->replica affinity and fan out as ONE
+        call per replica; keys with no affinity go to every alive
+        replica (a release of an unknown pod is a no-op there). Same
+        lost-release contract as ``_handle_release`` for dead
+        replicas."""
+        if self._sole is not None:
+            for key in pod_keys:
+                self._sole.handle("release", {"pod_key": key})
+            return
+        order: dict[int, list[str]] = {}
+        everywhere: list[str] = []
+        with self._lock:
+            for key in pod_keys:
+                idx = self._pod_replica.pop(key, None)
+                self._pod_attempts.pop(key, None)
+                self._alloc_cache.pop(key, None)
+                if idx is None:
+                    everywhere.append(key)
+                else:
+                    order.setdefault(idx, []).append(key)
+        if everywhere:
+            for rep in self._alive():
+                order.setdefault(rep.index, []).extend(everywhere)
+        self._fan_out(
+            [self.replicas[i] for i in order
+             if self.replicas[i].alive],
+            lambda rep: rep.transport.release_many(order[rep.index]),
+        )
 
     # -- restart / recovery ---------------------------------------------------
     def rebuild_from_pods(self, pods: list[dict[str, str]]) -> int:
@@ -1313,9 +2674,14 @@ class ShardRouter:
                     ))
         restored = 0
         for idx, plist in sorted(by_replica.items()):
-            restored += self.replicas[idx].extender.rebuild_from_pods(
-                plist
-            )
+            try:
+                restored += self.replicas[idx].transport \
+                    .rebuild_from_pods(plist)
+            except ReplicaUnavailable:
+                log.error("rebuild: replica r%d unreachable; its %d "
+                          "pod(s) restore at its own restart", idx,
+                          len(plist))
+                continue
             with self._lock:
                 for annotations in plist:
                     payload = annotations.get(codec.ANNO_ALLOC)
@@ -1325,15 +2691,14 @@ class ShardRouter:
                         except codec.CodecError:
                             continue
                         self._pod_replica[alloc.pod_key] = idx
+                        if self.mode == "subprocess":
+                            self._alloc_cache[alloc.pod_key] = alloc
         for key, counts in rewrites.items():
             parts: dict[int, dict[str, list[TopologyCoord]]] = {}
             for idx in counts:
-                res = self.replicas[idx].extender.gang.reservation(*key)
+                res = self._reservation_of(self.replicas[idx], key)
                 if res is not None:
-                    parts[idx] = {
-                        sid: sorted(coords)
-                        for sid, coords in res.slice_coords.items()
-                    }
+                    parts[idx] = res["slices"]
             if len(parts) > 1:
                 rdv = _Rendezvous(
                     key, parts,
@@ -1369,9 +2734,16 @@ class ShardRouter:
         rep = self.replicas[idx]
         rep.alive = False
         rep.killed = True
-        if rep.extender.journal is not None:
-            rep.extender.journal.crash()
-        rep.extender.state.retire()
+        self._drop_dead_alloc_cache(idx)
+        ext = rep.extender
+        if ext is not None:
+            if ext.journal is not None:
+                ext.journal.crash()
+            ext.state.retire()
+        else:
+            # subprocess replica: REAL process death (SIGKILL) —
+            # nothing modeled, nothing flushed
+            rep.transport.kill()
 
     def partition_replica(self, idx: int) -> None:
         """Model a network partition: the replica's state survives but
@@ -1402,15 +2774,20 @@ class ShardRouter:
         with self._lock:
             owed = [key for key, pending in self._aborted_dcn.items()
                     if idx in pending]
+        settled = []
         for key in owed:
-            if rep.extender.gang.reservation(*key) is not None:
+            if self._reservation_of(rep, key) is not None:
                 log.warning(
                     "replica %s returned holding part of aborted "
                     "rendezvous %s/%s; dissolving", rep.name, *key,
                 )
-                rep.extender.gang.dissolve(key)
+                try:
+                    rep.transport.gang_dissolve(key)
+                except ReplicaUnavailable:
+                    continue  # died again: stays on the pending sentence
+            settled.append(key)
         with self._lock:
-            for key in owed:
+            for key in settled:
                 pending = self._aborted_dcn.get(key)
                 if pending is not None:
                     pending.discard(idx)
@@ -1423,31 +2800,44 @@ class ShardRouter:
         pods: list[dict[str, str]],
     ) -> int:
         """Cold-restart one killed replica the way a restarted shard
-        daemon would: a fresh Extender, its nodes re-ingested, its
+        daemon would: a fresh Extender (in-process) or a freshly
+        spawned worker daemon (subprocess), its nodes re-ingested, its
         ledger + gang reservations rebuilt from pod annotations
         (``rebuild_from_pods``), with live-rendezvous parts restored
         by their LOCAL quorum. Returns allocations restored."""
         old = self.replicas[idx]
-        ext = Extender(
-            self._replica_cfgs[idx], clock=self.clock,
-            eviction_sink=self.pending_evictions,
-        )
-        # every externally-wired hook survives the restart (a fresh
-        # daemon would be re-wired by its main; the router plays that
-        # role here) — dropping the degraded gate would let ONE
-        # restarted shard bind while the rest of the plane refuses
-        ext.evict_precheck = old.extender.evict_precheck
-        ext.binder = old.extender.binder
-        ext.degraded_gate = old.extender.degraded_gate
-        self.replicas[idx] = PlannerReplica(idx, ext)
+        fake_clock = hasattr(self.clock, "advance")
+        if self.mode == "subprocess":
+            try:
+                old.transport.kill()  # reap a half-dead daemon first
+            except (OSError, subprocess.SubprocessError) as e:
+                log.warning("restart r%d: old worker reap failed: %s",
+                            idx, e)
+            transport = self._make_transport(
+                idx, self._replica_cfgs[idx], fake_clock
+            )
+        else:
+            ext = Extender(
+                self._replica_cfgs[idx], clock=self.clock,
+                eviction_sink=self.pending_evictions,
+            )
+            # every externally-wired hook survives the restart (a fresh
+            # daemon would be re-wired by its main; the router plays
+            # that role here) — dropping the degraded gate would let
+            # ONE restarted shard bind while the rest of the plane
+            # refuses
+            ext.evict_precheck = old.extender.evict_precheck
+            ext.binder = old.extender.binder
+            ext.degraded_gate = old.extender.degraded_gate
+            transport = InProcessTransport(ext)
+        self.replicas[idx] = PlannerReplica(idx, transport)
         rep = self.replicas[idx]
-        for name, annotations in node_annotations:
-            out = ext.handle("upsert_node", {
-                "name": name, "annotations": annotations,
-            })
+        items = [{"name": name, "annotations": annotations}
+                 for name, annotations in node_annotations]
+        for item, out in zip(items, rep.transport.upsert_nodes(items)):
             if isinstance(out, dict) and out.get("error"):
                 log.error("restart r%d: node %s rejected: %s",
-                          idx, name, out["error"])
+                          idx, item["name"], out["error"])
         with self._lock:
             live_rdv = {
                 key: rdv for key, rdv in self._dcn.items()
@@ -1483,7 +2873,7 @@ class ShardRouter:
                                  shape=None, allow_dcn=True)
                     ))
             plist.append(annotations)
-        restored = ext.rebuild_from_pods(plist)
+        restored = rep.transport.rebuild_from_pods(plist)
         with self._lock:
             for annotations in plist:
                 payload = annotations.get(codec.ANNO_ALLOC)
@@ -1493,6 +2883,8 @@ class ShardRouter:
                     except codec.CodecError:
                         continue
                     self._pod_replica[alloc.pod_key] = idx
+                    if self.mode == "subprocess":
+                        self._alloc_cache[alloc.pod_key] = alloc
         rep.alive = True
         # a restored fragment of a rendezvous aborted while this
         # replica was down dies here (and the replica leaves the
@@ -1505,12 +2897,9 @@ class ShardRouter:
         return restored
 
     def shutdown(self) -> None:
-        """Close every replica's sinks (harness stop path)."""
+        """Close every replica (sinks in-process, graceful daemon stop
+        in subprocess mode) — the harness stop path."""
         for rep in self.replicas:
-            ext = rep.extender
-            if ext.trace is not None:
-                ext.trace.close()
-            ext.events.close()
-            if ext.journal is not None:
-                ext.journal.close()
-                ext.state.retire()
+            rep.transport.close()
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)

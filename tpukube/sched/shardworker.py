@@ -1,0 +1,327 @@
+"""Shard worker daemon — one planner replica as its own process.
+
+The process-parallel sharded control plane (ISSUE 14) runs each
+:class:`~tpukube.sched.shard.PlannerReplica` as a real OS process: a
+plain :class:`~tpukube.sched.extender.Extender` (``planner_replicas``
+forced to 1 — a worker IS one planner, never a router) serving
+
+  * the standard extender webhook app (``make_app``: /filter,
+    /prioritize, /bind, /healthz, /metrics, /state/*, /statusz) —
+    the worker is a ``main_extender``-style daemon, and
+  * the ``/worker/*`` routes below — the replica half of the
+    :class:`~tpukube.sched.shard.SubprocessTransport` contract: batch
+    admit/plan/bind for the driver path, gauges + gang prepare for the
+    router's two-phase rendezvous, summary/allocs for the federated
+    read views, eviction drain, and FakeClock advance.
+
+Every /worker route dispatches into the SAME replica-side helpers the
+in-process transport calls directly (``shard.replica_gauges``,
+``shard.gang_prepare_part``, ...) — the transport changes the wire,
+never the computation, which is what makes the process-mode N=1
+placement parity a structural property rather than a coincidence.
+
+The router spawns workers via ``tpukube.cli shard-worker`` (a resolved
+per-replica YAML is the ONE config source; the spawn scrubs TPUKUBE_*
+env so an inherited ``TPUKUBE_PLANNER_REPLICAS`` cannot make a worker
+try to be a router). In production the same daemon shape runs as one
+Deployment per replica behind the router webhook front — see
+deploy/README's multi-daemon sketch.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Optional
+
+from aiohttp import web
+
+from tpukube.core import codec
+from tpukube.sched import kube, shard
+from tpukube.sched.extender import Extender, make_app
+from tpukube.sched.gang import GangError
+from tpukube.sched.state import StateError
+
+log = logging.getLogger("tpukube.shardworker")
+
+
+#: batched transport bodies (a 10k-node fleet upsert, a 2k-pod admit
+#: wave, a rebuild feed) far exceed aiohttp's 1 MiB default cap
+CLIENT_MAX_SIZE = 1 << 30
+
+
+def make_worker_app(extender: Extender, clock=None) -> web.Application:
+    """The worker daemon's app: the full extender webhook surface plus
+    the /worker/* transport routes."""
+    app = make_app(extender, client_max_size=CLIENT_MAX_SIZE)
+
+    async def _json(request: web.Request) -> Any:
+        try:
+            return await request.json()
+        except json.JSONDecodeError as e:
+            raise web.HTTPBadRequest(text=f"bad JSON: {e}")
+
+    async def handle(request: web.Request) -> web.Response:
+        doc = await _json(request)
+        try:
+            out = extender.handle(doc["kind"], doc["body"])
+        except kube.KubeSchemaError as e:
+            # in-band so the router re-raises the SAME exception type
+            # the in-process transport would have propagated
+            return web.json_response({"schema_error": str(e)})
+        return web.json_response(out)
+
+    async def upsert(request: web.Request) -> web.Response:
+        doc = await _json(request)
+        return web.json_response({"results": [
+            extender.handle("upsert_node", item)
+            for item in doc["items"]
+        ]})
+
+    async def admit(request: web.Request) -> web.Response:
+        doc = await _json(request)
+        admitted = []
+        for obj in doc["pods"]:
+            try:
+                admitted.append(bool(extender.admit(
+                    kube.pod_from_k8s(obj)
+                )))
+            except kube.KubeSchemaError as e:
+                log.error("admit: undecodable pod object (%s)", e)
+                admitted.append(False)
+        return web.json_response({"admitted": admitted})
+
+    async def plan(request: web.Request) -> web.Response:
+        return web.json_response({"planned": extender.plan_pending()})
+
+    async def planned(request: web.Request) -> web.Response:
+        doc = await _json(request)
+        return web.json_response({"nodes": {
+            key: extender.planned_node(key) for key in doc["keys"]
+        }})
+
+    async def bind_many(request: web.Request) -> web.Response:
+        doc = await _json(request)
+        results = []
+        for body in doc["bodies"]:
+            try:
+                results.append(extender.handle("bind", body))
+            except kube.KubeSchemaError as e:
+                results.append(kube.binding_result(
+                    f"bad bind body: {e}"
+                ))
+        return web.json_response({"results": results})
+
+    async def release_many(request: web.Request) -> web.Response:
+        doc = await _json(request)
+        for key in doc["keys"]:
+            extender.handle("release", {"pod_key": key})
+        return web.json_response({})
+
+    async def gauges(request: web.Request) -> web.Response:
+        return web.json_response(
+            {"slices": shard.replica_gauges(extender)}
+        )
+
+    async def gang(request: web.Request) -> web.Response:
+        doc = await _json(request)
+        op = doc.get("op")
+        try:
+            if op == "fit":
+                pod = kube.pod_from_k8s(doc["pod"])
+                return web.json_response({"fits": shard.gang_fit_probe(
+                    extender, pod, int(doc["total"])
+                )})
+            if op == "prepare":
+                pod = kube.pod_from_k8s(doc["pod"])
+                parts = shard.gang_prepare_part(
+                    extender, pod, int(doc["cpp"]),
+                    {sid: int(v)
+                     for sid, v in doc["volumes"].items()},
+                )
+                return web.json_response({"parts": parts})
+            key = (doc["namespace"], doc["name"]) \
+                if "namespace" in doc else None
+            if op == "drop":
+                extender.gang.drop_reservation(key)
+                return web.json_response({})
+            if op == "dissolve":
+                extender.gang.dissolve(key)
+                return web.json_response({})
+            if op == "reservation":
+                res = extender.gang.reservation(*key)
+                return web.json_response({"reservation": (
+                    None if res is None else {
+                        "committed": res.committed,
+                        "slices": {
+                            sid: sorted(coords)
+                            for sid, coords in
+                            res.slice_coords.items()
+                        },
+                    }
+                )})
+            if op == "sweep":
+                extender.gang.sweep()
+                return web.json_response({})
+        except GangError as e:
+            return web.json_response({"error": str(e), "kind": "gang"})
+        except StateError as e:
+            return web.json_response({"error": str(e), "kind": "state"})
+        raise web.HTTPBadRequest(text=f"unknown gang op {op!r}")
+
+    async def allocs(request: web.Request) -> web.Response:
+        return web.json_response({"allocs": [
+            codec.alloc_obj(a) for a in extender.state.allocations()
+        ]})
+
+    async def alloc_one(request: web.Request) -> web.Response:
+        pod = request.query.get("pod", "")
+        a = extender.state.allocation(pod)
+        return web.json_response(
+            {"alloc": codec.alloc_obj(a) if a is not None else None}
+        )
+
+    async def nodes(request: web.Request) -> web.Response:
+        return web.json_response(
+            {"names": list(extender.state.node_names())}
+        )
+
+    async def summary(request: web.Request) -> web.Response:
+        return web.json_response(shard.replica_summary(extender))
+
+    async def emit(request: web.Request) -> web.Response:
+        doc = await _json(request)
+        extender.events.emit(
+            doc.get("reason", ""), obj=doc.get("obj", ""),
+            message=doc.get("message", ""),
+            **({"type": doc["type"]} if doc.get("type") else {}),
+        )
+        return web.json_response({})
+
+    async def rebuild(request: web.Request) -> web.Response:
+        doc = await _json(request)
+        return web.json_response(
+            {"restored": extender.rebuild_from_pods(doc["pods"])}
+        )
+
+    async def evictions(request: web.Request) -> web.Response:
+        out: list[str] = []
+        q = extender.pending_evictions
+        while True:
+            try:
+                out.append(q.popleft())
+            except IndexError:
+                break
+        return web.json_response({"pods": out})
+
+    async def stall(request: web.Request) -> web.Response:
+        # test-only: hold this request open for N seconds without
+        # blocking the worker loop — the router's fan-out concurrency
+        # proof (tests/test_shard_proc.py) measures overlap with it
+        import asyncio
+
+        doc = await _json(request)
+        await asyncio.sleep(min(float(doc.get("seconds", 0)), 5.0))
+        return web.json_response({})
+
+    async def advance(request: web.Request) -> web.Response:
+        doc = await _json(request)
+        adv = getattr(clock, "advance", None)
+        if adv is None:
+            raise web.HTTPBadRequest(
+                text="worker runs the system clock (spawn with "
+                     "--fake-clock to advance simulated time)"
+            )
+        adv(float(doc["seconds"]))
+        return web.json_response({"now": clock.monotonic()})
+
+    app.router.add_post("/worker/handle", handle)
+    app.router.add_post("/worker/upsert", upsert)
+    app.router.add_post("/worker/admit", admit)
+    app.router.add_post("/worker/plan", plan)
+    app.router.add_post("/worker/planned", planned)
+    app.router.add_post("/worker/bind", bind_many)
+    app.router.add_post("/worker/release", release_many)
+    app.router.add_get("/worker/gauges", gauges)
+    app.router.add_post("/worker/gang", gang)
+    app.router.add_get("/worker/allocs", allocs)
+    app.router.add_get("/worker/alloc", alloc_one)
+    app.router.add_get("/worker/nodes", nodes)
+    app.router.add_get("/worker/summary", summary)
+    app.router.add_post("/worker/emit", emit)
+    app.router.add_post("/worker/rebuild", rebuild)
+    app.router.add_post("/worker/evictions", evictions)
+    app.router.add_post("/worker/advance", advance)
+    app.router.add_post("/worker/stall", stall)
+    return app
+
+
+def main_worker(argv: Optional[list[str]] = None) -> int:
+    """``tpukube.cli shard-worker`` — the per-replica planner daemon
+    the SubprocessTransport spawns (and a production replica runs)."""
+    import argparse
+
+    from tpukube.core.config import load_config
+
+    p = argparse.ArgumentParser(
+        prog="tpukube-shard-worker",
+        description="one planner replica of the sharded control plane",
+    )
+    p.add_argument("--config", metavar="YAML", required=True,
+                   help="resolved per-replica config (the router "
+                        "writes one; production pins one per replica)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--fake-clock", action="store_true",
+                   help="run scheduling-semantic time on a FakeClock "
+                        "advanced by the router (/worker/advance) — "
+                        "the sim/bench plane's discrete-event mode")
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=(logging.WARNING, logging.INFO,
+               logging.DEBUG)[min(args.verbose, 2)],
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+    )
+    cfg = load_config(yaml_path=args.config)
+    if cfg.planner_replicas != 1:
+        p.error("a shard worker is ONE planner replica: the config "
+                "must say planner_replicas: 1 (the router writes "
+                "per-replica configs; see sched/shard.py)")
+    from tpukube.core.clock import SYSTEM, FakeClock
+
+    clock = FakeClock() if args.fake_clock else SYSTEM
+    extender = Extender(cfg, clock=clock)
+    # SHARD_WORKER_PROFILE=<path>: dump a cProfile of this worker's
+    # whole life to <path>.<port> at shutdown — the only way to see
+    # where a replica daemon's plan wall goes from the router side.
+    # Deliberately NOT a TPUKUBE_* var: the router scrubs those from
+    # worker env so the per-replica YAML stays the one config source.
+    import os
+
+    prof = None
+    prof_path = os.environ.get("SHARD_WORKER_PROFILE")
+    if prof_path:
+        import cProfile
+
+        prof = cProfile.Profile()
+        prof.enable()
+    log.warning("shard worker serving on %s:%d (fake_clock=%s)",
+                args.host, args.port, args.fake_clock)
+    try:
+        web.run_app(make_worker_app(extender, clock=clock),
+                    host=args.host, port=args.port,
+                    print=None, handle_signals=True)
+    finally:
+        if prof is not None:
+            prof.disable()
+            prof.dump_stats(f"{prof_path}.{args.port}")
+        if extender.trace is not None:
+            extender.trace.close()
+        if extender.decisions is not None:
+            extender.decisions.close()
+        extender.events.close()
+        if extender.journal is not None:
+            extender.journal.close()
+            extender.state.retire()
+    return 0

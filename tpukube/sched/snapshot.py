@@ -742,22 +742,29 @@ class SnapshotCache:
                 log.warning("snapshot build: slice %s vanished: %s",
                             sid, e)
                 continue
-            used, total = self._state.slice_share_counts(sid)
-            # audit builds bypass the ledger's incremental occupied
-            # cache (walk_occupied_coords): the sentinel exists to
-            # catch seams that forgot their bookkeeping, so it must
-            # re-derive from the node views, never from a set that the
+            # audit builds bypass EVERY incremental ledger cache (the
+            # walk_* variants re-derive from the node views): the
+            # sentinel exists to catch seams that forgot their
+            # bookkeeping, so it must never read a set or counter the
             # same seams maintain
-            occupied = (self._state.walk_occupied_coords(sid) if audit
-                        else self._state.occupied_coords(sid))
+            if audit:
+                used, total = self._state.walk_slice_share_counts(sid)
+                occupied = self._state.walk_occupied_coords(sid)
+                unhealthy = self._state.walk_unhealthy_coords(sid)
+                broken = self._state.walk_broken_links(sid)
+            else:
+                used, total = self._state.slice_share_counts(sid)
+                occupied = self._state.occupied_coords(sid)
+                unhealthy = self._state.unhealthy_coords(sid)
+                broken = self._state.broken_links(sid)
             slices[sid] = SliceSnapshot(
                 slice_id=sid,
                 mesh=mesh,
                 occupied=frozenset(occupied),
                 reserved=frozenset(self._gang.reserved_coords(sid)),
-                unhealthy=frozenset(self._state.unhealthy_coords(sid)),
+                unhealthy=frozenset(unhealthy),
                 terminating=frozenset(self._gang.terminating_coords(sid)),
-                broken=frozenset(self._state.broken_links(sid)),
+                broken=frozenset(broken),
                 used_shares=used,
                 total_shares=total,
             )

@@ -326,6 +326,19 @@ class ClusterState:
         # (walk_occupied_coords) so a seam that forgot BOTH its delta
         # and its incremental update still cannot hide from the audit.
         self._occ_cache: dict[str, set[TopologyCoord]] = {}
+        # The REMAINING per-slice structural walks, given the same
+        # incremental treatment (ISSUE 14 satellite; ROADMAP O(fleet)
+        # item): unhealthy coord sets, broken-link report counts, and
+        # the (used, total) share integers. Same seeding/unseeded
+        # contract as _occ_cache, same seams (structural upsert,
+        # health-only re-annotation, commit, release), and the audit
+        # sentinel again re-derives via the walk_* variants so these
+        # caches can never hide a missed seam. _broken_cache counts
+        # REPORTING VIEWS per canonical link (both endpoint hosts may
+        # report one link; the set view is the keys with count > 0).
+        self._unhealthy_cache: dict[str, set[TopologyCoord]] = {}
+        self._broken_cache: dict[str, dict[Link, int]] = {}
+        self._share_cache: dict[str, list[int]] = {}  # sid -> [used, total]
 
     def set_delta_sink(self, sink) -> None:
         """Attach the snapshot cache's delta log (None detaches)."""
@@ -655,6 +668,27 @@ class ClusterState:
             )
             self._occ_apply_locked(info.slice_id, add=occ_new,
                                    remove=occ_old)
+            # ... and the same one-node-out/one-node-in transition for
+            # the unhealthy/broken/share caches (ISSUE 14 satellite)
+            used_old, total_old = (self._view_share_counts(prev)
+                                   if prev is not None else (0, 0))
+            used_new, total_new = self._view_share_counts(view)
+            self._aux_apply_locked(
+                info.slice_id,
+                unhealthy_add=tuple(
+                    c.coord for c in info.chips
+                    if c.health is not Health.HEALTHY
+                ),
+                unhealthy_remove=tuple(
+                    c.coord for c in prev.info.chips
+                    if c.health is not Health.HEALTHY
+                ) if prev is not None else (),
+                broken_add=tuple(set(info.bad_links)),
+                broken_remove=(tuple(set(prev.info.bad_links))
+                               if prev is not None else ()),
+                used_delta=used_new - used_old,
+                total_delta=total_new - total_old,
+            )
             self._epoch += 1
             # a STRUCTURALLY changed node payload may move links,
             # topology, or sharing mode — all structural for the
@@ -710,6 +744,13 @@ class ClusterState:
         self._nodes[name] = view
         self._occ_apply_locked(info.slice_id, add=tuple(occupied_add),
                                remove=tuple(occupied_remove))
+        self._aux_apply_locked(
+            info.slice_id,
+            unhealthy_add=tuple(unhealthy_add),
+            unhealthy_remove=tuple(unhealthy_remove),
+            # links are untouched on a health-only change by definition
+            used_delta=used_d, total_delta=total_d,
+        )
         self._epoch += 1
         self._note_delta_locked(
             slice_id=info.slice_id,
@@ -827,6 +868,51 @@ class ClusterState:
         cached.difference_update(remove)
         cached.update(add)
 
+    def _aux_apply_locked(self, slice_id: str, *,
+                          unhealthy_add: tuple = (),
+                          unhealthy_remove: tuple = (),
+                          broken_add: tuple = (),
+                          broken_remove: tuple = (),
+                          used_delta: int = 0,
+                          total_delta: int = 0) -> None:
+        """Advance the slice's incremental unhealthy/broken/share-count
+        caches by one seam's transitions (callers hold ``self._lock``;
+        same contract as ``_occ_apply_locked`` — unseeded slices stay
+        unseeded, the first reader pays the walk once). ``broken_*``
+        are per-VIEW link report transitions: the count map tracks how
+        many node views currently report each canonical link."""
+        unhealthy = self._unhealthy_cache.get(slice_id)
+        if unhealthy is not None:
+            unhealthy.difference_update(unhealthy_remove)
+            unhealthy.update(unhealthy_add)
+        counts = self._broken_cache.get(slice_id)
+        if counts is not None:
+            for link in broken_remove:
+                n = counts.get(link, 0) - 1
+                if n <= 0:
+                    counts.pop(link, None)
+                else:
+                    counts[link] = n
+            for link in broken_add:
+                counts[link] = counts.get(link, 0) + 1
+        shares = self._share_cache.get(slice_id)
+        if shares is not None:
+            shares[0] += used_delta
+            shares[1] += total_delta
+
+    @staticmethod
+    def _view_share_counts(view: NodeView) -> tuple[int, int]:
+        """One view's (used, total) share contribution over its healthy
+        chips — the per-node term both the walk and the structural-
+        upsert transition math use."""
+        n = view.shares_per_chip
+        used = total = 0
+        for chip in view.info.chips:
+            if chip.health is Health.HEALTHY:
+                total += n
+                used += min(n, view.used_share_count(chip.index))
+        return used, total
+
     def _walk_occupied_locked(
         self, slice_id: Optional[str]
     ) -> set[TopologyCoord]:
@@ -878,39 +964,117 @@ class ClusterState:
                 self._occ_cache[sid] = cached
             return set(cached)
 
-    def unhealthy_coords(self, slice_id: Optional[str] = None) -> set[TopologyCoord]:
+    def _resolve_sid_locked(self, slice_id: Optional[str]) -> Optional[str]:
+        """The no-argument form of the per-slice coord accessors serves
+        single-slice clusters and raises on ambiguity (matching
+        ``_slice_views_locked``); None = no slices registered yet."""
+        if slice_id is not None:
+            return slice_id
+        if len(self._slices) > 1:
+            raise StateError(
+                "coord sets are slice-local; pass slice_id on a "
+                f"{len(self._slices)}-slice cluster"
+            )
+        if not self._slices:
+            return None
+        return next(iter(self._slices))
+
+    def _walk_unhealthy_locked(
+        self, slice_id: Optional[str]
+    ) -> set[TopologyCoord]:
+        return {
+            chip.coord
+            for view in self._slice_views_locked(slice_id)
+            for chip in view.info.chips
+            if chip.health is not Health.HEALTHY
+        }
+
+    def walk_unhealthy_coords(
+        self, slice_id: Optional[str] = None
+    ) -> set[TopologyCoord]:
+        """``unhealthy_coords`` WITHOUT the incremental cache — the
+        audit sentinel's independent derivation (see
+        ``walk_occupied_coords``)."""
         with self._lock:
-            return {
-                chip.coord
-                for view in self._slice_views_locked(slice_id)
-                for chip in view.info.chips
-                if chip.health is not Health.HEALTHY
-            }
+            return self._walk_unhealthy_locked(slice_id)
+
+    def unhealthy_coords(self, slice_id: Optional[str] = None) -> set[TopologyCoord]:
+        """Coords of unhealthy chips, served from the per-slice
+        incremental set (seeded by one walk, advanced at the health
+        and structural seams) — the returned set is the caller's copy."""
+        with self._lock:
+            sid = self._resolve_sid_locked(slice_id)
+            if sid is None:
+                return set()
+            cached = self._unhealthy_cache.get(sid)
+            if cached is None:
+                cached = self._walk_unhealthy_locked(sid)
+                self._unhealthy_cache[sid] = cached
+            return set(cached)
+
+    def _walk_broken_locked(
+        self, slice_id: Optional[str]
+    ) -> dict[Link, int]:
+        counts: dict[Link, int] = {}
+        for view in self._slice_views_locked(slice_id):
+            # distinct links per view: the count is "how many views
+            # report this link", the unit the upsert transitions move
+            for link in set(view.info.bad_links):
+                counts[link] = counts.get(link, 0) + 1
+        return counts
+
+    def walk_broken_links(
+        self, slice_id: Optional[str] = None
+    ) -> set[Link]:
+        """``broken_links`` WITHOUT the incremental cache (the audit
+        sentinel's derivation)."""
+        with self._lock:
+            return set(self._walk_broken_locked(slice_id))
 
     def broken_links(self, slice_id: Optional[str] = None) -> set[Link]:
-        """Downed ICI links, unioned over node reports. Both endpoint hosts
-        may report the same link; canonical pairs dedupe them."""
+        """Downed ICI links, unioned over node reports. Both endpoint
+        hosts may report the same link; the incremental cache counts
+        reporting views per canonical link (a link leaves the set only
+        when its LAST reporter withdraws it)."""
         with self._lock:
-            return {
-                link
-                for view in self._slice_views_locked(slice_id)
-                for link in view.info.bad_links
-            }
+            sid = self._resolve_sid_locked(slice_id)
+            if sid is None:
+                return set()
+            counts = self._broken_cache.get(sid)
+            if counts is None:
+                counts = self._walk_broken_locked(sid)
+                self._broken_cache[sid] = counts
+            return set(counts)
+
+    def _walk_share_counts_locked(self, slice_id: str) -> list[int]:
+        total = used = 0
+        for view in self._slice_views_locked(slice_id):
+            u, t = self._view_share_counts(view)
+            used += u
+            total += t
+        return [used, total]
+
+    def walk_slice_share_counts(self, slice_id: str) -> tuple[int, int]:
+        """``slice_share_counts`` WITHOUT the incremental cache (the
+        audit sentinel's derivation)."""
+        with self._lock:
+            used, total = self._walk_share_counts_locked(slice_id)
+            return used, total
 
     def slice_share_counts(self, slice_id: str) -> tuple[int, int]:
         """(used, total) shares over healthy capacity of ONE slice —
         the integer pair the snapshot carries so ledger deltas can
         advance utilization in O(1) (total only moves on health or
-        topology changes, which are full-rebuild markers)."""
+        topology changes). Served from the per-slice incremental pair,
+        seeded by one walk and advanced at the commit/release/health/
+        structural seams — structural rebuilds stop walking every view
+        (ROADMAP O(fleet) item)."""
         with self._lock:
-            total = used = 0
-            for view in self._slice_views_locked(slice_id):
-                n = view.shares_per_chip
-                for chip in view.info.chips:
-                    if chip.health is Health.HEALTHY:
-                        total += n
-                        used += min(n, view.used_share_count(chip.index))
-            return used, total
+            shares = self._share_cache.get(slice_id)
+            if shares is None:
+                shares = self._walk_share_counts_locked(slice_id)
+                self._share_cache[slice_id] = shares
+            return shares[0], shares[1]
 
     def slice_utilization(self, slice_id: str) -> float:
         """Allocated share fraction over healthy capacity of ONE slice —
@@ -989,6 +1153,12 @@ class ClusterState:
             view.add_ids(adding)
             self._allocs[alloc.pod_key] = alloc
             self._occ_apply_locked(view.info.slice_id, add=newly_occupied)
+            # all committed chips are healthy (validated above), so the
+            # counted share delta is exactly the added weight
+            self._aux_apply_locked(
+                view.info.slice_id,
+                used_delta=sum(pending_shares.values()),
+            )
             self._epoch += 1
             self._note_delta_locked(
                 slice_id=view.info.slice_id,
@@ -1037,6 +1207,8 @@ class ClusterState:
                 and view.chip(index).health is Health.HEALTHY
             )
             self._occ_apply_locked(view.info.slice_id, remove=freed)
+            self._aux_apply_locked(view.info.slice_id,
+                                   used_delta=used_delta)
             self._epoch += 1
             self._note_delta_locked(
                 slice_id=view.info.slice_id,

@@ -189,6 +189,7 @@ class SimCluster:
         slices: Optional[dict[str, MeshSpec]] = None,
         clock=None,
         in_process: bool = False,
+        cached_node_body: bool = False,
     ):
         """Single-slice by default (``mesh``); pass ``slices`` (slice id ->
         MeshSpec) for a multi-slice cluster — node names are then prefixed
@@ -207,6 +208,13 @@ class SimCluster:
         self.config = config or load_config(env={})
         self.clock = clock if clock is not None else SYSTEM
         self._in_process = in_process
+        # nodeCacheCapable taken to its conclusion (ISSUE 14 satellite):
+        # once the extender has ingested the node set, sampled webhooks
+        # send {"NodesCached": true} instead of re-listing 10k node
+        # names per request; the extender expands the candidate set
+        # from its own cache. Placements are parity-tested against the
+        # protocol-faithful names body (default off).
+        self._cached_node_body = cached_node_body
         if slices is not None and mesh is not None:
             raise ValueError("pass either mesh or slices, not both")
         # the dynamic lock-order detector must be live BEFORE the
@@ -264,12 +272,17 @@ class SimCluster:
                     name=name, chips=chips, shares_per_chip=shares,
                     slice_id=sid,
                 )
-        if self.config.planner_replicas > 1:
+        if (self.config.planner_replicas > 1
+                or self.config.shard_transport == "subprocess"):
             # Slice-partitioned control plane (sched/shard.py): N full
             # planner replicas behind the router, each owning a
             # disjoint slice set. The router speaks the Extender
             # decision surface, so everything downstream (effectors,
-            # schedulers, chaos checkers) runs unchanged.
+            # schedulers, chaos checkers) runs unchanged. With
+            # shard_transport=subprocess each replica is a spawned
+            # worker DAEMON (even at N=1 — that point is the process-
+            # mode parity/throughput baseline) and the router fans
+            # calls out over the webhook HTTP contract.
             from tpukube.sched.shard import ShardRouter
 
             self.extender: Any = ShardRouter(self.config,
@@ -284,6 +297,12 @@ class SimCluster:
         self._node_obj_cache: dict[str, dict[str, Any]] = {}
         self._node_objs_list: Optional[list[dict[str, Any]]] = None
         self._synced_objs: list[dict[str, Any]] = []  # see _extender_node_args
+        # the names-only webhook body, cached alongside _synced_objs:
+        # rebuilding a 10k-entry name list per sampled webhook was an
+        # O(nodes) harness term the kilonode drives paid per pod
+        # (ISSUE 14 satellite; parity-tested against the rebuild-
+        # every-webhook protocol-faithful path)
+        self._synced_names: list[str] = []
         self._port = _free_port()
         self._http: Optional[_AppThread] = None
         # keep-alive connection per client thread (kube-scheduler likewise
@@ -332,18 +351,25 @@ class SimCluster:
     def advance(self, seconds: float) -> None:
         """Advance the injected fake clock (discrete-event time).
         Raises on a real clock — a sim that thinks it is compressing
-        time while actually sleeping wall time is a silent lie."""
+        time while actually sleeping wall time is a silent lie.
+        A process-mode sharded cluster fans the advance out to its
+        worker daemons so every replica's scheduling clock moves in
+        lockstep with the router's."""
         advance = getattr(self.clock, "advance", None)
         if advance is None:
             raise RuntimeError(
                 "advance() needs a FakeClock (pass clock=FakeClock())"
             )
         advance(seconds)
+        fan = getattr(self.extender, "advance_replicas", None)
+        if fan is not None:
+            fan(seconds)
 
     def start(self) -> None:
         if self._in_process:
             return  # webhooks dispatch straight into Extender.handle
-        if self.config.planner_replicas > 1:
+        if (self.config.planner_replicas > 1
+                or self.config.shard_transport == "subprocess"):
             raise RuntimeError(
                 "a sharded SimCluster (planner_replicas > 1) runs "
                 "in_process=True — the in-process router is the "
@@ -459,7 +485,8 @@ class SimCluster:
         state — ledger, gang reservations, pending webhook context,
         queued evictions — is gone. Nothing is flushed or unwound;
         that is the point."""
-        if self.config.planner_replicas > 1:
+        if (self.config.planner_replicas > 1
+                or self.config.shard_transport == "subprocess"):
             raise RuntimeError(
                 "sharded cluster: crash/restart individual replicas "
                 "(crash_replica/restart_replica), not the whole plane"
@@ -532,7 +559,7 @@ class SimCluster:
                                   "restored_allocs": restored}
         # the fresh extender has ingested nothing over the webhook
         # channel yet: the next schedule() must send full node objects
-        self._synced_objs = []
+        self._commit_synced([])
         if not self._in_process:
             self._http = _AppThread(make_app(self.extender), "127.0.0.1",
                                     self._port)
@@ -595,8 +622,23 @@ class SimCluster:
         if len(objs) == len(synced) and all(
             a is b for a, b in zip(objs, synced)
         ):
-            return {"NodeNames": [o["metadata"]["name"] for o in objs]}, None
+            if self._cached_node_body:
+                # NodesCached mode: the extender expands the candidate
+                # set from its own cache — the body names no nodes at
+                # all (O(1) per webhook AND per wire hop)
+                return {"NodesCached": True}, None
+            # the cached names list rides with the synced set (never
+            # mutated downstream: the schema layer copies) — the
+            # names-only body costs O(1) per webhook, not O(nodes)
+            return {"NodeNames": self._synced_names}, None
         return {"Nodes": {"Items": objs}}, objs
+
+    def _commit_synced(self, objs: list[dict[str, Any]]) -> None:
+        """Record the node set the extender has ingested error-free,
+        caching the names-only body alongside (see
+        ``_extender_node_args``)."""
+        self._synced_objs = objs
+        self._synced_names = [o["metadata"]["name"] for o in objs]
 
     def make_pod(
         self,
@@ -688,7 +730,12 @@ class SimCluster:
         half-assembled gang's running members must not keep their chips).
         Thin wrapper over the same :class:`~tpukube.apiserver.
         EvictionExecutor` a real cluster runs, pointed at this harness's
-        pod store instead of the REST channel."""
+        pod store instead of the REST channel. A process-mode router
+        first pulls each worker daemon's local eviction queue onto the
+        shared bus the executor drains."""
+        pull = getattr(self.extender, "pull_evictions", None)
+        if pull is not None:
+            pull()
         return self._evictions.drain()
 
     def schedule(
@@ -714,7 +761,7 @@ class SimCluster:
             if pending_objs is not None:
                 # the extender ingested this node set error-free; later
                 # cycles (any thread) may go names-only
-                self._synced_objs = pending_objs
+                self._commit_synced(pending_objs)
             feasible_names = fres["NodeNames"]
             if not feasible_names:
                 raise RuntimeError(f"unschedulable: {fres['FailedNodes']}")
@@ -761,39 +808,65 @@ class SimCluster:
         if ext.cycle is None:
             raise RuntimeError("schedule_pending needs batch_enabled=true")
         self._sync_nodes()
+        # the router's batched driver surface (admit_many /
+        # planned_many / bind_many): one fanned-out call per replica
+        # per round instead of one dispatch per pod — in process mode
+        # a per-pod HTTP round-trip would hand the router tax the
+        # whole multi-core win back. Absent (plain Extender), the
+        # per-pod path below is the same protocol.
+        admit_many = getattr(ext, "admit_many", None)
+        planned_many = getattr(ext, "planned_many", None)
+        bind_many = getattr(ext, "bind_many", None)
         results: dict[str, tuple[str, AllocResult]] = {}
         remaining = list(pods)
         for _ in range(retries):
             if not remaining:
                 break
             self.drain_evictions()
-            for obj in remaining:
-                ext.admit(kube.pod_from_k8s(obj))
+            infos = [kube.pod_from_k8s(obj) for obj in remaining]
+            if admit_many is not None:
+                admit_many(infos)
+            else:
+                for info in infos:
+                    ext.admit(info)
             ext.plan_pending()
+            keys = [f"{o['metadata']['namespace']}/"
+                    f"{o['metadata']['name']}" for o in remaining]
+            if planned_many is not None:
+                planned = planned_many(keys)
+            else:
+                planned = {k: ext.planned_node(k) for k in keys}
             still: list[dict[str, Any]] = []
-            for obj in remaining:
+            bind_objs: list[dict[str, Any]] = []
+            bind_bodies: list[dict[str, Any]] = []
+            for obj, key in zip(remaining, keys):
                 meta = obj["metadata"]
-                key = f"{meta['namespace']}/{meta['name']}"
-                node = ext.planned_node(key)
+                node = planned.get(key)
                 if node is None:
                     still.append(obj)
                     continue
-                bres = self._post("/bind", {
+                body = {
                     "PodName": meta["name"],
                     "PodNamespace": meta["namespace"],
                     "PodUID": meta["uid"],
                     "Node": node,
-                })
+                }
+                if bind_many is not None:
+                    bind_objs.append(obj)
+                    bind_bodies.append(body)
+                    continue
+                bres = self._post("/bind", body)
                 if bres.get("Error"):
                     still.append(obj)
                     continue
-                meta.setdefault("annotations", {}).update(
-                    bres.get("Annotations", {})
-                )
-                obj["spec"]["nodeName"] = node
-                results[key] = (node, codec.decode_alloc(
-                    meta["annotations"][codec.ANNO_ALLOC]
-                ))
+                self._apply_bind(obj, node, bres, results)
+            if bind_bodies:
+                for obj, body, bres in zip(bind_objs, bind_bodies,
+                                           bind_many(bind_bodies)):
+                    if bres.get("Error"):
+                        still.append(obj)
+                        continue
+                    self._apply_bind(obj, body["Node"], bres, results)
             remaining = still
         if remaining:
             names = [o["metadata"]["name"] for o in remaining[:3]]
@@ -803,29 +876,54 @@ class SimCluster:
             )
         return results
 
+    def _apply_bind(self, obj: dict[str, Any], node: str,
+                    bres: dict[str, Any],
+                    results: dict[str, tuple[str, AllocResult]]) -> None:
+        """The apiserver role for one successful bind answer: persist
+        the alloc annotation + nodeName on the pod object and record
+        the result (shared by the per-pod and batched bind paths)."""
+        meta = obj["metadata"]
+        meta.setdefault("annotations", {}).update(
+            bres.get("Annotations", {})
+        )
+        obj["spec"]["nodeName"] = node
+        key = f"{meta['namespace']}/{meta['name']}"
+        results[key] = (node, codec.decode_alloc(
+            meta["annotations"][codec.ANNO_ALLOC]
+        ))
+
     def _sync_nodes(self) -> None:
         """Push node annotations through the recorded ``upsert_node``
         decision (the nodeCacheCapable out-of-band refresh): the batch
         driver skips /filter webhooks, which are how node topology
         normally reaches the extender. Identity-cached like
-        _extender_node_args — unchanged node sets cost nothing."""
+        _extender_node_args — unchanged node sets cost nothing. A
+        sharded router ingests the whole fleet through its batched
+        ``upsert_nodes_many`` (one fan-out instead of one dispatch —
+        in process mode one HTTP round-trip — per node)."""
         objs = self.node_objects()
         synced = self._synced_objs
         if len(objs) == len(synced) and all(
             a is b for a, b in zip(objs, synced)
         ):
             return
-        for obj in objs:
-            res = self.extender.handle("upsert_node", {
-                "name": obj["metadata"]["name"],
-                "annotations": obj["metadata"]["annotations"],
-            })
+        items = [{
+            "name": obj["metadata"]["name"],
+            "annotations": obj["metadata"]["annotations"],
+        } for obj in objs]
+        batched = getattr(self.extender, "upsert_nodes_many", None)
+        if batched is not None:
+            answers = batched(items)
+        else:
+            answers = [self.extender.handle("upsert_node", item)
+                       for item in items]
+        for item, res in zip(items, answers):
             if isinstance(res, dict) and res.get("error"):
                 raise RuntimeError(
-                    f"node sync failed for "
-                    f"{obj['metadata']['name']}: {res['error']}"
+                    f"node sync failed for {item['name']}: "
+                    f"{res['error']}"
                 )
-        self._synced_objs = objs
+        self._commit_synced(objs)
 
     def delete_pod(self, name: str, namespace: str = "default") -> None:
         """Remove the pod object, then let the lifecycle release loop

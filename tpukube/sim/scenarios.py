@@ -57,6 +57,9 @@ _PASSTHROUGH_KEYS = (
     # bench replica sweep pin replica count + plan-served answers
     "TPUKUBE_PLANNER_REPLICAS",
     "TPUKUBE_FILTER_FROM_PLAN",
+    # process-parallel sharding (ISSUE 14): subprocess replica daemons
+    # for the true multi-core sweep (check.sh shard-mp smoke, bench)
+    "TPUKUBE_SHARD_TRANSPORT",
 )
 
 
@@ -814,8 +817,11 @@ def _kilonode_drive(cfg: TpuKubeConfig, metric: str, total_target: int,
     sample_every = 101  # full-webhook-protocol sampling cadence
     clock = FakeClock()
     t0 = time.perf_counter()
+    # NodesCached sampled-webhook bodies (ISSUE 14 satellite): after
+    # the one-time ingest the kilonode drives stop re-listing O(nodes)
+    # names per sampled webhook (parity-tested in tests/test_shard_proc)
     with SimCluster(cfg, clock=clock, in_process=True,
-                    slices=slices) as c:
+                    slices=slices, cached_node_body=True) as c:
         setup_s = None
         if not include_setup:
             c._sync_nodes()  # the one-time node ingest, off the clock
@@ -920,6 +926,9 @@ def _kilonode_drive(cfg: TpuKubeConfig, metric: str, total_target: int,
                 ],
                 "slice_assignment": doc["slice_assignment"],
                 "rendezvous": doc["rendezvous"],
+                # process mode: transport RTTs + health-check counters
+                # ride the result (ISSUE 14)
+                "transport": doc["transport"],
             }
         if ext.decisions is not None:
             # the measured-overhead guard (ISSUE 12): provenance's
