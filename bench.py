@@ -565,6 +565,143 @@ def kilonode100k() -> dict:
     }
 
 
+def _coldstart_fleet(n_nodes: int, hetero: bool) -> tuple[list, list]:
+    """Mint ``n_nodes`` worth of node-annotation items (the
+    ``upsert_nodes`` wire shape) over 10,240-node slices of 32x32x40
+    (scenario 14's geometry; a smaller point uses one right-sized
+    slice). ``hetero`` sprinkles per-node health flips and bad ICI
+    links so payload shapes vary across the fleet the way a real aging
+    fleet's do — the homogeneous run is the mesh-fragment memo's best
+    case, the heterogeneous run its honest case. Returns ``(items,
+    keepalive)`` — the caller must hold ``keepalive`` (the minted
+    NodeInfo fleet) across the measurement: scenario 14's setup runs
+    with the sim's whole fleet live on the heap, and the per-node
+    path's allocation storms pay GC full-heap scans against it (the
+    dominant fleet-scale term the bulk path avoids)."""
+    from tpukube.core import codec
+    from tpukube.core.mesh import MeshSpec
+    from tpukube.core.types import ChipInfo, Health, NodeInfo
+
+    slice_nodes = 10240
+    if n_nodes <= slice_nodes:
+        # one right-sized slice: 4 chips/node under host_block (2,2,1)
+        chips = n_nodes * 4
+        z = max(2, chips // (32 * 32))
+        dims = (32, 32, z) if chips >= 32 * 32 * 2 else (16, 16, 16)
+        meshes = {"s00": MeshSpec(dims=dims, host_block=(2, 2, 1))}
+    else:
+        meshes = {
+            f"s{i:02d}": MeshSpec(dims=(32, 32, 40),
+                                  host_block=(2, 2, 1))
+            for i in range((n_nodes + slice_nodes - 1) // slice_nodes)
+        }
+    items: list[dict] = []
+    keepalive: list = []
+    for sid in sorted(meshes):
+        m = meshes[sid]
+        for host in m.all_hosts():
+            if len(items) >= n_nodes:
+                break
+            name = f"{sid}-{host}"
+            coords = m.coords_of_host(host)
+            chips = [
+                ChipInfo(chip_id=f"{name}-chip-{i}", index=i, coord=c,
+                         hbm_bytes=16 * 2 ** 30)
+                for i, c in enumerate(coords)
+            ]
+            if hetero and len(items) % 7 == 0:
+                chips[0].health = Health.UNHEALTHY
+            info = NodeInfo(name=name, chips=chips, slice_id=sid)
+            if hetero and len(items) % 13 == 0:
+                # one bad link between two ICI-adjacent chips of this
+                # node's own 2x2 host block
+                for other in coords[1:]:
+                    if other in m.neighbors(coords[0]):
+                        info.bad_links = [(coords[0], other)]
+                        break
+            keepalive.append(info)
+            items.append({
+                "name": name,
+                "annotations": codec.annotate_node(info, m),
+            })
+    return items, keepalive
+
+
+def _coldstart_point(n_nodes: int, hetero: bool) -> dict:
+    """One coldstart measurement: the bulk ``upsert_nodes`` ingest wall
+    vs the legacy per-node ``upsert_node`` decision loop, on fresh
+    extenders over the same minted fleet (annotation encode is setup,
+    untimed; the minted fleet stays LIVE on the heap for both arms —
+    see _coldstart_fleet). ``bulk_warm_s`` adds the deferred decode
+    the background warmer drains off the serving path — reported so
+    the lazy contract's deferred cost stays visible next to the
+    headline."""
+    import gc
+
+    from tpukube.core.config import load_config
+    from tpukube.sched.extender import Extender
+
+    items, keepalive = _coldstart_fleet(n_nodes, hetero)
+    out: dict = {"nodes": len(items), "chips": len(items) * 4,
+                 "hetero": hetero}
+
+    cfg = load_config(env={})
+    ext = Extender(cfg)
+    gc.collect()
+    t0 = time.perf_counter()
+    results = ext.upsert_nodes_many(items)
+    out["bulk_s"] = round(time.perf_counter() - t0, 3)
+    bad = [r for r in results if r != {"ours": True}]
+    if bad:
+        raise RuntimeError(f"coldstart bulk ingest rejected items: "
+                           f"{bad[:3]}")
+    t0 = time.perf_counter()
+    while ext.state.warm_pending(2048):
+        pass
+    out["bulk_warm_s"] = round(time.perf_counter() - t0, 3)
+    stats = ext.state.ingest_stats()
+    out["decode_cache_hit_rate"] = stats["decode_cache_hit_rate"]
+    # drop the bulk arm's ledger before timing the per-node arm (its
+    # GC cost must scan the shared minted fleet, not the rival arm's)
+    ext.state.retire()
+    del ext
+    gc.collect()
+
+    ext2 = Extender(cfg)
+    ext2.bulk_ingest = False
+    t0 = time.perf_counter()
+    results = ext2.upsert_nodes_many(items)
+    out["per_node_s"] = round(time.perf_counter() - t0, 3)
+    bad = [r for r in results if r != {"ours": True}]
+    if bad:
+        raise RuntimeError(f"coldstart per-node ingest rejected "
+                           f"items: {bad[:3]}")
+    out["speedup"] = (round(out["per_node_s"] / out["bulk_s"], 1)
+                      if out["bulk_s"] > 0 else None)
+    del ext2, keepalive
+    gc.collect()
+    return out
+
+
+def coldstart() -> dict:
+    """ISSUE 15 acceptance: cold-start fleet ingestion — the bulk
+    ``upsert_nodes`` fast path (probe-validated lazy ingest, one
+    epoch/delta/journal seam per batch) vs the per-node ``upsert_node``
+    decision loop, at 1k / 10k / ~102k nodes, homogeneous and (at the
+    10k point) heterogeneous payloads. The ~102k point is scenario
+    14's fleet shape (10 slices x 32x32x40 = 102,400 nodes / 409,600
+    chips) — the acceptance point, where the per-node path's GC
+    full-heap scans over the live fleet make the gap superlinear
+    (~5x+ here vs ~3x at 10k); check.sh floors the 10k point (fast
+    enough for CI) and BENCH records this full sweep."""
+    return {
+        "1k": _coldstart_point(1024, hetero=False),
+        "10k": _coldstart_point(10240, hetero=False),
+        "10k_hetero": _coldstart_point(10240, hetero=True),
+        "100k": _coldstart_point(102400, hetero=False),
+    }
+
+
 def run() -> dict:
     from tpukube.sim import scenarios
 
@@ -591,6 +728,7 @@ def run() -> dict:
     result["shard_scaling"] = shard_scaling()
     result["kilonode100k"] = kilonode100k()
     result["recovery"] = recovery()
+    result["coldstart"] = coldstart()
     return result
 
 

@@ -1,0 +1,732 @@
+"""ISSUE 15: bulk cold-start ingestion + generation-based incremental
+resync.
+
+The acceptance gates:
+  * bulk ``upsert_nodes`` produces ledger/snapshot/cache state
+    identical to per-node upserts (mixed health/link/vTPU payloads,
+    error items, changed-payload re-annotations), with the audit
+    sentinel re-deriving via full walks so the probe-seeded caches can
+    never hide a missed seam;
+  * a mid-ingest crash recovers through the scenario-13 journal
+    machinery (the "nodes" WAL record replays through the same fast
+    path; a lost record reconciles from the apiserver);
+  * ``allocs_since`` equals the full-read diff at every step of a
+    random lifecycle, and a gap/overflow/restart ALWAYS degrades to a
+    full read — never a stale answer;
+  * the lifecycle resync and the router's federated ``allocations``
+    path move O(changed-allocs) wire bytes per churn wave;
+  * a killed replica's warm restart replays its own journal segment
+    (ROADMAP sharding item (d)) with the cold re-ingest as the
+    failure ladder.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+
+import pytest
+
+from tpukube.chaos import ledger_divergence
+from tpukube.core import codec
+from tpukube.core.config import load_config
+from tpukube.core.mesh import MeshSpec
+from tpukube.core.types import (
+    AllocResult,
+    ChipInfo,
+    Health,
+    NodeInfo,
+    PodGroup,
+    TopologyCoord,
+    make_device_id,
+)
+from tpukube.sched.extender import Extender
+from tpukube.sched.snapshot import _audit_divergence
+from tpukube.sim.harness import SimCluster
+
+MESH = MeshSpec(dims=(4, 4, 2), host_block=(2, 2, 1))
+
+
+def _fleet_items(mesh=MESH, sid="slice-0", vtpu_hosts=(),
+                 unhealthy_hosts=(), link_hosts=(), prefix=""):
+    """Node-annotation items over one slice, with optional per-host
+    health flips, bad ICI links, and vTPU share payloads — the mixed
+    shapes the parity suite must not collapse."""
+    items = []
+    for host in mesh.all_hosts():
+        name = prefix + host
+        coords = mesh.coords_of_host(host)
+        chips = [
+            ChipInfo(chip_id=f"{name}-c{i}", index=i, coord=c,
+                     hbm_bytes=16 * 2 ** 30)
+            for i, c in enumerate(coords)
+        ]
+        if host in unhealthy_hosts:
+            chips[0].health = Health.UNHEALTHY
+        info = NodeInfo(
+            name=name, chips=chips, slice_id=sid,
+            shares_per_chip=4 if host in vtpu_hosts else 1,
+        )
+        if host in link_hosts:
+            for other in coords[1:]:
+                if other in mesh.neighbors(coords[0]):
+                    info.bad_links = [(coords[0], other)]
+                    break
+        items.append({"name": name,
+                      "annotations": codec.annotate_node(info, mesh)})
+    return items
+
+
+def _mixed_items():
+    hosts = MESH.all_hosts()
+    return _fleet_items(vtpu_hosts={hosts[1]},
+                        unhealthy_hosts={hosts[2]},
+                        link_hosts={hosts[3]})
+
+
+def _ingest(items, bulk: bool, cfg=None) -> Extender:
+    ext = Extender(cfg or load_config(env={}))
+    ext.bulk_ingest = bulk
+    results = ext.upsert_nodes_many(items)
+    assert all(r == {"ours": True} for r in results), results
+    return ext
+
+
+def _fingerprint(ext: Extender) -> dict:
+    """Everything observable about the ingested state, with the cached
+    reads CROSS-CHECKED against their ground-truth walks (a probe-
+    seeded cache that disagrees with the walk is the bug this suite
+    exists to catch)."""
+    st = ext.state
+    while st.warm_pending(4096):
+        pass
+    out = {"names": st.node_names(), "slices": sorted(st.slice_ids()),
+           "utilization": st.utilization()}
+    for sid in st.slice_ids():
+        occ, wocc = st.occupied_coords(sid), st.walk_occupied_coords(sid)
+        unh, wunh = st.unhealthy_coords(sid), st.walk_unhealthy_coords(sid)
+        brk, wbrk = st.broken_links(sid), st.walk_broken_links(sid)
+        shr = st.slice_share_counts(sid)
+        wshr = st.walk_slice_share_counts(sid)
+        assert occ == wocc and unh == wunh and brk == wbrk \
+            and tuple(shr) == tuple(wshr), f"cache != walk in {sid}"
+        out[sid] = (frozenset(occ), frozenset(unh), frozenset(brk),
+                    tuple(shr))
+    out["nodes"] = {}
+    for name in st.node_names():
+        view = st.node(name)
+        out["nodes"][name] = (
+            view.raw_payload,
+            view.shares_per_chip,
+            tuple(sorted((c.index, tuple(c.coord), c.health.value)
+                         for c in view.info.chips)),
+        )
+    return out
+
+
+# -- parity: bulk ingest vs per-node upserts --------------------------------
+
+def test_bulk_ingest_parity_mixed_payloads():
+    items = _mixed_items()
+    bulk = _ingest(items, bulk=True)
+    per = _ingest(items, bulk=False)
+    assert _fingerprint(bulk) == _fingerprint(per)
+    # the scheduling snapshots agree too (content, not cache keys)
+    diffs = _audit_divergence(bulk.snapshots.current(),
+                              per.snapshots.current())
+    assert diffs == [], diffs
+
+
+def test_bulk_ingest_error_items_match_per_node():
+    """Every malformed shape errors with the per-node path's message,
+    and a bad item never poisons its batchmates."""
+    good = _mixed_items()
+    bad_json = {"name": "bj", "annotations": {
+        codec.ANNO_NODE_TOPOLOGY: "{nope"}}
+    wrong_name = json.loads(
+        good[0]["annotations"][codec.ANNO_NODE_TOPOLOGY])
+    wrong_name["node"] = "imposter"
+    name_item = {"name": "real-name", "annotations": {
+        codec.ANNO_NODE_TOPOLOGY: json.dumps(wrong_name)}}
+    small = MeshSpec(dims=(2, 2, 2), host_block=(2, 2, 1))
+    mesh_item = _fleet_items(mesh=small, prefix="m-")[0]
+    conflict = json.loads(
+        good[1]["annotations"][codec.ANNO_NODE_TOPOLOGY])
+    conflict["node"] = "claim-jumper"
+    conflict_item = {"name": "claim-jumper", "annotations": {
+        codec.ANNO_NODE_TOPOLOGY: json.dumps(conflict)}}
+    batch = good + [bad_json, name_item, mesh_item, conflict_item,
+                    {"name": "no-anno", "annotations": {}}]
+
+    bulk = Extender(load_config(env={}))
+    res = bulk.upsert_nodes_many(batch)
+
+    per = Extender(load_config(env={}))
+    per.bulk_ingest = False
+    res_per = per.upsert_nodes_many(batch)
+    assert res == res_per
+    assert all(r == {"ours": True} for r in res[:len(good)])
+    assert "bad JSON" in res[len(good)]["error"]
+    assert "imposter" in res[len(good) + 1]["error"]
+    assert "must agree on its geometry" in res[len(good) + 2]["error"]
+    assert "both claim" in res[len(good) + 3]["error"]
+    assert res[-1] == {"ours": False}
+    assert _fingerprint(bulk) == _fingerprint(per)
+
+
+def test_bulk_ingest_batch_internal_conflict_unwinds_cleanly():
+    """Two items of ONE batch claiming the same chips: first stages,
+    second errors, and the survivor's claims are intact."""
+    items = _mixed_items()
+    dup = json.loads(items[0]["annotations"][codec.ANNO_NODE_TOPOLOGY])
+    dup["node"] = "dup"
+    batch = [items[0], {"name": "dup", "annotations": {
+        codec.ANNO_NODE_TOPOLOGY: json.dumps(dup)}}] + items[1:]
+    ext = Extender(load_config(env={}))
+    res = ext.upsert_nodes_many(batch)
+    assert res[0] == {"ours": True}
+    assert "both claim" in res[1]["error"]
+    assert all(r == {"ours": True} for r in res[2:])
+    per = Extender(load_config(env={}))
+    per.bulk_ingest = False
+    per.upsert_nodes_many(batch)
+    assert _fingerprint(ext) == _fingerprint(per)
+
+
+def test_bulk_ingest_duplicate_name_in_one_batch_matches_per_node():
+    """The SAME node listed twice in one batch (webhook bodies repeat
+    candidates): identical payload answers True twice like the
+    per-node path's unchanged-payload second upsert — never a
+    both-claim error (the name-string identity staging trick must not
+    compare cross-item) — and a CHANGED second payload lands the
+    re-annotation path."""
+    items = _mixed_items()
+    batch = [items[0], dict(items[0])] + items[1:]
+    bulk = Extender(load_config(env={}))
+    res = bulk.upsert_nodes_many(batch)
+    per = Extender(load_config(env={}))
+    per.bulk_ingest = False
+    assert res == per.upsert_nodes_many(batch)
+    assert res[0] == res[1] == {"ours": True}
+    assert _fingerprint(bulk) == _fingerprint(per)
+    # duplicate with a CHANGED payload: second occurrence re-annotates
+    doc = json.loads(items[0]["annotations"][codec.ANNO_NODE_TOPOLOGY])
+    doc["chips"][0]["health"] = "Unhealthy"
+    changed = {"name": items[0]["name"], "annotations": {
+        codec.ANNO_NODE_TOPOLOGY: json.dumps(doc)}}
+    batch2 = [items[0], changed] + items[1:]
+    b2 = Extender(load_config(env={}))
+    r2 = b2.upsert_nodes_many(batch2)
+    p2 = Extender(load_config(env={}))
+    p2.bulk_ingest = False
+    assert r2 == p2.upsert_nodes_many(batch2)
+    assert _fingerprint(b2) == _fingerprint(p2)
+
+
+def test_decode_counters_track_resend_suppression():
+    """Cold ingest = all misses (every payload names its own node);
+    re-sending the identical fleet = all hits, no parse."""
+    items = _mixed_items()
+    ext = _ingest(items, bulk=True)
+    s0 = ext.state.ingest_stats()
+    assert s0["decode_cache_misses"] == len(items)
+    assert s0["decode_cache_hit_rate"] == 0.0
+    res = ext.upsert_nodes_many(items)  # the webhook re-send shape
+    assert all(r == {"ours": True} for r in res)
+    s1 = ext.state.ingest_stats()
+    assert s1["decode_cache_hits"] == len(items)
+    assert s1["decode_cache_misses"] == len(items)
+    assert s1["decode_cache_hit_rate"] == 0.5
+
+
+def test_bulk_ingest_changed_payload_takes_per_node_path():
+    """A re-annotation of a known node (health flip) through the bulk
+    surface lands the per-node path's health-only delta semantics —
+    state identical to a per-node upsert doing the same."""
+    items = _mixed_items()
+    flipped = []
+    for item in items:
+        doc = json.loads(item["annotations"][codec.ANNO_NODE_TOPOLOGY])
+        if item["name"].endswith(MESH.all_hosts()[0]):
+            doc["chips"][1]["health"] = "Unhealthy"
+        flipped.append({"name": item["name"], "annotations": {
+            codec.ANNO_NODE_TOPOLOGY: json.dumps(doc)}})
+
+    exts = []
+    for bulk in (True, False):
+        ext = _ingest(items, bulk=bulk)
+        ext.bulk_ingest = bulk
+        res = ext.upsert_nodes_many(flipped)
+        assert all(r == {"ours": True} for r in res)
+        exts.append(ext)
+    assert _fingerprint(exts[0]) == _fingerprint(exts[1])
+    sid = exts[0].state.slice_ids()[0]
+    assert len(exts[0].state.unhealthy_coords(sid)) == 2  # old + new
+
+
+def test_bulk_ingest_append_to_live_slice_advances_caches():
+    """A second batch adding NEW nodes to an already-seeded slice must
+    advance the incremental caches, not reseed them (allocs committed
+    in between survive)."""
+    big = MeshSpec(dims=(4, 4, 4), host_block=(2, 2, 1))
+    items = _fleet_items(mesh=big)
+    first, second = items[:4], items[4:]
+    ext = _ingest(first, bulk=True)
+    alloc = AllocResult(pod_key="default/p0", node_name=first[0]["name"],
+                        device_ids=[make_device_id(0)],
+                        coords=[big.coords_of_host(big.all_hosts()[0])[0]])
+    ext.state.commit(alloc)
+    res = ext.upsert_nodes_many(second)
+    assert all(r == {"ours": True} for r in res)
+    per = Extender(load_config(env={}))
+    per.bulk_ingest = False
+    per.upsert_nodes_many(first)
+    per.state.commit(alloc)
+    per.upsert_nodes_many(second)
+    assert _fingerprint(ext) == _fingerprint(per)
+    assert ext.state.allocation("default/p0") is not None
+
+
+def test_bulk_ingest_placement_parity_through_webhooks():
+    """The whole webhook stack places identically with bulk ingest on
+    vs off (the per-node oracle), audit sentinel at 1.0."""
+    placements = {}
+    for bulk in ("1", "0"):
+        cfg = load_config(env={
+            "TPUKUBE_BULK_INGEST_ENABLED": bulk,
+            "TPUKUBE_SNAPSHOT_AUDIT_RATE": "1.0",
+        })
+        with SimCluster(cfg) as c:
+            got = {}
+            grp = PodGroup("g", min_member=4)
+            for i in range(4):
+                node, alloc = c.schedule(
+                    c.make_pod(f"g-{i}", tpu=1, group=grp))
+                got[f"g-{i}"] = (node, tuple(alloc.device_ids))
+            for i in range(3):
+                node, alloc = c.schedule(c.make_pod(f"p-{i}", tpu=2))
+                got[f"p-{i}"] = (node, tuple(alloc.device_ids))
+            assert c.extender.snapshots.audit_divergences == 0
+            assert c.extender.snapshots.audit_checks > 0
+            placements[bulk] = got
+    assert placements["1"] == placements["0"]
+
+
+def test_bulk_ingest_checkpoint_roundtrip_keeps_lazy(tmp_path):
+    """A checkpoint captured over a still-lazy bulk-ingested fleet
+    rides the RAW annotations; recovery keeps them lazy and first
+    touch decodes to the same views."""
+    from tpukube.sched import journal as journal_mod
+    from tpukube.sched.shard import _ListApi
+
+    env = {"TPUKUBE_JOURNAL_ENABLED": "1",
+           "TPUKUBE_JOURNAL_PATH": str(tmp_path / "wal.jsonl")}
+    items = _mixed_items()
+    ext = _ingest_no_warm(items, env)
+    alloc = AllocResult(pod_key="default/p0", node_name=items[0]["name"],
+                        device_ids=[make_device_id(0)],
+                        coords=[MESH.coords_of_host(MESH.all_hosts()[0])[0]])
+    ext.state.commit(alloc)
+    ext.journal.write_checkpoint_sync(ext.checkpoint_doc())
+    # the commit materialized its own node; everything else stays lazy
+    assert ext.state.ingest_stats()["lazy_pending"] == len(items) - 1
+    ext.journal.close()
+    ext.state.retire()
+
+    ext2 = Extender(load_config(env=env))
+    journal_mod.recover_extender(ext2, _ListApi(
+        [{"metadata": {"name": it["name"],
+                       "annotations": it["annotations"]}}
+         for it in items],
+        [_pod_obj(alloc)],
+    ))
+    assert ext2.state.allocation("default/p0") is not None
+    oracle = _ingest(items, bulk=True)
+    oracle.state.commit(alloc)
+    fp2 = _fingerprint(ext2)
+    assert fp2["nodes"] == _fingerprint(oracle)["nodes"]
+    assert fp2["utilization"] == pytest.approx(
+        oracle.state.utilization())
+
+
+def _ingest_no_warm(items, env):
+    """Bulk-ingest without triggering the background warmer (tests
+    that must observe the lazy store call state.ingest_nodes
+    directly)."""
+    ext = Extender(load_config(env=env))
+    results = ext.state.ingest_nodes(items)
+    assert all(r == {"ours": True} for r in results), results
+    return ext
+
+
+def _pod_obj(alloc: AllocResult) -> dict:
+    ns, name = alloc.pod_key.split("/", 1)
+    return {
+        "metadata": {"name": name, "namespace": ns,
+                     "annotations": {
+                         codec.ANNO_ALLOC: codec.encode_alloc(alloc)}},
+        "spec": {"nodeName": alloc.node_name},
+        "status": {"phase": "Running"},
+    }
+
+
+def test_mid_ingest_crash_replays_or_reconciles(tmp_path):
+    """Scenario-13 machinery around the bulk seam: (a) a drained
+    'nodes' WAL record replays through the same fast path on
+    recovery; (b) a crash that LOSES the queued record still
+    converges via the apiserver reconcile."""
+    from tpukube.sched import journal as journal_mod
+    from tpukube.sched.shard import _ListApi
+
+    items = _mixed_items()
+    node_objs = [{"metadata": {"name": it["name"],
+                               "annotations": it["annotations"]}}
+                 for it in items]
+    for drained in (True, False):
+        env = {"TPUKUBE_JOURNAL_ENABLED": "1",
+               "TPUKUBE_JOURNAL_PATH": str(
+                   tmp_path / f"wal-{drained}.jsonl")}
+        ext = _ingest_no_warm(items, env)
+        if drained:
+            ext.journal.close()  # flushes the queued 'nodes' record
+        else:
+            ext.journal.crash()  # queued records LOST mid-ingest
+        ext.state.retire()
+
+        ext2 = Extender(load_config(env=env))
+        journal_mod.recover_extender(ext2, _ListApi(node_objs, []))
+        assert _fingerprint(ext2)["nodes"] == \
+            _fingerprint(_ingest(items, bulk=True))["nodes"]
+        ext2.journal.close()
+        ext2.state.retire()
+
+
+# -- generation-based incremental resync ------------------------------------
+
+def _mini_committed_extender():
+    ext = _ingest(_fleet_items(), bulk=True)
+    free = []  # (node_name, chip_index, coord)
+    for item in _fleet_items():
+        name = item["name"]
+        for i, c in enumerate(MESH.coords_of_host(name)):
+            free.append((name, i, c))
+    return ext, free
+
+
+def _apply_delta(mirror: dict, delta: dict) -> None:
+    if "full" in delta:
+        mirror.clear()
+        mirror.update({a.pod_key: a for a in delta["full"]})
+    else:
+        for key in delta["removes"]:
+            mirror.pop(key, None)
+        for a in delta["adds"]:
+            mirror[a.pod_key] = a
+
+
+def test_allocs_since_equals_full_read_property():
+    """Seeded random lifecycle: a mirror advanced by ``allocs_since``
+    equals the full read at EVERY read point, at several read
+    cadences and log capacities (including gap-forcing ones)."""
+    rng = random.Random(15)
+    for capacity, cadence in ((65536, 1), (65536, 7), (8, 3), (4, 9)):
+        ext, free = _mini_committed_extender()
+        ext.state.set_generation_log(capacity)
+        live: dict[str, tuple] = {}  # key -> (node, idx, coord)
+        mirror: dict[str, AllocResult] = {}
+        cursor = None
+        seq = 0
+        fulls = 0
+        for step in range(120):
+            if free and (not live or rng.random() < 0.6):
+                node, idx, coord = free.pop(
+                    rng.randrange(len(free)))
+                alloc = AllocResult(
+                    pod_key=f"default/p{seq}", node_name=node,
+                    device_ids=[make_device_id(idx)], coords=[coord])
+                seq += 1
+                ext.state.commit(alloc)
+                live[alloc.pod_key] = (node, idx, coord)
+            else:
+                key = rng.choice(sorted(live))
+                slot = live.pop(key)
+                ext.state.release(key)
+                free.append(slot)
+            if step % cadence == 0:
+                delta = ext.state.allocs_since(cursor)
+                cursor = delta["cursor"]
+                assert delta["bytes"] >= 0
+                if "full" in delta:
+                    fulls += 1
+                _apply_delta(mirror, delta)
+                truth = {a.pod_key: a for a in ext.state.allocations()}
+                assert mirror == truth, (capacity, cadence, step)
+        if capacity >= 120:
+            assert fulls == 1  # only the bootstrap read
+
+
+def test_allocs_since_gap_and_restart_degrade_to_full():
+    ext, free = _mini_committed_extender()
+    ext.state.set_generation_log(2)
+    d0 = ext.state.allocs_since(None)
+    assert "full" in d0
+    for i in range(4):  # 4 > capacity 2: the log gapped
+        node, idx, coord = free.pop()
+        ext.state.commit(AllocResult(
+            pod_key=f"default/g{i}", node_name=node,
+            device_ids=[make_device_id(idx)], coords=[coord]))
+    d1 = ext.state.allocs_since(d0["cursor"])
+    assert "full" in d1 and len(d1["full"]) == 4
+    # a cursor from ANOTHER ledger incarnation: full, never stale
+    other = Extender(load_config(env={}))
+    other.state.set_generation_log(16)
+    d2 = other.state.allocs_since(d1["cursor"])
+    assert "full" in d2
+    # a nonsense/future cursor: full
+    inc, _gen = ext.state.generation()
+    assert "full" in ext.state.allocs_since((inc, 10 ** 9))
+    assert "full" in ext.state.allocs_since("garbage")
+
+
+def test_allocs_since_disabled_returns_none():
+    ext, _ = _mini_committed_extender()
+    ext.state.set_generation_log(0)
+    assert ext.state.allocs_since(None) is None
+
+
+def test_generation_rides_checkpoint(tmp_path):
+    """Recovery resumes the generation numbering (never regresses),
+    and the fresh incarnation token full-reads any pre-crash cursor."""
+    from tpukube.sched import journal as journal_mod
+    from tpukube.sched.shard import _ListApi
+
+    env = {"TPUKUBE_JOURNAL_ENABLED": "1",
+           "TPUKUBE_JOURNAL_PATH": str(tmp_path / "wal.jsonl")}
+    items = _mixed_items()
+    ext = _ingest(items, bulk=False, cfg=load_config(env=env))
+    allocs = []
+    # hosts[1]/[2] carry the vTPU/unhealthy payload flips: commit on
+    # plain healthy hosts so the lifecycle itself can't error
+    for i, host in enumerate(MESH.all_hosts()[4:7]):
+        a = AllocResult(pod_key=f"default/p{i}", node_name=host,
+                        device_ids=[make_device_id(0)],
+                        coords=[MESH.coords_of_host(host)[0]])
+        ext.state.commit(a)
+        allocs.append(a)
+    old_cursor = ext.state.allocs_since(None)["cursor"]
+    _inc, old_gen = ext.state.generation()
+    assert old_gen == 3
+    ext.journal.write_checkpoint_sync(ext.checkpoint_doc())
+    ext.journal.crash()
+    ext.state.retire()
+
+    ext2 = Extender(load_config(env=env))
+    journal_mod.recover_extender(ext2, _ListApi(
+        [{"metadata": {"name": it["name"],
+                       "annotations": it["annotations"]}}
+         for it in items],
+        [_pod_obj(a) for a in allocs],
+    ))
+    inc2, gen2 = ext2.state.generation()
+    assert gen2 >= old_gen
+    assert inc2 != _inc
+    d = ext2.state.allocs_since(old_cursor)
+    assert "full" in d and len(d["full"]) == 3
+    ext2.journal.close()
+    ext2.state.retire()
+
+
+def test_lifecycle_resync_rides_the_generation_log():
+    """Churn waves through the sim's real release loop: ONE bootstrap
+    full read, every later resync incremental, wire bytes O(Δ), and
+    the releases actually land (mirror correctness end to end)."""
+    cfg = load_config(env={"TPUKUBE_SNAPSHOT_AUDIT_RATE": "1.0"})
+    with SimCluster(cfg) as c:
+        for wave in range(4):
+            names = [f"w{wave}-{i}" for i in range(3)]
+            for n in names:
+                c.schedule(c.make_pod(n, tpu=1))
+            for n in names:
+                c.complete_pod(n)
+        stats = c._lifecycle.resync_stats()
+        assert stats["full"] == 1, stats  # the bootstrap read only
+        assert stats["incremental"] >= 4
+        assert stats["bytes"] > 0
+        assert stats["incremental_hit_ratio"] == 1.0
+        assert c.extender.state.allocations() == []
+        assert ledger_divergence(c) == []
+        assert c.extender.snapshots.audit_divergences == 0
+
+
+def test_lifecycle_resync_gap_falls_back_full_never_stale():
+    """A generation log too small for the wave: the resync degrades to
+    counted FULL reads and still releases everything."""
+    cfg = load_config(env={"TPUKUBE_GENERATION_LOG_CAPACITY": "2"})
+    with SimCluster(cfg) as c:
+        c._lifecycle.check_once()  # burn the bootstrap full read
+        names = [f"p-{i}" for i in range(6)]
+        for n in names:
+            c.schedule(c.make_pod(n, tpu=1))
+        for n in names[:-1]:
+            c.pods.pop(f"default/{n}")
+        c._lifecycle.check_once()  # 6 commits >> capacity 2: gap
+        stats = c._lifecycle.resync_stats()
+        assert stats["full"] >= 2  # bootstrap + the gap fallback
+        assert len(c.extender.state.allocations()) == 1
+        assert ledger_divergence(c) == []
+
+
+def test_lifecycle_resync_disabled_keeps_legacy_reads():
+    cfg = load_config(env={"TPUKUBE_GENERATION_LOG_CAPACITY": "0"})
+    with SimCluster(cfg) as c:
+        c.schedule(c.make_pod("p", tpu=1))
+        c.complete_pod("p")
+        stats = c._lifecycle.resync_stats()
+        assert stats == {"full": 0, "incremental": 0, "bytes": 0,
+                         "incremental_hit_ratio": None}
+        assert c.extender.state.allocations() == []
+
+
+def test_federated_allocs_since_incremental_and_kill_fallback():
+    """The sharded plane's federated resync: incremental against a
+    stable replica set, merged FULL after a replica kill/restart —
+    never a stale merge."""
+    cfg = load_config(env={"TPUKUBE_PLANNER_REPLICAS": "2",
+                           "TPUKUBE_BATCH_ENABLED": "1"})
+    slices = {
+        "s0": MeshSpec(dims=(2, 2, 2), host_block=(2, 2, 1)),
+        "s1": MeshSpec(dims=(2, 2, 2), host_block=(2, 2, 1)),
+    }
+    with SimCluster(cfg, slices=slices, in_process=True) as c:
+        for i in range(4):
+            c.schedule(c.make_pod(f"a-{i}", tpu=1))
+        fed = c.extender.state
+        d0 = fed.allocs_since(None)
+        assert "full" in d0 and len(d0["full"]) == 4
+        c.schedule(c.make_pod("late", tpu=1))
+        c.complete_pod("a-0")
+        d1 = fed.allocs_since(d0["cursor"])
+        assert "adds" in d1, d1
+        adds = {a.pod_key for a in d1["adds"]}
+        assert "default/late" in adds
+        assert "default/a-0" in d1["removes"]
+        # replica death: the merged answer degrades to FULL
+        c.crash_replica(1)
+        d2 = fed.allocs_since(d1["cursor"])
+        assert "full" in d2
+        mirror = {a.pod_key: a for a in d2["full"]}
+        truth = {a.pod_key: a for a in fed.allocations()}
+        assert mirror == truth
+
+
+def test_restart_replica_replays_journal_segment_warm(tmp_path):
+    """ROADMAP sharding item (d): a journal-enabled replica's restart
+    replays its own WAL segment (warm) instead of the full re-ingest;
+    deleting the segment exercises the cold failure ladder."""
+    cfg = load_config(env={
+        "TPUKUBE_PLANNER_REPLICAS": "2",
+        "TPUKUBE_BATCH_ENABLED": "1",
+        "TPUKUBE_JOURNAL_ENABLED": "1",
+        "TPUKUBE_JOURNAL_PATH": str(tmp_path / "wal.jsonl"),
+    })
+    slices = {
+        "s0": MeshSpec(dims=(2, 2, 2), host_block=(2, 2, 1)),
+        "s1": MeshSpec(dims=(2, 2, 2), host_block=(2, 2, 1)),
+    }
+    with SimCluster(cfg, slices=slices, in_process=True) as c:
+        for i in range(6):
+            c.schedule(c.make_pod(f"p-{i}", tpu=1))
+        before = {a.pod_key: (a.node_name, tuple(a.device_ids))
+                  for a in c.extender.state.allocations()}
+        victim = 1
+        victim_allocs = len(
+            c.extender.replicas[victim].extender.state.allocations())
+        assert victim_allocs > 0
+        c.crash_replica(victim)
+        restored = c.restart_replica(victim)
+        assert c.extender.last_restart == {
+            "replica": victim, "warm": True, "restored": restored}
+        after = {a.pod_key: (a.node_name, tuple(a.device_ids))
+                 for a in c.extender.state.allocations()}
+        assert after == before
+        assert ledger_divergence(c) == []
+
+        # failure ladder: lose the segment -> cold re-ingest, same state
+        c.crash_replica(victim)
+        seg = f"{cfg.journal_path}.r{victim}"
+        import os
+        os.unlink(seg)
+        if os.path.exists(seg + ".ckpt"):
+            os.unlink(seg + ".ckpt")
+        c.restart_replica(victim)
+        assert c.extender.last_restart["warm"] is False
+        after2 = {a.pod_key: (a.node_name, tuple(a.device_ids))
+                  for a in c.extender.state.allocations()}
+        assert after2 == before
+        assert ledger_divergence(c) == []
+
+
+# -- observability + config -------------------------------------------------
+
+def test_ingest_and_resync_statusz_sections():
+    from tpukube.obs.statusz import extender_statusz
+
+    cfg = load_config(env={})
+    with SimCluster(cfg) as c:
+        c.schedule(c.make_pod("p", tpu=1))
+        c.complete_pod("p")  # first resync: the bootstrap full read
+        c.schedule(c.make_pod("q", tpu=1))
+        c.complete_pod("q")  # second: rides the generation log
+        doc = extender_statusz(c.extender, lifecycle=c._lifecycle)
+        assert doc["ingest"]["enabled"] is True
+        assert doc["ingest"]["nodes_total"] == len(c.nodes)
+        assert doc["ingest"]["batches"] >= 1
+        assert doc["resync"]["enabled"] is True
+        assert doc["resync"]["incremental"] >= 1
+
+    off = load_config(env={"TPUKUBE_BULK_INGEST_ENABLED": "0",
+                           "TPUKUBE_GENERATION_LOG_CAPACITY": "0"})
+    with SimCluster(off) as c:
+        c.schedule(c.make_pod("p", tpu=1))
+        doc = extender_statusz(c.extender, lifecycle=c._lifecycle)
+        assert doc["ingest"] == {"enabled": False}
+        assert doc["resync"] == {"enabled": False}
+
+
+def test_ingest_resync_series_render_only_when_on():
+    from tpukube.metrics import render_extender_metrics
+    from tpukube.obs.registry import DECLARED_SERIES
+
+    for name in ("tpukube_ingest_nodes_total", "tpukube_ingest_seconds",
+                 "tpukube_resync_full_total",
+                 "tpukube_resync_incremental_total",
+                 "tpukube_resync_bytes_total"):
+        assert name in DECLARED_SERIES
+
+    cfg = load_config(env={})
+    with SimCluster(cfg) as c:
+        c.schedule(c.make_pod("p", tpu=1))
+        c.complete_pod("p")
+        text = render_extender_metrics(c.extender,
+                                       lifecycle=c._lifecycle)
+        assert "tpukube_ingest_nodes_total" in text
+        assert "tpukube_resync_incremental_total" in text
+        assert "tpukube_resync_bytes_total" in text
+
+    off = load_config(env={"TPUKUBE_BULK_INGEST_ENABLED": "0",
+                           "TPUKUBE_GENERATION_LOG_CAPACITY": "0"})
+    with SimCluster(off) as c:
+        c.schedule(c.make_pod("p", tpu=1))
+        text = render_extender_metrics(c.extender,
+                                       lifecycle=c._lifecycle)
+        assert "tpukube_ingest_" not in text
+        assert "tpukube_resync_" not in text
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="generation_log_capacity"):
+        load_config(env={"TPUKUBE_GENERATION_LOG_CAPACITY": "-1"})
+    cfg = load_config(env={"TPUKUBE_GENERATION_LOG_CAPACITY": "0",
+                           "TPUKUBE_BULK_INGEST_ENABLED": "false"})
+    assert cfg.generation_log_capacity == 0
+    assert cfg.bulk_ingest_enabled is False

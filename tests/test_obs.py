@@ -195,15 +195,17 @@ def test_extender_metrics_byte_compat_golden():
     text = render_extender_metrics(ext)
     # additions since the golden was captured: the _bucket histogram
     # families (PR 1), the event-journal counter (PR 2, which also
-    # opts into # HELP), and the scheduling-snapshot cache + per-slice
-    # fragmentation families (ISSUE 5). Everything else must render
-    # byte-identically.
+    # opts into # HELP), the scheduling-snapshot cache + per-slice
+    # fragmentation families (ISSUE 5), and the bulk-ingest families
+    # (ISSUE 15, default-on like the snapshot deltas). Everything else
+    # must render byte-identically.
     legacy = "".join(
         line for line in text.splitlines(keepends=True)
         if "_bucket" not in line
         and "tpukube_events_total" not in line
         and "tpukube_snapshot_" not in line
         and "tpukube_slice_" not in line
+        and "tpukube_ingest_" not in line
         and not line.startswith("# HELP")
     )
     assert legacy == EXTENDER_GOLDEN

@@ -117,7 +117,8 @@ import json
 import os
 import sys
 
-floor = json.load(open("tools/perf_floor.json"))["kilonode10k"]
+floors = json.load(open("tools/perf_floor.json"))
+floor = floors["kilonode10k"]
 os.environ.setdefault("TPUKUBE_KILONODE10K_PODS", str(floor["pods"]))
 
 from tpukube.sim import scenarios
@@ -134,8 +135,18 @@ print(json.dumps({
     "fast_patches": r["cycle"]["fast_patches"],
     "gang_batches": r["cycle"]["gang_batches"],
     "snapshot": r["snapshot"],
+    "resync": r["resync"],
 }))
 bad = []
+# generation-based incremental resync (ISSUE 15): every churn-wave
+# lifecycle reconcile after the one bootstrap full read must ride the
+# allocs_since change log — a ratio under the floor means per-wave
+# full-ledger reads came back
+ratio = r["resync"]["incremental_hit_ratio"]
+ratio_min = floors["coldstart"]["resync_hit_ratio_min"]
+if ratio is None or ratio < ratio_min:
+    bad.append(f"resync incremental_hit_ratio={ratio} below the "
+               f"{ratio_min} floor")
 if r["pods_per_sec"] < floor["pods_per_sec_min"]:
     bad.append(f"pods_per_sec={r['pods_per_sec']} below the "
                f"{floor['pods_per_sec_min']}/s floor")
@@ -150,6 +161,31 @@ if speedup is None or speedup < floor["delta_speedup_min"]:
 if bad:
     sys.exit("kilonode-10k smoke FAILED: " + "; ".join(bad))
 print("kilonode-10k smoke OK")
+PY
+
+echo
+echo "== cold-start smoke (bulk fleet ingestion at the 10,240-node point:"
+echo "   bulk upsert_nodes vs the per-node decision loop — speedup floor"
+echo "   from tools/perf_floor.json; the >=5x ISSUE 15 acceptance point"
+echo "   is the 102,400-node sweep recorded by the full bench's"
+echo "   coldstart key) =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+import sys
+
+floor = json.load(open("tools/perf_floor.json"))["coldstart"]
+
+import bench
+
+# parity is the test suite's job (tests/test_ingest.py); this stage
+# guards the COST model — the probe-validated lazy batch must keep
+# beating the per-node decision loop on a cold fleet
+r = bench._coldstart_point(floor["nodes"], hetero=False)
+print(json.dumps(r))
+if r["speedup"] is None or r["speedup"] < floor["ingest_speedup_min"]:
+    sys.exit(f"cold-start smoke FAILED: ingest speedup {r['speedup']}x "
+             f"below the {floor['ingest_speedup_min']}x floor")
+print("cold-start smoke OK")
 PY
 
 echo

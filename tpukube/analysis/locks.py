@@ -271,6 +271,11 @@ GUARDED_ATTRS = {
         "_nodes": "_lock", "_slices": "_lock", "_allocs": "_lock",
         "_hosts_cache": "_lock", "_epoch": "_lock",
         "_occ_cache": "_lock",
+        # bulk ingest + generation resync structures (ISSUE 15):
+        # touched from webhook threads, the background warmer, and
+        # resync loops alike
+        "_lazy_payloads": "_lock",
+        "_gen_log": "_lock", "_generation": "_lock",
     },
     ("sched/gang.py", "GangManager"): {
         "_reservations": "_lock", "_terminating_coords": "_lock",

@@ -109,6 +109,27 @@ class TpuKubeConfig:
     # keeps the /metrics exposition free of the tpukube_snapshot_delta_*
     # series.
     snapshot_delta_enabled: bool = True
+    # Bulk cold-start ingestion (sched/state.py ingest_nodes, ISSUE
+    # 15): batched node upserts (handle("upsert_nodes") /
+    # upsert_nodes_many) probe-validate payloads, defer the full decode
+    # to first touch (lazy NodeViews + a background warmer, the
+    # checkpoint restore's contract), seed the per-slice incremental
+    # caches from the probe aggregates, and fire ONE
+    # epoch/delta/journal seam per batch. Resulting state is identical
+    # to per-item upserts (parity-tested); false loops the per-item
+    # path under the same decision surface and keeps the exposition
+    # free of the tpukube_ingest_* series.
+    bulk_ingest_enabled: bool = True
+    # Generation-based incremental resync (ISSUE 15): the ledger
+    # stamps a generation on every alloc mutation and keeps a bounded
+    # per-generation change log; allocs_since(cursor) then serves a
+    # churn wave's resync as O(changed-allocs) adds/removes instead of
+    # the full ledger (per replica over the process transport). The
+    # capacity must exceed the deepest alloc churn between two resync
+    # reads (commits + releases of one wave) — a gap degrades to a
+    # counted FULL read, never a stale answer. 0 disables the log (the
+    # legacy full-read behavior; no tpukube_resync_* series render).
+    generation_log_capacity: int = 65536
 
     # Durable control-plane state (sched/journal.py, ISSUE 11): with
     # journal_enabled the extender appends every ledger/gang mutation
@@ -389,6 +410,11 @@ def load_config(
         )
     if cfg.batch_max_pods < 1:
         raise ValueError("batch_max_pods must be >= 1")
+    if cfg.generation_log_capacity < 0:
+        raise ValueError(
+            "generation_log_capacity must be >= 0 (0 = incremental "
+            "resync off)"
+        )
     if cfg.journal_enabled and not cfg.journal_path:
         # a journal with nowhere to write would silently provide NO
         # durability — the operator who enabled it believes it is live

@@ -147,6 +147,43 @@ def build_extender_registry(extender, reconcile=None, evictions=None,
     journal = getattr(extender, "journal", None)
     if journal is not None:
         _add_journal_metrics(reg, journal)
+    # bulk cold-start ingestion + generation-based incremental resync
+    # (ISSUE 15): series render only while the features are on
+    if getattr(extender, "bulk_ingest", False):
+        st = extender.state
+        reg.counter(
+            "tpukube_ingest_nodes_total",
+            fn=lambda: st.ingest_nodes_total,
+            help_text="Nodes ingested through the bulk cold-start "
+                      "fast path (handle('upsert_nodes')).")
+        reg.summary(
+            "tpukube_ingest_seconds",
+            quantiles=(0.5, 0.99),
+            values_fn=st.ingest_seconds_snapshot,
+            help_text="Wall time per bulk-ingest batch (probe + "
+                      "seeding; the deferred decode drains on the "
+                      "background warmer).")
+    if (lifecycle is not None
+            and getattr(extender, "resync_incremental", False)
+            and hasattr(lifecycle, "resync_full")):
+        reg.counter(
+            "tpukube_resync_full_total",
+            fn=lambda: lifecycle.resync_full,
+            help_text="Lifecycle resyncs that read the FULL ledger "
+                      "(the one bootstrap read, plus any generation-"
+                      "log gap/restart fallback).")
+        reg.counter(
+            "tpukube_resync_incremental_total",
+            fn=lambda: lifecycle.resync_incremental,
+            help_text="Lifecycle resyncs served O(Δ) from the "
+                      "generation log (allocs_since adds/removes).")
+        reg.counter(
+            "tpukube_resync_bytes_total",
+            fn=lambda: lifecycle.resync_bytes,
+            help_text="Wire-shape bytes the resync reads moved "
+                      "(encoded alloc lengths) — O(changed-allocs) "
+                      "per churn wave when the incremental path "
+                      "holds.")
     # batched scheduling cycles (sched/cycle.py): series render only
     # when batch_enabled actually built a planner — the legacy
     # exposition stays byte-identical with batching off

@@ -79,6 +79,16 @@ DECLARED_SERIES: frozenset[str] = frozenset({
     # journal.py; series render only while journal_enabled built a
     # StateJournal — legacy exposition stays byte-identical with the
     # journal off)
+    # extender: bulk cold-start ingestion + generation-based
+    # incremental resync (ISSUE 15; ingest series render only while
+    # bulk_ingest_enabled, resync series only when the extender runs a
+    # generation log AND a lifecycle loop is wired — the
+    # feature-off exposition stays byte-identical)
+    "tpukube_ingest_nodes_total",
+    "tpukube_ingest_seconds",
+    "tpukube_resync_full_total",
+    "tpukube_resync_incremental_total",
+    "tpukube_resync_bytes_total",
     "tpukube_journal_appends_total",
     "tpukube_journal_bytes_total",
     "tpukube_checkpoint_seconds",

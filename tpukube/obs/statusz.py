@@ -150,6 +150,19 @@ def extender_statusz(
         # a hit_rate near zero under webhook load means every cycle is
         # rebuilding (a mutation storm, or an epoch bump on a read path)
         "snapshot": extender.snapshots.stats(),
+        # bulk cold-start ingestion (ISSUE 15): batch counters, the
+        # decode-cache hit rate, and the lazy backlog still awaiting
+        # materialization (the background warmer's queue)
+        "ingest": ({"enabled": True, **state.ingest_stats()}
+                   if getattr(extender, "bulk_ingest", False)
+                   else {"enabled": False}),
+        # generation-based incremental resync (ISSUE 15): full vs
+        # incremental lifecycle reads and the wire-shape bytes moved
+        "resync": ({"enabled": True, **lifecycle.resync_stats()}
+                   if lifecycle is not None
+                   and getattr(extender, "resync_incremental", False)
+                   and hasattr(lifecycle, "resync_stats")
+                   else {"enabled": False}),
         # durable-state journal (sched/journal.py): WAL position,
         # checkpoint cadence, and the last recovery's stats — a
         # last_recovery in cold-fallback mode means the journal could

@@ -165,6 +165,19 @@ class Extender:
         # record SnapshotDeltas and the cache advances O(Δ); off =
         # rebuild-every-epoch (the parity oracle)
         self.snapshots.delta_enabled = config.snapshot_delta_enabled
+        # bulk cold-start ingestion (ISSUE 15): handle("upsert_nodes")
+        # routes through ClusterState.ingest_nodes — probe-validated
+        # lazy ingest, one deferred epoch/delta/journal seam per batch.
+        # Off = the same decision surface loops per-item upserts (the
+        # parity oracle), and the tpukube_ingest_* series do not render.
+        self.bulk_ingest = config.bulk_ingest_enabled
+        # generation-based incremental resync (ISSUE 15): size the
+        # ledger's alloc change log so lifecycle resyncs read O(Δ)
+        # via allocs_since instead of the full ledger per wave
+        # (capacity 0 keeps the legacy full read and the exposition
+        # free of the tpukube_resync_* series)
+        self.state.set_generation_log(config.generation_log_capacity)
+        self.resync_incremental = config.generation_log_capacity > 0
         # Durable control-plane state (sched/journal.py, ISSUE 11):
         # with journal_enabled every ledger/gang mutation seam appends
         # one WAL record (enqueue-only — the journal's drain thread
@@ -371,6 +384,22 @@ class Extender:
 
     def _ingest_nodes(self, raw_nodes: list[dict[str, Any]]) -> list[str]:
         names = []
+        if self.bulk_ingest:
+            # the webhook body re-sends the whole candidate fleet every
+            # request: ride the batch fast path (ONE lock hold, known
+            # unchanged payloads answered by signature, new nodes
+            # staged lazily). A bad payload still aborts the request
+            # like the per-node path's raise did.
+            items = []
+            for obj in raw_nodes:
+                name, annotations = kube.node_name_and_annotations(obj)
+                items.append({"name": name, "annotations": annotations})
+                names.append(name)
+            for res in self.state.ingest_nodes(items):
+                if isinstance(res, dict) and res.get("error"):
+                    raise StateError(res["error"])
+            self.state.maybe_start_warmer()
+            return names
         for obj in raw_nodes:
             name, annotations = kube.node_name_and_annotations(obj)
             self.state.upsert_node(name, annotations)
@@ -1359,6 +1388,21 @@ class Extender:
     def release(self, pod_key: str) -> None:
         self.handle("release", {"pod_key": pod_key})
 
+    def release_many(self, pod_keys: list[str]) -> None:
+        """Batched releases (the lifecycle resync's flush surface — the
+        ShardRouter fans these out per replica; here each is the same
+        recorded release decision the per-key path dispatches)."""
+        for key in pod_keys:
+            self.handle("release", {"pod_key": key})
+
+    def upsert_nodes_many(self, items: list[dict[str, Any]]) -> list[Any]:
+        """Batched node ingest in the ShardRouter's surface shape: one
+        ``upsert_nodes`` decision for the whole batch (the bulk
+        cold-start fast path when ``bulk_ingest_enabled``), per-item
+        results positionally."""
+        return self.handle("upsert_nodes", {"items": list(items)})[
+            "results"]
+
     # -- atomic webhook dispatch --------------------------------------------
     def handle(self, kind: str, body: Any) -> Any:
         """Process one decision request body and return the wire response.
@@ -1503,6 +1547,29 @@ class Extender:
                     )}
                 except (codec.CodecError, StateError) as e:
                     response = {"error": str(e)}
+            elif kind == "upsert_nodes":
+                # batched fleet ingest (ISSUE 15): ONE recorded decision
+                # for the whole batch; per-item results ride the
+                # response positionally in the per-item shape
+                items = list(body.get("items") or [])
+                if self.bulk_ingest:
+                    results = self.state.ingest_nodes(items)
+                    # drain the deferred decodes off the serving path,
+                    # exactly like the journal recovery's warmer
+                    self.state.maybe_start_warmer()
+                else:
+                    results = []
+                    for item in items:
+                        try:
+                            results.append({
+                                "ours": self.state.upsert_node(
+                                    item["name"],
+                                    dict(item.get("annotations") or {}),
+                                )
+                            })
+                        except (codec.CodecError, StateError) as e:
+                            results.append({"error": str(e)})
+                response = {"results": results}
             else:
                 raise ValueError(f"unknown decision kind {kind!r}")
             if self.trace is not None:

@@ -643,6 +643,18 @@ def replay_records(extender, records: list[dict]) -> int:
                 state.release(d["p"])
             elif kind == "node":
                 state.upsert_node(d["n"], dict(d["anno"]))
+            elif kind == "nodes":
+                # one bulk-ingest batch (ISSUE 15): replay through the
+                # same fast path; per-item errors are logged by the
+                # ingest and the reconcile covers them
+                for out in state.ingest_nodes([
+                    {"name": n, "annotations": dict(a)}
+                    for n, a in d["items"]
+                ]):
+                    if isinstance(out, dict) and out.get("error"):
+                        log.error("journal replay: bulk-ingest item "
+                                  "failed: %s — the apiserver "
+                                  "reconcile covers it", out["error"])
             else:
                 gang.apply_journal(rec)
             applied += 1

@@ -73,10 +73,11 @@ def make_worker_app(extender: Extender, clock=None) -> web.Application:
 
     async def upsert(request: web.Request) -> web.Response:
         doc = await _json(request)
-        return web.json_response({"results": [
-            extender.handle("upsert_node", item)
-            for item in doc["items"]
-        ]})
+        # ONE bulk-ingest decision for the whole batch (ISSUE 15): the
+        # worker ingests its shard through the cold-start fast path
+        return web.json_response({
+            "results": extender.upsert_nodes_many(doc["items"])
+        })
 
     async def admit(request: web.Request) -> web.Response:
         doc = await _json(request)
@@ -174,6 +175,47 @@ def make_worker_app(extender: Extender, clock=None) -> web.Application:
             codec.alloc_obj(a) for a in extender.state.allocations()
         ]})
 
+    async def allocs_since(request: web.Request) -> web.Response:
+        # generation-based incremental resync (ISSUE 15): a churn
+        # wave's federated read moves O(changed-allocs) bytes per
+        # replica instead of the whole ledger
+        doc = await _json(request)
+        out = extender.state.allocs_since(doc.get("cursor"))
+        if out is None:
+            return web.json_response({"disabled": True})
+        wire: dict = {"cursor": list(out["cursor"]),
+                      "bytes": out["bytes"]}
+        if "full" in out:
+            wire["full"] = [codec.alloc_obj(a) for a in out["full"]]
+        else:
+            wire["adds"] = [codec.alloc_obj(a) for a in out["adds"]]
+            wire["removes"] = out["removes"]
+        return web.json_response(wire)
+
+    async def recover(request: web.Request) -> web.Response:
+        # warm restart from this worker's own journal segment,
+        # reconciled against the router-provided node/pod truth
+        # (ROADMAP sharding item (d)); an error answer tells the
+        # router to fall back to the cold re-ingest on a fresh daemon
+        from tpukube.sched import journal as journal_mod
+
+        doc = await _json(request)
+        if extender.journal is None:
+            return web.json_response(
+                {"recover_error": "journal disabled"})
+        try:
+            stats = journal_mod.recover_extender(
+                extender,
+                shard._ListApi(doc.get("nodes") or [],
+                               doc.get("pods") or []),
+            )
+        except journal_mod.JournalError as e:
+            return web.json_response({"recover_error": str(e)})
+        return web.json_response({
+            "stats": stats,
+            "restored": len(extender.state.allocations()),
+        })
+
     async def alloc_one(request: web.Request) -> web.Response:
         pod = request.query.get("pod", "")
         a = extender.state.allocation(pod)
@@ -245,6 +287,8 @@ def make_worker_app(extender: Extender, clock=None) -> web.Application:
     app.router.add_get("/worker/gauges", gauges)
     app.router.add_post("/worker/gang", gang)
     app.router.add_get("/worker/allocs", allocs)
+    app.router.add_post("/worker/allocs_since", allocs_since)
+    app.router.add_post("/worker/recover", recover)
     app.router.add_get("/worker/alloc", alloc_one)
     app.router.add_get("/worker/nodes", nodes)
     app.router.add_get("/worker/summary", summary)

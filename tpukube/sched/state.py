@@ -13,17 +13,21 @@ n=1 case of a vTPU node, so one ledger covers both resources.
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
 import os
 import threading
+import time
 import zlib
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
 
 from tpukube.core import codec
 from tpukube.core.mesh import MeshSpec
 from tpukube.core.types import (
+    DEFAULT_SLICE,
     AllocResult,
     ChipInfo,
     Health,
@@ -36,6 +40,13 @@ from tpukube.core.types import (
 
 
 log = logging.getLogger("tpukube.state")
+
+#: per-process ledger-incarnation stream: ``allocs_since`` cursors embed
+#: (pid, count) so a cursor minted against one ledger incarnation can
+#: never read another incarnation's change log as its own — a restarted
+#: worker process gets a fresh pid, a fresh in-process ledger a fresh
+#: count, and either way the mismatch degrades to a full read.
+_INCARNATIONS = itertools.count(1)
 
 
 class StateError(RuntimeError):
@@ -231,6 +242,148 @@ def _view_from_doc(doc: dict, mesh: MeshSpec) -> NodeView:
                     health_summary=doc.get("hs"))
 
 
+def _alloc_bytes(allocs: list[AllocResult]) -> int:
+    """Wire-shape size of an alloc list (encoded annotation lengths) —
+    the honest byte count a remote resync consumer would move. O(n)
+    encodes, paid only on resync reads (Δ-sized in steady state)."""
+    return sum(len(codec.encode_alloc(a)) for a in allocs)
+
+
+#: shared decoder for the probe's raw_decode fast path: json.loads
+#: spends two whitespace-regex matches per document on stripping the
+#: (for our encoder, never-present) leading/trailing space — at 100k
+#: nodes that is 200k regex calls for nothing. Payloads that DO carry
+#: surrounding whitespace fall back to json.loads below.
+_PROBE_DECODER = json.JSONDecoder()
+
+#: NamedTuple's generated __new__ is a Python-level lambda; at 4 chips
+#: per node the probe constructs ~400k coords per 100k-node fleet, so
+#: it builds them the way _make does — straight through tuple.__new__.
+_TUPLE_NEW = tuple.__new__
+
+
+def _probe_node_payload(name: str, payload: str,
+                        mesh_memo: Optional[dict] = None) -> dict:
+    """Structural probe of a node-topology payload for the bulk ingest
+    fast path: runs every validation ``decode_node_topology`` +
+    ``node_from_annotations`` enforce — schema version, mesh, chip
+    entries (ids/indices/coords/hbm/cores/health values), shares,
+    badLinks containment + adjacency, slice id, annotation-vs-node name
+    — WITHOUT constructing the ChipInfo/NodeInfo objects (the deferred
+    cost lazy materialization pays on first touch). Raises CodecError
+    with the same messages the full decode raises, so a malformed
+    payload errors at ingest, never silently on first touch."""
+    try:
+        try:
+            obj, end = _PROBE_DECODER.raw_decode(payload)
+            if end != len(payload) and payload[end:].strip():
+                raise json.JSONDecodeError("Extra data", payload, end)
+        except json.JSONDecodeError:
+            # leading/trailing whitespace (or junk — which re-raises
+            # with loads' message): the tolerant path
+            obj = json.loads(payload)
+    except json.JSONDecodeError as e:
+        raise codec.CodecError(f"node-topology: bad JSON: {e}") from e
+    codec._check_version(obj, "node-topology")
+    try:
+        fragment = codec._field(obj, "mesh", "node-topology")
+        # a homogeneous fleet repeats one mesh fragment per slice:
+        # decode (and validate) it once per distinct fragment. The key
+        # covers exactly the fields from_json reads.
+        mesh = None
+        memo_key = None
+        if mesh_memo is not None:
+            memo_key = (
+                tuple(fragment["dims"]),
+                tuple(fragment.get("host_block", (2, 2, 1))),
+                tuple(fragment.get("torus", (False, False, False))),
+            )
+            mesh = mesh_memo.get(memo_key)
+        if mesh is None:
+            mesh = MeshSpec.from_json(fragment)
+            if memo_key is not None:
+                mesh_memo[memo_key] = mesh
+    except (KeyError, TypeError, ValueError) as e:
+        if isinstance(e, codec.CodecError):
+            raise
+        raise codec.CodecError(
+            f"node-topology: malformed mesh: {e}") from e
+    raw_chips = codec._field(obj, "chips", "node-topology")
+    if not isinstance(raw_chips, list):
+        raise codec.CodecError("node-topology: 'chips' must be a list")
+    # ONE pass over the chip entries (this loop runs per chip of the
+    # whole fleet): coord construction + every field materialization
+    # decodes later, so a malformed entry fails HERE with the decode's
+    # message. The "Healthy" string compare is the hot fast path — the
+    # enum call validates only the rare non-healthy value (junk raises
+    # the decode's exact error).
+    coords: list[TopologyCoord] = []
+    unhealthy: list[TopologyCoord] = []
+    append_coord = coords.append
+    try:
+        for c in raw_chips:
+            x, y, z = c["coord"]
+            coord = _TUPLE_NEW(TopologyCoord,
+                               (int(x), int(y), int(z)))
+            append_coord(coord)
+            c["id"]
+            int(c["index"])
+            int(c["hbm"])
+            int(c.get("cores", 2))
+            h = c.get("health", "Healthy")
+            if h != "Healthy" and Health(h) is not Health.HEALTHY:
+                unhealthy.append(coord)
+    except (KeyError, TypeError, ValueError) as e:
+        raise codec.CodecError(
+            f"node-topology: malformed chip entry: {e}") from e
+    try:
+        shares = int(obj.get("sharesPerChip", 1))
+    except (TypeError, ValueError) as e:
+        raise codec.CodecError(
+            f"node-topology: bad sharesPerChip: {e}") from e
+    if shares < 1:
+        raise codec.CodecError(
+            f"node-topology: sharesPerChip must be >= 1, got {shares}")
+    raw_links = obj.get("badLinks", [])
+    if not isinstance(raw_links, list):
+        raise codec.CodecError("node-topology: 'badLinks' must be a list")
+    try:
+        bad_links = [canonical_link(a, b) for a, b in raw_links]
+    except (TypeError, ValueError) as e:
+        raise codec.CodecError(
+            f"node-topology: malformed badLinks entry: {e}") from e
+    for a, b in bad_links:
+        if not (mesh.contains(a) and mesh.contains(b)):
+            raise codec.CodecError(
+                f"node-topology: badLinks endpoint outside mesh "
+                f"{mesh.dims}: {[a.as_list(), b.as_list()]}"
+            )
+        if b not in mesh.neighbors(a):
+            raise codec.CodecError(
+                f"node-topology: badLinks pair not ICI-adjacent: "
+                f"{[a.as_list(), b.as_list()]}"
+            )
+    slice_id = obj.get("slice", DEFAULT_SLICE)
+    if not isinstance(slice_id, str) or not slice_id:
+        raise codec.CodecError(
+            f"node-topology: bad slice id {slice_id!r}")
+    anno_name = codec._field(obj, "node", "node-topology")
+    if anno_name != name:
+        raise codec.CodecError(
+            f"node-topology annotation names {anno_name!r} but lives "
+            f"on {name!r}"
+        )
+    return {
+        "slice": slice_id,
+        "mesh": mesh,
+        "coords": coords,
+        "unhealthy": unhealthy,
+        "links": bad_links,
+        "shares": shares,
+        "healthy_chips": len(coords) - len(unhealthy),
+    }
+
+
 @dataclass
 class SliceView:
     """One ICI domain: its mesh geometry plus the data-driven coord->host
@@ -339,6 +492,45 @@ class ClusterState:
         self._unhealthy_cache: dict[str, set[TopologyCoord]] = {}
         self._broken_cache: dict[str, dict[Link, int]] = {}
         self._share_cache: dict[str, list[int]] = {}  # sid -> [used, total]
+        # Bulk cold-start ingestion (ISSUE 15 tentpole): nodes ingested
+        # through ingest_nodes() live here UNDECODED — name ->
+        # (topology payload, full annotation dict, slice id) — until
+        # first touch materializes a NodeView (_view_locked), the same
+        # lazy contract the checkpoint restore's _lazy_index keeps. The
+        # probe already ran every validation the full decode enforces
+        # and extracted the host map + health/link aggregates, so a
+        # materialization failure is pathological (and degrades that
+        # one node to 'unknown', like a CRC-failing checkpoint line).
+        self._lazy_payloads: dict[str, tuple[str, dict[str, str], str]] = {}
+        # decode-avoidance counters: a batch item whose payload
+        # matches the retained one by signature (a webhook re-send of
+        # an unchanged fleet) is a HIT — answered without any parse; a
+        # fresh probe (a parse) is a miss. Every payload embeds its
+        # own node name, so cross-NODE payloads are never identical —
+        # the win is per-node re-send suppression, and the hit rate
+        # reads ~1.0 in steady state / 0.0 on a cold start.
+        self._decode_hits = 0
+        self._decode_misses = 0
+        # ingest counters (the /statusz "ingest" section + the
+        # tpukube_ingest_* series)
+        self.ingest_nodes_total = 0
+        self.ingest_batches = 0
+        self._ingest_seconds: deque[float] = deque(maxlen=64)
+        self.ingest_seconds_total = 0.0
+        self._warming = False
+        # Generation-based incremental resync (ISSUE 15 tentpole): a
+        # monotonically increasing generation stamped on every ALLOC
+        # mutation seam (commit/release — exactly the set
+        # ``allocations()`` serves), plus a bounded per-generation
+        # change log ``allocs_since`` answers adds/removes from. The
+        # log is None until set_generation_log() sizes it (the Extender
+        # wires config.generation_log_capacity; 0 keeps it off) —
+        # disabled, allocs_since answers None and consumers keep the
+        # legacy full read. A cursor the log cannot cover (gap,
+        # restart, overflow) gets a FULL answer — never a stale one.
+        self._generation = 0
+        self._incarnation = f"{os.getpid():x}.{next(_INCARNATIONS):x}"
+        self._gen_log: Optional[deque] = None
 
     def set_delta_sink(self, sink) -> None:
         """Attach the snapshot cache's delta log (None detaches)."""
@@ -390,6 +582,93 @@ class ClusterState:
         with self._lock:
             return self._epoch
 
+    # -- generation-based incremental resync (ISSUE 15) ----------------------
+    def set_generation_log(self, capacity: int) -> None:
+        """Size (and enable) the per-generation alloc change log; 0
+        disables it — ``allocs_since`` then answers None and consumers
+        keep the legacy full read. The capacity must exceed the deepest
+        alloc churn between two consumer reads (a churn wave's commits
+        plus its releases) or steady-state resyncs degrade to full
+        reads (counted, never wrong)."""
+        with self._lock:
+            self._gen_log = deque(maxlen=capacity) if capacity > 0 \
+                else None
+
+    def _note_gen_locked(self, kind: str, alloc=None,
+                         pod_key: Optional[str] = None) -> None:
+        """Stamp one alloc mutation (callers hold ``self._lock`` and
+        call this right where the ``_allocs`` map changed)."""
+        self._generation += 1
+        gen_log = self._gen_log
+        if gen_log is not None:
+            gen_log.append((
+                self._generation, kind,
+                alloc if kind == "add" else pod_key,
+            ))
+
+    def generation(self):
+        """The opaque resync cursor: (ledger incarnation, generation).
+        Feed it back into ``allocs_since`` to read only what changed."""
+        with self._lock:
+            return (self._incarnation, self._generation)
+
+    def allocs_since(self, cursor) -> Optional[dict]:
+        """The alloc changes since ``cursor`` (a prior answer's
+        ``cursor``, or None to bootstrap). None when the log is
+        disabled (legacy full-read consumers); otherwise a dict:
+
+          * ``{"cursor": C, "adds": [AllocResult...], "removes":
+            [pod_key...], "bytes": n}`` — the incremental answer;
+            apply removes, then adds, to a mirror of the ledger.
+          * ``{"cursor": C, "full": [AllocResult...], "bytes": n}`` —
+            bootstrap, wrong incarnation (a restart), or a log gap
+            (overflow): the full ledger. A gap ALWAYS degrades to this
+            — never to a stale or partial answer.
+
+        ``bytes`` is the wire-shape size of the answer (encoded alloc
+        lengths) — what a remote consumer would actually move; the
+        tpukube_resync_bytes_total feed."""
+        with self._lock:
+            gen_log = self._gen_log
+            if gen_log is None:
+                return None
+            cur = (self._incarnation, self._generation)
+            gen: Optional[int] = None
+            if cursor is not None:
+                try:
+                    inc, gen = cursor[0], int(cursor[1])
+                except (TypeError, ValueError, IndexError):
+                    gen = None
+                else:
+                    if inc != self._incarnation or gen > self._generation:
+                        gen = None  # another incarnation's cursor
+            if gen is None or (
+                gen < self._generation
+                and (not gen_log or gen_log[0][0] > gen + 1)
+            ):
+                # bootstrap or gap: the log cannot cover (gen, now]
+                allocs = list(self._allocs.values())
+                return {"cursor": cur, "full": allocs,
+                        "bytes": _alloc_bytes(allocs)}
+            # net effect per pod key, in generation order (an add after
+            # a remove of the same key is an add, and vice versa)
+            merged: dict[str, tuple[str, Optional[AllocResult]]] = {}
+            for g, kind, payload in gen_log:
+                if g <= gen:
+                    continue
+                if kind == "add":
+                    merged[payload.pod_key] = ("add", payload)
+                else:
+                    merged[payload] = ("remove", None)
+            adds = [a for kind, a in merged.values() if kind == "add"]
+            removes = [k for k, (kind, _) in merged.items()
+                       if kind == "remove"]
+            return {
+                "cursor": cur, "adds": adds, "removes": removes,
+                "bytes": _alloc_bytes(adds) + sum(
+                    len(k) for k in removes),
+            }
+
     # -- lazy materialization (checkpoint warm restore) ---------------------
     def _view_locked(self, name: str) -> Optional[NodeView]:
         """The node's view, materializing it from the open checkpoint
@@ -400,6 +679,12 @@ class ClusterState:
         view = self._nodes.get(name)
         if view is not None:
             return view
+        lazy = self._lazy_payloads.pop(name, None)
+        if lazy is not None:
+            # bulk-ingested node (ISSUE 15): decode the retained
+            # annotations on first touch — the probe already ran every
+            # validation, so failure here is pathological
+            return self._materialize_payload_locked(name, *lazy)
         entry = self._lazy_index.pop(name, None)
         if entry is None:
             return None
@@ -420,6 +705,17 @@ class ClusterState:
             self._drop_lazy_fd_locked()
             return None
         doc = json.loads(raw.decode("utf-8"))
+        if "anno" in doc:
+            # a checkpoint line captured from a still-lazy bulk-ingest
+            # node carries the RAW annotations (never decoded by the
+            # capturing process) — decode on touch, same as the
+            # in-memory lazy store it round-tripped from
+            self._drop_lazy_fd_locked()
+            return self._materialize_payload_locked(
+                name,
+                (doc["anno"] or {}).get(codec.ANNO_NODE_TOPOLOGY, ""),
+                dict(doc["anno"] or {}), doc["slice"],
+            )
         mesh = self._slices[sid].mesh
         view = _view_from_doc(doc, mesh)
         for alloc in self._lazy_allocs.pop(name, ()):
@@ -441,14 +737,64 @@ class ClusterState:
                 pass
             self._lazy_fd = None
 
+    def _materialize_payload_locked(
+        self, name: str, payload: str, annotations: dict[str, str],
+        sid: str,
+    ) -> Optional[NodeView]:
+        """Materialize one bulk-ingested lazy node from its retained
+        annotations (callers hold ``self._lock``; the entry is already
+        popped). This is the deferred half of an ingest the probe
+        already validated, so a failure here is pathological and
+        degrades the node to 'unknown' (its next re-annotation
+        re-registers it) exactly like a CRC-failing checkpoint line."""
+        del sid
+        try:
+            info, _mesh = codec.decode_node_topology(payload)
+        except codec.CodecError as e:
+            log.error("lazy node %s: retained payload fails its full "
+                      "decode (%s); treating the node as unknown until "
+                      "it re-annotates", name, e)
+            self._names_cache = None  # the node SET just shrank
+            return None
+        if info.name != name:
+            log.error("lazy node %s: retained payload names %r; "
+                      "treating the node as unknown", name, info.name)
+            self._names_cache = None
+            return None
+        info.annotations = dict(annotations)
+        summary = None
+        raw_summary = annotations.get(codec.ANNO_HEALTH_SUMMARY)
+        if raw_summary:
+            try:
+                summary = codec.decode_health_summary(raw_summary)
+            except codec.CodecError as e:
+                # same tolerance as the eager upsert path: a malformed
+                # summary never rejects the topology
+                log.warning("node %s: undecodable health summary: %s",
+                            name, e)
+        view = NodeView(info=info, raw_payload=payload,
+                        health_summary=summary)
+        for alloc in self._lazy_allocs.pop(name, ()):
+            # checkpoint-restored occupancy re-applies exactly as the
+            # eager restore would; materialization changes NOTHING
+            # observable, so no epoch moves (see _view_locked)
+            view.add_ids(alloc.device_ids)  # tpukube: allow(epoch-discipline) materialization promotes equivalent state; nothing observable changes, so the snapshot must NOT invalidate
+        self._nodes[name] = view  # tpukube: allow(epoch-discipline) see above — cache promotion, not a mutation
+        return view
+
     def _materialize_slice_locked(self, slice_id: Optional[str]) -> None:
         """Materialize every lazy node of one slice (None = all) ahead
         of a whole-slice scan (occupied_coords and friends)."""
-        if not self._lazy_index:
+        if not self._lazy_index and not self._lazy_payloads:
             return
         for name in [
             n for n, e in self._lazy_index.items()
             if slice_id is None or e[3] == slice_id
+        ]:
+            self._view_locked(name)
+        for name in [
+            n for n, e in self._lazy_payloads.items()
+            if slice_id is None or e[2] == slice_id
         ]:
             self._view_locked(name)
 
@@ -461,9 +807,12 @@ class ClusterState:
         with self._lock:
             if self._retired:
                 return 0
-            for name in list(self._lazy_index)[:limit]:
+            batch = list(self._lazy_index)[:limit]
+            if len(batch) < limit:
+                batch += list(self._lazy_payloads)[:limit - len(batch)]
+            for name in batch:
                 self._view_locked(name)
-            return len(self._lazy_index)
+            return len(self._lazy_index) + len(self._lazy_payloads)
 
     def retire(self) -> None:
         """Stop background warming for good (the owner crashed or shut
@@ -494,6 +843,9 @@ class ClusterState:
         view = self._nodes.get(name)
         if view is not None:
             return view.raw_payload == payload
+        lazy = self._lazy_payloads.get(name)
+        if lazy is not None:
+            return lazy[0] == payload
         entry = self._lazy_index.get(name)
         if entry is None:
             return False
@@ -512,10 +864,16 @@ class ClusterState:
             lazy = self._lazy_index
             crc32 = zlib.crc32
             out: set[str] = set()
+            lazy_payloads = self._lazy_payloads
             for name, payload in payloads.items():
                 view = nodes.get(name)
                 if view is not None:
                     if view.raw_payload == payload:
+                        out.add(name)
+                    continue
+                entry2 = lazy_payloads.get(name)
+                if entry2 is not None:
+                    if entry2[0] == payload:
                         out.add(name)
                     continue
                 entry = lazy.get(name)
@@ -765,6 +1123,290 @@ class ClusterState:
         self._note_journal_locked(
             "node", {"n": name, "anno": dict(annotations)})
 
+    # -- bulk cold-start ingestion (ISSUE 15 tentpole) -----------------------
+    def ingest_nodes(self, items: list[dict]) -> list:
+        """Fleet-scale node ingest fast path. Each item is ``{"name":
+        ..., "annotations": {...}}``; the result list matches the
+        per-item ``upsert_node`` decision responses positionally
+        (``{"ours": bool}`` or ``{"error": str}``).
+
+        Semantics match per-item upserts — the parity suite proves the
+        resulting ledger/host/occupancy state identical — but the cost
+        model is the cold start's: payloads are PROBED (validated +
+        host-mapped) without building NodeView objects, the decoded
+        views materialize lazily on first touch exactly like the
+        checkpoint restore's, the per-slice incremental coord/share
+        caches are seeded from the probe aggregates (so the first
+        snapshot rebuild is O(slices), not O(fleet)), and the
+        epoch/delta/journal seam fires ONCE per batch instead of per
+        node. Items naming an already-known node with a CHANGED payload
+        are routed through the legacy per-node path (its health-only
+        delta and occupancy carry-over semantics own that shape)."""
+        t0 = time.perf_counter()
+        results: list = [None] * len(items)
+        slow: list[int] = []
+        with self._lock:
+            # phase 1 — probe + validate: reads only, nothing mutated,
+            # so a bad item errors without a partial apply
+            staged: list[tuple] = []  # (pos, name, payload, annos, probe)
+            mesh_memo: dict = {}  # one mesh decode per distinct fragment
+            new_slices: dict[str, MeshSpec] = {}
+            staged_hosts: dict[str, dict[TopologyCoord, str]] = {}
+            agg: dict[str, dict] = {}  # sid -> batch aggregates
+            # hot-loop locals (this loop runs per node of the fleet)
+            nodes_get = self._nodes.get
+            lazyp_get = self._lazy_payloads.get
+            lazy_index = self._lazy_index
+            slices_get = self._slices.get
+            anno_key = codec.ANNO_NODE_TOPOLOGY
+            # per-sid (live_hosts, live_get, batch_hosts, agg entry):
+            # resolved once per slice, not once per node
+            slice_ctx: dict[str, tuple] = {}
+            staged_payloads: dict[str, str] = {}  # name staged earlier
+            for pos, item in enumerate(items):
+                name = item["name"]
+                annotations = dict(item.get("annotations") or {})
+                payload = annotations.get(anno_key)
+                if payload is None:
+                    results[pos] = {"ours": False}
+                    continue
+                view = nodes_get(name)
+                if view is not None:
+                    if view.raw_payload == payload:
+                        self._decode_hits += 1
+                        results[pos] = {"ours": True}
+                    else:
+                        slow.append(pos)
+                    continue
+                lazy = lazyp_get(name)
+                if lazy is not None:
+                    if lazy[0] == payload:
+                        self._decode_hits += 1
+                        results[pos] = {"ours": True}
+                    else:
+                        slow.append(pos)
+                    continue
+                if name in lazy_index:
+                    if self._payload_matches_locked(name, payload):
+                        self._decode_hits += 1
+                        results[pos] = {"ours": True}
+                    else:
+                        slow.append(pos)
+                    continue
+                earlier = staged_payloads.get(name)
+                if earlier is not None:
+                    # the SAME node twice in one batch: the per-node
+                    # path's second upsert answers unchanged-payload
+                    # True / runs the re-annotation path — match it
+                    # (the name-string identity trick below only
+                    # covers claims within ONE item)
+                    if earlier == payload:
+                        self._decode_hits += 1
+                        results[pos] = {"ours": True}
+                    else:
+                        slow.append(pos)
+                    continue
+                self._decode_misses += 1
+                try:
+                    probe = _probe_node_payload(name, payload,
+                                                mesh_memo)
+                except codec.CodecError as e:
+                    results[pos] = {"error": str(e)}
+                    continue
+                sid = probe["slice"]
+                mesh = probe["mesh"]
+                ctx = slice_ctx.get(sid)
+                if ctx is None:
+                    sl = slices_get(sid)
+                    live_hosts = (self._hosts_locked(sl)
+                                  if sl is not None else {})
+                    a = agg[sid] = {"unhealthy": set(), "links": {},
+                                    "total": 0}
+                    batch_hosts = staged_hosts[sid] = {}
+                    ctx = slice_ctx[sid] = (
+                        sl.mesh if sl is not None else None,
+                        live_hosts, live_hosts.get,
+                        batch_hosts, batch_hosts.setdefault, a,
+                    )
+                (live_mesh, live_hosts, live_get,
+                 batch_hosts, bh_setdefault, a) = ctx
+                have_mesh = (live_mesh if live_mesh is not None
+                             else new_slices.get(sid))
+                # identity first: the memo hands every node of a
+                # homogeneous fleet the SAME MeshSpec object, so the
+                # dataclass __eq__ runs only on genuine disagreement
+                if (have_mesh is not None and have_mesh is not mesh
+                        and have_mesh != mesh):
+                    results[pos] = {"error": (
+                        f"node {name} reports mesh {mesh.dims} for "
+                        f"slice {sid}, which has {have_mesh.dims} — "
+                        f"nodes of one slice must agree on its geometry"
+                    )}
+                    continue
+                # validate-and-stage in ONE pass (this loop runs per
+                # chip of the whole fleet): setdefault stages the claim
+                # unless someone staged it first; a conflict unwinds
+                # this node's own staged claims (rare) and errors with
+                # the per-node path's message. An empty live map (the
+                # cold start) skips its per-coord probe entirely.
+                claimed_by = None
+                if live_hosts:
+                    for coord in probe["coords"]:
+                        claimed_by = live_get(coord)
+                        if claimed_by is None:
+                            owner = bh_setdefault(coord, name)
+                            if owner is name:
+                                continue
+                            claimed_by = owner
+                        break
+                else:
+                    for coord in probe["coords"]:
+                        owner = bh_setdefault(coord, name)
+                        if owner is not name:
+                            claimed_by = owner
+                            break
+                if claimed_by is not None:
+                    results[pos] = {"error": (
+                        f"nodes {claimed_by} and {name} both claim "
+                        f"chip {tuple(coord)} in slice {sid}"
+                    )}
+                    for coord in probe["coords"]:
+                        if batch_hosts.get(coord) is name:
+                            del batch_hosts[coord]
+                    continue
+                if have_mesh is None:
+                    new_slices[sid] = mesh
+                if probe["unhealthy"]:
+                    a["unhealthy"].update(probe["unhealthy"])
+                if probe["links"]:
+                    for link in set(probe["links"]):
+                        a["links"][link] = a["links"].get(link, 0) + 1
+                a["total"] += probe["shares"] * probe["healthy_chips"]
+                staged_payloads[name] = payload
+                staged.append((pos, name, payload, annotations, probe))
+            # phase 2 — apply: straight-line mutations, no raises, one
+            # deferred epoch/delta/journal seam for the whole batch
+            if staged:
+                for sid, mesh in new_slices.items():
+                    self._slices[sid] = SliceView(mesh=mesh)
+                for pos, name, payload, annotations, probe in staged:
+                    self._lazy_payloads[name] = (
+                        payload, annotations, probe["slice"])
+                    results[pos] = {"ours": True}
+                for sid, batch_hosts in staged_hosts.items():
+                    if not batch_hosts:
+                        continue
+                    sl = self._slices[sid]
+                    self._hosts_locked(sl).update(batch_hosts)
+                    sl.hosts_blob = None
+                    self._hosts_cache.pop(sid, None)
+                    a = agg[sid]
+                    if sid in new_slices:
+                        # a slice born in this batch is COMPLETE
+                        # information: seed the incremental caches so
+                        # the first reader never pays the O(slice)
+                        # walk that would materialize the lazy fleet
+                        self._occ_cache[sid] = set(a["unhealthy"])
+                        self._unhealthy_cache[sid] = set(a["unhealthy"])
+                        self._broken_cache[sid] = dict(a["links"])
+                        self._share_cache[sid] = [0, a["total"]]
+                    else:
+                        # appending NEW nodes to a live slice: advance
+                        # already-seeded caches by the batch aggregates
+                        # (fresh nodes hold no shares — pure adds)
+                        self._occ_apply_locked(
+                            sid, add=tuple(a["unhealthy"]))
+                        self._aux_apply_locked(
+                            sid,
+                            unhealthy_add=tuple(a["unhealthy"]),
+                            broken_add=tuple(
+                                link for link, n in a["links"].items()
+                                for _ in range(n)
+                            ),
+                            total_delta=a["total"],
+                        )
+                self._names_cache = None
+                self._epoch += 1
+                self._note_delta_locked(
+                    full=True, why=f"bulk ingest ({len(staged)} nodes)")
+                if self._journal is not None:
+                    self._note_journal_locked("nodes", {"items": [
+                        [name, annotations]
+                        for _, name, _, annotations, _ in staged
+                    ]})
+                self.ingest_nodes_total += len(staged)
+            self.ingest_batches += 1
+            dt = time.perf_counter() - t0
+            self._ingest_seconds.append(dt)
+            self.ingest_seconds_total += dt
+        # known-node changed payloads run the legacy per-node path
+        # OUTSIDE the batch lock hold (upsert_node re-acquires; the
+        # per-node seams own health-only deltas and occupancy carry)
+        for pos in slow:
+            item = items[pos]
+            try:
+                results[pos] = {"ours": self.upsert_node(
+                    item["name"], dict(item.get("annotations") or {})
+                )}
+            except (codec.CodecError, StateError) as e:
+                results[pos] = {"error": str(e)}
+        return results
+
+    def ingest_stats(self) -> dict:
+        """The /statusz "ingest" section: batch counters, decode-cache
+        hit rate, and the lazy backlog still awaiting materialization."""
+        with self._lock:
+            decode = self._decode_hits + self._decode_misses
+            last = (self._ingest_seconds[-1]
+                    if self._ingest_seconds else None)
+            return {
+                "nodes_total": self.ingest_nodes_total,
+                "batches": self.ingest_batches,
+                "seconds_total": round(self.ingest_seconds_total, 6),
+                "last_batch_s": (round(last, 6)
+                                 if last is not None else None),
+                "decode_cache_hits": self._decode_hits,
+                "decode_cache_misses": self._decode_misses,
+                "decode_cache_hit_rate": (
+                    round(self._decode_hits / decode, 4)
+                    if decode else None
+                ),
+                "lazy_pending": (len(self._lazy_index)
+                                 + len(self._lazy_payloads)),
+            }
+
+    def ingest_seconds_snapshot(self) -> list[float]:
+        """Copy of the per-batch ingest-wall window (the /metrics
+        summary's values_fn)."""
+        with self._lock:
+            return list(self._ingest_seconds)
+
+    def maybe_start_warmer(self) -> None:
+        """Start (at most one) background materializer draining the
+        lazy stores in batches — the bulk ingest epilogue's analog of
+        the journal recovery's warmer: the steady-state serving path
+        should never meet a cold node, without the ingest paying
+        O(fleet) decode up front."""
+        with self._lock:
+            if (self._warming or self._retired
+                    or not (self._lazy_index or self._lazy_payloads)):
+                return
+            self._warming = True
+
+        def run() -> None:
+            try:
+                # brief head start for the caller's epilogue — warming
+                # is strictly background work
+                time.sleep(0.05)
+                while self.warm_pending(512):
+                    pass
+            finally:
+                with self._lock:
+                    self._warming = False
+
+        threading.Thread(target=run, daemon=True,
+                         name="tpukube-ingest-warmer").start()
+
     # -- views -------------------------------------------------------------
     @property
     def mesh(self) -> Optional[MeshSpec]:
@@ -818,6 +1460,9 @@ class ClusterState:
             view = self._nodes.get(name)
             if view is not None:
                 return view.info.slice_id
+            lazy = self._lazy_payloads.get(name)
+            if lazy is not None:
+                return lazy[2]
             entry = self._lazy_index.get(name)
             return entry[3] if entry is not None else None
 
@@ -835,6 +1480,7 @@ class ClusterState:
             if names is None:
                 names = self._names_cache = tuple(sorted(
                     set(self._nodes) | set(self._lazy_index)
+                    | set(self._lazy_payloads)
                 ))
             return names
 
@@ -1092,16 +1738,20 @@ class ClusterState:
 
     # -- utilization (north-star metric feed) ------------------------------
     def utilization(self) -> float:
-        """Allocated share fraction over healthy capacity, 0..1."""
+        """Allocated share fraction over healthy capacity, 0..1 —
+        summed from the per-slice incremental share counts (seeded by
+        one walk per slice, advanced at every seam), so a metrics
+        scrape stops walking every chip of the fleet per pull and a
+        lazily-ingested fleet is counted without materializing it."""
         with self._lock:
-            total = 0
-            used = 0
-            for view in self._nodes.values():
-                n = view.shares_per_chip
-                for chip in view.info.chips:
-                    if chip.health is Health.HEALTHY:
-                        total += n
-                        used += min(n, view.used_share_count(chip.index))
+            used = total = 0
+            for sid in self._slices:
+                shares = self._share_cache.get(sid)
+                if shares is None:
+                    shares = self._walk_share_counts_locked(sid)
+                    self._share_cache[sid] = shares
+                used += shares[0]
+                total += shares[1]
             return used / total if total else 0.0
 
     def priority_of(self, pod_key: str) -> int:
@@ -1152,6 +1802,7 @@ class ClusterState:
             )
             view.add_ids(adding)
             self._allocs[alloc.pod_key] = alloc
+            self._note_gen_locked("add", alloc=alloc)
             self._occ_apply_locked(view.info.slice_id, add=newly_occupied)
             # all committed chips are healthy (validated above), so the
             # counted share delta is exactly the added weight
@@ -1179,6 +1830,7 @@ class ClusterState:
             if alloc is None:
                 return None
             self._allocs.pop(pod_key, None)
+            self._note_gen_locked("remove", pod_key=pod_key)
             view = self._view_locked(alloc.node_name)
             if view is None:
                 # node view gone: its chips are in no slice's occupied
@@ -1289,6 +1941,24 @@ class ClusterState:
                          zlib.crc32(raw_payload), len(raw_payload))
                 node_cache[name] = (view.raw_payload, entry)
                 entries.append(entry)
+            for name, (payload, annotations, sid) in \
+                    self._lazy_payloads.items():
+                # a still-lazy bulk-ingest node rides as its RAW
+                # annotations (this capture must not decode the fleet);
+                # the loader keeps it lazy and decodes on first touch
+                cached = node_cache.get(name)
+                if cached is not None and cached[0] is payload:
+                    entries.append(cached[1])
+                    continue
+                line = json.dumps(
+                    {"n": name, "slice": sid, "anno": annotations},
+                    separators=(",", ":"))
+                raw_payload = payload.encode("utf-8")
+                entry = ("line", name, line,
+                         zlib.crc32(line.encode("utf-8")), sid,
+                         zlib.crc32(raw_payload), len(raw_payload))
+                node_cache[name] = (payload, entry)
+                entries.append(entry)
             for name, le in self._lazy_index.items():
                 off, length, crc, sid, pcrc, plen = le
                 entries.append(("ref", name, off, length, crc, sid,
@@ -1307,6 +1977,11 @@ class ClusterState:
                 alloc_index[key] = cached[2]
             head = {
                 "epoch": self._epoch,
+                # the alloc generation rides the checkpoint so a warm
+                # recovery RESUMES the numbering (never regresses);
+                # resync cursors from the dead incarnation still full-
+                # read once — the incarnation token changed
+                "gen": self._generation,
                 "slices": {
                     sid: [list(sl.mesh.dims), list(sl.mesh.host_block),
                           list(sl.mesh.torus)]
@@ -1347,11 +2022,13 @@ class ClusterState:
         non-fresh ledger (recovery constructs a new extender, never
         restores over one)."""
         with self._lock:
-            if self._nodes or self._allocs or self._lazy_index:
+            if (self._nodes or self._allocs or self._lazy_index
+                    or self._lazy_payloads):
                 raise StateError(
                     "restore_checkpoint requires a fresh ledger"
                 )
             self._epoch = int(head.get("epoch", 0))
+            self._generation = int(head.get("gen", 0))
             for sid, (dims, block, torus) in head["slices"].items():
                 self._slices[sid] = SliceView(
                     mesh=MeshSpec(

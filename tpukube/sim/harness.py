@@ -377,8 +377,13 @@ class SimCluster:
                 "separate extender daemons"
             )
         try:
-            self._http = _AppThread(make_app(self.extender), "127.0.0.1",
-                                    self._port)
+            # the same loop objects the production daemon hands
+            # make_app (cli.main_extender): the sim daemon's /statusz
+            # resync/evictions sections answer like the real one's
+            self._http = _AppThread(
+                make_app(self.extender, evictions=self._evictions,
+                         lifecycle=self._lifecycle),
+                "127.0.0.1", self._port)
             self._http.start()
         except BaseException:
             # __enter__ raising means __exit__/stop() never runs: the
@@ -472,11 +477,16 @@ class SimCluster:
         ]
         # the SAME lifecycle filter every restart path applies:
         # terminal-phase pods' annotation residue must not be restored
+        full_pods = router.replica_pods(idx, self.pods)
         pods = [
             annotations for annotations, _alloc, _key in
-            live_alloc_pods(router.replica_pods(idx, self.pods))
+            live_alloc_pods(full_pods)
         ]
-        return router.restart_replica(idx, node_annos, pods)
+        # the full pod objects ride along so a journal-enabled replica
+        # can replay its own segment (warm restart) and reconcile
+        # against the same truth the cold rebuild would consume
+        return router.restart_replica(idx, node_annos, pods,
+                                      pod_objects=full_pods)
 
     # -- crash / cold restart (chaos scenario 9) -----------------------------
     def crash_extender(self) -> None:
@@ -561,8 +571,13 @@ class SimCluster:
         # channel yet: the next schedule() must send full node objects
         self._commit_synced([])
         if not self._in_process:
-            self._http = _AppThread(make_app(self.extender), "127.0.0.1",
-                                    self._port)
+            # the same loop objects the production daemon hands
+            # make_app (cli.main_extender): the sim daemon's /statusz
+            # resync/evictions sections answer like the real one's
+            self._http = _AppThread(
+                make_app(self.extender, evictions=self._evictions,
+                         lifecycle=self._lifecycle),
+                "127.0.0.1", self._port)
             self._http.start()
         return restored
 

@@ -60,6 +60,12 @@ _PASSTHROUGH_KEYS = (
     # process-parallel sharding (ISSUE 14): subprocess replica daemons
     # for the true multi-core sweep (check.sh shard-mp smoke, bench)
     "TPUKUBE_SHARD_TRANSPORT",
+    # bulk cold-start ingestion + generation-based incremental resync
+    # (ISSUE 15): the parity suite re-runs scenarios with the bulk
+    # path off (the per-node oracle) / the generation log disabled
+    # (legacy full-read resyncs) asserting bit-identical placements
+    "TPUKUBE_BULK_INGEST_ENABLED",
+    "TPUKUBE_GENERATION_LOG_CAPACITY",
 )
 
 
@@ -912,6 +918,11 @@ def _kilonode_drive(cfg: TpuKubeConfig, metric: str, total_target: int,
         }
         if setup_s is not None:
             result["setup_s"] = setup_s
+        # generation-based incremental resync (ISSUE 15): the per-wave
+        # lifecycle reconcile's full-vs-incremental read counts and the
+        # wire-shape bytes they moved — check.sh's coldstart smoke
+        # floors the incremental-hit ratio on this key
+        result["resync"] = c._lifecycle.resync_stats()
         statusz = getattr(ext, "statusz", None)
         if statusz is not None:
             # sharded plane: the router topology + rendezvous ledger +

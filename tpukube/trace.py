@@ -42,7 +42,7 @@ from typing import Any, Callable, Iterable, Optional
 # recorded because it unblocks gated gang binds, so replay must apply it
 # at the same point in the stream.
 KINDS = ("filter", "prioritize", "bind", "release", "reconcile",
-         "upsert_node", "victim_gone")
+         "upsert_node", "upsert_nodes", "victim_gone")
 
 # Annotation kinds: pure observability markers (tpukube.obs.timeline
 # span hooks — gang reserve, preemption plan, gang commit, plugin
