@@ -471,6 +471,10 @@ def _shard_sweep_point(n: int, pods: int, transport: str) -> dict:
         "plan_ms_per_pod": r["cycle"]["plan_ms_per_pod"],
         "webhook_p99_ms": r["webhook_p99_ms"],
         "utilization_percent": r["utilization_percent"],
+        # bytes-per-churn-wave over the router->replica transport
+        # (ISSUE 16): the wire baseline the ROADMAP codec item is
+        # judged against — all zeros at the inprocess points
+        "wire": r.get("wire"),
     }
 
 

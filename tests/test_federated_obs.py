@@ -1,0 +1,320 @@
+"""ISSUE 16: federated observability plane over the sharded control
+plane — cross-replica trace propagation, stitched /explain, aggregated
+/metrics, and wire-cost accounting.
+
+The acceptance gates covered here:
+  * a DCN gang scheduled over the REAL subprocess transport yields a
+    stitched /explain chain naming both parts, their replicas, and the
+    rendezvous verdict;
+  * the router's federated /metrics (worker registries merged under a
+    ``replica`` label + router-local series) passes promlint;
+  * the merged Chrome trace stitches the router's fan-out spans and
+    both workers' captures on one clock, joined by propagated trace
+    context;
+  * ``shard_transport: inprocess`` at N=1 keeps the exposition
+    byte-identical to the sole extender's own (off-is-off);
+plus the satellites: /events federation with replica attribution and
+the router's observability HTTP listener.
+
+Worker daemons are real subprocesses; tests that need them skip
+gracefully where spawning is unavailable.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import urllib.request
+
+import pytest
+
+from tpukube.core.clock import FakeClock
+from tpukube.core.config import load_config
+from tpukube.core.mesh import MeshSpec
+from tpukube.core.types import PodGroup
+from tpukube.metrics import render_extender_metrics, render_federated_metrics
+from tpukube.obs.events import filter_events, format_event
+from tpukube.obs.slo import parse_metrics, validate_exposition
+from tpukube.obs.timeline import merged_chrome_trace
+from tpukube.sched.shard import ShardRouter
+from tpukube.sim.harness import SimCluster
+
+from tests.test_shard_proc import needs_workers
+
+
+def obs_config(n: int = 2, **extra: str):
+    """2 subprocess planner replicas with decision provenance fully on
+    (sampling 1.0) — the federated-observability acceptance shape."""
+    return load_config(env={
+        "TPUKUBE_PLANNER_REPLICAS": str(n),
+        "TPUKUBE_SHARD_TRANSPORT": "subprocess",
+        "TPUKUBE_BATCH_ENABLED": "1",
+        "TPUKUBE_DECISIONS_ENABLED": "1",
+        "TPUKUBE_DECISIONS_SAMPLE_RATE": "1.0",
+        **extra,
+    })
+
+
+def two_slices(dims=(2, 2, 2)) -> dict[str, MeshSpec]:
+    return {
+        sid: MeshSpec(dims=dims, host_block=(2, 2, 1),
+                      torus=(False, False, False))
+        for sid in ("s0", "s1")
+    }
+
+
+def _fill_and_rendezvous(c: SimCluster) -> None:
+    """Commit one 4-member gang into each slice, then an 8-member
+    DCN gang that can only place via the two-phase rendezvous."""
+    for g in ("fill-a", "fill-b"):
+        grp = PodGroup(g, min_member=4)
+        for i in range(4):
+            c.schedule(c.make_pod(f"{g}-{i}", tpu=1, group=grp))
+    dcn = PodGroup("dcn", min_member=8, allow_dcn=True)
+    for i in range(8):
+        c.schedule(c.make_pod(f"dcn-{i}", tpu=1, group=dcn,
+                              priority=50))
+
+
+# -- N=1 parity: off-is-off --------------------------------------------------
+
+def test_n1_federated_exposition_is_sole_extender_verbatim():
+    """At planner_replicas=1 the federated renderer IS the sole
+    extender's renderer — byte-identical, no replica labels, no router
+    series, and no router-side observability state at all."""
+    router = ShardRouter(load_config(env={}))
+    assert router._sole is not None
+    text = render_federated_metrics(router)
+    assert text == render_extender_metrics(router._sole)
+    assert 'replica="' not in text
+    assert "tpukube_router_wire_bytes_total" not in text
+    # the router-side obs plane never initializes in sole mode
+    assert router.trace is None
+    assert router.decisions is None
+
+
+# -- federated /metrics ------------------------------------------------------
+
+@needs_workers
+def test_federated_metrics_two_replicas_lint_clean():
+    """The merged exposition after real cross-replica activity (two
+    committed fill gangs + a DCN rendezvous gang) passes promlint and
+    carries both replicas' series under the replica label plus the
+    router-local wire counter."""
+    cfg = obs_config()
+    with SimCluster(cfg, clock=FakeClock(), in_process=True,
+                    slices=two_slices()) as c:
+        _fill_and_rendezvous(c)
+        router = c.extender
+        text = render_federated_metrics(router)
+    errors = validate_exposition(text)
+    assert errors == [], "\n".join(errors)
+    assert 'replica="r0"' in text and 'replica="r1"' in text
+    names = {s.name for s in parse_metrics(text)}
+    # router-local series
+    assert "tpukube_replica_up" in names
+    assert "tpukube_router_wire_bytes_total" in names
+    # worker-side series federate under the replica label (both
+    # replicas really bound pods in this drive)
+    binds = [s for s in parse_metrics(text)
+             if s.name == "tpukube_binds_total"]
+    assert {s.label("replica") for s in binds} >= {"r0", "r1"}
+
+
+@needs_workers
+def test_wire_accounting_and_flight_recorder():
+    """Every fanned call is billed: the transport's wire counters are
+    non-zero in both directions for the webhook op, per-replica totals
+    cover both workers, and the bounded flight recorder holds the
+    recent calls with op/replica/bytes/rtt."""
+    cfg = obs_config()
+    with SimCluster(cfg, clock=FakeClock(), in_process=True,
+                    slices=two_slices()) as c:
+        _fill_and_rendezvous(c)
+        router = c.extender
+        wt = router.wire_totals()
+        assert wt["tx"] > 0 and wt["rx"] > 0
+        assert wt["total"] == wt["tx"] + wt["rx"]
+        assert set(wt["per_replica"]) == {"r0", "r1"}
+        assert "handle" in wt["by_op"]
+        flights = router.flights_snapshot()
+        assert flights, "flight recorder is empty after real traffic"
+        for f in flights:
+            assert f["replica"] in ("r0", "r1")
+            assert f["tx_bytes"] >= 0 and f["rx_bytes"] >= 0
+        # the wire bill and flights surface on /statusz (statusz's own
+        # summary fan-out is itself billed, so the total only grows)
+        doc = router.statusz()
+        assert doc["wire"]["total"] >= wt["total"]
+        assert doc["flights"]
+
+
+# -- stitched /explain -------------------------------------------------------
+
+@needs_workers
+def test_dcn_gang_stitched_explain_cites_both_replicas():
+    """The federated chain for one DCN gang member, assembled over the
+    real subprocess transport, names both parts, both replicas, and
+    the rendezvous verdict — the ISSUE 16 acceptance sentence."""
+    cfg = obs_config()
+    with SimCluster(cfg, clock=FakeClock(), in_process=True,
+                    slices=two_slices()) as c:
+        _fill_and_rendezvous(c)
+        router = c.extender
+        doc = router.explain("default/dcn-0")
+    assert doc is not None and doc["pod"] == "default/dcn-0"
+    assert doc["verdict"] == "placed"
+    # the chain carries router stages AND the owning replica's stages
+    cited = {ev.get("replica") for ev in doc["stages"]}
+    assert "router" in cited
+    assert cited & {"r0", "r1"}
+    stages = {ev.get("stage") for ev in doc["stages"]}
+    assert "route" in stages and "rendezvous" in stages
+    # the rendezvous verdict names both parts with their replicas
+    rdv = [ev for ev in doc["stages"] if ev.get("stage") == "rendezvous"]
+    assert any(ev.get("outcome") == "committed" for ev in rdv)
+    parts = {(p["replica"], p["slice"])
+             for ev in rdv for p in (ev.get("parts") or [])}
+    assert parts == {("r0", "s0"), ("r1", "s1")}
+    why = "\n".join(doc["why"])
+    assert "DCN rendezvous committed for gang default/dcn" in why
+    assert "replica r0" in why and "replica r1" in why
+
+
+@needs_workers
+def test_stitched_explain_resolves_bare_names_and_plain_pods():
+    """A non-gang pod's federated chain still stitches (route stage +
+    owning replica's webhook stages), and a bare pod name resolves in
+    the default namespace — the `tpukube-obs explain --url <router>`
+    contract."""
+    cfg = obs_config()
+    with SimCluster(cfg, clock=FakeClock(), in_process=True,
+                    slices=two_slices()) as c:
+        c.schedule(c.make_pod("solo", tpu=1))
+        router = c.extender
+        doc = router.explain("solo")
+        assert doc is not None and doc["pod"] == "default/solo"
+        assert doc["verdict"] == "placed"
+        stages = {ev.get("stage") for ev in doc["stages"]}
+        assert "route" in stages
+        # batch-mode worker provenance: the cycle planned it, then bound
+        assert {"cycle_plan", "bind"} <= stages
+        # seqs are reassigned contiguously after the merge
+        seqs = [ev["seq"] for ev in doc["stages"]]
+        assert seqs == list(range(1, len(seqs) + 1))
+        assert router.explain("default/never-seen") is None
+
+
+# -- merged timeline ---------------------------------------------------------
+
+@needs_workers
+def test_merged_timeline_joins_router_and_worker_captures():
+    """One Chrome trace from three captures (router + both workers):
+    each capture is its own process, the router's fan-out spans render
+    as explicit-bounds slices, and worker events carry the propagated
+    trace context that joins them to the router's spans."""
+    cfg = obs_config()
+    with SimCluster(cfg, clock=FakeClock(), in_process=True,
+                    slices=two_slices()) as c:
+        _fill_and_rendezvous(c)
+        router = c.extender
+        assert router.trace is not None
+        captures = [("router", router.trace.events())]
+        for rep in router.replicas:
+            captures.append((rep.name, rep.transport.trace_events()))
+    merged = merged_chrome_trace(captures)
+    evs = merged["traceEvents"]
+    procs = {e["args"]["name"] for e in evs
+             if e.get("name") == "process_name"}
+    assert procs == {"router", "r0", "r1"}
+    router_traces = {e["args"].get("trace") for e in evs
+                     if e.get("ph") == "X" and e["pid"] == 1}
+    worker_traces = {e["args"].get("trace") for e in evs
+                     if e.get("ph") == "X" and e["pid"] > 1}
+    joined = (router_traces - {None}) & (worker_traces - {None})
+    assert joined, "no propagated trace id joins router and workers"
+    # router span slices carry true wall-clock bounds (dur from t0/t1)
+    spans = [e for e in evs if e.get("ph") == "X" and e["pid"] == 1]
+    assert spans and all(e["dur"] >= 1.0 for e in spans)
+
+
+# -- federated /events -------------------------------------------------------
+
+@needs_workers
+def test_events_federated_with_replica_attribution():
+    """/events merges the worker journals: every row is stamped with
+    its source replica, the --replica filter narrows to one journal,
+    and the human rendering shows the attribution."""
+    cfg = obs_config()
+    with SimCluster(cfg, clock=FakeClock(), in_process=True,
+                    slices=two_slices()) as c:
+        _fill_and_rendezvous(c)
+        router = c.extender
+        evs = router.events_federated()
+        assert evs
+        assert {ev.get("replica") for ev in evs} == {"r0", "r1"}
+        committed = router.events_federated(reason="GangCommitted")
+        assert {ev["reason"] for ev in committed} == {"GangCommitted"}
+        only0 = router.events_federated(replica="r0")
+        assert only0 and {ev["replica"] for ev in only0} == {"r0"}
+        assert filter_events(evs, replica="r0") == only0
+        line = format_event(only0[0])
+        assert line.endswith("@r0")
+        # single-planner events (no attribution) never match a
+        # replica filter
+        assert filter_events([{"reason": "GangCommitted"}],
+                             replica="r0") == []
+
+
+# -- the router's observability listener -------------------------------------
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get(url: str) -> str:
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.read().decode()
+
+
+@needs_workers
+def test_router_obs_listener_serves_federated_views():
+    """make_router_app over a live 2-replica plane: /metrics lints
+    clean over HTTP, /explain answers the stitched chain, /events
+    honors the replica filter, and /statusz carries the wire bill."""
+    from tpukube.sched.extender import run_probe_server
+    from tpukube.sched.shardworker import make_router_app
+
+    cfg = obs_config()
+    with SimCluster(cfg, clock=FakeClock(), in_process=True,
+                    slices=two_slices()) as c:
+        _fill_and_rendezvous(c)
+        router = c.extender
+        port = _free_port()
+        stop = run_probe_server(make_router_app(router),
+                                "127.0.0.1", port)
+        try:
+            base = f"http://127.0.0.1:{port}"
+            assert _get(f"{base}/healthz") == "ok"
+            text = _get(f"{base}/metrics")
+            assert validate_exposition(text) == []
+            assert 'replica="r0"' in text and 'replica="r1"' in text
+            doc = json.loads(_get(f"{base}/explain?pod=default/dcn-0"))
+            assert doc["verdict"] == "placed"
+            assert any(ev.get("stage") == "rendezvous"
+                       for ev in doc["stages"])
+            evs = json.loads(_get(f"{base}/events?replica=r1"))
+            assert evs and {ev["replica"] for ev in evs} == {"r1"}
+            stz = json.loads(_get(f"{base}/statusz"))
+            assert stz["sharded"] is True
+            assert stz["wire"]["total"] > 0
+            assert json.loads(_get(f"{base}/trace"))
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(f"{base}/explain")
+            assert ei.value.code == 400
+        finally:
+            stop()

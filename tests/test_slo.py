@@ -178,6 +178,36 @@ def _rendered_sample_names() -> set:
                 server, sampler=sampler, events=journal,
             )
     names |= {s.name for s in parse_metrics(text)}
+
+    # the shard router's federated registry (ISSUE 16 federation
+    # rules): the subprocess-gated transport telemetry only renders in
+    # process mode, so duck-type a 2-replica process-mode router — the
+    # registry itself is the real one, only the transports are stubs
+    # (spawning worker daemons is not available everywhere this runs)
+    from tpukube.metrics import render_router_metrics
+
+    transport = SimpleNamespace(
+        summary=lambda: {},
+        rtt_snapshot=lambda: [0.001, 0.002],
+        wire_snapshot=lambda: {
+            "tx": 1, "rx": 1,
+            "by_op": {"handle": {"tx": 1, "rx": 1}},
+        },
+        health_checks=1,
+        health_failures=0,
+    )
+    router = SimpleNamespace(
+        mode="subprocess",
+        replicas=[
+            SimpleNamespace(index=i, name=f"r{i}", alive=True,
+                            killed=False, pods_routed=0,
+                            transport=transport)
+            for i in range(2)
+        ],
+        rendezvous_prepared=0, rendezvous_committed=0,
+        rendezvous_aborted=0,
+    )
+    names |= {s.name for s in parse_metrics(render_router_metrics(router))}
     return names
 
 

@@ -437,6 +437,143 @@ print("process-mode shard smoke OK")
 PY
 
 echo
+echo "== federated observability smoke (2 SUBPROCESS planner daemons:"
+echo "   the router's merged /metrics must lint clean over HTTP with"
+echo "   replica attribution, the stitched /explain must answer a DCN"
+echo "   gang member citing both replicas, and the router-side"
+echo "   provenance overhead on a sharded scenario-12 drive stays under"
+echo "   the tools/perf_floor.json ceiling; skips where subprocesses"
+echo "   are unavailable) =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+import socket
+import sys
+import urllib.request
+
+floor = json.load(open("tools/perf_floor.json"))["federated_obs"]
+
+# probe: can this environment spawn worker daemons at all? (some CI
+# sandboxes forbid subprocess/socket use — skip LOUDLY, not silently)
+from tpukube.core.config import load_config
+from tpukube.sched.shard import ShardError, SubprocessTransport
+
+try:
+    probe = SubprocessTransport(0, load_config(env={}),
+                                fake_clock=False)
+    probe.close()
+except (ShardError, OSError) as e:
+    print(f"federated observability smoke SKIPPED: cannot spawn "
+          f"worker daemons here ({e})")
+    sys.exit(0)
+
+from tpukube.core.mesh import MeshSpec
+from tpukube.core.types import PodGroup
+from tpukube.obs.slo import validate_exposition
+from tpukube.sched.extender import run_probe_server
+from tpukube.sched.shardworker import make_router_app
+from tpukube.sim import scenarios
+from tpukube.sim.harness import SimCluster
+
+bad = []
+
+# part 1: the live federated plane — fill both slices, force a DCN
+# rendezvous, then read the router's observability listener over HTTP
+cfg = load_config(env={
+    "TPUKUBE_PLANNER_REPLICAS": "2",
+    "TPUKUBE_SHARD_TRANSPORT": "subprocess",
+    "TPUKUBE_BATCH_ENABLED": "1",
+    "TPUKUBE_DECISIONS_ENABLED": "1",
+    "TPUKUBE_DECISIONS_SAMPLE_RATE": "1.0",
+})
+slices = {
+    sid: MeshSpec(dims=(2, 2, 2), host_block=(2, 2, 1),
+                  torus=(False, False, False))
+    for sid in ("s0", "s1")
+}
+with SimCluster(cfg, in_process=True, slices=slices) as c:
+    for g in ("fill-a", "fill-b"):
+        grp = PodGroup(g, min_member=4)
+        for i in range(4):
+            c.schedule(c.make_pod(f"{g}-{i}", tpu=1, group=grp))
+    dcn = PodGroup("dcn", min_member=8, allow_dcn=True)
+    for i in range(8):
+        c.schedule(c.make_pod(f"dcn-{i}", tpu=1, group=dcn,
+                              priority=50))
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    stop = run_probe_server(make_router_app(c.extender),
+                            "127.0.0.1", port)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        errors = validate_exposition(text)
+        if errors:
+            bad.append(f"federated /metrics fails promlint: {errors}")
+        for rep in ('replica="r0"', 'replica="r1"'):
+            if rep not in text:
+                bad.append(f"federated /metrics misses {rep}")
+        if "tpukube_router_wire_bytes_total" not in text:
+            bad.append("federated /metrics misses the wire counter")
+        with urllib.request.urlopen(
+                f"{base}/explain?pod=default/dcn-0", timeout=10) as r:
+            doc = json.load(r)
+        why = "\n".join(doc.get("why", []))
+        if doc.get("verdict") != "placed":
+            bad.append(f"stitched explain verdict={doc.get('verdict')}")
+        if "DCN rendezvous committed" not in why \
+                or "replica r0" not in why or "replica r1" not in why:
+            bad.append("stitched explain does not cite both replicas "
+                       "and the rendezvous verdict")
+    finally:
+        stop()
+
+# part 2: observability overhead on the sharded drive — the router's
+# DecisionLog (route/spillover/rendezvous stages + fan-out spans)
+# against real subprocess RPCs; same measurement as the decisions
+# smoke, taken on the federated plane
+cfg = load_config(env={
+    "TPUKUBE_SIM_MESH_DIMS": "8,8,16",
+    "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    "TPUKUBE_BATCH_ENABLED": "1",
+    "TPUKUBE_BATCH_MAX_PODS": "2048",
+    "TPUKUBE_FILTER_FROM_PLAN": "1",
+    "TPUKUBE_PLANNER_REPLICAS": "2",
+    "TPUKUBE_SHARD_TRANSPORT": "subprocess",
+    "TPUKUBE_DECISIONS_ENABLED": "1",
+    "TPUKUBE_DECISIONS_SAMPLE_RATE": "1.0",
+})
+mesh = cfg.sim_mesh()
+slices = {
+    f"s{i:02d}": MeshSpec(dims=mesh.dims, host_block=mesh.host_block,
+                          torus=mesh.torus)
+    for i in range(4)
+}
+r = scenarios._kilonode_drive(
+    cfg, metric="federated_obs", total_target=floor["pods"],
+    gang_size=128, max_alive=2048, check_leaks=True,
+    slices=slices, include_setup=False,
+)
+print(json.dumps({
+    "pods": r["pods_total"],
+    "overhead_pct": r["decisions"]["overhead_pct"],
+    "wire_total_bytes": r["wire"]["total_bytes"],
+    "wire_bytes_per_wave": r["wire"]["bytes_per_wave"],
+}))
+if r["decisions"]["overhead_pct"] > floor["overhead_pct_max"]:
+    bad.append(f"router provenance overhead "
+               f"{r['decisions']['overhead_pct']}% above the "
+               f"{floor['overhead_pct_max']}% ceiling")
+if not r["wire"]["total_bytes"]:
+    bad.append("sharded drive billed zero wire bytes")
+if bad:
+    sys.exit("federated observability smoke FAILED: " + "; ".join(bad))
+print("federated observability smoke OK")
+PY
+
+echo
 echo "== native asan (libtpuinfo self-test under ASan/UBSan) =="
 if command -v g++ >/dev/null 2>&1; then
   make -C tpukube/native asan

@@ -715,9 +715,19 @@ def main_obs(argv: Optional[list[str]] = None) -> int:
         help="convert a JSONL decision trace (trace_path capture, or a "
              "/trace dump) to Chrome trace-event JSON",
     )
-    tp.add_argument("trace_file")
+    tp.add_argument("trace_file", nargs="+",
+                    help="JSONL capture(s); pass several with --merge "
+                         "(the router's .router sink plus each "
+                         "replica's own capture)")
     tp.add_argument("-o", "--out", default="-", metavar="FILE",
                     help="output file ('-' = stdout)")
+    tp.add_argument("--merge", action="store_true",
+                    help="stitch several per-process captures into ONE "
+                         "Chrome trace: one process lane per file "
+                         "(named for it), a shared time zero, and the "
+                         "router's fan-out spans rendered as true "
+                         "wall-clock slices enclosing the worker spans "
+                         "they fanned out to")
     tp.add_argument("--stats", action="store_true",
                     help="also print per-phase timing stats (JSON) to stderr")
 
@@ -730,6 +740,10 @@ def main_obs(argv: Optional[list[str]] = None) -> int:
     ep.add_argument("--node", default=None, help="filter by node name")
     ep.add_argument("--reason", default=None,
                     help="filter by reason (e.g. ChipUnhealthy)")
+    ep.add_argument("--replica", default=None,
+                    help="filter by source replica (r0, r1, ...) in a "
+                         "federated /events dump — the router stamps "
+                         "each merged event with its source replica")
     ep.add_argument("--since", type=float, default=None, metavar="T",
                     help="absolute unix timestamp, or (values < 1e9) "
                          "seconds before the newest event in the capture")
@@ -746,7 +760,10 @@ def main_obs(argv: Optional[list[str]] = None) -> int:
                          "default/<name>)")
     xsrc = xp.add_mutually_exclusive_group(required=True)
     xsrc.add_argument("--url", default=None,
-                      help="live extender base URL (reads /explain)")
+                      help="live extender OR shard-router base URL "
+                           "(reads /explain; a router resolves the "
+                           "owning replicas transparently and answers "
+                           "the stitched federated chain)")
     xsrc.add_argument("--file", default=None, metavar="JSONL",
                       help="decisions_path JSONL sink capture to "
                            "assemble offline")
@@ -772,10 +789,31 @@ def main_obs(argv: Optional[list[str]] = None) -> int:
     args = p.parse_args(argv)
 
     if args.cmd == "timeline":
+        import os as os_mod
+
         from tpukube import trace as trace_mod
         from tpukube.obs import timeline
 
-        events = trace_mod.load(args.trace_file)
+        if len(args.trace_file) > 1 and not args.merge:
+            p.error("multiple trace files require --merge")
+        if args.merge:
+            captures = [
+                (os_mod.path.basename(path), trace_mod.load(path))
+                for path in args.trace_file
+            ]
+            text = json.dumps(timeline.merged_chrome_trace(captures),
+                              sort_keys=True) + "\n"
+            if args.out == "-":
+                sys.stdout.write(text)
+            else:
+                with open(args.out, "w") as f:
+                    f.write(text)
+            if args.stats:
+                merged = [e for _, evs in captures for e in evs]
+                print(json.dumps(timeline.phase_stats(merged),
+                                 indent=2), file=sys.stderr)
+            return 0
+        events = trace_mod.load(args.trace_file[0])
         if args.out == "-":
             timeline.dump_chrome_trace(events, sys.stdout)
         else:
@@ -826,7 +864,7 @@ def main_obs(argv: Optional[list[str]] = None) -> int:
             since = newest - since
         evs = events_mod.filter_events(
             evs, reason=args.reason, pod=args.pod, node=args.node,
-            since=since,
+            since=since, replica=args.replica,
         )
         for ev in evs:
             if args.as_json:

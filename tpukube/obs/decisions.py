@@ -48,6 +48,8 @@ import zlib
 from collections import deque
 from typing import Any, Iterable, Optional
 
+from tpukube.trace import TRACE_CONTEXT
+
 #: stage vocabulary in use (documentation, not an enum — the explain
 #: renderer treats unknown stages as opaque provenance lines):
 #:   admit        pod entered the batch scheduling queue
@@ -66,10 +68,18 @@ from typing import Any, Iterable, Optional
 #:   plan_expired the plan TTL'd out unbound
 #:   preempted    the pod lost its chips to a higher-priority gang
 #:   release      the pod's allocation was released
+#:   route        (router) the fan-out router chose a replica to score
+#:                the pod on
+#:   spillover    (router) the home replica refused and the router
+#:                spilled the pod to another replica
+#:   rendezvous   (router) a two-phase DCN rendezvous verdict for the
+#:                pod's gang (outcome prepared/committed/aborted, with
+#:                the per-replica parts)
 STAGES = (
     "admit", "cycle_plan", "filter", "prioritize", "gang_reserve",
     "preemption_plan", "tenancy", "refusal", "bind", "assume_undo",
     "plan_expired", "preempted", "release",
+    "route", "spillover", "rendezvous",
 )
 
 #: stages that are refusals — the consistency lint
@@ -141,6 +151,12 @@ class DecisionLog:
             "stage": stage,
         }
         ev.update(fields)
+        ctx = TRACE_CONTEXT.get()
+        if ctx is not None:
+            # router-originated request (sharded mode): tag the stage
+            # so the stitched /explain and merged timeline can join it
+            # to the router's fan-out span; absent outside that path
+            ev.setdefault("ctx", dict(ctx))
         self._ring.append(ev)
         self.recorded += 1
         if self._sink is not None:
@@ -221,6 +237,32 @@ def pod_events(events: Iterable[dict[str, Any]],
            if isinstance(e, dict) and e.get("pod") == pod_key]
     out.sort(key=lambda e: e.get("seq", 0))
     return out
+
+
+def merge_stage_events(
+    groups: Iterable[tuple[str, Iterable[dict[str, Any]]]],
+) -> list[dict[str, Any]]:
+    """Stitch stage-event streams from several processes (the router's
+    own log plus each owning replica's /explain chain) into ONE stream:
+    every event gains a ``replica`` attribution (kept when the source
+    already set one), ordering falls back from per-process seq to the
+    wall clock (the only ordering that exists across processes), and
+    seq is reassigned so :func:`explain_doc` renders the merged chain
+    exactly like a local one."""
+    merged: list[dict[str, Any]] = []
+    for label, evs in groups:
+        for ev in evs:
+            if not isinstance(ev, dict):
+                continue
+            ev = dict(ev)
+            ev.setdefault("replica", label)
+            merged.append(ev)
+    merged.sort(key=lambda e: (float(e.get("ts", 0.0)),
+                               str(e.get("replica", "")),
+                               int(e.get("seq", 0))))
+    for i, ev in enumerate(merged, start=1):
+        ev["seq"] = i
+    return merged
 
 
 def explain_doc(events: Iterable[dict[str, Any]],
@@ -354,6 +396,28 @@ def explain_doc(events: Iterable[dict[str, Any]],
             if verdict == "placed":
                 verdict = "released"
             why.append("allocation released")
+        elif stage == "route":
+            why.append(
+                f"router: scored on replica {ev.get('replica')}"
+                + (f" ({ev.get('reason')})" if ev.get("reason") else "")
+            )
+        elif stage == "spillover":
+            why.append(
+                f"router: spilled over from replica {ev.get('primary')} "
+                f"to replica {ev.get('replica')}"
+            )
+        elif stage == "rendezvous":
+            parts = ev.get("parts") or []
+            detail = ", ".join(
+                f"{p.get('chips')} chip(s) on {p.get('slice')} "
+                f"(replica {p.get('replica')})" for p in parts
+            )
+            why.append(
+                f"router: DCN rendezvous {ev.get('outcome')} for gang "
+                f"{ev.get('gang')}"
+                + (f" — {detail}" if detail else "")
+                + (f" ({ev.get('reason')})" if ev.get("reason") else "")
+            )
         else:
             why.append(f"{stage}: " + ", ".join(
                 f"{k}={v}" for k, v in sorted(ev.items())

@@ -198,9 +198,13 @@ class EventJournal:
 def filter_events(events: Iterable[dict[str, Any]],
                   reason: Optional[str] = None, pod: Optional[str] = None,
                   node: Optional[str] = None,
-                  since: Optional[float] = None) -> list[dict[str, Any]]:
+                  since: Optional[float] = None,
+                  replica: Optional[str] = None) -> list[dict[str, Any]]:
     """The journal's query predicate over plain event dicts — shared by
-    the live ring and `tpukube-obs events` reading a JSONL sink."""
+    the live ring and `tpukube-obs events` reading a JSONL sink.
+    ``replica`` matches the source-replica attribution a federated
+    merge stamps (sched/shard.py ``events_federated``); events without
+    one (a single-planner journal) never match a replica filter."""
     out = []
     for ev in events:
         if not isinstance(ev, dict):
@@ -208,6 +212,8 @@ def filter_events(events: Iterable[dict[str, Any]],
         if reason is not None and ev.get("reason") != reason:
             continue
         if node is not None and ev.get("node") != node:
+            continue
+        if replica is not None and ev.get("replica") != replica:
             continue
         if pod is not None:
             # exact pod identity only: "pod/<key>" or any object whose
@@ -240,6 +246,7 @@ def format_event(ev: dict[str, Any]) -> str:
     count = ev.get("count", 1)
     suffix = f" (x{count})" if count > 1 else ""
     node = f" [{ev['node']}]" if ev.get("node") else ""
+    replica = f" @{ev['replica']}" if ev.get("replica") else ""
     return (f"{ts} {ev.get('type', NORMAL):7s} {ev.get('reason', '?'):20s} "
             f"{ev.get('object', ''):32s} {ev.get('message', '')}"
-            f"{suffix}{node}")
+            f"{suffix}{node}{replica}")

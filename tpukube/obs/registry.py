@@ -153,6 +153,10 @@ DECLARED_SERIES: frozenset[str] = frozenset({
     "tpukube_replica_rtt_seconds",
     "tpukube_replica_health_checks_total",
     "tpukube_replica_health_check_failures_total",
+    # federated observability plane (ISSUE 16): cumulative wire bytes
+    # per {op, dir, replica} over the subprocess transport — the
+    # measured baseline the ROADMAP codec item is judged against
+    "tpukube_router_wire_bytes_total",
     # both daemons (unified retry/circuit layer, core/retry.py; series
     # render only where a Retrier/CircuitBreaker is actually wired)
     "tpukube_retry_attempts_total",

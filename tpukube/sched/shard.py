@@ -88,6 +88,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import replace as dc_replace
 from typing import Any, Optional
 
+from tpukube import trace as trace_mod
 from tpukube.core import codec
 from tpukube.core.config import TpuKubeConfig
 from tpukube.core.types import AllocResult, PodGroup, PodInfo, TopologyCoord
@@ -375,6 +376,33 @@ class InProcessTransport:
     def events_emit(self, *args, **kwargs) -> None:
         self.extender.events.emit(*args, **kwargs)
 
+    # federated observability -----------------------------------------------
+    def explain(self, pod_key: str) -> Optional[dict[str, Any]]:
+        dlog = self.extender.decisions
+        return dlog.explain(pod_key) if dlog is not None else None
+
+    def events_query(self, reason=None, pod=None, node=None,
+                     since=None) -> list[dict[str, Any]]:
+        return self.extender.events.events(reason=reason, pod=pod,
+                                           node=node, since=since)
+
+    def metrics_text(self) -> str:
+        from tpukube.metrics import render_extender_metrics
+
+        return render_extender_metrics(self.extender)
+
+    def statusz_doc(self) -> dict[str, Any]:
+        from tpukube.obs.statusz import extender_statusz
+
+        return extender_statusz(self.extender)
+
+    def trace_events(self, since_seq: int = 0) -> list[dict[str, Any]]:
+        tr = self.extender.trace
+        return tr.events(since_seq=since_seq) if tr is not None else []
+
+    def wire_snapshot(self) -> Optional[dict[str, Any]]:
+        return None  # direct dispatch: nothing crosses a wire
+
     # lifecycle -------------------------------------------------------------
     def rebuild_from_pods(self, pods: list[dict[str, str]]) -> int:
         return self.extender.rebuild_from_pods(pods)
@@ -465,6 +493,17 @@ class SubprocessTransport:
         self.rtt_window: deque[float] = deque(maxlen=self.RTT_WINDOW)
         self.rtt_sum = 0.0
         self.rtt_count = 0
+        # wire-cost accounting (the codec item's baseline): request and
+        # response bytes as they cross this transport, total and per op
+        # (op = the /worker/* route tail). Updated under _lock with the
+        # RTT stats; read via wire_snapshot().
+        self.wire_tx = 0
+        self.wire_rx = 0
+        self.wire_by_op: dict[str, dict[str, int]] = {}
+        #: optional (index, op, tx_bytes, rx_bytes, rtt_s) hook the
+        #: router uses to feed its fan-out flight recorder; called
+        #: outside the transport lock, after each completed request
+        self.on_wire = None
         self._lock = threading.Lock()
         self._conn: Optional[http.client.HTTPConnection] = None
         self._port = _free_port()
@@ -530,9 +569,21 @@ class SubprocessTransport:
     # -- wire ---------------------------------------------------------------
     def _request(self, method: str, path: str,
                  body: Optional[dict] = None, timeout: float = 60.0,
-                 mark_down: bool = True) -> Any:
+                 mark_down: bool = True, as_text: bool = False) -> Any:
         payload = (json.dumps(body).encode("utf-8")
                    if body is not None else None)
+        headers = {"Content-Type": "application/json"} \
+            if payload is not None else {}
+        ctx = trace_mod.TRACE_CONTEXT.get()
+        if ctx is not None:
+            # propagate the router's trace context so the worker tags
+            # its decision records and timeline spans with it
+            headers["X-Tpukube-Trace"] = \
+                f"{ctx.get('trace', '')}/{ctx.get('parent', '')}"
+        op = path.split("?", 1)[0].lstrip("/")
+        if op.startswith("worker/"):
+            op = op[len("worker/"):]
+        op = op.replace("/", "_")
         t0 = time.perf_counter()
         with self._lock:
             if self.down:
@@ -552,8 +603,6 @@ class SubprocessTransport:
                     # heavy call (a 10k-node upsert, a 2k-pod plan)
                     # and read as replica death
                     conn.sock.settimeout(timeout)
-                headers = {"Content-Type": "application/json"} \
-                    if payload is not None else {}
                 conn.request(method, path, body=payload, headers=headers)
                 resp = conn.getresponse()
                 raw = resp.read()
@@ -570,11 +619,25 @@ class SubprocessTransport:
             self.rtt_window.append(dt)
             self.rtt_sum += dt
             self.rtt_count += 1
+            tx, rx = len(payload or b""), len(raw)
+            self.wire_tx += tx
+            self.wire_rx += rx
+            cell = self.wire_by_op.get(op)
+            if cell is None:
+                cell = self.wire_by_op[op] = \
+                    {"tx": 0, "rx": 0, "calls": 0}
+            cell["tx"] += tx
+            cell["rx"] += rx
+            cell["calls"] += 1
+        if self.on_wire is not None:
+            self.on_wire(self.index, op, tx, rx, dt)
         if resp.status >= 400:
             raise ShardError(
                 f"replica r{self.index} {path}: HTTP {resp.status}: "
                 f"{raw.decode(errors='replace')[:200]}"
             )
+        if as_text:
+            return raw.decode("utf-8", errors="replace")
         return json.loads(raw) if raw else None
 
     def _mark_down_locked(self, err: Exception) -> None:
@@ -746,6 +809,50 @@ class SubprocessTransport:
         self._request("POST", "/worker/emit", {
             "reason": reason, "obj": obj, "message": message, **kwargs,
         })
+
+    # federated observability -----------------------------------------------
+    def explain(self, pod_key: str) -> Optional[dict[str, Any]]:
+        from urllib.parse import quote
+
+        try:
+            return self._request(
+                "GET", f"/explain?pod={quote(pod_key, safe='')}")
+        except ShardError:
+            return None  # provenance disabled on the worker (404)
+
+    def events_query(self, reason=None, pod=None, node=None,
+                     since=None) -> list[dict[str, Any]]:
+        from urllib.parse import urlencode
+
+        q = {k: v for k, v in (("reason", reason), ("pod", pod),
+                               ("node", node), ("since", since))
+             if v is not None}
+        path = "/events" + (f"?{urlencode(q)}" if q else "")
+        return self._request("GET", path) or []
+
+    def metrics_text(self) -> str:
+        return self._request("GET", "/metrics", as_text=True)
+
+    def statusz_doc(self) -> dict[str, Any]:
+        return self._request("GET", "/statusz")
+
+    def trace_events(self, since_seq: int = 0) -> list[dict[str, Any]]:
+        try:
+            return self._request(
+                "GET", f"/trace?since={since_seq}") or []
+        except ShardError:
+            return []  # tracing disabled on the worker (404)
+
+    def wire_snapshot(self) -> dict[str, Any]:
+        """Cumulative request/response byte counters, total and per op
+        — the baseline the ROADMAP codec item will be judged against."""
+        with self._lock:
+            return {
+                "tx": self.wire_tx,
+                "rx": self.wire_rx,
+                "by_op": {op: dict(c)
+                          for op, c in self.wire_by_op.items()},
+            }
 
     # lifecycle -------------------------------------------------------------
     def rebuild_from_pods(self, pods: list[dict[str, str]]) -> int:
@@ -1281,9 +1388,47 @@ class ShardRouter:
         self.cycle = (_RouterCycle(self)
                       if config.batch_enabled else None)
         self.events = _MergedEvents(self)
-        self.trace = None
         self.journal = None
-        self.decisions = None
+        # -- federated observability plane (ISSUE 16) -------------------
+        # Router-local trace spans (fan-out timing), route/spillover/
+        # rendezvous provenance, and the fan-out flight recorder exist
+        # ONLY when the router actually federates (N>1, or any
+        # subprocess topology). The N=1 in-process parity gate keeps
+        # the router invisible: trace/decisions stay None and the sole
+        # Extender's own surfaces serve verbatim (off-is-off — the
+        # byte-compat goldens hold).
+        self._trace_ids = None
+        self._flights: Optional[deque] = None
+        if self._sole is not None:
+            self.trace = None
+            self.decisions = None
+        else:
+            import itertools
+
+            self._trace_ids = itertools.count(1)
+            self.trace = (trace_mod.DecisionTrace(
+                capacity=config.trace_capacity,
+                path=(f"{config.trace_path}.router"
+                      if config.trace_path else None),
+                max_sink_bytes=config.trace_sink_max_bytes,
+            ) if config.trace_capacity > 0 else None)
+            from tpukube.obs.decisions import DecisionLog
+
+            self.decisions = (DecisionLog(
+                capacity=config.decisions_capacity,
+                sample_rate=config.decisions_sample_rate,
+                seed=config.decisions_seed,
+                path=(f"{config.decisions_path}.router"
+                      if config.decisions_path else None),
+                max_sink_bytes=config.decisions_sink_max_bytes,
+            ) if config.decisions_enabled else None)
+            # bounded ring of recent fan-out requests with sizes and
+            # RTTs (/statusz "flights" section) — fed by the subprocess
+            # transports' on_wire hook; stays empty in-process
+            self._flights = deque(maxlen=256)
+            for rep in self.replicas:
+                if rep.transport.mode == "subprocess":
+                    rep.transport.on_wire = self._record_flight
 
     def _make_transport(self, index: int, rcfg: TpuKubeConfig,
                         fake_clock: bool):
@@ -1331,6 +1476,19 @@ class ShardRouter:
         already recorded by the transport's ``on_down``."""
         out: dict[int, Any] = {}
         if self._pool is not None and len(reps) > 1:
+            ctx = trace_mod.TRACE_CONTEXT.get()
+            if ctx is not None:
+                # ThreadPoolExecutor does not propagate contextvars:
+                # re-set the trace context inside each pooled call so
+                # the transport stamps the X-Tpukube-Trace header
+                inner = fn
+
+                def fn(rep, _inner=inner, _ctx=ctx):
+                    tok = trace_mod.TRACE_CONTEXT.set(_ctx)
+                    try:
+                        return _inner(rep)
+                    finally:
+                        trace_mod.TRACE_CONTEXT.reset(tok)
             with self._lock:
                 self._inflight += 1
             try:
@@ -1351,6 +1509,178 @@ class ShardRouter:
             except ReplicaUnavailable:
                 continue
         return out
+
+    # -- federated observability helpers ------------------------------------
+    def _traced(self, op: str, pod_key: str = "", **fields):
+        """Context manager around one fanned operation: allocates a
+        trace id, exposes it through ``TRACE_CONTEXT`` (the transport
+        stamps it on every request it carries; the workers tag their
+        records with it), and records one router span with explicit
+        wall-clock bounds on exit — the enclosing slice the merged
+        timeline nests worker spans under. A no-op object when router
+        tracing is off (N=1 in-process, or trace_capacity 0)."""
+        from contextlib import contextmanager
+
+        @contextmanager
+        def _span():
+            if self.trace is None or self._trace_ids is None:
+                yield
+                return
+            cur = trace_mod.TRACE_CONTEXT.get()
+            if cur is not None:
+                # nested fan-out (e.g. the sweep inside a gang route):
+                # stay on the enclosing trace, allocate a child span
+                tid = cur["trace"]
+                sid = f"{tid}.{next(self._trace_ids)}"
+            else:
+                tid = f"t{next(self._trace_ids)}"
+                sid = f"{tid}.0"
+            tok = trace_mod.TRACE_CONTEXT.set(
+                {"trace": tid, "parent": sid})
+            t0 = time.time()
+            try:
+                yield
+            finally:
+                trace_mod.TRACE_CONTEXT.reset(tok)
+                self.trace.span(op, pod_key, trace=tid, span=sid,
+                                t0=t0, t1=time.time(), **fields)
+
+        return _span()
+
+    def _decide(self, pod_key: str, stage: str, **fields) -> None:
+        """Record one router-side provenance stage (route / spillover /
+        rendezvous) when provenance is on and the pod is sampled."""
+        dlog = self.decisions
+        if dlog is not None and dlog.wants(pod_key):
+            dlog.record(pod_key, stage, replica_source="router",
+                        **fields)
+
+    def _record_flight(self, idx: int, op: str, tx: int, rx: int,
+                       dt: float) -> None:
+        """The subprocess transports' on_wire hook: one bounded ring
+        entry per completed request (sizes + RTT) — the /statusz
+        flight recorder. Lock-free (one atomic deque append)."""
+        flights = self._flights
+        if flights is not None:
+            flights.append({
+                "ts": round(time.time(), 3),
+                "replica": f"r{idx}",
+                "op": op,
+                "tx_bytes": tx,
+                "rx_bytes": rx,
+                "rtt_ms": round(dt * 1000.0, 3),
+            })
+
+    def flights_snapshot(self, limit: int = 64) -> list[dict[str, Any]]:
+        """Most recent fan-out requests, oldest first."""
+        if self._flights is None:
+            return []
+        for _ in range(5):
+            try:
+                out = list(self._flights)
+                break
+            except RuntimeError:  # deque mutated mid-iteration
+                continue
+        else:
+            out = []
+        return out[-limit:]
+
+    def wire_totals(self) -> dict[str, Any]:
+        """Cumulative wire bytes across every replica transport (zeros
+        in-process — direct dispatch moves no bytes): the bytes-per-
+        churn-wave numerator on the driver surface, and the baseline
+        the ROADMAP codec item is judged against."""
+        tx = rx = 0
+        by_op: dict[str, dict[str, int]] = {}
+        per_replica: dict[str, dict[str, int]] = {}
+        for rep in self.replicas:
+            snap = rep.transport.wire_snapshot() \
+                if hasattr(rep.transport, "wire_snapshot") else None
+            if not snap:
+                continue
+            tx += snap["tx"]
+            rx += snap["rx"]
+            per_replica[rep.name] = {"tx": snap["tx"], "rx": snap["rx"]}
+            for op, cell in snap["by_op"].items():
+                agg = by_op.setdefault(
+                    op, {"tx": 0, "rx": 0, "calls": 0})
+                for k in ("tx", "rx", "calls"):
+                    agg[k] += cell[k]
+        return {"tx": tx, "rx": rx, "total": tx + rx,
+                "per_replica": per_replica, "by_op": by_op}
+
+    def explain(self, pod_key: str) -> Optional[dict[str, Any]]:
+        """Stitched federated /explain: the router's own route /
+        spillover / rendezvous stages (including the gang pseudo-key
+        chain when the pod belongs to a DCN gang) merged with every
+        alive replica's local chain for the pod, rendered as ONE
+        document — a DCN gang member's explain names both replicas and
+        the rendezvous verdict. N=1 delegates to the sole planner's
+        log verbatim (off-is-off)."""
+        from tpukube.obs.decisions import explain_doc, merge_stage_events
+
+        if self._sole is not None:
+            dlog = self._sole.decisions
+            return dlog.explain(pod_key) if dlog is not None else None
+        if "/" not in pod_key:
+            pod_key = f"default/{pod_key}"
+        groups: list[tuple[str, list[dict[str, Any]]]] = []
+        if self.decisions is not None:
+            router_evs = [dict(ev)
+                          for ev in self.decisions.events(pod=pod_key)]
+            # the gang's own rendezvous chain lives under its
+            # pseudo-key (gang:<ns>/<name>) so EVERY member can pull
+            # it — re-key those events onto the asked pod
+            gangs = sorted({ev["gang"] for ev in router_evs
+                            if ev.get("gang")})
+            for gang in gangs:
+                for ev in self.decisions.events(pod=f"gang:{gang}"):
+                    ev = dict(ev)
+                    ev["pod"] = pod_key
+                    router_evs.append(ev)
+            if router_evs:
+                groups.append(("router", router_evs))
+        fanned = self._fan_out(
+            self._alive(), lambda rep: rep.transport.explain(pod_key)
+        )
+        for idx in sorted(fanned):
+            doc = fanned[idx]
+            if doc and doc.get("stages"):
+                groups.append(
+                    (self.replicas[idx].name, doc["stages"]))
+        if not groups:
+            return None
+        return explain_doc(merge_stage_events(groups), pod_key)
+
+    def events_federated(self, reason=None, pod=None, node=None,
+                         since=None, replica=None,
+                         limit: Optional[int] = None
+                         ) -> list[dict[str, Any]]:
+        """Merged event journals across the replica set, every event
+        stamped with its source replica, wall-clock ordered — the
+        router /events surface and `tpukube-obs events --replica`
+        feed."""
+        rows: list[dict[str, Any]] = []
+        fanned = self._fan_out(
+            self._alive(),
+            lambda rep: rep.transport.events_query(
+                reason=reason, pod=pod, node=node, since=since),
+        )
+        for idx in sorted(fanned):
+            name = self.replicas[idx].name
+            for ev in fanned[idx] or []:
+                if not isinstance(ev, dict):
+                    continue
+                ev = dict(ev)
+                ev.setdefault("replica", name)
+                rows.append(ev)
+        if replica is not None:
+            rows = [e for e in rows if e.get("replica") == replica]
+        rows.sort(key=lambda e: (float(e.get("ts", 0.0)),
+                                 str(e.get("replica", ""))))
+        if limit is not None:
+            rows = rows[-limit:]
+        return rows
 
     # -- Extender-surface passthroughs --------------------------------------
     @property
@@ -1510,6 +1840,19 @@ class ShardRouter:
                     "snapshot_hits": summary["snapshot_hits"],
                     "snapshot_rebuilds": summary["snapshot_rebuilds"],
                 })
+            if self._sole is None and summary is not None:
+                # federated per-replica observability sections: each
+                # worker's decisions ring / event journal / journal
+                # stats, attributed by replica (a dead daemon's row
+                # stays liveness-only above)
+                try:
+                    zdoc = rep.transport.statusz_doc()
+                except (ReplicaUnavailable, ShardError):
+                    zdoc = None
+                if zdoc is not None:
+                    row["decisions"] = zdoc.get("decisions")
+                    row["events"] = zdoc.get("events")
+                    row["journal"] = zdoc.get("journal")
             per_replica.append(row)
         doc = {
             "replicas": per_replica,
@@ -1522,6 +1865,18 @@ class ShardRouter:
             },
             "transport": self.transport_statusz(),
         }
+        if self._sole is None:
+            # the router's OWN observability plane (absent under the
+            # N=1 in-process parity gate — off-is-off)
+            doc["router_obs"] = {
+                "trace": (self.trace.stats() if self.trace is not None
+                          else {"enabled": False}),
+                "decisions": (self.decisions.stats()
+                              if self.decisions is not None
+                              else {"enabled": False}),
+            }
+            doc["wire"] = self.wire_totals()
+            doc["flights"] = self.flights_snapshot()
         return doc
 
     def transport_statusz(self) -> dict[str, Any]:
@@ -1542,6 +1897,7 @@ class ShardRouter:
         for rep in self.replicas:
             tr = rep.transport
             rtts = tr.rtt_snapshot()
+            wire = tr.wire_snapshot()
             rows.append({
                 "replica": rep.name,
                 "alive": rep.alive,
@@ -1550,6 +1906,8 @@ class ShardRouter:
                 "requests": tr.rtt_count,
                 "health_checks": tr.health_checks,
                 "health_failures": tr.health_failures,
+                "wire_tx_bytes": wire["tx"] if wire else 0,
+                "wire_rx_bytes": wire["rx"] if wire else 0,
             })
         out["replicas"] = rows
         return out
@@ -1860,6 +2218,40 @@ class ShardRouter:
     def _prepare_rendezvous(
         self, pod: PodInfo, cpp: int, total: int
     ) -> Optional[_Rendezvous]:
+        assert pod.group is not None
+        key = (pod.namespace, pod.group.name)
+        with self._traced("rendezvous_prepare", pod.key(),
+                          gang=f"{key[0]}/{key[1]}"):
+            return self._prepare_rendezvous_inner(pod, cpp, total)
+
+    def _decide_rendezvous(self, pod_key: str, key: tuple[str, str],
+                           **fields) -> None:
+        """Record one rendezvous stage on the gang's own pseudo-key
+        (``gang:<ns>/<name>``) — the stitched /explain re-keys the gang
+        chain into EVERY member's answer, so recording it once covers
+        the triggering pod and the members that never touched the
+        prepare alike (a per-pod copy would render the verdict twice
+        for the trigger). ``pod_key`` stays in the signature as the
+        trigger attribution carried on the event itself."""
+        if self.decisions is None:
+            return
+        gang = f"{key[0]}/{key[1]}"
+        self.decisions.record(f"gang:{gang}", "rendezvous", gang=gang,
+                              replica_source="router",
+                              trigger=pod_key or None, **fields)
+
+    @staticmethod
+    def _rdv_parts_doc(replicas, parts) -> list[dict[str, Any]]:
+        return [
+            {"replica": replicas[i].name, "slice": sid,
+             "chips": len(coords)}
+            for i, p in sorted(parts.items())
+            for sid, coords in sorted(p.items())
+        ]
+
+    def _prepare_rendezvous_inner(
+        self, pod: PodInfo, cpp: int, total: int
+    ) -> Optional[_Rendezvous]:
         """Phases 1+2 of the rendezvous (see module docstring): plan
         per-replica contiguous parts greedily, PREPARE each part as a
         local reservation, and commit the rendezvous record — or abort
@@ -1931,6 +2323,9 @@ class ShardRouter:
                     key[0], key[1], rep.name, e, len(prepared),
                 )
                 self._abort_prepared(key, prepared)
+                self._decide_rendezvous(
+                    pod.key(), key, outcome="aborted",
+                    reason=f"prepare failed on {rep.name}")
                 if not isinstance(
                     e, (GangError, StateError, ReplicaUnavailable)
                 ):
@@ -1951,6 +2346,9 @@ class ShardRouter:
                 got_total, total,
             )
             self._abort_prepared(key, prepared)
+            self._decide_rendezvous(
+                pod.key(), key, outcome="aborted",
+                reason="gauges raced occupancy")
             return None
         rdv = _Rendezvous(key, parts, local_min,
                           created=self.clock.monotonic())
@@ -1970,6 +2368,9 @@ class ShardRouter:
             key[0], key[1], total,
             {self.replicas[i].name: sorted(p) for i, p in parts.items()},
         )
+        self._decide_rendezvous(
+            pod.key(), key, outcome="prepared", chips=total,
+            parts=self._rdv_parts_doc(self.replicas, parts))
         return rdv
 
     def _abort_prepared(self, key: tuple[str, str],
@@ -2007,10 +2408,11 @@ class ShardRouter:
             # pull the replica-local eviction queues — INCLUDING any
             # victims those sweeps just rolled back — onto the shared
             # bus
-            self.health_check()
-            self._fan_out(self._alive(),
-                          lambda rep: rep.transport.gang_sweep())
-            self.pull_evictions()
+            with self._traced("sweep"):
+                self.health_check()
+                self._fan_out(self._alive(),
+                              lambda rep: rep.transport.gang_sweep())
+                self.pull_evictions()
         aborted: list[tuple[str, str]] = []
         with self._lock:
             live = list(self._dcn.items())
@@ -2068,6 +2470,9 @@ class ShardRouter:
                 )
                 log.warning("rendezvous %s/%s aborted (part lost "
                             "pre-commit)", key[0], key[1])
+                self._decide_rendezvous(
+                    "", key, outcome="aborted",
+                    reason="part lost pre-commit")
             elif not held and rdv.committed and all(
                 self.replicas[idx].alive for idx in rdv.parts
             ):
@@ -2110,20 +2515,22 @@ class ShardRouter:
                 return 0
             self._health_checked_at = now
         failed = 0
-        for rep in self.replicas:
-            if not rep.alive:
-                continue
-            self.health_checks_total += 1
-            try:
-                ok = rep.transport.healthz()
-            except ReplicaUnavailable:
-                ok = False
-            if not ok:
-                failed += 1
-                self.health_failures_total += 1
-                log.error("replica %s failed its health check; marking "
-                          "dead (crash_replica semantics)", rep.name)
-                self._mark_replica_dead(rep.index)
+        with self._traced("health_check"):
+            for rep in self.replicas:
+                if not rep.alive:
+                    continue
+                self.health_checks_total += 1
+                try:
+                    ok = rep.transport.healthz()
+                except ReplicaUnavailable:
+                    ok = False
+                if not ok:
+                    failed += 1
+                    self.health_failures_total += 1
+                    log.error("replica %s failed its health check; "
+                              "marking dead (crash_replica semantics)",
+                              rep.name)
+                    self._mark_replica_dead(rep.index)
         return failed
 
     def _mark_replica_dead(self, idx: int) -> None:
@@ -2246,12 +2653,13 @@ class ShardRouter:
                 }
                 continue
             order.setdefault(idx, []).append(pos)
-        out = self._fan_out(
-            [self.replicas[i] for i in order],
-            lambda rep: rep.transport.upsert_nodes(
-                [items[p] for p in order[rep.index]]
-            ),
-        )
+        with self._traced("upsert_nodes", nodes=len(items)):
+            out = self._fan_out(
+                [self.replicas[i] for i in order],
+                lambda rep: rep.transport.upsert_nodes(
+                    [items[p] for p in order[rep.index]]
+                ),
+            )
         for idx, positions in order.items():
             per = out.get(idx)
             for j, pos in enumerate(positions):
@@ -2272,17 +2680,20 @@ class ShardRouter:
             [self.replicas[idx]] if idx is not None
             else list(self.replicas)
         )
-        for rep in targets:
-            if not rep.alive:
-                # a dead replica's release is lost exactly like a real
-                # crashed daemon's: the restart rebuild (killed) or the
-                # post-heal lifecycle resync (partitioned) re-converges
-                # against the pod store
-                continue
-            try:
-                rep.transport.handle("release", {"pod_key": pod_key})
-            except ReplicaUnavailable:
-                continue  # died mid-release: same lost-release contract
+        with self._traced("release", pod_key):
+            for rep in targets:
+                if not rep.alive:
+                    # a dead replica's release is lost exactly like a
+                    # real crashed daemon's: the restart rebuild
+                    # (killed) or the post-heal lifecycle resync
+                    # (partitioned) re-converges against the pod store
+                    continue
+                try:
+                    rep.transport.handle("release",
+                                         {"pod_key": pod_key})
+                except ReplicaUnavailable:
+                    continue  # died mid-release: same lost-release
+                    # contract
         with self._lock:
             self._alloc_cache.pop(pod_key, None)
         return None
@@ -2343,14 +2754,15 @@ class ShardRouter:
             if nodes is not None:
                 return kube.filter_result(list(nodes), {})
             return kube.filter_result_names(list(names or []), {})
-        if pod.group is not None:
-            idx = self._route_gang(pod)
-        else:
-            with self._lock:
-                idx = self._pod_replica.get(pod.key())
-            if idx is None or not self.replicas[idx].alive:
-                idx = self._pick_pod_replica(pod.key())
-        return self._score_on(kind, body, pod, parts, idx)
+        with self._traced(kind, pod.key()):
+            if pod.group is not None:
+                idx = self._route_gang(pod)
+            else:
+                with self._lock:
+                    idx = self._pod_replica.get(pod.key())
+                if idx is None or not self.replicas[idx].alive:
+                    idx = self._pick_pod_replica(pod.key())
+            return self._score_on(kind, body, pod, parts, idx)
 
     @staticmethod
     def _sub_body(body: Any, parts: Optional[dict[int, list]],
@@ -2406,6 +2818,20 @@ class ShardRouter:
                 with self._lock:
                     self._pod_replica[pod.key()] = i
                 rep.pods_routed += 1
+                if i == idx:
+                    self._decide(
+                        pod.key(), "route", replica=rep.name,
+                        feasible=len(feasible_names),
+                        **({"gang": f"{pod.namespace}/{pod.group.name}"}
+                           if pod.group is not None else {}),
+                    )
+                else:
+                    self._decide(
+                        pod.key(), "spillover",
+                        primary=self.replicas[idx].name,
+                        replica=rep.name,
+                        feasible=len(feasible_names),
+                    )
                 return out
         if last_out is not None:
             return last_out
@@ -2482,13 +2908,14 @@ class ShardRouter:
         key, idx, err = self._bind_target(body)
         if err is not None:
             return err
-        try:
-            out = self.replicas[idx].transport.handle("bind", body)
-        except ReplicaUnavailable:
-            return kube.binding_result(
-                f"{key}: replica {self.replicas[idx].name} died "
-                f"mid-bind; scheduler will retry"
-            )
+        with self._traced("bind", key):
+            try:
+                out = self.replicas[idx].transport.handle("bind", body)
+            except ReplicaUnavailable:
+                return kube.binding_result(
+                    f"{key}: replica {self.replicas[idx].name} died "
+                    f"mid-bind; scheduler will retry"
+                )
         return self._after_bind(key, idx, out)
 
     def bind_many(self, bodies: list[dict]) -> list[dict]:
@@ -2511,12 +2938,13 @@ class ShardRouter:
                 results[pos] = err
                 continue
             order.setdefault(idx, []).append(pos)
-        out = self._fan_out(
-            [self.replicas[i] for i in order],
-            lambda rep: rep.transport.bind_many(
-                [bodies[p] for p in order[rep.index]]
-            ),
-        )
+        with self._traced("bind_many", pods=len(bodies)):
+            out = self._fan_out(
+                [self.replicas[i] for i in order],
+                lambda rep: rep.transport.bind_many(
+                    [bodies[p] for p in order[rep.index]]
+                ),
+            )
         for idx, positions in order.items():
             per = out.get(idx)
             for j, pos in enumerate(positions):
@@ -2552,6 +2980,9 @@ class ShardRouter:
             message=(f"rendezvous committed: all {len(rdv.parts)} "
                      f"parts assembled"),
         )
+        self._decide_rendezvous(
+            "", rdv.key, outcome="committed",
+            parts=self._rdv_parts_doc(self.replicas, rdv.parts))
 
     def _globalize_gang_env(self, out: dict, rdv: _Rendezvous) -> None:
         """A rendezvous member's bind answer carries the TPU_KUBE_GANG_*
@@ -2625,17 +3056,18 @@ class ShardRouter:
             return [self._sole.admit(p) for p in pods]
         results: list[bool] = [False] * len(pods)
         order: dict[int, list[int]] = {}
-        for pos, pod in enumerate(pods):
-            idx = self._route_pod(pod)
-            if not self.replicas[idx].alive:
-                continue
-            order.setdefault(idx, []).append(pos)
-        out = self._fan_out(
-            [self.replicas[i] for i in order],
-            lambda rep: rep.transport.admit_many(
-                [pods[p] for p in order[rep.index]]
-            ),
-        )
+        with self._traced("admit_many", pods=len(pods)):
+            for pos, pod in enumerate(pods):
+                idx = self._route_pod(pod)
+                if not self.replicas[idx].alive:
+                    continue
+                order.setdefault(idx, []).append(pos)
+            out = self._fan_out(
+                [self.replicas[i] for i in order],
+                lambda rep: rep.transport.admit_many(
+                    [pods[p] for p in order[rep.index]]
+                ),
+            )
         for idx, positions in order.items():
             per = out.get(idx)
             if per is None:
@@ -2648,6 +3080,8 @@ class ShardRouter:
                     with self._lock:
                         self._pod_replica[pods[pos].key()] = idx
                     rep.pods_routed += 1
+                    self._decide(pods[pos].key(), "route",
+                                 replica=rep.name)
         return results
 
     def plan_pending(self) -> int:
@@ -2658,9 +3092,10 @@ class ShardRouter:
         if self._sole is not None:
             return self._sole.plan_pending()
         self.sweep()
-        out = self._fan_out(
-            self._alive(), lambda rep: rep.transport.plan_pending()
-        )
+        with self._traced("plan_pending"):
+            out = self._fan_out(
+                self._alive(), lambda rep: rep.transport.plan_pending()
+            )
         return sum(out.values())
 
     def _planned_miss(self, pod_key: str, idx: int) -> None:
@@ -3140,3 +3575,7 @@ class ShardRouter:
             rep.transport.close()
         if self._pool is not None:
             self._pool.shutdown(wait=False)
+        if self.trace is not None:
+            self.trace.close()
+        if self.decisions is not None:
+            self.decisions.close()

@@ -36,6 +36,7 @@ from typing import Any, Optional
 
 from aiohttp import web
 
+from tpukube import trace as trace_mod
 from tpukube.core import codec
 from tpukube.sched import kube, shard
 from tpukube.sched.extender import Extender, make_app
@@ -54,6 +55,29 @@ def make_worker_app(extender: Extender, clock=None) -> web.Application:
     """The worker daemon's app: the full extender webhook surface plus
     the /worker/* transport routes."""
     app = make_app(extender, client_max_size=CLIENT_MAX_SIZE)
+
+    @web.middleware
+    async def trace_context_mw(request: web.Request, handler):
+        # the router stamps X-Tpukube-Trace: <trace>/<parent span> on
+        # every fanned request; expose it through the TRACE_CONTEXT
+        # contextvar for the request's duration so the replica-local
+        # DecisionTrace / DecisionLog records tag themselves with the
+        # router's trace — the join key the merged timeline and the
+        # stitched /explain use. No header (an unsharded deployment, a
+        # kubelet probe) → the contextvar stays None and the records
+        # are byte-identical to the unsharded ones (off-is-off).
+        hdr = request.headers.get("X-Tpukube-Trace")
+        if not hdr:
+            return await handler(request)
+        trace_id, _, parent = hdr.partition("/")
+        tok = trace_mod.TRACE_CONTEXT.set(
+            {"trace": trace_id, "parent": parent})
+        try:
+            return await handler(request)
+        finally:
+            trace_mod.TRACE_CONTEXT.reset(tok)
+
+    app.middlewares.append(trace_context_mw)
 
     async def _json(request: web.Request) -> Any:
         try:
@@ -297,6 +321,78 @@ def make_worker_app(extender: Extender, clock=None) -> web.Application:
     app.router.add_post("/worker/evictions", evictions)
     app.router.add_post("/worker/advance", advance)
     app.router.add_post("/worker/stall", stall)
+    return app
+
+
+def make_router_app(router) -> web.Application:
+    """The router's federated observability listener (ISSUE 16): the
+    aggregation half of the sharded control plane. /metrics renders
+    every worker registry merged under a ``replica`` label plus the
+    router-local series; /explain stitches the router's own
+    route/spillover/rendezvous stages with the owning replicas'
+    chains; /events merges the worker journals with replica
+    attribution; /statusz carries the wire bill and the flight
+    recorder. Webhook traffic does NOT flow here — this listener is
+    observability-only (serve it with
+    :func:`tpukube.sched.extender.run_probe_server`). The fan-outs
+    behind these routes are blocking HTTP round-trips, so every
+    handler hops to a thread: a slow replica must not stall the
+    listener's own /healthz."""
+    import asyncio
+
+    app = web.Application()
+
+    async def healthz(request: web.Request) -> web.Response:
+        return web.Response(text="ok")
+
+    async def metrics(request: web.Request) -> web.Response:
+        from tpukube.metrics import render_federated_metrics
+
+        text = await asyncio.to_thread(render_federated_metrics, router)
+        return web.Response(text=text, content_type="text/plain")
+
+    async def statusz(request: web.Request) -> web.Response:
+        from tpukube.obs.statusz import router_statusz
+
+        return web.json_response(
+            await asyncio.to_thread(router_statusz, router))
+
+    async def explain(request: web.Request) -> web.Response:
+        pod = request.query.get("pod", "")
+        if not pod:
+            raise web.HTTPBadRequest(text="missing ?pod=<ns/name>")
+        doc = await asyncio.to_thread(router.explain, pod)
+        if doc is None:
+            raise web.HTTPNotFound(
+                text="decision provenance is disabled "
+                     "(decisions_enabled: false)")
+        return web.json_response(doc)
+
+    async def events(request: web.Request) -> web.Response:
+        q = request.query
+        since = q.get("since")
+        rows = await asyncio.to_thread(
+            lambda: router.events_federated(
+                reason=q.get("reason"), pod=q.get("pod"),
+                node=q.get("node"),
+                since=float(since) if since else None,
+                replica=q.get("replica"),
+            )
+        )
+        return web.json_response(rows)
+
+    async def trace_route(request: web.Request) -> web.Response:
+        if router.trace is None:
+            raise web.HTTPNotFound(text="router tracing disabled")
+        since = int(request.query.get("since", 0))
+        return web.json_response(router.trace.events(since_seq=since))
+
+    app.router.add_get("/healthz", healthz)
+    app.router.add_get("/metrics", metrics)
+    app.router.add_get("/statusz", statusz)
+    app.router.add_get("/explain", explain)
+    app.router.add_get("/events", events)
+    app.router.add_get("/trace", trace_route)
     return app
 
 

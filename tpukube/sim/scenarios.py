@@ -858,7 +858,9 @@ def _kilonode_drive(cfg: TpuKubeConfig, metric: str, total_target: int,
         wave = min(cfg.batch_max_pods, capacity // 2)
         alive: _deque[str] = _deque()
         seq = 0
+        waves = 0
         while scheduled < total_target:
+            waves += 1
             room = capacity - len(alive)
             n = min(wave, room, total_target - scheduled)
             if n > 0:
@@ -940,6 +942,26 @@ def _kilonode_drive(cfg: TpuKubeConfig, metric: str, total_target: int,
                 # process mode: transport RTTs + health-check counters
                 # ride the result (ISSUE 14)
                 "transport": doc["transport"],
+            }
+        wire_fn = getattr(ext, "wire_totals", None)
+        if wire_fn is not None:
+            # federated wire-cost accounting (ISSUE 16): the transport
+            # byte bill normalized per churn wave — the measured
+            # baseline the ROADMAP codec item is judged against (all
+            # zeros over the in-process transport, which moves no
+            # bytes)
+            wt = wire_fn()
+            top = sorted(wt["by_op"].items(),
+                         key=lambda kv: -(kv[1]["tx"] + kv[1]["rx"]))
+            result["wire"] = {
+                "tx_bytes": wt["tx"],
+                "rx_bytes": wt["rx"],
+                "total_bytes": wt["total"],
+                "waves": waves,
+                "bytes_per_wave": (round(wt["total"] / waves, 1)
+                                   if waves else 0.0),
+                "per_replica": wt["per_replica"],
+                "top_ops": dict(top[:8]),
             }
         if ext.decisions is not None:
             # the measured-overhead guard (ISSUE 12): provenance's

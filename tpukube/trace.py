@@ -20,6 +20,7 @@ stream to a JSONL file sink for post-mortem replay across restarts.
 
 from __future__ import annotations
 
+import contextvars
 import io
 import json
 import logging
@@ -29,6 +30,19 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable, Optional
+
+#: Cross-process trace context (the federated observability plane). The
+#: shard router stamps a trace id + parent span id on every fanned call
+#: (``X-Tpukube-Trace: <trace>/<span>``); the worker's HTTP layer sets
+#: this contextvar for the request task, and ``record()`` below tags
+#: events with it so ``tpukube-obs timeline --merge`` can nest worker
+#: spans under the router's fan-out spans. A contextvar (not a thread
+#: local): the worker handles requests on asyncio tasks, where
+#: concurrent requests share one thread but never one context. Unset
+#: (the None default) means no tagging at all — the N=1 in-process
+#: event stream stays byte-identical to the pre-federation captures.
+TRACE_CONTEXT: contextvars.ContextVar[Optional[dict]] = \
+    contextvars.ContextVar("tpukube_trace_context", default=None)
 
 # Event kinds. filter/prioritize/bind carry the webhook request/response
 # verbatim; release carries the pod key (the apiserver-side pod deletion
@@ -193,6 +207,7 @@ class DecisionTrace:
 
     def record(self, kind: str, request: Any, response: Any) -> dict:
         assert kind in KINDS or kind in ANNOTATION_KINDS, kind
+        ctx = TRACE_CONTEXT.get()
         with self._lock:
             self._seq += 1
             ev = {
@@ -202,6 +217,12 @@ class DecisionTrace:
                 "request": request,
                 "response": response,
             }
+            if ctx is not None:
+                # router-originated request: tag the event so merged
+                # timelines can parent this decision under the fan-out
+                # span (absent entirely outside sharded mode — replay
+                # ignores it, goldens never see it)
+                ev["ctx"] = dict(ctx)
             self._events.append(ev)
             if self._sink is not None:
                 # enqueue under the ring lock so sink order IS seq order
