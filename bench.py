@@ -275,10 +275,23 @@ def kilonode10k() -> dict:
     (scenario 12) — throughput with the incremental snapshot + fast-
     state maintenance, plus the delta-apply vs forced-rebuild p50s.
     ``TPUKUBE_KILONODE10K_PODS`` scales it (default 40000; check.sh
-    smoke uses a shorter fixed trace)."""
+    smoke uses a shorter fixed trace). Runs with the capacity flight
+    recorder ON (ISSUE 17) so the ``capacity`` key reports the
+    measured recorder overhead and the stranded-chip baseline the
+    defrag work inherits."""
+    import os
+
     from tpukube.sim import scenarios
 
-    r = scenarios.run(12)
+    saved = os.environ.get("TPUKUBE_CAPACITY_ENABLED")
+    os.environ["TPUKUBE_CAPACITY_ENABLED"] = saved or "1"
+    try:
+        r = scenarios.run(12)
+    finally:
+        if saved is None:
+            del os.environ["TPUKUBE_CAPACITY_ENABLED"]
+    cap = r.get("capacity") or {}
+    stranded = r.get("stranded") or {}
     return {
         "nodes": r["nodes"],
         "chips": r["chips"],
@@ -294,6 +307,12 @@ def kilonode10k() -> dict:
         "gang_batches": r["cycle"]["gang_batches"],
         "snapshot": r["snapshot"],
         "utilization_percent": r["utilization_percent"],
+        "capacity": {
+            "overhead_pct": cap.get("overhead_pct"),
+            "samples": cap.get("samples"),
+            "stranded_chips": stranded.get("chips_requested", 0),
+            "recoverable_chips": stranded.get("recoverable_chips", 0),
+        },
     }
 
 

@@ -1,0 +1,626 @@
+"""ISSUE 17: capacity analytics & demand forensics plane —
+flight recorder, stranded-demand root-causing, what-if probes.
+
+The acceptance gates covered here:
+  * the bounded flight recorder (ring capacity, JSONL sink rotation,
+    FakeClock-compressed sampling cadence);
+  * the stranded-demand taxonomy — one test per reason, including the
+    fragmented-vs-capacity disambiguation on a hand-built torus where
+    chips are free but no contiguous box exists;
+  * what-if probe answers agree with the real planner's verdict on the
+    same fleet (probe says fits ⇔ scheduling succeeds);
+  * off-is-off: with ``capacity_enabled`` false (the default) nothing
+    capacity-shaped reaches /metrics or /statusz, and the only series
+    a capacity-on run adds are the declared capacity family;
+  * federated merge: per-replica attribution survives the stitch and a
+    dead replica degrades loudly (``dead_replicas``), never silently.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import pytest
+
+from tpukube.core.clock import FakeClock
+from tpukube.core.config import load_config
+from tpukube.core.mesh import MeshSpec
+from tpukube.core.types import PodGroup
+from tpukube.metrics import render_extender_metrics
+from tpukube.obs.capacity import (
+    UNSCHEDULABLE_REASONS,
+    format_capacity,
+    merge_capacity_docs,
+    merge_probe_docs,
+    parse_duration,
+    parse_shape,
+    parse_since,
+)
+from tpukube.obs.slo import parse_metrics
+from tpukube.obs.statusz import extender_statusz
+from tpukube.sched import kube
+from tpukube.sim.harness import SimCluster
+
+
+def cap_config(**extra: str):
+    return load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,2",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        "TPUKUBE_CAPACITY_ENABLED": "1",
+        **extra,
+    })
+
+
+def _info(c: SimCluster, name: str, tpu: int = 1, group=None):
+    """A PodInfo for the forensics seam, built through the same k8s
+    conversion the webhook path uses."""
+    return kube.pod_from_k8s(c.make_pod(name, tpu=tpu, group=group))
+
+
+def _fragment(c: SimCluster) -> None:
+    """Fill the 32-chip mesh with 1-chip pods, then complete every pod
+    on an even x-plane: 16 chips free but the largest contiguous box is
+    the 8-chip 1x4x2 plane — free ≠ placeable, the repack signal."""
+    placed = {}
+    for i in range(32):
+        _, alloc = c.schedule(c.make_pod(f"fill-{i}", tpu=1))
+        placed[f"fill-{i}"] = alloc
+    for name, alloc in placed.items():
+        if alloc.coords[0][0] % 2 == 0:
+            c.complete_pod(name)
+
+
+# -- duration / shape parsers (the shared --since seam) ----------------------
+
+def test_parse_duration_suffixes_and_bare_floats():
+    assert parse_duration("90") == 90.0
+    assert parse_duration("90s") == 90.0
+    assert parse_duration("15m") == 900.0
+    assert parse_duration("2h") == 7200.0
+    assert parse_duration("1d") == 86400.0
+    assert parse_duration(" 1.5h ") == 5400.0
+    assert parse_since("15m") == parse_duration("15m")
+    for junk in ("", "m", "abc", "15q", "h2"):
+        with pytest.raises(ValueError):
+            parse_duration(junk)
+
+
+def test_parse_shape():
+    assert parse_shape("4x4x4") == (4, 4, 4)
+    assert parse_shape("1X2x3") == (1, 2, 3)
+    for junk in ("4x4", "4x4x4x4", "0x1x1", "axbxc"):
+        with pytest.raises(ValueError):
+            parse_shape(junk)
+
+
+def test_cli_since_arg_wraps_parse_errors():
+    from tpukube.cli import _since_arg
+
+    assert _since_arg("15m") == 900.0
+    assert _since_arg("42") == 42.0
+    with pytest.raises(argparse.ArgumentTypeError):
+        _since_arg("soon")
+
+
+# -- flight recorder ---------------------------------------------------------
+
+def test_ring_bounds_hold_under_overflow():
+    cfg = cap_config(TPUKUBE_CAPACITY_SAMPLES="4")
+    with SimCluster(cfg, clock=FakeClock()) as c:
+        cap = c.extender.capacity
+        assert cap is not None
+        c.schedule(c.make_pod("a", tpu=1))
+        base = cap.samples_taken  # handle() itself may have sampled
+        for _ in range(10):
+            cap.sample_now()
+        assert cap.samples_taken == base + 10
+        assert len(cap.ring) == 4 == cap.stats()["ring"]
+        # the ring keeps the NEWEST samples, ordered
+        clocks = [s["clock"] for s in cap.samples()]
+        assert clocks == sorted(clocks)
+        s = cap.samples()[-1]
+        assert s["fleet"]["chips"] == 32
+        assert s["fleet"]["free_chips"] == 31
+
+
+def test_sink_rotation_caps_the_capture(tmp_path):
+    path = str(tmp_path / "capacity.jsonl")
+    cfg = cap_config(TPUKUBE_CAPACITY_PATH=path,
+                     TPUKUBE_CAPACITY_SINK_MAX_BYTES="4096")
+    with SimCluster(cfg, clock=FakeClock()) as c:
+        cap = c.extender.capacity
+        for _ in range(50):
+            cap.sample_now()
+        cap.close()
+        stats = cap.stats()["sink"]
+        assert stats["path"] == path
+        assert stats["rotations"] >= 1
+        assert os.path.getsize(path) <= 4096
+        assert os.path.exists(path + ".1")
+        # every surviving line is a whole JSON sample — rotation must
+        # never split or concatenate lines
+        lines = open(path).read().splitlines()
+        assert lines
+        for line in lines:
+            assert "fleet" in json.loads(line)
+
+
+def test_fake_clock_sampling_cadence():
+    """maybe_sample rides the SCHEDULING clock: repeated calls inside
+    one interval take one sample; advancing the FakeClock unlocks the
+    next — hours of cadence compress wall-free."""
+    clock = FakeClock()
+    with SimCluster(cap_config(), clock=clock) as c:
+        cap = c.extender.capacity
+        for _ in range(5):
+            cap.maybe_sample()
+        assert cap.samples_taken == 1
+        clock.advance(29.0)  # default interval is 30s
+        cap.maybe_sample()
+        assert cap.samples_taken == 1
+        clock.advance(1.0)
+        cap.maybe_sample()
+        assert cap.samples_taken == 2
+        for h in range(4):
+            clock.advance(3600.0)
+            cap.maybe_sample()
+        assert cap.samples_taken == 6
+
+
+def test_samples_since_window_clips_by_wall_ts():
+    with SimCluster(cap_config(), clock=FakeClock()) as c:
+        cap = c.extender.capacity
+        for _ in range(3):
+            cap.sample_now()
+        cut = cap.samples()[1]["ts"]
+        assert len(cap.samples(since=cut)) == 2
+        assert cap.samples(since=cut + 10.0) == []
+
+
+# -- stranded-demand forensics: the taxonomy ---------------------------------
+
+def test_taxonomy_quota_and_shed_are_string_routed():
+    """Tenancy refusals carry their own verdict — the plane refused,
+    geometry did not, so no geometric re-probe may overrule them."""
+    with SimCluster(cap_config(), clock=FakeClock()) as c:
+        cap = c.extender.capacity
+        cap.note_refusal(_info(c, "q"), "tenant team-a quota exceeded")
+        cap.note_refusal(_info(c, "s"), "admission shed: burn rate")
+        counts = cap.unschedulable_counts()
+        assert counts == {"quota": 1, "shed": 1}
+        by_reason = cap.stranded_by_reason()
+        assert by_reason["quota"] == (1, 1)
+        assert by_reason["shed"] == (1, 1)
+
+
+def test_taxonomy_capacity_when_no_chips_anywhere():
+    with SimCluster(cap_config(), clock=FakeClock()) as c:
+        cap = c.extender.capacity
+        _fragment(c)  # 16 free
+        grp = PodGroup("big", min_member=24)
+        cap.note_failed_plan(_info(c, "big-0", group=grp))
+        assert cap.unschedulable_counts() == {"capacity": 1}
+        rows = cap.stranded_summary()["by_shape"]
+        assert rows == [{"shape": "24", "demands": 1,
+                         "chips_requested": 24,
+                         "reasons": {"capacity": 1}}]
+
+
+def test_taxonomy_unhealthy_when_healing_would_cover():
+    """free < demand but free-if-healed >= demand: the root cause is
+    the unhealthy chip, not fleet size — a repair ticket, not a
+    capacity buy."""
+    with SimCluster(cap_config(), clock=FakeClock()) as c:
+        cap = c.extender.capacity
+        c.inject_fault("host-0-0-0", 0)
+        c.schedule(c.make_pod("sync", tpu=1))  # re-ingests the fault
+        # 32 chips: 1 unhealthy + 1 allocated -> 30 free, 31 if healed
+        cap.note_failed_plan(_info(c, "ask31", tpu=31))
+        assert cap.unschedulable_counts() == {"unhealthy": 1}
+
+
+def test_taxonomy_fragmented_vs_capacity_on_a_torus():
+    """The disambiguation the defragmenter pivots on, on a hand-built
+    torus: 16 chips free in two non-adjacent x-planes (the x-wraparound
+    does not join them — the occupied odd planes separate them even on
+    the ring). A 16-chip gang is FRAGMENTED (chips exist, repack
+    recovers them); a 24-chip gang is CAPACITY (no repack can mint
+    chips)."""
+    cfg = load_config(env={"TPUKUBE_CAPACITY_ENABLED": "1"})
+    mesh = MeshSpec(dims=(4, 4, 2), host_block=(2, 2, 1),
+                    torus=(True, True, False))
+    with SimCluster(cfg, mesh=mesh, clock=FakeClock()) as c:
+        cap = c.extender.capacity
+        _fragment(c)
+        grp = PodGroup("frag", min_member=16)
+        cap.note_failed_plan(_info(c, "frag-0", group=grp))
+        grp2 = PodGroup("toobig", min_member=24)
+        cap.note_failed_plan(_info(c, "toobig-0", group=grp2))
+        assert cap.unschedulable_counts() == {
+            "fragmented": 1, "capacity": 1,
+        }
+        by_reason = cap.stranded_by_reason()
+        assert by_reason["fragmented"] == (1, 16)
+        assert by_reason["capacity"] == (1, 24)
+        # the fragmented detail quantifies the repack upside:
+        # 16 free - the 8-chip largest box = 8 recoverable
+        rollup = cap.stranded_summary()
+        assert rollup["recoverable_chips"] == 8
+
+
+def test_taxonomy_transient_when_failure_no_longer_reproduces():
+    """A demand that fits by re-probe time classifies transient —
+    honest about the race, never a fabricated root cause."""
+    with SimCluster(cap_config(), clock=FakeClock()) as c:
+        cap = c.extender.capacity
+        c.schedule(c.make_pod("a", tpu=1))
+        cap.note_failed_plan(_info(c, "fits", tpu=2))
+        assert cap.unschedulable_counts() == {"transient": 1}
+
+
+def test_taxonomy_dcn_ineligible_vs_dcn_covered():
+    """Two slices, neither holds the whole gang: without the DCN
+    opt-in the demand is dcn-ineligible (spanning is the only serve);
+    with allow_dcn the greedy split covers it and the verdict is the
+    honest transient."""
+    cfg = load_config(env={"TPUKUBE_CAPACITY_ENABLED": "1"})
+    slices = {
+        sid: MeshSpec(dims=(2, 2, 2), host_block=(2, 2, 1),
+                      torus=(False, False, False))
+        for sid in ("s0", "s1")
+    }
+    with SimCluster(cfg, slices=slices, clock=FakeClock()) as c:
+        cap = c.extender.capacity
+        # fill both slices, then free each slice's contiguous z=1
+        # layer: 4 free per slice (one 2x2x1 box each), 8 fleet-wide —
+        # no single slice can hold the 8-chip gang
+        placed = {}
+        for i in range(16):
+            _, alloc = c.schedule(c.make_pod(f"fill-{i}", tpu=1))
+            placed[f"fill-{i}"] = alloc
+        for name, alloc in placed.items():
+            if alloc.coords[0][2] == 1:
+                c.complete_pod(name)
+        no_dcn = PodGroup("span", min_member=8)
+        cap.note_failed_plan(_info(c, "span-0", group=no_dcn))
+        assert cap.unschedulable_counts() == {"dcn-ineligible": 1}
+        dcn = PodGroup("span2", min_member=8, allow_dcn=True)
+        cap.note_failed_plan(_info(c, "span2-0", group=dcn))
+        assert cap.unschedulable_counts() == {
+            "dcn-ineligible": 1, "transient": 1,
+        }
+
+
+def test_gang_refusal_storm_is_one_ledger_row():
+    """128 refusals of one gang against one snapshot epoch: the
+    counter bills every refusal, the geometric probe runs ONCE, and
+    the ledger keeps one demand row."""
+    with SimCluster(cap_config(), clock=FakeClock()) as c:
+        cap = c.extender.capacity
+        _fragment(c)
+        grp = PodGroup("storm", min_member=16)
+        for i in range(128):
+            cap.note_failed_plan(_info(c, f"storm-{i}", group=grp))
+        assert cap.classified == 1
+        assert cap.unschedulable_counts() == {"fragmented": 128}
+        rollup = cap.stranded_summary()
+        assert rollup["demands"] == 1
+        assert rollup["chips_requested"] == 16
+
+
+def test_stranded_ledger_expires_stale_demands():
+    """Without a batch queue to consult, TTL retires a row — a
+    stranded entry must never outlive the demand it names."""
+    clock = FakeClock()
+    with SimCluster(cap_config(), clock=clock) as c:
+        cap = c.extender.capacity
+        _fragment(c)
+        grp = PodGroup("old", min_member=16)
+        cap.note_failed_plan(_info(c, "old-0", group=grp))
+        assert cap.stranded_summary()["demands"] == 1
+        clock.advance(901.0)
+        assert cap.stranded_summary()["demands"] == 0
+        # cumulative counters are history, not liveness: they survive
+        assert cap.unschedulable_counts() == {"fragmented": 1}
+
+
+def test_refused_webhook_pod_lands_in_forensics():
+    """The legacy (non-batch) seam end-to-end: a real gang refusal
+    through the webhook filter classifies without anyone calling the
+    recorder explicitly."""
+    with SimCluster(cap_config(), clock=FakeClock()) as c:
+        _fragment(c)
+        grp = PodGroup("stuck", min_member=16)
+        with pytest.raises(RuntimeError):
+            c.schedule(c.make_pod("stuck-0", tpu=1, group=grp))
+        counts = c.extender.capacity.unschedulable_counts()
+        assert counts.get("fragmented", 0) >= 1
+
+
+# -- what-if probes ----------------------------------------------------------
+
+def test_probe_parity_with_planner_verdict():
+    """probe() and the planner answer the same question the same way:
+    fits=False ⇔ scheduling raises, fits=True ⇔ scheduling places."""
+    with SimCluster(cap_config(), clock=FakeClock()) as c:
+        cap = c.extender.capacity
+        _fragment(c)
+        no16 = cap.probe(count=16)
+        assert not no16["fits"]
+        assert no16["free_chips"] == 16
+        assert no16["largest_free_box"] == 8
+        assert not no16["dcn"]["fits"]
+        grp = PodGroup("gang16", min_member=16)
+        with pytest.raises(RuntimeError):
+            c.schedule(c.make_pod("gang16-0", tpu=1, group=grp))
+        yes8 = cap.probe(count=8)
+        assert yes8["fits"] and yes8["slice"] is not None
+        shape8 = cap.probe(shape=(1, 4, 2))
+        assert shape8["fits"]
+        assert shape8["requested"]["chips"] == 8
+        # the planner agrees: an 8-member gang lands in each of the
+        # two free planes
+        for g in ("gang8a", "gang8b"):
+            grp8 = PodGroup(g, min_member=8)
+            for i in range(8):
+                c.schedule(c.make_pod(f"{g}-{i}", tpu=1, group=grp8))
+        # and once the planner consumed both boxes, the probe flips
+        assert not cap.probe(count=8)["fits"]
+        assert cap.probe(count=8)["free_chips"] == 0
+
+
+def test_probe_validates_its_ask():
+    with SimCluster(cap_config(), clock=FakeClock()) as c:
+        cap = c.extender.capacity
+        with pytest.raises(ValueError):
+            cap.probe()
+        with pytest.raises(ValueError):
+            cap.probe(count=4, shape=(1, 2, 2))
+        with pytest.raises(ValueError):
+            cap.probe(count=0)
+
+
+# -- off-is-off --------------------------------------------------------------
+
+def test_capacity_off_leaves_exposition_untouched():
+    """Default config: no recorder is constructed and nothing
+    capacity-shaped reaches /metrics or /statusz."""
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "4,4,2",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+    })
+    with SimCluster(cfg, clock=FakeClock()) as c:
+        c.schedule(c.make_pod("a", tpu=1))
+        assert c.extender.capacity is None
+        text = render_extender_metrics(c.extender)
+        assert "tpukube_capacity" not in text
+        assert "tpukube_unschedulable_pods" not in text
+        doc = extender_statusz(c.extender)
+        assert "capacity" not in doc
+
+
+def test_capacity_on_adds_exactly_the_declared_family():
+    """The same workload with capacity on adds the capacity series —
+    and ONLY them: the legacy series set is unchanged, so the off
+    exposition stays byte-identical by construction."""
+    def series_names(enabled: bool) -> set[str]:
+        env = {"TPUKUBE_SIM_MESH_DIMS": "4,4,2",
+               "TPUKUBE_SIM_HOST_BLOCK": "2,2,1"}
+        if enabled:
+            env["TPUKUBE_CAPACITY_ENABLED"] = "1"
+        with SimCluster(load_config(env=env), clock=FakeClock()) as c:
+            c.schedule(c.make_pod("a", tpu=1))
+            if enabled:
+                c.extender.capacity.sample_now()
+            return {s.name for s in
+                    parse_metrics(render_extender_metrics(c.extender))}
+
+    off, on = series_names(False), series_names(True)
+    assert off <= on
+    assert on - off == {
+        "tpukube_capacity_samples_total",
+        "tpukube_capacity_sample_seconds_total",
+        "tpukube_capacity_fleet_chips",
+        "tpukube_capacity_stranded_chips",
+        "tpukube_capacity_stranded_demands",
+        "tpukube_capacity_recoverable_chips",
+        "tpukube_unschedulable_pods",
+    }
+
+
+def test_capacity_on_statusz_and_reason_labels():
+    with SimCluster(cap_config(), clock=FakeClock()) as c:
+        cap = c.extender.capacity
+        _fragment(c)
+        grp = PodGroup("g", min_member=16)
+        cap.note_failed_plan(_info(c, "g-0", group=grp))
+        cap.sample_now()
+        doc = extender_statusz(c.extender)
+        assert doc["capacity"]["samples"] == cap.samples_taken >= 1
+        assert doc["capacity"]["stranded"]["demands"] == 1
+        text = render_extender_metrics(c.extender)
+        # every taxonomy reason renders (zero-filled), the fragmented
+        # one carries the count
+        for reason in UNSCHEDULABLE_REASONS:
+            assert f'reason="{reason}"' in text
+        assert ('tpukube_unschedulable_pods{reason="fragmented"} 1'
+                in text)
+        assert "tpukube_capacity_stranded_chips" in text
+
+
+def test_queue_age_histogram_renders_with_batching_only():
+    """The tpukube_cycle_queue_age_seconds satellite: a real
+    _bucket/_count histogram with batching on, absent otherwise."""
+    env = {"TPUKUBE_SIM_MESH_DIMS": "4,4,2",
+           "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+           "TPUKUBE_BATCH_ENABLED": "1"}
+    with SimCluster(load_config(env=env), clock=FakeClock()) as c:
+        c.schedule_pending([c.make_pod(f"p-{i}", tpu=1)
+                            for i in range(4)])
+        text = render_extender_metrics(c.extender)
+    assert "tpukube_cycle_queue_age_seconds_bucket" in text
+    assert 'le="+Inf"' in text
+    env.pop("TPUKUBE_BATCH_ENABLED")
+    with SimCluster(load_config(env=env), clock=FakeClock()) as c:
+        c.schedule(c.make_pod("solo", tpu=1))
+        assert "tpukube_cycle_queue_age_seconds" not in \
+            render_extender_metrics(c.extender)
+
+
+# -- federation --------------------------------------------------------------
+
+def _doc(ts, shape, reason, chips, samples_stats=None):
+    return {
+        "samples": [{"ts": ts, "clock": ts,
+                     "fleet": {"utilization": 0.5}}],
+        "stranded": {
+            "demands": 1, "chips_requested": chips,
+            "recoverable_chips": chips // 2,
+            "by_shape": [{"shape": shape, "demands": 1,
+                          "chips_requested": chips,
+                          "reasons": {reason: 1}}],
+        },
+        "unschedulable": {reason: 1},
+        "stats": samples_stats or {"samples": 1},
+    }
+
+
+def test_merge_keeps_attribution_and_names_the_dead():
+    merged = merge_capacity_docs([
+        ("r0", _doc(2.0, "64", "fragmented", 64)),
+        ("r1", _doc(1.0, "64", "capacity", 64)),
+        ("r2", None),
+    ])
+    assert merged["dead_replicas"] == ["r2"]
+    # samples interleave by wall ts, each stamped with its source
+    assert [(s["ts"], s["replica"]) for s in merged["samples"]] == \
+        [(1.0, "r1"), (2.0, "r0")]
+    row = merged["stranded"]["by_shape"][0]
+    assert row["demands"] == 2
+    assert row["reasons"] == {"fragmented": 1, "capacity": 1}
+    assert row["replicas"] == {"r0": 1, "r1": 1}
+    assert merged["stranded"]["recoverable_chips"] == 64
+    assert merged["unschedulable"] == {"fragmented": 1, "capacity": 1}
+    assert set(merged["stats"]) == {"r0", "r1"}
+
+
+def test_merge_probe_any_whole_fit_wins_and_dcn_composes():
+    fit = {"free_chips": 8, "largest_free_box": 8, "fits": True,
+           "slice": "s1", "slices": {"s1": {"fits": True}},
+           "dcn": {"fits": True, "parts": {"s1": 8}}}
+    nofit = {"free_chips": 4, "largest_free_box": 4, "fits": False,
+             "slice": None, "slices": {"s0": {"fits": False}},
+             "dcn": {"fits": False, "parts": {}}}
+    merged = merge_probe_docs(
+        [("r0", nofit), ("r1", fit), ("r2", None)],
+        {"count": 8, "shape": None, "chips": 8})
+    assert merged["fits"] and merged["replica"] == "r1"
+    assert merged["slice"] == "s1"
+    assert merged["free_chips"] == 12
+    assert merged["dead_replicas"] == ["r2"]
+    assert merged["slices"]["s0"]["replica"] == "r0"
+    # no replica fits it whole -> the composed DCN verdict remains
+    merged2 = merge_probe_docs(
+        [("r0", nofit), ("r1", None)],
+        {"count": 8, "shape": None, "chips": 8})
+    assert not merged2["fits"]
+    assert merged2["dead_replicas"] == ["r1"]
+
+
+def test_router_capacity_doc_degrades_loudly_on_dead_replica():
+    """The in-process sharded plane: /capacity federates both
+    replicas' forensics with attribution; partitioning one away turns
+    it into a named dead replica — a partial fleet view is never
+    served as whole."""
+    cfg = load_config(env={
+        "TPUKUBE_PLANNER_REPLICAS": "2",
+        "TPUKUBE_BATCH_ENABLED": "1",
+        "TPUKUBE_CAPACITY_ENABLED": "1",
+    })
+    slices = {
+        sid: MeshSpec(dims=(2, 2, 2), host_block=(2, 2, 1),
+                      torus=(False, False, False))
+        for sid in ("s0", "s1")
+    }
+    with SimCluster(cfg, in_process=True, slices=slices,
+                    clock=FakeClock()) as c:
+        c.schedule_pending([c.make_pod(f"p-{i}", tpu=1)
+                            for i in range(4)])
+        router = c.extender
+        for rep in router.replicas:
+            rep.transport.extender.capacity.sample_now()
+        doc = router.capacity_doc()
+        assert doc["dead_replicas"] == []
+        assert set(doc["stats"]) == {"r0", "r1"}
+        assert {s["replica"] for s in doc["samples"]} == {"r0", "r1"}
+        probe = router.capacity_probe(count=4)
+        assert probe["fits"]
+        c.partition_replica(1)
+        doc2 = router.capacity_doc()
+        assert doc2["dead_replicas"] == ["r1"]
+        assert set(doc2["stats"]) == {"r0"}
+        text = format_capacity(doc2)
+        assert "WARNING: no capacity answer from replica(s) r1" in text
+
+
+def test_sole_router_serves_the_extender_doc_verbatim():
+    """N=1: the router's /capacity IS the sole extender's document —
+    no merge wrapper, no dead_replicas key, byte-identical off-is-off
+    with the federated plane too."""
+    from tpukube.sched.shard import ShardRouter
+
+    router = ShardRouter(load_config(env={
+        "TPUKUBE_CAPACITY_ENABLED": "1",
+    }))
+    assert router._sole is not None
+    router._sole.capacity.sample_now()
+    doc = router.capacity_doc()
+    assert doc == router._sole.capacity.capacity_doc()
+    assert "dead_replicas" not in doc
+    off = ShardRouter(load_config(env={}))
+    assert off.capacity_doc() is None
+    assert off.capacity_probe(count=4) is None
+
+
+# -- rendering ---------------------------------------------------------------
+
+def test_format_capacity_sparkline_csv_json():
+    doc = merge_capacity_docs([
+        ("r0", _doc(2.0, "64", "fragmented", 64)),
+        ("r1", None),
+    ])
+    spark = format_capacity(doc)
+    assert "utilization" in spark
+    assert "stranded: 1x 64-chip demand(s) (1x fragmented)" in spark
+    assert "64 chips requested [r0: 1]" in spark
+    assert "32 chips recoverable by repack" in spark
+    assert "unschedulable plans: fragmented=1" in spark
+    assert "WARNING: no capacity answer from replica(s) r1" in spark
+    csv = format_capacity(doc, "csv")
+    assert csv.splitlines()[0].startswith("ts,replica,utilization")
+    assert len(csv.splitlines()) == 2
+    assert json.loads(format_capacity(doc, "json")) == doc
+
+
+def test_explain_chain_carries_the_stranded_stage():
+    """With provenance on, a classified demand lands in the pod's
+    explain chain naming the root cause — `tpukube-obs explain` tells
+    the operator WHY the gang is stuck, not just that it is."""
+    cfg = cap_config(TPUKUBE_DECISIONS_ENABLED="1",
+                     TPUKUBE_DECISIONS_SAMPLE_RATE="1.0")
+    with SimCluster(cfg, clock=FakeClock()) as c:
+        cap = c.extender.capacity
+        _fragment(c)
+        grp = PodGroup("stuck", min_member=16)
+        cap.note_failed_plan(_info(c, "stuck-0", group=grp))
+        doc = c.extender.decisions.explain("default/stuck-0")
+        from tpukube.obs.decisions import explain_doc
+        rendered = explain_doc(doc["events"], "default/stuck-0") \
+            if isinstance(doc, dict) and "events" in doc else doc
+        stages = [ev for ev in rendered["stages"]
+                  if ev.get("stage") == "stranded"]
+        assert stages and stages[0]["reason"] == "fragmented"
+        why = "\n".join(rendered["why"])
+        assert "root cause fragmented" in why

@@ -136,14 +136,16 @@ def _rendered_sample_names() -> set:
     from tpukube.plugin import DevicePluginServer
     from tpukube.sched.extender import Extender
 
-    # tenancy on (with a quota'd tenant): the tenant families are
-    # conditional series the tenancy rules reference — the cross-check
-    # must see the exposition a tenancy deployment actually renders
+    # tenancy and capacity analytics on (with a quota'd tenant): the
+    # tenant and capacity families are conditional series the tenancy
+    # and capacity rules reference — the cross-check must see the
+    # exposition such a deployment actually renders
     cfg = load_config(env={
         "TPUKUBE_SIM_MESH_DIMS": "2,2,1",
         "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
         "TPUKUBE_TENANCY_ENABLED": "1",
         "TPUKUBE_TENANCY_QUOTAS": "teamA=chips:2,hbm:0.5",
+        "TPUKUBE_CAPACITY_ENABLED": "1",
     })
     ext = Extender(cfg)
     ext.events.emit("GangCommitted", obj="gang/x")

@@ -574,6 +574,184 @@ print("federated observability smoke OK")
 PY
 
 echo
+echo "== capacity smoke (flight recorder on a scenario-12 slice at"
+echo "   sample-interval 1 — measured overhead under the"
+echo "   tools/perf_floor.json capacity.overhead_pct_max ceiling; then"
+echo "   stranded-demand forensics federated across 2 SUBPROCESS"
+echo "   planner daemons: a deliberately fragmented 64-chip gang must"
+echo "   classify 'fragmented' with recoverable chips and per-replica"
+echo "   attribution, and the what-if probe must confirm no contiguous"
+echo "   fit while free chips cover the ask; the federated half skips"
+echo "   where subprocesses are unavailable) =="
+JAX_PLATFORMS=cpu TPUKUBE_CAPACITY_ENABLED=1 \
+  TPUKUBE_CAPACITY_SAMPLE_INTERVAL_SECONDS=1 python - <<'PY'
+import json
+import os
+import sys
+
+floor = json.load(open("tools/perf_floor.json"))["capacity"]
+os.environ["TPUKUBE_KILONODE10K_PODS"] = str(floor["pods"])
+
+from tpukube.sim import scenarios
+
+r = scenarios.run(12)
+cap = r["capacity"]
+print(json.dumps({
+    "samples": cap["samples"], "sample_seconds": cap["sample_seconds"],
+    "overhead_pct": cap["overhead_pct"], "wall_s": r["wall_s"],
+    "stranded_chips": r["stranded"]["chips_requested"],
+}))
+bad = []
+if not cap["samples"]:
+    bad.append("the flight recorder took no samples at interval 1")
+if not r.get("utilization_over_time"):
+    bad.append("scenario 12 recorded no utilization_over_time")
+if cap["overhead_pct"] is None \
+        or cap["overhead_pct"] > floor["overhead_pct_max"]:
+    bad.append(f"recorder overhead_pct={cap['overhead_pct']} exceeds "
+               f"the {floor['overhead_pct_max']}% ceiling")
+if bad:
+    sys.exit("capacity smoke FAILED: " + "; ".join(bad))
+print("capacity recorder-overhead smoke OK")
+PY
+
+JAX_PLATFORMS=cpu python - <<'PY'
+import contextlib
+import io
+import json
+import socket
+import sys
+import urllib.request
+
+from tpukube.core.config import load_config
+from tpukube.sched.shard import ShardError, SubprocessTransport
+
+try:
+    probe = SubprocessTransport(0, load_config(env={}),
+                                fake_clock=False)
+    probe.close()
+except (ShardError, OSError) as e:
+    print(f"capacity forensics smoke SKIPPED: cannot spawn worker "
+          f"daemons here ({e})")
+    sys.exit(0)
+
+from tpukube.core import codec
+from tpukube.core.clock import FakeClock
+from tpukube.core.mesh import MeshSpec
+from tpukube.core.types import PodGroup
+from tpukube.sched.extender import run_probe_server
+from tpukube.sched.shardworker import make_router_app
+from tpukube.sim.harness import SimCluster
+
+bad = []
+cfg = load_config(env={
+    "TPUKUBE_PLANNER_REPLICAS": "2",
+    "TPUKUBE_SHARD_TRANSPORT": "subprocess",
+    "TPUKUBE_BATCH_ENABLED": "1",
+    "TPUKUBE_CAPACITY_ENABLED": "1",
+    "TPUKUBE_CAPACITY_SAMPLE_INTERVAL_SECONDS": "1",
+})
+# one 8x8x2 slice (128 chips) per replica
+slices = {
+    sid: MeshSpec(dims=(8, 8, 2), host_block=(2, 2, 1),
+                  torus=(False, False, False))
+    for sid in ("s0", "s1")
+}
+with SimCluster(cfg, in_process=True, slices=slices,
+                clock=FakeClock()) as c:
+    # fill the fleet with 1-chip pods, then complete every pod on an
+    # even x-plane: each slice keeps 64 chips free but fragmented into
+    # 16-chip planes — the ROADMAP defrag scenario's precondition
+    for i in range(256):
+        c.schedule(c.make_pod(f"fill-{i}", tpu=1))
+    for key, pod in list(c.pods.items()):
+        alloc = codec.decode_alloc(
+            pod["metadata"]["annotations"][codec.ANNO_ALLOC])
+        if alloc.coords and alloc.coords[0][0] % 2 == 0:
+            c.pods.pop(key)
+    c._lifecycle.check_once()
+    c.advance(2.0)
+    # a 64-chip gang: chips are free (64/slice) but no contiguous box
+    grp = PodGroup("stranded", min_member=64)
+    try:
+        c.schedule(c.make_pod("stranded-0", tpu=1, group=grp))
+        bad.append("the fragmented 64-chip gang unexpectedly placed")
+    except Exception:
+        pass
+    c.advance(2.0)
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    stop = run_probe_server(make_router_app(c.extender),
+                            "127.0.0.1", port)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        with urllib.request.urlopen(f"{base}/capacity",
+                                    timeout=10) as r:
+            doc = json.load(r)
+        rows = {row["shape"]: row
+                for row in doc["stranded"]["by_shape"]}
+        row = rows.get("64")
+        if row is None:
+            bad.append(f"no stranded ledger row for the 64-chip "
+                       f"demand: {doc['stranded']}")
+        else:
+            if not row["reasons"].get("fragmented"):
+                bad.append(f"root cause is not fragmented: "
+                           f"{row['reasons']}")
+            if not any(rep in row.get("replicas", {})
+                       for rep in ("r0", "r1")):
+                bad.append("stranded row carries no per-replica "
+                           "attribution")
+        if doc["stranded"]["recoverable_chips"] <= 0:
+            bad.append("fragmented stranding reports no "
+                       "repack-recoverable chips")
+        if not doc["unschedulable"].get("fragmented"):
+            bad.append(f"tpukube_unschedulable_pods misses the "
+                       f"fragmented count: {doc['unschedulable']}")
+        missing = [rep for rep in ("r0", "r1")
+                   if rep not in doc["stats"]]
+        if missing:
+            bad.append(f"federated /capacity misses replicas "
+                       f"{missing}")
+        if doc["dead_replicas"]:
+            bad.append(f"live replicas reported dead: "
+                       f"{doc['dead_replicas']}")
+        with urllib.request.urlopen(
+                f"{base}/capacity/probe?count=64", timeout=10) as r:
+            probe_doc = json.load(r)
+        if probe_doc["fits"]:
+            bad.append("the what-if probe claims a contiguous "
+                       "64-chip fit on a fragmented fleet")
+        if probe_doc["free_chips"] < 64:
+            bad.append(f"probe sees {probe_doc['free_chips']} free "
+                       f"chips — the fragmentation proof needs >= 64")
+        # the CLI against the live federated endpoint: the sparkline
+        # rendering must name the stranded shape and the root cause
+        from tpukube.cli import main_obs
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            main_obs(["capacity", "--url", base])
+        text = out.getvalue()
+        if "64-chip" not in text or "fragmented" not in text:
+            bad.append(f"tpukube-obs capacity does not name the "
+                       f"stranded shape + cause:\n{text}")
+        print(json.dumps({
+            "stranded": doc["stranded"],
+            "unschedulable": doc["unschedulable"],
+            "probe_fits": probe_doc["fits"],
+            "probe_free_chips": probe_doc["free_chips"],
+        }))
+    finally:
+        stop()
+if bad:
+    sys.exit("capacity forensics smoke FAILED: " + "; ".join(bad))
+print("capacity forensics smoke OK")
+PY
+
+echo
 echo "== native asan (libtpuinfo self-test under ASan/UBSan) =="
 if command -v g++ >/dev/null 2>&1; then
   make -C tpukube/native asan

@@ -626,6 +626,8 @@ def main_extender(argv: Optional[list[str]] = None) -> int:
             extender.trace.close()
         if extender.decisions is not None:
             extender.decisions.close()
+        if extender.capacity is not None:
+            extender.capacity.close()
         extender.events.close()
     return 0
 
@@ -696,6 +698,18 @@ def main_sim(argv: Optional[list[str]] = None) -> int:
 
 # -- tpukube-obs -------------------------------------------------------------
 
+def _since_arg(text: str) -> float:
+    """argparse type for ``--since``: epoch seconds, a bare relative
+    number, or a suffixed duration (15m, 2h, 90s, 1d) — the shared
+    parser lives in tpukube.obs.capacity."""
+    from tpukube.obs.capacity import parse_since
+
+    try:
+        return parse_since(text)
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(str(e)) from None
+
+
 def main_obs(argv: Optional[list[str]] = None) -> int:
     """Offline observability tooling: ``timeline`` converts a JSONL
     decision trace to Chrome trace-event JSON (Perfetto-loadable
@@ -707,7 +721,7 @@ def main_obs(argv: Optional[list[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="tpukube-obs",
         description="offline observability tooling "
-                    "(timeline / events / slo)",
+                    "(timeline / events / capacity / slo)",
     )
     sub = p.add_subparsers(dest="cmd", required=True)
     tp = sub.add_parser(
@@ -744,11 +758,49 @@ def main_obs(argv: Optional[list[str]] = None) -> int:
                     help="filter by source replica (r0, r1, ...) in a "
                          "federated /events dump — the router stamps "
                          "each merged event with its source replica")
-    ep.add_argument("--since", type=float, default=None, metavar="T",
-                    help="absolute unix timestamp, or (values < 1e9) "
+    ep.add_argument("--since", type=_since_arg, default=None, metavar="T",
+                    help="absolute unix timestamp, a relative duration "
+                         "(15m, 2h, 90s, 1d), or a bare number < 1e9 = "
                          "seconds before the newest event in the capture")
     ep.add_argument("--json", action="store_true", dest="as_json",
                     help="one JSON object per event instead of text lines")
+
+    cp = sub.add_parser(
+        "capacity",
+        help="render a capacity flight-recorder capture or a live "
+             "/capacity endpoint (sparkline / csv / json)",
+    )
+    cp.add_argument("capacity_file", nargs="*",
+                    help="capacity_path JSONL capture(s); pass several "
+                         "with --merge (one per replica)")
+    cp.add_argument("--url", default=None,
+                    help="live extender OR shard-router base URL "
+                         "(reads /capacity; a router answers the "
+                         "federated merge with per-replica "
+                         "attribution)")
+    cp.add_argument("--token-file", default=None, metavar="FILE",
+                    help="bearer token file for an --auth-token-file "
+                         "extender (/capacity sits behind its auth)")
+    cp.add_argument("--merge", action="store_true",
+                    help="stitch several per-replica captures into one "
+                         "fleet view (each file becomes a replica lane "
+                         "named for it)")
+    cp.add_argument("--since", type=_since_arg, default=None,
+                    metavar="T",
+                    help="absolute unix timestamp, a relative duration "
+                         "(15m, 2h), or a bare number < 1e9 = seconds "
+                         "before the newest sample")
+    cp.add_argument("--format", default="sparkline",
+                    choices=("sparkline", "csv", "json"),
+                    help="output rendering (default: sparkline)")
+    cp.add_argument("--probe-count", type=int, default=None,
+                    metavar="N",
+                    help="with --url: what-if probe for N contiguous "
+                         "chips (/capacity/probe) instead of the "
+                         "recorder document")
+    cp.add_argument("--probe-shape", default=None, metavar="XxYxZ",
+                    help="with --url: what-if probe for a shaped box "
+                         "(e.g. 4x4x4)")
 
     xp = sub.add_parser(
         "explain",
@@ -871,6 +923,67 @@ def main_obs(argv: Optional[list[str]] = None) -> int:
                 print(json.dumps(ev, sort_keys=True))
             else:
                 print(events_mod.format_event(ev))
+        return 0
+
+    if args.cmd == "capacity":
+        import os as os_mod
+
+        from tpukube import trace as trace_mod
+        from tpukube.obs import capacity as capacity_mod
+
+        if args.url:
+            if args.capacity_file:
+                p.error("--url and capture files are exclusive")
+
+            def fetch(path: str) -> dict:
+                req = urllib.request.Request(f"{args.url}{path}")
+                if args.token_file:
+                    with open(args.token_file) as f:
+                        req.add_header("Authorization",
+                                       f"Bearer {f.read().strip()}")
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    return json.loads(r.read())
+
+            if args.probe_count is not None or args.probe_shape:
+                q = (f"count={args.probe_count}"
+                     if args.probe_count is not None
+                     else f"shape={args.probe_shape}")
+                doc = fetch(f"/capacity/probe?{q}")
+                print(json.dumps(doc, indent=2, sort_keys=True))
+                # composes into scripts: exit 0 only when the demand
+                # fits somewhere (contiguous or via the DCN fallback)
+                return 0 if (doc.get("fits")
+                             or (doc.get("dcn") or {}).get("fits")) \
+                    else 1
+            since = f"?since={args.since}" if args.since is not None \
+                else ""
+            print(capacity_mod.format_capacity(
+                fetch(f"/capacity{since}"), args.format))
+            return 0
+        if not args.capacity_file:
+            p.error("a capture file or --url is required")
+        if args.probe_count is not None or args.probe_shape:
+            p.error("--probe-count/--probe-shape need --url (a probe "
+                    "runs against a live snapshot)")
+        if len(args.capacity_file) > 1 and not args.merge:
+            p.error("multiple capture files require --merge")
+        since = args.since
+        if args.merge:
+            per = [(os_mod.path.basename(path),
+                    {"samples": trace_mod.load(path)})
+                   for path in args.capacity_file]
+            doc = capacity_mod.merge_capacity_docs(per)
+        else:
+            doc = {"samples": trace_mod.load(args.capacity_file[0])}
+        samples = doc.get("samples") or []
+        if since is not None:
+            if since < 1e9:
+                newest = max((float(s.get("ts", 0.0))
+                              for s in samples), default=0.0)
+                since = newest - since
+            doc["samples"] = [s for s in samples
+                              if float(s.get("ts", 0.0)) >= since]
+        print(capacity_mod.format_capacity(doc, args.format))
         return 0
 
     # slo

@@ -234,6 +234,26 @@ class TpuKubeConfig:
     decisions_path: str = ""
     decisions_sink_max_bytes: int = 64 * 1024**2
 
+    # Capacity analytics & demand forensics (tpukube/obs/capacity.py,
+    # ISSUE 17). With capacity_enabled the extender keeps a bounded
+    # flight-recorder ring of periodic fleet samples (per-slice
+    # utilization / fragmentation / largest-free-box, queue depth,
+    # tenant shares), root-causes every failed/deferred plan into the
+    # stranded-demand taxonomy (fragmented / capacity / quota / shed /
+    # unhealthy / dcn-ineligible), and serves /capacity +
+    # /capacity/probe. Samples ride the scheduling clock (FakeClock-
+    # compressible) and the epoch-cached snapshot's observer view.
+    # false (the default) constructs NOTHING: no recorder, no series,
+    # placements and exposition stay byte-identical.
+    capacity_enabled: bool = False
+    capacity_sample_interval_seconds: float = 30.0
+    # flight-recorder ring depth (samples, not bytes)
+    capacity_samples: int = 2048
+    # optional JSONL sample sink for `tpukube-obs capacity --merge`
+    # (size-capped like the trace/events/decisions sinks)
+    capacity_path: str = ""
+    capacity_sink_max_bytes: int = 64 * 1024**2
+
     # Multi-tenant serving plane (tpukube/tenancy, ISSUE 9). With
     # tenancy_enabled the extender attaches a TenantPlane: tenant ids
     # from the tenancy_label pod label (unlabeled pods belong to
@@ -448,6 +468,19 @@ def load_config(
         raise ValueError(
             "decisions_seed and decisions_sink_max_bytes must be >= 0"
         )
+    if cfg.capacity_path and not cfg.capacity_enabled:
+        raise ValueError(
+            "capacity_path is set but capacity_enabled is false — "
+            "enable capacity analytics or drop the path"
+        )
+    if cfg.capacity_enabled and cfg.capacity_samples < 1:
+        raise ValueError("capacity_samples must be >= 1 when enabled")
+    if cfg.capacity_enabled and cfg.capacity_sample_interval_seconds <= 0:
+        raise ValueError(
+            "capacity_sample_interval_seconds must be positive"
+        )
+    if cfg.capacity_sink_max_bytes < 0:
+        raise ValueError("capacity_sink_max_bytes must be >= 0")
     if cfg.tenancy_quotas and not cfg.tenancy_enabled:
         # quotas without the plane would be silently unenforced — an
         # operator who wrote caps believes they are live; fail loudly

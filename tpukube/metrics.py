@@ -203,6 +203,12 @@ def build_extender_registry(extender, reconcile=None, evictions=None,
     decisions = getattr(extender, "decisions", None)
     if decisions is not None:
         _add_decision_metrics(reg, extender, decisions)
+    # capacity analytics & demand forensics (obs/capacity.py): series
+    # render only when capacity_enabled built a CapacityRecorder —
+    # capacity-off exposition stays byte-identical
+    capacity = getattr(extender, "capacity", None)
+    if capacity is not None:
+        _add_capacity_metrics(reg, capacity)
     # unified retry/circuit layer (ISSUE 4): series render only when
     # the daemon actually wired the channel objects — sim/dev
     # extenders keep the legacy exposition byte-identical
@@ -653,6 +659,10 @@ def _add_cycle_metrics(reg: Registry, cycle) -> None:
                   "_bucket histogram is cumulative).")
     # the cumulative histogram the summary's window flattens
     reg.register(cycle.cycle_hist)
+    # queue-age distribution (ISSUE 17): every planned pod's
+    # admitted-to-planned age — the starvation signal /statusz
+    # windows, now alertable as _bucket series
+    reg.register(cycle.queue_age_hist)
     reg.gauge(
         "tpukube_cycle_queue_depth",
         fn=lambda: cycle.queue_depth(),
@@ -770,6 +780,60 @@ def _add_decision_metrics(reg: Registry, extender, decisions) -> None:
                   "guards against a floor.")
     if extender.phase_hist is not None:
         reg.register(extender.phase_hist)
+
+
+def _add_capacity_metrics(reg: Registry, capacity) -> None:
+    """Capacity analytics families (obs/capacity.py): flight-recorder
+    volume + measured overhead (the check.sh capacity smoke's
+    numerator), the failed-plan taxonomy counter, and the live
+    stranded ledger per root cause — the stranded-ratio recording rule
+    and the fragmentation ticket alert read these."""
+    from tpukube.obs.capacity import UNSCHEDULABLE_REASONS
+
+    reg.counter(
+        "tpukube_capacity_samples_total",
+        fn=lambda: capacity.samples_taken,
+        help_text="Flight-recorder fleet samples taken (scheduling "
+                  "clock cadence).")
+    reg.counter(
+        "tpukube_capacity_sample_seconds_total",
+        fn=lambda: capacity.sample_seconds,
+        help_text="Cumulative wall spent sampling + classifying — the "
+                  "measured overhead the check.sh capacity smoke "
+                  "floors.")
+    reg.gauge(
+        "tpukube_capacity_fleet_chips",
+        fn=lambda: capacity.fleet_chips,
+        help_text="Fleet chip count at the last flight-recorder "
+                  "sample (the stranded-ratio denominator).")
+    reg.gauge(
+        "tpukube_capacity_recoverable_chips",
+        fn=lambda: capacity._recoverable_last,
+        help_text="Chips a perfect repack would recover into the "
+                  "largest contiguous boxes, from the last stranded "
+                  "classification (the defragmenter's objective).")
+    unsched = reg.counter(
+        "tpukube_unschedulable_pods",
+        help_text="Failed/deferred plans root-caused by reason "
+                  "(fragmented = chips free but no contiguous box; "
+                  "capacity = not enough free chips anywhere).")
+    chips_g = reg.gauge(
+        "tpukube_capacity_stranded_chips",
+        help_text="Chips requested by live stranded demands, by root "
+                  "cause (ledger entries expire with their demand).")
+    demands_g = reg.gauge(
+        "tpukube_capacity_stranded_demands",
+        help_text="Live stranded demands (gangs collapse to one), by "
+                  "root cause.")
+    for reason in UNSCHEDULABLE_REASONS:
+        unsched.labels(reason=reason).set_function(
+            lambda r=reason: capacity.unschedulable_counts().get(r, 0))
+        chips_g.labels(reason=reason).set_function(
+            lambda r=reason:
+            capacity.stranded_by_reason().get(r, (0, 0))[1])
+        demands_g.labels(reason=reason).set_function(
+            lambda r=reason:
+            capacity.stranded_by_reason().get(r, (0, 0))[0])
 
 
 def _add_retry_metrics(reg: Registry, retriers=(), circuits=()) -> None:

@@ -75,11 +75,14 @@ from tpukube.trace import TRACE_CONTEXT
 #:   rendezvous   (router) a two-phase DCN rendezvous verdict for the
 #:                pod's gang (outcome prepared/committed/aborted, with
 #:                the per-replica parts)
+#:   stranded     the capacity forensics root-caused a failed/deferred
+#:                plan (reason from the unschedulable taxonomy, with
+#:                free-chip / largest-box / recoverable counts)
 STAGES = (
     "admit", "cycle_plan", "filter", "prioritize", "gang_reserve",
     "preemption_plan", "tenancy", "refusal", "bind", "assume_undo",
     "plan_expired", "preempted", "release",
-    "route", "spillover", "rendezvous",
+    "route", "spillover", "rendezvous", "stranded",
 )
 
 #: stages that are refusals — the consistency lint
@@ -405,6 +408,23 @@ def explain_doc(events: Iterable[dict[str, Any]],
             why.append(
                 f"router: spilled over from replica {ev.get('primary')} "
                 f"to replica {ev.get('replica')}"
+            )
+        elif stage == "stranded":
+            # verdict stays pending/unschedulable — forensics explains
+            # WHY the demand cannot place, it is not a new outcome
+            bits = []
+            if ev.get("free_chips") is not None:
+                bits.append(f"{ev['free_chips']} chips free")
+            if ev.get("largest_free_box") is not None:
+                bits.append(
+                    f"largest contiguous box {ev['largest_free_box']}")
+            if ev.get("recoverable_chips"):
+                bits.append(
+                    f"{ev['recoverable_chips']} recoverable by repack")
+            why.append(
+                f"stranded: {ev.get('chips')} chip(s) unschedulable — "
+                f"root cause {ev.get('reason')}"
+                + (f" ({', '.join(bits)})" if bits else "")
             )
         elif stage == "rendezvous":
             parts = ev.get("parts") or []

@@ -107,6 +107,9 @@ DECLARED_SERIES: frozenset[str] = frozenset({
     "tpukube_cycle_batch_size",
     "tpukube_cycle_wall_seconds",
     "tpukube_cycle_queue_depth",
+    # queue-age histogram (ISSUE 17): the starvation signal as _bucket
+    # series so it can be alerted on (renders only with batching on)
+    "tpukube_cycle_queue_age_seconds",
     # extender: decision provenance + cycle phase profiling
     # (tpukube/obs/decisions.py, ISSUE 12; series render only when
     # decisions_enabled built a DecisionLog — legacy exposition stays
@@ -157,6 +160,17 @@ DECLARED_SERIES: frozenset[str] = frozenset({
     # per {op, dir, replica} over the subprocess transport — the
     # measured baseline the ROADMAP codec item is judged against
     "tpukube_router_wire_bytes_total",
+    # capacity analytics & demand forensics (tpukube/obs/capacity.py,
+    # ISSUE 17; series render only when capacity_enabled built a
+    # CapacityRecorder — legacy exposition stays byte-identical with
+    # the recorder off)
+    "tpukube_capacity_samples_total",
+    "tpukube_capacity_sample_seconds_total",
+    "tpukube_capacity_fleet_chips",
+    "tpukube_capacity_stranded_chips",
+    "tpukube_capacity_stranded_demands",
+    "tpukube_capacity_recoverable_chips",
+    "tpukube_unschedulable_pods",
     # both daemons (unified retry/circuit layer, core/retry.py; series
     # render only where a Retrier/CircuitBreaker is actually wired)
     "tpukube_retry_attempts_total",

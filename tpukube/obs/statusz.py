@@ -198,6 +198,15 @@ def extender_statusz(
         }
     else:
         out["events"] = {"enabled": False}
+    # capacity analytics (obs/capacity.py, ISSUE 17): the key itself
+    # is CONDITIONAL — off-is-off means the legacy /statusz document
+    # stays byte-identical, like the lifecycle/reconcile keys
+    capacity = getattr(extender, "capacity", None)
+    if capacity is not None:
+        out["capacity"] = {
+            **capacity.stats(),
+            "stranded": capacity.stranded_summary(),
+        }
     if lifecycle is not None:
         out["lifecycle_releases"] = lifecycle.released
     if reconcile is not None:

@@ -320,6 +320,14 @@ class SchedulingCycle:
         self.cycle_wall_total = 0.0  # cumulative (the windows rotate)
         self.cycle_hist = Histogram("tpukube_cycle_wall_seconds",
                                     bucket_only=True)
+        # queue-age histogram (ISSUE 17): the starvation signal the
+        # percentile window on /statusz carries, exportable as _bucket
+        # series so Prometheus can alert on it. Long-tail buckets: a
+        # pod stuck for hours IS the signal, sub-second ages are noise.
+        self.queue_age_hist = Histogram(
+            "tpukube_cycle_queue_age_seconds", bucket_only=True,
+            buckets=(1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+                     1800.0, 3600.0))
 
     # -- queue admission -----------------------------------------------------
     def enqueue(self, pod: PodInfo,
@@ -668,6 +676,20 @@ class SchedulingCycle:
                        else None),
             )
 
+        def _note_stranded(p: PodInfo, entry: PodPlan) -> None:
+            # stranded-demand forensics (ISSUE 17): every plan that
+            # produced no node — a refusal error or an unschedulable
+            # verdict (feasible computed, empty) — gets root-caused by
+            # the capacity recorder. Assumed plans and plan-served
+            # binds are successes; bind errors are transport, not
+            # capacity.
+            cap = ext.capacity
+            if (cap is not None and entry.node is None
+                    and not entry.assumed
+                    and (entry.error is not None
+                         or entry.feasible is not None)):
+                cap.note_failed_plan(p, entry.error)
+
         def _age_of(key: str) -> Optional[float]:
             # READ, never pop: the first-admit stamp outlives the plan
             # so a refused-and-retried pod keeps accumulating age
@@ -677,6 +699,7 @@ class SchedulingCycle:
                 return None
             age = max(0.0, now - qt)
             ages.append(age)
+            self.queue_age_hist.observe(age)
             return age
 
         # ONE shared tuple for driver/informer admissions: every such
@@ -714,6 +737,7 @@ class SchedulingCycle:
                     self.pods_planned += 1
                     _note_plan(key2, entry, "gang_batch",
                                _age_of(key2))
+                    _note_stranded(p2, entry)
                 i = j
                 continue
             key = pod.key()
@@ -756,6 +780,7 @@ class SchedulingCycle:
             self._plans[key] = entry
             self.pods_planned += 1
             _note_plan(key, entry, arm, age)
+            _note_stranded(pod, entry)
             i += 1
         self.cycles += 1
         self.batch_sizes.append(len(batch))
@@ -763,6 +788,12 @@ class SchedulingCycle:
         self.cycle_walls.append(wall)
         self.cycle_wall_total += wall
         self.cycle_hist.observe(wall)
+        # flight-recorder cadence (ISSUE 17): batch-driven drives may
+        # never touch the webhook tail's hook, so the cycle end is the
+        # sampling seam — one clock read when the interval has not
+        # elapsed
+        if ext.capacity is not None:
+            ext.capacity.maybe_sample()
         if ph is not None:
             # additive phases: queue wait (the batch's longest), the
             # snapshot/fast-state pin, and the planning remainder
@@ -1349,6 +1380,28 @@ class SchedulingCycle:
             self._enqueued_at.pop(key, None)
 
     # -- observability -------------------------------------------------------
+    def is_pending(self, pod_key: str) -> bool:
+        """True while ``pod_key`` has an un-retired first-admit stamp —
+        i.e. it was admitted and has not bound, released, or TTL'd out.
+        The capacity recorder's stranded ledger uses this to expire
+        entries whose demand left the system (ISSUE 17)."""
+        return pod_key in self._enqueued_at
+
+    def pending_oldest_age(self, now: float) -> Optional[float]:
+        """Oldest pending-admit age at clock time ``now`` (None when
+        nothing is pending). Same bounded-retry snapshot as stats():
+        admission threads insert while the recorder reads."""
+        stamps: list[float] = []
+        for _ in range(5):
+            try:
+                stamps = list(self._enqueued_at.values())
+                break
+            except RuntimeError:  # dict mutated mid-iteration
+                continue
+        if not stamps:
+            return None
+        return max(0.0, now - min(stamps))
+
     def stats(self) -> dict[str, Any]:
         """The /statusz "cycle" section."""
         from tpukube.obs.registry import quantile

@@ -273,6 +273,17 @@ class Extender:
             # verdict — shares and tenant-local burn at decision time
             # — into the provenance ring (None = no recording)
             self.tenants.decisions = self.decisions
+        # Capacity analytics & demand forensics (obs/capacity.py,
+        # ISSUE 17): flight-recorder ring + stranded-demand forensics +
+        # what-if probes. None (the config default) constructs nothing
+        # — no sample is ever taken, no series renders, /capacity 404s,
+        # and placements/exposition stay byte-identical. Built AFTER
+        # snapshots/cycle/tenants so a sample can read all of them.
+        self.capacity = None
+        if config.capacity_enabled:
+            from tpukube.obs.capacity import CapacityRecorder
+
+            self.capacity = CapacityRecorder(self, config)
         self.preemptions = 0   # victims evicted for higher-priority gangs
         self.binds_total = 0   # successful binds (metrics counter)
         # The bind EFFECTOR: with bindVerb configured, kube-scheduler
@@ -1480,6 +1491,11 @@ class Extender:
                     self._note_decision(pod.key(), "refusal",
                                         kind="filter_error",
                                         reason=str(e))
+                    if self.capacity is not None:
+                        # stranded-demand forensics: root-cause the
+                        # legacy-path refusal (fragmented / capacity /
+                        # quota / shed / unhealthy / dcn-ineligible)
+                        self.capacity.note_refusal(pod, str(e))
                 if tt0 is not None:
                     self.tenants.observe_admission(
                         self.tenants.tenant_of(pod),
@@ -1576,6 +1592,11 @@ class Extender:
                 self.trace.record(kind, body, response)
             if self.journal is not None:
                 self._maybe_checkpoint()
+            if self.capacity is not None:
+                # amortized flight-recorder hook (the checkpoint
+                # seam's pattern): a scheduling-clock read per
+                # decision, a real sample only on interval expiry
+                self.capacity.maybe_sample()
             return response
 
     def checkpoint_doc(self) -> dict:
@@ -2176,6 +2197,57 @@ def make_app(
             pod = f"default/{pod}"
         return web.json_response(extender.decisions.explain(pod))
 
+    async def capacity_handler(request: web.Request) -> web.Response:
+        # behind the bearer middleware: samples disclose utilization,
+        # tenant shares, and the stranded-demand ledger
+        if extender.capacity is None:
+            raise web.HTTPNotFound(
+                text="capacity analytics disabled (set capacity_enabled)"
+            )
+        from tpukube.obs.capacity import parse_since
+
+        q = request.query
+        since: Any = None
+        if q.get("since"):
+            try:
+                since = parse_since(q["since"])
+            except ValueError:
+                raise web.HTTPBadRequest(
+                    text="since must be a unix ts or duration (15m, 2h)"
+                )
+        if since is not None and since < 1e9:
+            # relative window: anchored to the newest sample's wall ts
+            # (the events/CLI relative-since semantics)
+            samples = extender.capacity.samples()
+            newest = max((float(s.get("ts", 0.0)) for s in samples),
+                         default=0.0)
+            since = newest - since
+        return web.json_response(extender.capacity.capacity_doc(since))
+
+    async def capacity_probe_handler(request: web.Request) -> web.Response:
+        # read-only what-if fit dry-run against the observer snapshot
+        if extender.capacity is None:
+            raise web.HTTPNotFound(
+                text="capacity analytics disabled (set capacity_enabled)"
+            )
+        from tpukube.obs.capacity import parse_shape
+
+        q = request.query
+        count = shape = None
+        try:
+            if q.get("shape"):
+                shape = parse_shape(q["shape"])
+            elif q.get("count"):
+                count = int(q["count"])
+            else:
+                raise ValueError("want shape=XxYxZ or count=N")
+            cpp = int(q.get("cpp", 1))
+            doc = extender.capacity.probe(count=count, shape=shape,
+                                          chips_per_pod=cpp)
+        except ValueError as e:
+            raise web.HTTPBadRequest(text=str(e))
+        return web.json_response(doc)
+
     async def statusz_handler(request: web.Request) -> web.Response:
         # behind the bearer middleware like /state and /trace: the
         # pending-eviction queue and reservation summary disclose
@@ -2199,6 +2271,8 @@ def make_app(
     app.router.add_get("/trace", trace_handler)
     app.router.add_get("/events", events_handler)
     app.router.add_get("/explain", explain_handler)
+    app.router.add_get("/capacity", capacity_handler)
+    app.router.add_get("/capacity/probe", capacity_probe_handler)
     app.router.add_get("/statusz", statusz_handler)
     return app
 

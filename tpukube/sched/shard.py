@@ -400,6 +400,18 @@ class InProcessTransport:
         tr = self.extender.trace
         return tr.events(since_seq=since_seq) if tr is not None else []
 
+    def capacity_doc(self, since=None) -> Optional[dict[str, Any]]:
+        cap = self.extender.capacity
+        return cap.capacity_doc(since=since) if cap is not None else None
+
+    def capacity_probe(self, count=None, shape=None,
+                       chips_per_pod=1) -> Optional[dict[str, Any]]:
+        cap = self.extender.capacity
+        if cap is None:
+            return None
+        return cap.probe(count=count, shape=shape,
+                         chips_per_pod=chips_per_pod)
+
     def wire_snapshot(self) -> Optional[dict[str, Any]]:
         return None  # direct dispatch: nothing crosses a wire
 
@@ -458,6 +470,8 @@ class InProcessTransport:
         ext = self.extender
         if ext.trace is not None:
             ext.trace.close()
+        if ext.capacity is not None:
+            ext.capacity.close()
         ext.events.close()
         if ext.journal is not None:
             ext.journal.close()
@@ -842,6 +856,29 @@ class SubprocessTransport:
                 "GET", f"/trace?since={since_seq}") or []
         except ShardError:
             return []  # tracing disabled on the worker (404)
+
+    def capacity_doc(self, since=None) -> Optional[dict[str, Any]]:
+        path = "/capacity" + (f"?since={since}" if since is not None
+                              else "")
+        try:
+            return self._request("GET", path)
+        except ShardError:
+            return None  # capacity disabled on the worker (404)
+
+    def capacity_probe(self, count=None, shape=None,
+                       chips_per_pod=1) -> Optional[dict[str, Any]]:
+        from urllib.parse import urlencode
+
+        q: dict[str, Any] = {"chips_per_pod": chips_per_pod}
+        if count is not None:
+            q["count"] = count
+        if shape is not None:
+            q["shape"] = "x".join(str(d) for d in shape)
+        try:
+            return self._request(
+                "GET", f"/capacity/probe?{urlencode(q)}")
+        except ShardError:
+            return None  # capacity disabled on the worker (404)
 
     def wire_snapshot(self) -> dict[str, Any]:
         """Cumulative request/response byte counters, total and per op
@@ -1681,6 +1718,62 @@ class ShardRouter:
         if limit is not None:
             rows = rows[-limit:]
         return rows
+
+    def capacity_doc(self, since=None) -> Optional[dict[str, Any]]:
+        """The router /capacity surface: N=1 serves the sole planner's
+        document verbatim (off-is-off); N>1 stitches EVERY replica's
+        answer — a killed or unreachable replica lands in
+        ``dead_replicas`` so the merged fleet view degrades loudly
+        instead of silently narrowing (never stale, never partial
+        without saying so). None when no replica has capacity on."""
+        from tpukube.obs.capacity import merge_capacity_docs
+
+        if self._sole is not None:
+            cap = self._sole.capacity
+            return cap.capacity_doc(since=since) if cap is not None \
+                else None
+        fanned = self._fan_out(
+            self._alive(),
+            lambda rep: rep.transport.capacity_doc(since=since),
+        )
+        per: list[tuple[str, Optional[dict[str, Any]]]] = []
+        for rep in self.replicas:
+            per.append((rep.name, fanned.get(rep.index)))
+        if not any(doc is not None for _, doc in per):
+            return None
+        return merge_capacity_docs(per)
+
+    def capacity_probe(self, count=None, shape=None,
+                       chips_per_pod=1) -> Optional[dict[str, Any]]:
+        """The router /capacity/probe surface: fans the read-only
+        what-if ask to every replica and merges — the demand fits if
+        ANY replica fits it whole; the DCN fallback composes the
+        per-replica largest boxes; dead replicas are named in the
+        answer (a probe that cannot see a shard must say so)."""
+        from tpukube.obs.capacity import merge_probe_docs
+
+        if self._sole is not None:
+            cap = self._sole.capacity
+            if cap is None:
+                return None
+            return cap.probe(count=count, shape=shape,
+                             chips_per_pod=chips_per_pod)
+        fanned = self._fan_out(
+            self._alive(),
+            lambda rep: rep.transport.capacity_probe(
+                count=count, shape=shape, chips_per_pod=chips_per_pod),
+        )
+        per = [(rep.name, fanned.get(rep.index))
+               for rep in self.replicas]
+        if not any(doc is not None for _, doc in per):
+            return None
+        total = (count if count is not None
+                 else shape[0] * shape[1] * shape[2])
+        return merge_probe_docs(per, {
+            "count": count,
+            "shape": list(shape) if shape else None,
+            "chips": total,
+        })
 
     # -- Extender-surface passthroughs --------------------------------------
     @property

@@ -387,12 +387,52 @@ def make_router_app(router) -> web.Application:
         since = int(request.query.get("since", 0))
         return web.json_response(router.trace.events(since_seq=since))
 
+    async def capacity(request: web.Request) -> web.Response:
+        from tpukube.obs.capacity import parse_since
+
+        raw = request.query.get("since")
+        try:
+            since = parse_since(raw) if raw else None
+        except ValueError as e:
+            raise web.HTTPBadRequest(text=str(e)) from None
+        doc = await asyncio.to_thread(router.capacity_doc, since)
+        if doc is None:
+            raise web.HTTPNotFound(
+                text="capacity analytics disabled "
+                     "(set capacity_enabled)")
+        return web.json_response(doc)
+
+    async def capacity_probe(request: web.Request) -> web.Response:
+        from tpukube.obs.capacity import parse_shape
+
+        q = request.query
+        try:
+            count = int(q["count"]) if "count" in q else None
+            shape = (parse_shape(q["shape"]) if "shape" in q
+                     else None)
+            cpp = int(q.get("chips_per_pod", 1))
+            if (count is None) == (shape is None):
+                raise ValueError(
+                    "probe wants exactly one of ?count= / ?shape=")
+        except ValueError as e:
+            raise web.HTTPBadRequest(text=str(e)) from None
+        doc = await asyncio.to_thread(
+            lambda: router.capacity_probe(
+                count=count, shape=shape, chips_per_pod=cpp))
+        if doc is None:
+            raise web.HTTPNotFound(
+                text="capacity analytics disabled "
+                     "(set capacity_enabled)")
+        return web.json_response(doc)
+
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/statusz", statusz)
     app.router.add_get("/explain", explain)
     app.router.add_get("/events", events)
     app.router.add_get("/trace", trace_route)
+    app.router.add_get("/capacity", capacity)
+    app.router.add_get("/capacity/probe", capacity_probe)
     return app
 
 
@@ -460,6 +500,8 @@ def main_worker(argv: Optional[list[str]] = None) -> int:
             extender.trace.close()
         if extender.decisions is not None:
             extender.decisions.close()
+        if extender.capacity is not None:
+            extender.capacity.close()
         extender.events.close()
         if extender.journal is not None:
             extender.journal.close()

@@ -414,6 +414,8 @@ class SimCluster:
             else:
                 if self.extender.trace is not None:
                     self.extender.trace.close()
+                if self.extender.capacity is not None:
+                    self.extender.capacity.close()
                 self.extender.events.close()
                 if self.extender.journal is not None:
                     self.extender.journal.close()

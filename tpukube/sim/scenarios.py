@@ -66,6 +66,13 @@ _PASSTHROUGH_KEYS = (
     # (legacy full-read resyncs) asserting bit-identical placements
     "TPUKUBE_BULK_INGEST_ENABLED",
     "TPUKUBE_GENERATION_LOG_CAPACITY",
+    # capacity analytics (ISSUE 17): the check.sh capacity smoke and
+    # the bench capacity key re-run the scenario-12 slice with the
+    # flight recorder on and floor the measured sampling overhead
+    "TPUKUBE_CAPACITY_ENABLED",
+    "TPUKUBE_CAPACITY_SAMPLE_INTERVAL_SECONDS",
+    "TPUKUBE_CAPACITY_SAMPLES",
+    "TPUKUBE_CAPACITY_PATH",
 )
 
 
@@ -972,6 +979,31 @@ def _kilonode_drive(cfg: TpuKubeConfig, metric: str, total_target: int,
                 **ds,
                 "overhead_pct": (round(100.0 * ds["record_seconds"]
                                        / wall, 3) if wall else None),
+            }
+        # capacity flight recorder (ISSUE 17): utilization-over-time +
+        # the stranded forensics rollup ride the result, plus the
+        # measured recorder overhead — check.sh's capacity smoke
+        # floors it like the decisions overhead above
+        cap = getattr(ext, "capacity", None)  # raw extender
+        if cap is not None:
+            cap_doc = cap.capacity_doc()
+        else:  # router surface (federated; None when capacity is off)
+            cap_fn = getattr(ext, "capacity_doc", None)
+            cap_doc = cap_fn() if cap_fn is not None else None
+        if cap_doc is not None:
+            result["utilization_over_time"] = [
+                (s.get("fleet") or {}).get("utilization")
+                for s in cap_doc["samples"]
+            ]
+            result["stranded"] = cap_doc["stranded"]
+            cstats = cap_doc.get("stats") or {}
+            secs = cstats.get("sample_seconds")
+            result["capacity"] = {
+                **cstats,
+                "overhead_pct": (
+                    round(100.0 * secs / wall, 3)
+                    if wall and isinstance(secs, (int, float))
+                    else None),
             }
         if delta_stats:
             # the ISSUE 10 acceptance numbers: the O(Δ) delta-advance
