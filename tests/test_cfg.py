@@ -405,6 +405,142 @@ def test_epoch_findings_waivable(tmp_path):
     assert base.apply_waivers(sf, raw) == []
 
 
+# -- interprocedural delegation (ISSUE 18) -----------------------------------
+
+DELEGATED_BUMP_OK = '''\
+class GangManager:
+    def drop(self, key):
+        with self._lock:
+            self._reservations.pop(key, None)
+            self._bump_locked()
+
+    def _bump_locked(self):
+        self._epoch += 1
+'''
+
+DELEGATED_BUMP_TWO_LEVEL = '''\
+class GangManager:
+    def drop(self, key):
+        with self._lock:
+            self._reservations.pop(key, None)
+            self._outer_locked()
+
+    def _outer_locked(self):
+        self._inner_locked()
+
+    def _inner_locked(self):
+        self._epoch += 1
+'''
+
+DELEGATED_BUMP_PARTIAL = '''\
+class GangManager:
+    def drop(self, key):
+        with self._lock:
+            self._reservations.pop(key, None)
+            self._bump_locked(key)
+
+    def _bump_locked(self, key):
+        if key is None:
+            return
+        self._epoch += 1
+'''
+
+
+def test_delegated_bump_one_level_accepted(tmp_path):
+    """`self._helper()` whose body bumps on EVERY exit discharges the
+    caller's epoch obligation — the one-level interprocedural summary."""
+    assert check_epochs(
+        _sf(tmp_path, "sched/gang.py", DELEGATED_BUMP_OK)) == []
+
+
+def test_delegated_bump_two_level_chain_rejected(tmp_path):
+    """Helper summaries use the DIRECT predicate only: a helper that
+    merely calls another bumping helper does not vouch — unbounded
+    delegation chains would make the proof unreadable and unsound
+    (the middle hop can grow a bail-out path silently)."""
+    findings = check_epochs(
+        _sf(tmp_path, "sched/gang.py", DELEGATED_BUMP_TWO_LEVEL))
+    assert findings
+    assert all(f.rule == "epoch-discipline" for f in findings)
+
+
+def test_delegated_bump_partial_helper_rejected(tmp_path):
+    """A helper that bumps on only SOME of its paths does not
+    discharge the caller — always_satisfies demands every exit."""
+    assert check_epochs(
+        _sf(tmp_path, "sched/gang.py", DELEGATED_BUMP_PARTIAL))
+
+
+def test_classgraph_tracks_locks_held_at_call_sites():
+    from tpukube.analysis import callgraph
+
+    tree = ast.parse(textwrap.dedent('''
+        class C:
+            def outer(self):
+                self.before()
+                with self._lock:
+                    self.under()
+                self.after()
+    '''))
+    cg = callgraph.ClassGraph(tree.body[0], lock_attrs=("_lock",))
+    assert cg.sites_of("under")[0].held == frozenset({"_lock"})
+    assert cg.sites_of("before")[0].held == frozenset()
+    assert cg.sites_of("after")[0].held == frozenset()
+
+
+# -- seam-triple mutation-kill sweep (ISSUE 18) -------------------------------
+
+def _seam_mutants(src: str):
+    """(description, first line, end line) per deletable seam site:
+    every `_note_delta_locked`/`_note_journal_locked` statement-call
+    and every `self._epoch += 1`."""
+    out = []
+    for n in ast.walk(ast.parse(src)):
+        if (isinstance(n, ast.Expr) and isinstance(n.value, ast.Call)
+                and isinstance(n.value.func, ast.Attribute)
+                and n.value.func.attr in ("_note_delta_locked",
+                                          "_note_journal_locked")):
+            out.append((n.value.func.attr, n.lineno, n.end_lineno))
+        elif (isinstance(n, ast.AugAssign) and isinstance(n.op, ast.Add)
+                and cfg._self_attr(n.target) == "_epoch"):
+            out.append(("_epoch += 1", n.lineno, n.end_lineno))
+    return out
+
+
+def test_seam_triple_mutation_kill_sweep():
+    """Deleting ANY single delta note, journal note, or epoch bump in
+    the shipped ledger/gang modules flips lint to failing — the
+    bump/delta/journal triple is provably covered site by site, with
+    the real waivers applied (a waiver that masked a kill would show
+    up here as a survivor)."""
+    from tpukube.analysis.seams import check_seam_triples
+
+    survivors = []
+    total = 0
+    for rel in ("sched/state.py", "sched/gang.py"):
+        path = os.path.join(REPO, "tpukube", rel)
+        src = open(path).read()
+        lines = src.splitlines(keepends=True)
+        mutants = _seam_mutants(src)
+        assert len(mutants) >= 20, f"{rel}: seam sites went missing?"
+        total += len(mutants)
+        for what, lo, hi in mutants:
+            mutated = list(lines)
+            indent = len(lines[lo - 1]) - len(lines[lo - 1].lstrip())
+            mutated[lo - 1] = " " * indent + "pass\n"
+            for i in range(lo, hi):
+                mutated[i] = "\n"
+            sf = base.SourceFile(path, text="".join(mutated), rel=rel)
+            findings = base.apply_waivers(
+                sf, check_seam_triples(sf) + check_epochs(sf))
+            if not findings:
+                survivors.append(f"{rel}:{lo} ({what})")
+    assert total >= 80
+    assert not survivors, (
+        "deleting these seam sites went UNDETECTED: "
+        + ", ".join(survivors))
+
+
 # -- reservation-leak fixture pairs ------------------------------------------
 
 LEAK_TRY_FINALLY_VIO = '''\

@@ -565,6 +565,251 @@ def test_unparseable_file_is_a_finding_not_a_crash(tmp_path):
     assert main([str(tmp_path)]) == 1  # pointed finding, no traceback
 
 
+# -- seam-triple (ISSUE 18) --------------------------------------------------
+
+def _seam_registry():
+    from tpukube.analysis import seams
+
+    return {("sched/ledger.py", "Ledger"): seams.TripleSpec(
+        lock_attr="_lock",
+        journal_exempt=frozenset({"replay"}),
+    )}
+
+
+VIOLATING_SEAMS = '''\
+class Ledger:
+    def missing_journal(self, key):
+        with self._lock:
+            self._map[key] = 1
+            self._epoch += 1
+            self._note_delta_locked(slices=(key,), why="x")
+
+    def missing_both_on_branch(self, key, fast):
+        with self._lock:
+            self._epoch += 1
+            if fast:
+                return None
+            self._note_delta_locked(slices=(key,), why="x")
+            self._note_journal_locked("k", {"key": key})
+
+    def raises_before_journal(self, key):
+        with self._lock:
+            self._epoch += 1
+            self._note_delta_locked(slices=(key,), why="x")
+            if key is None:
+                raise ValueError("bad key")
+            self._note_journal_locked("k", {"key": key})
+
+    def double_bump_one_delta(self, a):
+        with self._lock:
+            self._epoch += 1
+            self._epoch += 1
+            self._note_delta_locked(slices=(a,), why="x")
+            self._note_journal_locked("k", {"a": a})
+'''
+
+CLEAN_SEAMS = '''\
+class Ledger:
+    def commit(self, key):
+        with self._lock:
+            self._map[key] = 1
+            self._epoch += 1
+            self._note_delta_locked(slices=(key,), why="commit")
+            self._note_journal_locked("k", {"key": key})
+
+    def replay(self, doc):
+        with self._lock:
+            self._epoch += 1
+            self._note_delta_locked(slices=(doc,), why="replay")
+
+    def _drop_locked(self, key):
+        self._map.pop(key, None)
+        self._epoch += 1
+        self._note_delta_locked(slices=(key,), why="drop")
+        self._note_journal_locked("k", {"key": key})
+'''
+
+
+def test_seam_triple_catches_and_passes(tmp_path):
+    from tpukube.analysis.seams import check_seam_triples
+
+    reg = _seam_registry()
+    sf = _sf(tmp_path, "sched/ledger.py", VIOLATING_SEAMS)
+    findings = check_seam_triples(sf, registry=reg)
+    assert all(f.rule == "seam-triple" for f in findings)
+    msgs = [f.message for f in findings]
+    # one per seeded hole: missing journal half, both halves on the
+    # early-return branch, the raise path, and the bump-to-bump gap
+    assert any("_note_journal_locked" in m and "missing_journal" in m
+               for m in msgs)
+    assert sum("missing_both_on_branch" in m for m in msgs) == 2
+    assert any("exception path" in m and "raises_before_journal" in m
+               for m in msgs)
+    assert any("reaches the next bump" in m for m in msgs)
+    assert len(findings) == 5
+    assert check_seam_triples(
+        _sf(tmp_path, "o/sched/ledger.py", CLEAN_SEAMS),
+        registry=reg) == []
+
+
+def test_seam_triple_raise_path_waivable_without_masking_bump(tmp_path):
+    """The raise-path finding anchors at the RAISE, not the bump — a
+    deliberate mutate-then-raise design gets waived there while the
+    same bump's normal-path obligations stay enforced."""
+    from tpukube.analysis.seams import check_seam_triples
+
+    src = VIOLATING_SEAMS.replace(
+        "            if key is None:\n"
+        "                raise ValueError(\"bad key\")",
+        "            if key is None:\n"
+        "                # tpukube: allow(seam-triple) fixture: the "
+        "failed-validation raise is not journaled by design\n"
+        "                raise ValueError(\"bad key\")")
+    sf = _sf(tmp_path, "sched/ledger.py", src)
+    raw = check_seam_triples(sf, registry=_seam_registry())
+    kept = base.apply_waivers(sf, raw)
+    assert len(kept) == len(raw) - 1
+    assert not any("exception path" in f.message for f in kept)
+
+
+def test_seam_triple_required_kinds_catch_deleted_journal_site(tmp_path):
+    """Deleting a journal-ONLY note (no bump beside it) is caught by
+    kind coverage: the replayer still dispatches on the string, so a
+    file that stops noting it has a dead recovery seam."""
+    from tpukube.analysis.seams import check_seam_triples
+
+    src = '''\
+class ClusterState:
+    def note_some(self):
+        with self._lock:
+            self._note_journal_locked("node", {})
+            self._note_journal_locked("nodes", {})
+            self._note_journal_locked("commit", {})
+'''
+    findings = check_seam_triples(_sf(tmp_path, "sched/state.py", src))
+    assert len(findings) == 1
+    assert '"release"' in findings[0].message
+
+
+# -- flag-discipline (ISSUE 18) ----------------------------------------------
+
+def _flag_registry():
+    from tpukube.analysis import flags
+
+    return (flags.FlagSpec(
+        flag="widget_enabled",
+        ctors=frozenset({"WidgetRing"}),
+        construct_scope=("sched/widgets.py",),
+        attr="widgets",
+        consumers=(("sched/widgets.py", "Owner"),),
+    ),)
+
+
+VIOLATING_FLAGS = '''\
+class Owner:
+    def __init__(self, config):
+        self.widgets = WidgetRing(config)
+
+    def use(self):
+        return self.widgets.count()
+'''
+
+CLEAN_FLAGS = '''\
+class Owner:
+    def __init__(self, config):
+        self.widgets = (WidgetRing(config)
+                        if config.widget_enabled else None)
+
+    def use(self):
+        if self.widgets is None:
+            return 0
+        return self.widgets.count()
+
+    def inline(self):
+        return (self.widgets.count()
+                if self.widgets is not None else 0)
+
+    def flag_named_block(self, config):
+        if config.widget_enabled:
+            return self.widgets.count()
+        return 0
+'''
+
+
+def test_flag_discipline_catches_and_passes(tmp_path):
+    from tpukube.analysis.flags import check_flags
+
+    reg = _flag_registry()
+    sf = _sf(tmp_path, "sched/widgets.py", VIOLATING_FLAGS)
+    findings = check_flags(sf, registry=reg)
+    assert len(findings) == 2
+    assert any("constructed without" in f.message for f in findings)
+    assert any("is None` guard" in f.message for f in findings)
+    assert check_flags(
+        _sf(tmp_path, "o/sched/widgets.py", CLEAN_FLAGS),
+        registry=reg) == []
+    # out of scope: the same code elsewhere is not this pass's business
+    assert check_flags(
+        _sf(tmp_path, "obs/other.py", VIOLATING_FLAGS),
+        registry=reg) == []
+
+
+def test_flag_discipline_registry_rot_against_config(tmp_path):
+    """A FLAG_REGISTRY entry whose flag is not a config field gates
+    nothing — flagged when linting core/config.py."""
+    from tpukube.analysis.flags import check_flags
+
+    src = '''\
+class TpuKubeConfig:
+    decisions_enabled: bool = False
+'''
+    findings = check_flags(_sf(tmp_path, "core/config.py", src),
+                           registry=_flag_registry())
+    assert len(findings) == 1
+    assert "widget_enabled" in findings[0].message
+
+
+def test_flag_discipline_shipped_registry_matches_config():
+    """Every shipped FLAG_REGISTRY flag is a real TpuKubeConfig field."""
+    from tpukube.analysis.flags import FLAG_REGISTRY
+    from tpukube.core.config import TpuKubeConfig
+
+    for spec in FLAG_REGISTRY:
+        assert hasattr(TpuKubeConfig, spec.flag) or \
+            spec.flag in TpuKubeConfig.__annotations__
+
+
+# -- name-consistency reverse audit (ISSUE 18) --------------------------------
+
+def test_registry_rot_reverse_audit(tmp_path):
+    """A declared series/reason whose last reference site was deleted
+    is a finding on the DECLARING file — dashboards and rules keep
+    resolving the name while nothing serves it."""
+    _sf(tmp_path, "obs/render.py",
+        'SERIES = "tpukube_used_series"\n'
+        'REASON = "UsedReason"\n')
+    reg = _sf(tmp_path, "obs/registry.py", '''\
+DECLARED_SERIES = frozenset({
+    "tpukube_used_series",
+    "tpukube_rotten_series",
+})
+''')
+    findings = check_names(reg)
+    assert len(findings) == 1
+    assert "tpukube_rotten_series" in findings[0].message
+    assert findings[0].line == 3
+
+    ev = _sf(tmp_path, "obs/events.py", '''\
+REASONS = (
+    "UsedReason",
+    "GhostReason",
+)
+''')
+    findings = check_names(ev)
+    assert len(findings) == 1
+    assert "GhostReason" in findings[0].message
+
+
 # -- the real tree (tier-1 acceptance) ---------------------------------------
 
 def test_tree_is_clean():
@@ -748,3 +993,55 @@ def test_dynamic_detector_concurrent_stress_via_config_flag():
     assert report["acquisitions"] > 0
     # uninstalled with the cluster
     assert threading.Lock is lockgraph._REAL_LOCK
+
+
+# -- federated lockgraph (ISSUE 18) ------------------------------------------
+
+def test_federated_lockgraph_merges_fleet_and_is_clean():
+    """The sharded plane under the monitor: the router's
+    ``lockgraph_report()`` merges its own edges with every replica's
+    (inproc replicas share the process-wide monitor and are listed
+    without double-merging) and the fleet-wide cycle check is clean
+    across the extended partial order (router/journal edges included)."""
+    from tpukube.core.config import load_config
+    from tpukube.sim import SimCluster
+
+    cfg = load_config(env={
+        "TPUKUBE_LOCK_MONITOR": "1",
+        "TPUKUBE_PLANNER_REPLICAS": "2",
+        "TPUKUBE_SHARD_SLICES": "2",
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,4",
+    })
+    with SimCluster(cfg, in_process=True) as c:
+        for i in range(8):
+            c.schedule(c.make_pod(f"p{i}", tpu=1))
+        rep = c.extender.lockgraph_report()
+        assert rep is not None
+        assert rep["cycles"] == [], rep["cycles"]
+        assert rep["acquisitions"] > 0
+        assert rep["replicas_reporting"] == ["r0", "r1"]
+        # every replica_summary row carries its own report too — the
+        # worker status surface the subprocess merge rides
+        doc = c.extender.statusz()
+        for row in doc["replicas"]:
+            assert row["lock_graph"]["cycles"] == []
+    assert threading.Lock is lockgraph._REAL_LOCK
+
+
+def test_federated_lockgraph_off_is_off():
+    """Monitor off: ``lockgraph_report()`` is None and replica
+    summaries carry NO lock_graph key — the status wire shape is
+    byte-identical to the pre-monitor plane."""
+    from tpukube.core.config import load_config
+    from tpukube.sim import SimCluster
+
+    cfg = load_config(env={
+        "TPUKUBE_PLANNER_REPLICAS": "2",
+        "TPUKUBE_SHARD_SLICES": "2",
+        "TPUKUBE_SIM_MESH_DIMS": "2,2,4",
+    })
+    with SimCluster(cfg, in_process=True) as c:
+        c.schedule(c.make_pod("p0", tpu=1))
+        assert c.extender.lockgraph_report() is None
+        for row in c.extender.statusz()["replicas"]:
+            assert "lock_graph" not in row

@@ -683,11 +683,14 @@ def test_incremental_occupied_matches_walk_through_lifecycle():
 def test_scenario14_smoke(monkeypatch):
     """tpukube-sim 14 at tier-1 scale: 2 tiny slices behind 2 planner
     replicas, full invariants (the scenario raises on leaks,
-    divergence, shortfall, or a dead replica)."""
+    divergence, shortfall, or a dead replica) — run under the dynamic
+    lock-order monitor, asserting the fleet-merged lockgraph (router +
+    worker edges) stays cycle-free (ISSUE 18 acceptance)."""
     monkeypatch.setenv("TPUKUBE_SHARD_SLICES", "2")
     monkeypatch.setenv("TPUKUBE_SIM_MESH_DIMS", "4,4,4")
     monkeypatch.setenv("TPUKUBE_PLANNER_REPLICAS", "2")
     monkeypatch.setenv("TPUKUBE_KILONODE100K_PODS", "400")
+    monkeypatch.setenv("TPUKUBE_LOCK_MONITOR", "1")
     from tpukube.sim import scenarios
 
     r = scenarios.run(14)
@@ -698,6 +701,10 @@ def test_scenario14_smoke(monkeypatch):
     assert len(r["shard"]["replicas"]) == 2
     assert all(x["alive"] for x in r["shard"]["replicas"])
     assert set(r["shard"]["slice_assignment"].values()) == {"r0", "r1"}
+    lg = r["shard"]["lock_graph"]
+    assert lg["cycles"] == [], lg["cycles"]
+    assert lg["acquisitions"] > 0
+    assert lg["replicas_reporting"] == ["r0", "r1"]
 
 
 def test_config_validation_replicas():

@@ -6,9 +6,20 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== tpukube-lint (static analysis: lock discipline/order, shared"
-echo "   state, name consistency, exception hygiene, CFG dataflow:"
-echo "   epoch discipline + reservation leaks, stale waivers) =="
+echo "   state, name consistency + registry reverse audit, exception"
+echo "   hygiene, CFG dataflow: epoch discipline + reservation leaks +"
+echo "   seam triples, flag discipline, stale waivers) =="
 python -m tpukube.analysis tpukube
+# the grown pass families must stay REGISTERED: a rule dropping out of
+# the runner (a lost ALL_RULES entry, a broken import) would make the
+# clean exit above trivially meaningless for that family
+rule_listing="$(python -m tpukube.analysis --list-rules)"
+for rule in seam-triple flag-discipline name-consistency epoch-discipline; do
+  grep -q "^${rule} " <<<"${rule_listing}" || {
+    echo "tpukube-lint: rule ${rule} missing from --list-rules" >&2
+    exit 1
+  }
+done
 
 echo
 echo "== tier-1 tests =="

@@ -32,6 +32,8 @@ ALL_RULES: tuple[str, ...] = (
     "epoch-discipline",
     "reservation-leak",
     "decision-provenance",
+    "seam-triple",
+    "flag-discipline",
     "unused-waiver",
     "bare-waiver",
 )
@@ -118,10 +120,12 @@ def _passes() -> dict[str, Callable[[SourceFile], list[Finding]]]:
     from tpukube.analysis import (
         consistency,
         epochs,
+        flags,
         hygiene,
         leaks,
         locks,
         provenance,
+        seams,
     )
 
     return {
@@ -134,6 +138,8 @@ def _passes() -> dict[str, Callable[[SourceFile], list[Finding]]]:
         "epoch-discipline": epochs.check_epochs,
         "reservation-leak": leaks.check_leaks,
         "decision-provenance": provenance.check_provenance,
+        "seam-triple": seams.check_seam_triples,
+        "flag-discipline": flags.check_flags,
     }
 
 

@@ -43,6 +43,13 @@ _RULE_DOCS = {
     "decision-provenance": "every refusal/denial seam (tenancy gate, "
                            "degraded gate, filter errors) records a "
                            "DecisionRecord",
+    "seam-triple": "every epoch bump in the ledger/gang pairs with a "
+                   "delta note AND a journal note on every path before "
+                   "the lock region exits; each replayed WAL kind is "
+                   "still written somewhere (CFG dataflow)",
+    "flag-discipline": "feature-gated subsystems built only under "
+                       "their config flag; every seam dereference is "
+                       "None-guarded (off-is-off)",
     "unused-waiver": "a waiver that suppressed zero findings is stale "
                      "and must be deleted",
     "bare-waiver": "waiver pragmas must name known rules and carry a "

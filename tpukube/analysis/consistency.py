@@ -10,6 +10,18 @@ expression referencing a series nobody renders fails before the alert
 silently goes blind. Only string LITERALS are checked — forwarding
 wrappers passing a ``reason`` variable are the call sites' problem, and
 the call sites are literals.
+
+The cross-check runs BOTH directions (ISSUE 18): the forward pass
+above catches a constructor naming an undeclared series; the reverse
+pass (``_check_registry_rot``) catches registry rot — a series or
+reason that stays DECLARED after its last render/emit site was
+deleted. A rotted declaration is worse than a missing one: the
+rules-file check keeps passing (the name resolves), so the alert
+reading it goes blind without any lint noise. Audited against the
+registries the federated-observability and capacity PRs grew
+(``tpukube_replica_*``, ``tpukube_capacity_*``,
+``tpukube_cycle_queue_age_seconds``): all declared entries have live
+reference sites as of this pass's introduction.
 """
 
 from __future__ import annotations
@@ -79,11 +91,86 @@ def _literal_arg(call: ast.Call, kwarg: str) -> Optional[str]:
     return None
 
 
+#: (path suffix, declared-registry variable) -> what its entries are.
+#: The reverse audit fires when linting the DECLARING file and scans
+#: the package tree (the declaring file's grandparent directory) for
+#: reference sites.
+_REGISTRY_DECLS: dict[str, tuple[str, str]] = {
+    "obs/registry.py": ("DECLARED_SERIES", "metric series"),
+    "obs/events.py": ("REASONS", "event reason"),
+}
+
+
+def _declared_entries(sf: SourceFile, var: str) -> list[tuple[int, str]]:
+    """(line, value) per string literal inside the module-level
+    ``var = (... | {...})`` declaration — parsed from the AST, not
+    imported, so fixture registries work."""
+    for node in sf.tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        if not any(isinstance(t, ast.Name) and t.id == var
+                   for t in targets):
+            continue
+        return [
+            (n.lineno, n.value) for n in ast.walk(node.value)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)
+        ]
+    return []
+
+
+def _check_registry_rot(sf: SourceFile) -> list[Finding]:
+    """The reverse cross-check: every declared series/reason must have
+    at least one string-literal reference SOMEWHERE ELSE in the package
+    tree. Deleting a render/emit site without retiring the declaration
+    leaves dashboards and prometheus-rules reading a name nothing
+    serves — the rules-file check alone cannot catch that (the name
+    still resolves against the registry)."""
+    decl = None
+    for sfx, (var, what) in _REGISTRY_DECLS.items():
+        if sf.in_scope((sfx,)):
+            decl = (var, what)
+            break
+    if decl is None:
+        return []
+    var, what = decl
+    entries = _declared_entries(sf, var)
+    if not entries:
+        return []
+    root = sf.path.resolve().parent.parent
+    own = sf.path.resolve()
+    referenced: set = set()
+    for f in sorted(root.rglob("*.py")):
+        if f.resolve() == own or f.name.endswith("_pb2.py"):
+            continue
+        try:
+            tree = ast.parse(f.read_text())
+        except (SyntaxError, ValueError, UnicodeDecodeError):
+            continue  # parse-error findings are the runner's job
+        for n in ast.walk(tree):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                referenced.add(n.value)
+    findings: list[Finding] = []
+    for line, value in entries:
+        if value not in referenced:
+            findings.append(Finding(
+                "name-consistency", sf.rel, line,
+                f"{what} {value!r} is declared in {var} but no module "
+                f"in the package references it — the render/emit site "
+                f"is gone; retire the declaration (a rotted entry keeps "
+                f"rules-file expressions resolving against a series "
+                f"nothing serves)",
+            ))
+    return findings
+
+
 def check_names(sf: SourceFile) -> list[Finding]:
     from tpukube.obs.events import REASONS
     from tpukube.obs.registry import DECLARED_SERIES
 
-    findings: list[Finding] = []
+    findings: list[Finding] = list(_check_registry_rot(sf))
     for node in ast.walk(sf.tree):
         if not isinstance(node, ast.Call):
             continue

@@ -37,6 +37,14 @@ Helper methods that bump INTERNALLY (``_rollback_locked``,
 ``_evict_and_mask_locked``, ``ClusterState.commit``) are deliberately
 NOT registered as mutators: their callers need no second bump, and
 their own bodies are checked like any other function.
+
+Since ISSUE 18 the bump predicate is interprocedural ONE level via
+:mod:`tpukube.analysis.callgraph`: a statement calling an intra-class
+helper whose own DIRECT statements bump on every exit counts as a
+bump for the caller — ``self._register_and_bump_locked(...)``
+satisfies the seam it follows. The helper summary uses direct bumps
+only, so a two-level chain (helper delegating to a sub-helper that
+bumps) is rejected by design.
 """
 
 from __future__ import annotations
@@ -45,7 +53,7 @@ import ast
 from dataclasses import dataclass
 from typing import Optional
 
-from tpukube.analysis import cfg
+from tpukube.analysis import callgraph, cfg
 from tpukube.analysis.base import Finding, SourceFile
 
 #: methods that mutate the receiver when called on a seam attribute
@@ -182,6 +190,7 @@ def check_epochs(sf: SourceFile,
         spec = specs.get(cls_node.name)
         if spec is None:
             continue
+        cg = callgraph.ClassGraph(cls_node)
         for fn in cls_node.body:
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
@@ -194,8 +203,14 @@ def check_epochs(sf: SourceFile,
             if not seams:
                 continue
 
+            # one-level delegation: a call to an intra-class helper
+            # whose direct statements bump on every exit is a bump
+            lifted = callgraph.delegating_satisfier(
+                cg, lambda stmt: _is_bump(stmt, spec),
+                exclude=(fn.name,))
+
             def bump(node: cfg.Node) -> bool:
-                return node.stmt is not None and _is_bump(node.stmt, spec)
+                return node.stmt is not None and lifted(node.stmt)
 
             for node, events in seams:
                 what = " + ".join(sorted(set(events)))

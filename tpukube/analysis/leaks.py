@@ -32,7 +32,7 @@ import ast
 from dataclasses import dataclass
 from typing import Optional
 
-from tpukube.analysis import cfg
+from tpukube.analysis import callgraph, cfg
 from tpukube.analysis.base import Finding, SourceFile
 
 
@@ -169,6 +169,7 @@ def check_leaks(sf: SourceFile,
     for cls_node in sf.tree.body:
         if not isinstance(cls_node, ast.ClassDef):
             continue
+        cg = callgraph.ClassGraph(cls_node)
         for fn in cls_node.body:
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
@@ -177,12 +178,19 @@ def check_leaks(sf: SourceFile,
                 continue
             g = cfg.build_cfg(fn)
 
-            def settles(node: cfg.Node) -> bool:
-                if node.stmt is None:
-                    return False
-                if _call_names(node.stmt) & spec.settles:
+            def _settle_stmt(stmt: ast.AST) -> bool:
+                if _call_names(stmt) & spec.settles:
                     return True
-                return bool(_store_attrs(node.stmt, spec.settle_stores))
+                return bool(_store_attrs(stmt, spec.settle_stores))
+
+            # one-level delegation: a call to an intra-class helper
+            # whose direct statements settle on every exit settles
+            # for the caller (a two-level chain does not)
+            lifted = callgraph.delegating_satisfier(
+                cg, _settle_stmt, exclude=(fn.name,))
+
+            def settles(node: cfg.Node) -> bool:
+                return node.stmt is not None and lifted(node.stmt)
 
             for node in g.nodes:
                 if node.stmt is None:

@@ -289,6 +289,16 @@ def install(scope: Optional[str] = PACKAGE_SCOPE) -> LockOrderMonitor:
     return monitor
 
 
+def active() -> Optional[LockOrderMonitor]:
+    """The currently installed monitor, or None when the detector is
+    off. The read-only accessor the federated surfaces use: a worker's
+    ``replica_summary`` attaches its report when a monitor is live, and
+    the router merges the fleet's edge sets into one cycle check —
+    without either surface owning install/uninstall."""
+    with _state_mu:
+        return _active
+
+
 def uninstall() -> None:
     """Undo one install(); the factories revert when the last nested
     install unwinds. Live proxies keep recording into their monitor —

@@ -284,13 +284,19 @@ class CapacityRecorder:
                 return
             key, total, shape, dcn, cpp = demand
             epoch = self._ext.snapshots.epoch_key()
-            memo = self._classified_at.get(key)
+            # the memo is shared with _expire_stranded_locked (which
+            # pops entries from another thread's sample tick): read and
+            # write under the lock; the expensive _classify probe stays
+            # OUTSIDE it (lock-discipline: no heavy work under _lock)
+            with self._lock:
+                memo = self._classified_at.get(key)
             if memo is not None and memo[0] == epoch:
                 reason, detail = memo[1], None
             else:
                 reason, detail = self._classify(total, shape, dcn, cpp,
                                                 error)
-                self._classified_at[key] = (epoch, reason)
+                with self._lock:
+                    self._classified_at[key] = (epoch, reason)
                 self.classified += 1
             self._unschedulable[reason] = \
                 self._unschedulable.get(reason, 0) + 1

@@ -1300,8 +1300,9 @@ class Extender:
                 if self.binder is not None:
                     # _handle_bind's effector undo needs to know whether
                     # THIS bind committed the gang (keyed, since other
-                    # binds may interleave once the decision lock drops)
-                    # tpukube: allow(shared-state) bind() is only entered through _handle_bind, which already holds the decision lock around this whole call
+                    # binds may interleave once the decision lock drops);
+                    # proven by the interprocedural caller-check: every
+                    # intra-class bind() call site holds _decision_lock
                     self._bind_gang_info[key] = (res, committed_now)
             with self._pending_lock:
                 self._pending.pop(key, None)
@@ -1496,7 +1497,7 @@ class Extender:
                         # legacy-path refusal (fragmented / capacity /
                         # quota / shed / unhealthy / dcn-ineligible)
                         self.capacity.note_refusal(pod, str(e))
-                if tt0 is not None:
+                if self.tenants is not None and tt0 is not None:
                     self.tenants.observe_admission(
                         self.tenants.tenant_of(pod),
                         time.monotonic() - tt0,
@@ -1608,6 +1609,10 @@ class Extender:
         memoized per payload; still-lazy nodes captured as byte refs
         into the previous checkpoint file), serialization and disk
         belong to the journal's drain thread."""
+        if self.journal is None:
+            raise RuntimeError(
+                "checkpoint capture requires the journal "
+                "(journal_enabled)")
         state_head, node_entries = self.state.checkpoint_doc(
             self._ckpt_cache
         )

@@ -962,6 +962,19 @@ class GangManager:
             # mutates nothing and owes no bump (epoch-discipline lint)
             entry = self._terminating_coords.get(pod_key)
             hit = entry is not None
+            gated = False
+            for res in self._reservations.values():
+                if pod_key in res.terminating_victims:
+                    res.terminating_victims.discard(pod_key)
+                    gated = True
+                    if not res.terminating_victims:
+                        log.info(
+                            "gang %s/%s: all preemption victims terminated; "
+                            "member binds may proceed",
+                            res.namespace, res.group.name,
+                        )
+            if not hit and not gated:
+                return False
             if hit:
                 self._terminating_coords.pop(pod_key, None)
                 if self._events is not None:
@@ -979,22 +992,14 @@ class GangManager:
                 self._epoch += 1
                 self._note_delta_locked(slices=(entry[0],),
                                         why=f"victim-gone {pod_key}")
-            for res in self._reservations.values():
-                if pod_key in res.terminating_victims:
-                    res.terminating_victims.discard(pod_key)
-                    hit = True
-                    if not res.terminating_victims:
-                        log.info(
-                            "gang %s/%s: all preemption victims terminated; "
-                            "member binds may proceed",
-                            res.namespace, res.group.name,
-                        )
-            if hit:
-                # WAL: covers both the coord unmask AND the bind-gate
-                # clear (a reservation can gate on a victim whose alloc
-                # carried no coords — the record must still replay)
-                self._note_journal_locked("gvgone", {"p": pod_key})
-            return hit
+            # WAL: ONE record covers both the coord unmask and the
+            # bind-gate clear (a reservation can gate on a victim whose
+            # alloc carried no coords — the record must still replay).
+            # The single unconditional site at the region tail is what
+            # lets the seam-triple pass PROVE every bump path journals
+            # without value-tracking `hit`.
+            self._note_journal_locked("gvgone", {"p": pod_key})
+            return True
 
     def terminating_victims_of(self, res: GangReservation) -> set[str]:
         """Victims whose termination still gates this gang's binds."""
@@ -1338,6 +1343,13 @@ class GangManager:
         for pk in doc.get("tv", ()):
             res.terminating_victims.add(pk)
         self._epoch += 1
+        # without the delta note this bump is a GAP in the contiguous
+        # delta chain: the first post-replay lookup (and every replayed
+        # `gre` record after it) would fall off the O(Δ) advance into a
+        # full O(chips) rebuild — found by tpukube-lint's seam-triple
+        # pass (journal-exempt: this IS replay; noting would re-record)
+        self._note_delta_locked(slices=res.slice_coords,
+                                why=f"replayed reservation {res.key}")
         return res
 
     def restore_checkpoint(self, doc: dict) -> int:

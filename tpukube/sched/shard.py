@@ -245,7 +245,7 @@ def replica_summary(extender: Extender) -> dict[str, Any]:
     if cycle is not None:
         cycle_stats = dict(cycle.stats())
         cycle_stats["cycle_wall_total"] = cycle.cycle_wall_total
-    return {
+    out = {
         "slices": st.slice_ids(),
         "nodes": len(st.node_names()),
         "allocs": len(st.allocations()),
@@ -268,6 +268,19 @@ def replica_summary(extender: Extender) -> dict[str, Any]:
         "latencies": {h: list(w)
                       for h, w in extender.latencies.items()},
     }
+    # federated lockgraph (ISSUE 18): with the dynamic lock-order
+    # detector installed in THIS process (lock_monitor on), the
+    # replica's observed edge set rides its summary row — the same
+    # surface the subprocess transport already serves over
+    # /worker/summary, so worker-process edges reach the router's
+    # fleet-wide cycle merge with no new wire protocol. Key absent
+    # when the monitor is off (off-is-off: summaries byte-identical).
+    from tpukube.analysis import lockgraph
+
+    mon = lockgraph.active()
+    if mon is not None:
+        out["lock_graph"] = mon.report()
+    return out
 
 
 # -- replica transports ------------------------------------------------------
@@ -1933,6 +1946,12 @@ class ShardRouter:
                     "snapshot_hits": summary["snapshot_hits"],
                     "snapshot_rebuilds": summary["snapshot_rebuilds"],
                 })
+                if "lock_graph" in summary:
+                    # federated lockgraph (ISSUE 18): the worker's
+                    # observed lock-order edges ride its status row
+                    # when the monitor is live (key absent otherwise —
+                    # off-is-off)
+                    row["lock_graph"] = summary["lock_graph"]
             if self._sole is None and summary is not None:
                 # federated per-replica observability sections: each
                 # worker's decisions ring / event journal / journal
@@ -3660,6 +3679,62 @@ class ShardRouter:
         self._settle_aborted_parts(idx)
         self.sweep()
         return restored
+
+    def lockgraph_report(self) -> Optional[dict]:
+        """The fleet-wide dynamic lock-order report: this process's
+        monitor merged with every subprocess replica's edge set (which
+        rides ``replica_summary``'s ``lock_graph`` key over the worker
+        status surface — no extra wire protocol). None when no monitor
+        is installed here (``lock_monitor`` off).
+
+        In-process replicas share THIS process's ref-counted monitor,
+        so their summaries report the same graph the router already
+        holds — merging them would only double the counts; they are
+        counted as reporting and skipped. Cycle detection runs on the
+        merged edge multiset: a worker-process inversion (held->acquired
+        the other way around on the far side of the HTTP boundary)
+        closes a cycle here exactly as a local one would."""
+        from tpukube.analysis import lockgraph
+
+        mon = lockgraph.active()
+        if mon is None:
+            return None
+        own = mon.report()
+        sites = dict(own["sites"])
+        acquisitions = own["acquisitions"]
+        merged: dict[tuple[str, str], int] = {
+            (e["from"], e["to"]): e["count"] for e in own["edges"]
+        }
+        reporting = []
+        for rep in self.replicas:
+            doc = None
+            if not rep.killed:
+                try:
+                    doc = rep.transport.summary()
+                except ReplicaUnavailable:
+                    doc = None
+            lg = (doc or {}).get("lock_graph")
+            if lg is None:
+                continue
+            reporting.append(rep.name)
+            if rep.transport.mode == "inprocess":
+                continue  # same process, same monitor: already merged
+            acquisitions += lg["acquisitions"]
+            for site, n in lg["sites"].items():
+                sites[site] = sites.get(site, 0) + n
+            for e in lg["edges"]:
+                key = (e["from"], e["to"])
+                merged[key] = merged.get(key, 0) + e["count"]
+        return {
+            "sites": dict(sorted(sites.items())),
+            "acquisitions": acquisitions,
+            "edges": [
+                {"from": a, "to": b, "count": n}
+                for (a, b), n in sorted(merged.items())
+            ],
+            "cycles": lockgraph.LockOrderMonitor._cycles_of(merged),
+            "replicas_reporting": reporting,
+        }
 
     def shutdown(self) -> None:
         """Close every replica (sinks in-process, graceful daemon stop

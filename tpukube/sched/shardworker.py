@@ -425,9 +425,18 @@ def make_router_app(router) -> web.Application:
                      "(set capacity_enabled)")
         return web.json_response(doc)
 
+    async def lockgraph_route(request: web.Request) -> web.Response:
+        doc = await asyncio.to_thread(router.lockgraph_report)
+        if doc is None:
+            raise web.HTTPNotFound(
+                text="dynamic lock-order detector disabled "
+                     "(set lock_monitor)")
+        return web.json_response(doc)
+
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/statusz", statusz)
+    app.router.add_get("/lockgraph", lockgraph_route)
     app.router.add_get("/explain", explain)
     app.router.add_get("/events", events)
     app.router.add_get("/trace", trace_route)
@@ -471,6 +480,17 @@ def main_worker(argv: Optional[list[str]] = None) -> int:
     from tpukube.core.clock import SYSTEM, FakeClock
 
     clock = FakeClock() if args.fake_clock else SYSTEM
+    # federated lockgraph (ISSUE 18): install the dynamic lock-order
+    # detector BEFORE the Extender is built so every scheduling lock
+    # this replica creates is wrapped; the observed edge set then rides
+    # replica_summary's lock_graph key over /worker/summary and the
+    # router merges a fleet-wide cycle report
+    monitor_installed = False
+    if cfg.lock_monitor:
+        from tpukube.analysis import lockgraph
+
+        lockgraph.install()
+        monitor_installed = True
     extender = Extender(cfg, clock=clock)
     # SHARD_WORKER_PROFILE=<path>: dump a cProfile of this worker's
     # whole life to <path>.<port> at shutdown — the only way to see
@@ -506,4 +526,8 @@ def main_worker(argv: Optional[list[str]] = None) -> int:
         if extender.journal is not None:
             extender.journal.close()
             extender.state.retire()
+        if monitor_installed:
+            from tpukube.analysis import lockgraph
+
+            lockgraph.uninstall()
     return 0

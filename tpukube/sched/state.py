@@ -964,6 +964,7 @@ class ClusterState:
                 )
             prev = self._nodes.get(name)
             if prev is not None and prev.info.slice_id != info.slice_id:
+                # tpukube: allow(seam-triple) a slice registered by an upsert that then fails validation holds no nodes; the WAL only records successful upserts, so a restart simply never sees the empty slice
                 raise StateError(
                     f"node {name} moved from slice {prev.info.slice_id} "
                     f"to {info.slice_id} — drop and re-add the node"
@@ -976,6 +977,7 @@ class ClusterState:
                 # a sharing-mode switch under live allocations cannot be
                 # accounted (committed ids carry the OLD mode's weights;
                 # mixing modes double-books chips) — drain the node first
+                # tpukube: allow(seam-triple) failed-validation raise: the registered-but-empty slice is deliberately not journaled (records land on success only)
                 raise StateError(
                     f"node {name} changed shares_per_chip "
                     f"{prev.info.shares_per_chip} -> {info.shares_per_chip} "
@@ -988,6 +990,7 @@ class ClusterState:
             for chip in info.chips:
                 claimed = hosts.get(chip.coord)
                 if claimed is not None and claimed != name:
+                    # tpukube: allow(seam-triple) failed-validation raise: the registered-but-empty slice is deliberately not journaled (records land on success only)
                     raise StateError(
                         f"nodes {claimed} and {name} both claim chip "
                         f"{tuple(chip.coord)} in slice {info.slice_id}"
@@ -1329,11 +1332,14 @@ class ClusterState:
                 self._epoch += 1
                 self._note_delta_locked(
                     full=True, why=f"bulk ingest ({len(staged)} nodes)")
-                if self._journal is not None:
-                    self._note_journal_locked("nodes", {"items": [
-                        [name, annotations]
-                        for _, name, _, annotations, _ in staged
-                    ]})
+                # the note itself no-ops without a journal; the ternary
+                # only skips building the O(batch) items list, keeping
+                # the call UNCONDITIONAL so the seam-triple pass can
+                # prove the bump/delta/journal triple on every path
+                self._note_journal_locked("nodes", {"items": [
+                    [name, annotations]
+                    for _, name, _, annotations, _ in staged
+                ] if self._journal is not None else []})
                 self.ingest_nodes_total += len(staged)
             self.ingest_batches += 1
             dt = time.perf_counter() - t0

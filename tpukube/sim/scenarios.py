@@ -73,6 +73,10 @@ _PASSTHROUGH_KEYS = (
     "TPUKUBE_CAPACITY_SAMPLE_INTERVAL_SECONDS",
     "TPUKUBE_CAPACITY_SAMPLES",
     "TPUKUBE_CAPACITY_PATH",
+    # federated lockgraph (ISSUE 18): re-run any scenario with the
+    # dynamic lock-order detector live — sharded runs merge worker
+    # edges into a fleet-wide cycle report on the result
+    "TPUKUBE_LOCK_MONITOR",
 )
 
 
@@ -950,6 +954,20 @@ def _kilonode_drive(cfg: TpuKubeConfig, metric: str, total_target: int,
                 # ride the result (ISSUE 14)
                 "transport": doc["transport"],
             }
+            # federated lockgraph (ISSUE 18): with lock_monitor on, the
+            # router merges its own observed lock-order edges with each
+            # subprocess replica's (reported over the worker status
+            # surface) and the fleet-wide cycle check rides the result
+            lg_fn = getattr(ext, "lockgraph_report", None)
+            if lg_fn is not None:
+                lg = lg_fn()
+                if lg is not None:
+                    result["shard"]["lock_graph"] = {
+                        "cycles": lg["cycles"],
+                        "acquisitions": lg["acquisitions"],
+                        "edge_count": len(lg["edges"]),
+                        "replicas_reporting": lg["replicas_reporting"],
+                    }
         wire_fn = getattr(ext, "wire_totals", None)
         if wire_fn is not None:
             # federated wire-cost accounting (ISSUE 16): the transport
