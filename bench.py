@@ -752,6 +752,7 @@ def run() -> dict:
     result["kilonode100k"] = kilonode100k()
     result["recovery"] = recovery()
     result["coldstart"] = coldstart()
+    result["elasticity"] = elasticity()
     return result
 
 
@@ -771,6 +772,85 @@ def chaos_stats() -> dict:
         "scenario9_wall_s": s9["wall_s"],
         "recovery_s": s9["recovery_s"],
     }
+
+
+def elasticity() -> dict:
+    """Fleet elasticity tracking (ISSUE 19), three points. (1) the
+    seeded maintenance-storm scenario (15) — drain/spot-churn/
+    autoscaler chaos — wall time plus the disruption-vs-budget and
+    audit numbers its invariants gate on. (2) disruption-per-drain and
+    drained-chips/s: one graceful drain of a resident-loaded 64-chip
+    slice under eviction budget 2, wall from ``begin()`` to the slice
+    leaving the ledger (cordon -> budgeted migrate -> un-ingest, the
+    whole choreography). (3) time-to-capacity at the 10k-node point:
+    bulk provisioning of a fresh 10,240-node slice (the autoscaler's
+    scale-up wire path, ``upsert_nodes_many``) until the new capacity
+    is visible to the placement sweeps — the region-scale answer to
+    'how long after a scale-up decision can pods actually land'."""
+    from tpukube.core.clock import FakeClock
+    from tpukube.core.config import load_config
+    from tpukube.core.mesh import MeshSpec
+    from tpukube.sched.extender import Extender
+    from tpukube.sim import scenarios
+    from tpukube.sim.harness import SimCluster
+
+    out: dict = {}
+    t0 = time.perf_counter()
+    s15 = scenarios.run(15)
+    out["scenario15_wall_s"] = round(time.perf_counter() - t0, 2)
+    out["drains_survived"] = s15["value"]
+    out["peak_tick_moves"] = s15["peak_tick_moves"]
+    out["budget_moves"] = s15["budget_moves"]
+    out["audit_divergences"] = s15["snapshot_audit"]["divergences"]
+
+    cfg = load_config(env={
+        "TPUKUBE_DRAIN_ENABLED": "1",
+        "TPUKUBE_DRAIN_MAX_CONCURRENT_MOVES": "2",
+    })
+    mesh = MeshSpec(dims=(4, 4, 4), host_block=(2, 2, 1))
+    with SimCluster(cfg, clock=FakeClock(),
+                    slices={"s0": mesh, "s1": mesh}) as c:
+        ext = c.extender
+        for i in range(16):
+            c.schedule(c.make_pod(f"d{i}", tpu=2))
+        doomed = sorted(n for n in ext.state.node_names()
+                        if ext.state.slice_of_node(n) == "s0")
+        t0 = time.perf_counter()
+        ext.drain.begin(doomed, reason="bench")
+        ticks = 0
+        while ext.drain.active():
+            ext.drain.tick()
+            c.clock.advance(1.0)
+            ticks += 1
+            if ticks > 200:
+                raise RuntimeError("bench drain failed to converge")
+        wall = time.perf_counter() - t0
+        st = ext.drain.statusz()
+        if st["completed"] != 1 or "s0" in ext.state.slice_ids():
+            raise RuntimeError(f"bench drain did not complete: {st}")
+        out["drain_wall_s"] = round(wall, 3)
+        out["drain_evictions"] = st["evictions_total"]
+        out["drain_peak_tick_moves"] = st["peak_tick_moves"]
+        out["drained_chips_per_s"] = round(
+            st["chips_removed_total"] / max(wall, 1e-6), 1)
+
+    items, keepalive = _coldstart_fleet(10240, hetero=False)
+    ext = Extender(load_config(env={}))
+    t0 = time.perf_counter()
+    results = ext.upsert_nodes_many(items)
+    snap = ext.snapshots.current()
+    free = sum(snap.slice(sid).free_chips
+               for sid in ext.state.slice_ids())
+    out["scale_up_10k_to_capacity_s"] = round(
+        time.perf_counter() - t0, 3)
+    bad = [r for r in results if r != {"ours": True}]
+    if bad or free < len(items) * 4:
+        raise RuntimeError(
+            f"scale-up point broken: {len(bad)} rejects, "
+            f"{free} chips visible")
+    ext.state.retire()
+    del ext, keepalive
+    return out
 
 
 if __name__ == "__main__":

@@ -685,6 +685,8 @@ class ClusterState:
             self._note_journal_locked("node", {})
             self._note_journal_locked("nodes", {})
             self._note_journal_locked("commit", {})
+            self._note_journal_locked("cordon", {})
+            self._note_journal_locked("unnodes", {})
 '''
     findings = check_seam_triples(_sf(tmp_path, "sched/state.py", src))
     assert len(findings) == 1

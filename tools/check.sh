@@ -44,6 +44,78 @@ JAX_PLATFORMS=cpu TPUKUBE_CHAOS_SEED=1337 \
 JAX_PLATFORMS=cpu python -m tpukube.cli sim 9 > /dev/null
 
 echo
+echo "== maintenance-storm smoke (scenario 15: seeded maintenance +"
+echo "   spot-churn storm over the drain choreography, the autoscaler"
+echo "   loop, and a sharded rebalance-away, at snapshot_audit_rate=1.0;"
+echo "   zero leaks / zero ledger divergence / all-or-nothing gang"
+echo "   survival / disruption within budget enforced by the scenario —"
+echo "   elasticity floors from tools/perf_floor.json) =="
+JAX_PLATFORMS=cpu TPUKUBE_CHAOS_SEED=1337 TPUKUBE_SNAPSHOT_AUDIT_RATE=1.0 \
+  python - <<'PY'
+import json
+import sys
+import time
+
+floor = json.load(open("tools/perf_floor.json"))["elasticity"]
+
+from tpukube.sim import scenarios
+
+# the scenario itself raises on invariant violations (eviction over the
+# per-tick budget, a gang left partially alive, leaked reservations,
+# ledger or audit divergence, autoscaler mis-decisions); the floors
+# below catch drain-cost rot
+t0 = time.perf_counter()
+r = scenarios.run(15)
+wall = round(time.perf_counter() - t0, 2)
+print(json.dumps({
+    "drains_survived": r["value"],
+    "peak_tick_moves": r["peak_tick_moves"],
+    "budget_moves": r["budget_moves"],
+    "audit": r["snapshot_audit"], "wall_s": wall,
+}))
+bad = []
+if r["value"] < floor["drains_survived_min"]:
+    bad.append(f"drains_survived={r['value']} below the "
+               f"{floor['drains_survived_min']} floor")
+if wall > floor["wall_s_max"]:
+    bad.append(f"wall_s={wall} exceeds the {floor['wall_s_max']}s "
+               f"ceiling")
+if r["snapshot_audit"]["checks"] < 1:
+    bad.append("the audit sentinel never checked a storm snapshot")
+if bad:
+    sys.exit("maintenance-storm smoke FAILED: " + "; ".join(bad))
+print("maintenance-storm smoke OK")
+PY
+
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+import sys
+
+floor = json.load(open("tools/perf_floor.json"))["elasticity"]
+
+import bench
+
+# the direct elasticity points: one graceful drain of a resident-loaded
+# slice (drained-chips/s) and the 10,240-node bulk scale-up until the
+# new capacity is visible to the placement sweeps
+r = bench.elasticity()
+print(json.dumps({k: r[k] for k in (
+    "drain_wall_s", "drain_evictions", "drained_chips_per_s",
+    "scale_up_10k_to_capacity_s")}))
+bad = []
+if r["drained_chips_per_s"] < floor["drained_chips_per_s_min"]:
+    bad.append(f"drained_chips_per_s={r['drained_chips_per_s']} below "
+               f"the {floor['drained_chips_per_s_min']}/s floor")
+if r["scale_up_10k_to_capacity_s"] > floor["scale_up_to_capacity_s_max"]:
+    bad.append(f"scale_up_10k_to_capacity_s="
+               f"{r['scale_up_10k_to_capacity_s']} exceeds the "
+               f"{floor['scale_up_to_capacity_s_max']}s ceiling")
+if bad:
+    sys.exit("elasticity smoke FAILED: " + "; ".join(bad))
+print("elasticity smoke OK")
+PY
+
+echo
 echo "== perf smoke (sched_micro filter/prioritize/plan p50 vs the"
 echo "   committed tools/perf_floor.json floor; >1.5x regression fails) =="
 JAX_PLATFORMS=cpu python - <<'PY'

@@ -91,7 +91,11 @@ class SeamSpec:
 EPOCH_REGISTRY: dict[tuple[str, str], SeamSpec] = {
     ("sched/state.py", "ClusterState"): SeamSpec(
         lock_attr="_lock",
-        seam_attrs=frozenset({"_nodes", "_allocs", "_slices"}),
+        # _cordoned joined with the drain plane (ISSUE 19): the cordon
+        # set feeds the snapshot's placement mask, so a cordon flip
+        # without a bump serves stale sweeps exactly like a node write
+        seam_attrs=frozenset({"_nodes", "_allocs", "_slices",
+                              "_cordoned"}),
         mutator_calls=frozenset({"add_ids", "remove_ids"}),
     ),
     ("sched/gang.py", "GangManager"): SeamSpec(

@@ -97,6 +97,20 @@ FLAG_REGISTRY: tuple[FlagSpec, ...] = (
         consumers=(("sched/extender.py", "Extender"),),
     ),
     FlagSpec(
+        flag="drain_enabled",
+        ctors=frozenset({"DrainCoordinator"}),
+        construct_scope=("sched/extender.py",),
+        attr="drain",
+        consumers=(("sched/extender.py", "Extender"),),
+    ),
+    FlagSpec(
+        flag="autoscale_enabled",
+        ctors=frozenset({"Autoscaler"}),
+        construct_scope=("sched/extender.py",),
+        attr="autoscaler",
+        consumers=(("sched/extender.py", "Extender"),),
+    ),
+    FlagSpec(
         flag="lock_monitor",
         ctors=frozenset({"lockgraph.install"}),
         construct_scope=("tpukube/cli.py", "sim/harness.py",

@@ -83,7 +83,8 @@ TRIPLE_REGISTRY: dict[tuple[str, str], TripleSpec] = {
 #: recovery code); growing a new WAL kind means adding it here AND to
 #: the replayer.
 REQUIRED_KINDS: dict[str, frozenset] = {
-    "sched/state.py": frozenset({"node", "nodes", "commit", "release"}),
+    "sched/state.py": frozenset({"node", "nodes", "commit", "release",
+                                 "cordon", "unnodes"}),
     "sched/gang.py": frozenset({
         "evict", "gre", "gdrop", "gterm", "gvgone", "gbound",
         "gmrel", "greas", "gvtaken", "guncommit",

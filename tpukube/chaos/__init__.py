@@ -33,6 +33,10 @@ from tpukube.chaos.cluster import (
     transient_api_error,
 )
 from tpukube.chaos.crash import CrashSchedule
+from tpukube.chaos.maintenance import (
+    MaintenanceSchedule,
+    SpotChurnSchedule,
+)
 from tpukube.chaos.schedule import ChaosSpec, FaultSchedule
 
 __all__ = [
@@ -41,6 +45,8 @@ __all__ = [
     "ChaosSpec",
     "CrashSchedule",
     "FaultSchedule",
+    "MaintenanceSchedule",
+    "SpotChurnSchedule",
     "converge",
     "leaked_reservations",
     "ledger_divergence",

@@ -652,7 +652,7 @@ def main_sim(argv: Optional[list[str]] = None) -> int:
         "tpukube-sim",
         "run a BASELINE config scenario against the real control-plane stack",
     )
-    p.add_argument("scenario", type=int, choices=range(1, 15),
+    p.add_argument("scenario", type=int, choices=range(1, 16),
                    help="BASELINE config number (1..5), 6 = the "
                         "steady-state churn benchmark (completions -> "
                         "release loop -> re-scheduling), 7 = fault "
@@ -662,7 +662,11 @@ def main_sim(argv: Optional[list[str]] = None) -> int:
                         "under churn (seeded fault schedule, retry/"
                         "circuit/degraded mode; chaos_seed config), "
                         "9 = extender crash + cold restart mid-gang-"
-                        "commit (rebuild_from_pods + reconcile repair)")
+                        "commit (rebuild_from_pods + reconcile repair), "
+                        "15 = maintenance storm (seeded maintenance + "
+                        "spot churn over graceful drains, the "
+                        "autoscaler loop, and a sharded rebalance-away; "
+                        "chaos_seed config)")
     args = p.parse_args(argv)
     cfg = _setup(args)
 

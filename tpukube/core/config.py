@@ -282,6 +282,42 @@ class TpuKubeConfig:
     # traffic; committed training gangs are never shed)
     tenancy_shed_priority_max: int = 0
 
+    # Graceful drain / decommission (tpukube/sched/drain.py, ISSUE
+    # 19). With drain_enabled the extender attaches a DrainCoordinator:
+    # cordon a node/slice (excluded from every placement sweep while
+    # live allocations keep serving), migrate-or-preempt residents
+    # through the existing preemption-planner + eviction-executor
+    # machinery under a bounded disruption budget, then release and
+    # un-ingest (the inverse of ingest_nodes — one epoch/delta/journal
+    # seam per batch). false (the default) constructs NOTHING:
+    # placements, exposition, and journal bytes stay byte-identical.
+    drain_enabled: bool = False
+    # most resident evictions per drain tick (the disruption budget's
+    # concurrency half — a drain never rips more than this many
+    # workloads out of service between two scheduling chances)
+    drain_max_concurrent_moves: int = 4
+    # most evictions charged to ONE tenant per drain tick (0 = no
+    # per-tenant budget; only meaningful with tenancy attribution)
+    drain_tenant_budget: int = 0
+
+    # Autoscaler loop (tpukube/sched/autoscale.py, ISSUE 19). With
+    # autoscale_enabled the extender attaches an Autoscaler that grows
+    # the simulated fleet against queue depth + tenant SLO burn (bulk
+    # ingest of provisioned slices) and shrinks it by driving drains
+    # when utilization idles below the floor. Requires drain_enabled —
+    # scale-down IS a drain. false (the default) constructs nothing.
+    autoscale_enabled: bool = False
+    # queue depth at/above which a scale-up fires (SLO page burn also
+    # triggers one regardless of depth)
+    autoscale_up_queue_depth: int = 8
+    # fleet utilization below which a scale-down drain is considered
+    autoscale_down_utilization: float = 0.25
+    # slice-count bounds the loop never crosses
+    autoscale_min_slices: int = 1
+    autoscale_max_slices: int = 16
+    # scheduling-clock seconds between scale actions (either direction)
+    autoscale_cooldown_seconds: float = 120.0
+
     # Which ICI slice this node belongs to (multi-slice clusters name
     # their pod slices; coords are slice-local — SURVEY.md §3 ICI/DCN note)
     slice_id: str = "slice-0"
@@ -522,6 +558,34 @@ def load_config(
             f"unknown shard_transport {cfg.shard_transport!r} "
             f"(inprocess | subprocess)"
         )
+    if cfg.drain_max_concurrent_moves < 1:
+        raise ValueError("drain_max_concurrent_moves must be >= 1")
+    if cfg.drain_tenant_budget < 0:
+        raise ValueError(
+            "drain_tenant_budget must be >= 0 (0 = no per-tenant cap)"
+        )
+    if cfg.autoscale_enabled and not cfg.drain_enabled:
+        # scale-down IS a drain: an autoscaler without the drain
+        # choreography would silently never shrink — fail loudly (the
+        # journal_enabled/journal_path pairing contract)
+        raise ValueError(
+            "autoscale_enabled requires drain_enabled — scale-down "
+            "drives the drain choreography"
+        )
+    if cfg.autoscale_up_queue_depth < 1:
+        raise ValueError("autoscale_up_queue_depth must be >= 1")
+    if not 0.0 <= cfg.autoscale_down_utilization <= 1.0:
+        raise ValueError(
+            "autoscale_down_utilization must be in [0, 1]"
+        )
+    if cfg.autoscale_min_slices < 1:
+        raise ValueError("autoscale_min_slices must be >= 1")
+    if cfg.autoscale_max_slices < cfg.autoscale_min_slices:
+        raise ValueError(
+            "autoscale_max_slices must be >= autoscale_min_slices"
+        )
+    if cfg.autoscale_cooldown_seconds < 0:
+        raise ValueError("autoscale_cooldown_seconds must be >= 0")
     if cfg.planner_replicas > 1 and cfg.tenancy_quotas:
         # each replica's TenantLedger sees only its own slice set, so a
         # cluster-wide chip cap split across N replicas would silently

@@ -209,6 +209,15 @@ def build_extender_registry(extender, reconcile=None, evictions=None,
     capacity = getattr(extender, "capacity", None)
     if capacity is not None:
         _add_capacity_metrics(reg, capacity)
+    # fleet elasticity (sched/drain.py + sched/autoscale.py, ISSUE 19):
+    # series render only when the flags built the objects —
+    # elasticity-off exposition stays byte-identical
+    drain = getattr(extender, "drain", None)
+    if drain is not None:
+        _add_drain_metrics(reg, drain)
+    autoscaler = getattr(extender, "autoscaler", None)
+    if autoscaler is not None:
+        _add_autoscaler_metrics(reg, autoscaler)
     # unified retry/circuit layer (ISSUE 4): series render only when
     # the daemon actually wired the channel objects — sim/dev
     # extenders keep the legacy exposition byte-identical
@@ -834,6 +843,74 @@ def _add_capacity_metrics(reg: Registry, capacity) -> None:
         demands_g.labels(reason=reason).set_function(
             lambda r=reason:
             capacity.stranded_by_reason().get(r, (0, 0))[0])
+
+
+def _add_drain_metrics(reg: Registry, drain) -> None:
+    """Drain choreography families (sched/drain.py): lifecycle
+    counters plus the disruption-budget gauge pair scenario 15 and the
+    elasticity bench read (peak moves per tick vs the configured
+    budget)."""
+    reg.counter(
+        "tpukube_drain_started_total",
+        fn=lambda: drain.drains_started,
+        help_text="Drains begun (cordon + record).")
+    reg.counter(
+        "tpukube_drain_completed_total",
+        fn=lambda: drain.drains_completed,
+        help_text="Drains whose nodes were fully un-ingested.")
+    reg.counter(
+        "tpukube_drain_evictions_total",
+        fn=lambda: drain.evictions_total,
+        help_text="Pods evicted by drain migrate-or-preempt ticks "
+                  "(gangs dissolve all-or-nothing).")
+    reg.counter(
+        "tpukube_drain_nodes_removed_total",
+        fn=lambda: drain.nodes_removed_total,
+        help_text="Nodes un-ingested at drain completion (the "
+                  "inverse of bulk ingest: one seam per batch).")
+    reg.counter(
+        "tpukube_drain_chips_removed_total",
+        fn=lambda: drain.chips_removed_total,
+        help_text="Chips decommissioned by completed drains.")
+    reg.counter(
+        "tpukube_drain_slices_dropped_total",
+        fn=lambda: drain.slices_dropped_total,
+        help_text="Slices whose last node left at drain completion.")
+    reg.gauge(
+        "tpukube_drain_peak_tick_moves",
+        fn=lambda: drain.peak_tick_moves,
+        help_text="Worst-ever workloads moved in one drain tick — "
+                  "must never exceed the configured disruption "
+                  "budget (drain_max_concurrent_moves).")
+    reg.gauge(
+        "tpukube_drain_active",
+        fn=lambda: len(drain.statusz()["active"]),
+        help_text="Drains currently in the migrate-or-preempt phase.")
+
+
+def _add_autoscaler_metrics(reg: Registry, autoscaler) -> None:
+    """Autoscaler loop families (sched/autoscale.py): scaling actions
+    and evaluation volume — the elasticity bench's time-to-capacity
+    numerator rides scale_ups/nodes_added."""
+    reg.counter(
+        "tpukube_autoscaler_scale_ups_total",
+        fn=lambda: autoscaler.scale_ups,
+        help_text="Scale-up actions (one provisioned slice each, "
+                  "bulk-ingested as one decision).")
+    reg.counter(
+        "tpukube_autoscaler_scale_downs_total",
+        fn=lambda: autoscaler.scale_downs,
+        help_text="Scale-down actions (one graceful slice drain "
+                  "each).")
+    reg.counter(
+        "tpukube_autoscaler_nodes_added_total",
+        fn=lambda: autoscaler.nodes_added_total,
+        help_text="Nodes successfully ingested by scale-ups.")
+    reg.counter(
+        "tpukube_autoscaler_ticks_total",
+        fn=lambda: autoscaler.ticks,
+        help_text="Scaling evaluations run (amortized onto the "
+                  "decision path at cooldown cadence).")
 
 
 def _add_retry_metrics(reg: Registry, retriers=(), circuits=()) -> None:

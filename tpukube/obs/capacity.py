@@ -60,8 +60,8 @@ from tpukube.sched import slicefit
 #: reproduces against the current snapshot (a racing release) — honest
 #: over plausible
 UNSCHEDULABLE_REASONS = (
-    "capacity", "dcn-ineligible", "fragmented", "quota", "shed",
-    "transient", "unhealthy",
+    "capacity", "dcn-ineligible", "draining", "fragmented", "quota",
+    "shed", "transient", "unhealthy",
 )
 
 #: scheduling-clock seconds a stranded-ledger entry survives without a
@@ -112,8 +112,11 @@ def parse_shape(text: str) -> tuple[int, int, int]:
 def _healed_free(ss) -> int:
     """Chips free for a new placement if every unhealthy/terminating
     chip healed — the counterfactual that separates ``unhealthy`` from
-    ``capacity`` in the taxonomy."""
-    blocked = (ss.occupied | ss.reserved) - (ss.unhealthy | ss.terminating)
+    ``capacity`` in the taxonomy. Cordoned chips stay blocked: healing
+    does not un-drain a node (that counterfactual is ``draining``'s,
+    probed separately)."""
+    blocked = ((ss.occupied | ss.reserved | ss.cordoned | ss.absent)
+               - ((ss.unhealthy | ss.terminating) - ss.cordoned))
     return ss.mesh.num_chips - len(blocked)
 
 
@@ -378,6 +381,10 @@ class CapacityRecorder:
             if healed >= total:
                 detail["healed_free_chips"] = healed
                 return "unhealthy", detail
+            dsid = self._fits_if_uncordoned(rows, total, shape)
+            if dsid is not None:
+                detail["fits_if_uncordoned"] = dsid
+                return "draining", detail
             return "capacity", detail
         candidates = [(sid, ss) for sid, ss in rows
                       if ss.blocked_free_chips >= total]
@@ -391,6 +398,12 @@ class CapacityRecorder:
             if coords is not None:
                 detail["fits_in"] = sid
                 return "transient", detail
+        dsid = self._fits_if_uncordoned(rows, total, shape)
+        if dsid is not None:
+            # the demand fits once the drain gives the chips back (or
+            # is cancelled) — stranded by elasticity, not by geometry
+            detail["fits_if_uncordoned"] = dsid
+            return "draining", detail
         boxes = {sid: slicefit.largest_free_box_in(ss.blocked_sweep())
                  for sid, ss in rows}
         detail["largest_free_box"] = max(boxes.values(), default=0)
@@ -409,6 +422,31 @@ class CapacityRecorder:
                 return "fragmented", detail
             return "dcn-ineligible", detail
         return "fragmented", detail
+
+    @staticmethod
+    def _fits_if_uncordoned(rows, total: int, shape):
+        """The drain counterfactual (ISSUE 19): the slice id where this
+        demand would fit if no chip were cordoned, else None. Probed
+        only when a placement failed AND some slice is mid-drain — the
+        operator's remedy is waiting out (or cancelling) the drain, not
+        adding capacity or defragmenting, and the taxonomy must say
+        so. The pre-filter skips slices whose UNCORDONED occupancy
+        already exceeds the demand (the probe could never fit)."""
+        for sid, ss in rows:
+            if not ss.cordoned:
+                continue
+            if ss.mesh.num_chips - len(
+                    ss.occupied | ss.reserved | ss.absent) < total:
+                continue
+            coords = slicefit.find_slice_in(
+                ss.uncordoned_sweep(),
+                count=None if shape is not None else total,
+                shape=shape,
+                broken=ss.broken,
+            )
+            if coords is not None:
+                return sid
+        return None
 
     @staticmethod
     def _dcn_covers(rows, total: int, cpp: int, boxes) -> bool:

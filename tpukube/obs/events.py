@@ -19,7 +19,9 @@ reason fails lint instead of silently fragmenting the journal):
   WatchReconnected, AllocDiverged, KubeletReregistered, BindFailed,
   CircuitOpen, CircuitClosed, RetryExhausted, DegradedMode,
   TenantQuotaDenied, TenantAdmissionShed, CheckpointWritten,
-  JournalTruncated, RecoveryCompleted, RecoveryDiverged
+  JournalTruncated, RecoveryCompleted, RecoveryDiverged,
+  DrainStarted, DrainCompleted, DrainCancelled, AutoscaleUp,
+  AutoscaleDown
 
 Dedup follows the K8s model: an event with the same (reason, object,
 message) as a live ring entry bumps that entry's ``count`` and
@@ -48,6 +50,8 @@ WARNING = "Warning"
 #: tpukube_events_total{reason} counter — key off these strings).
 REASONS: tuple[str, ...] = (
     "AllocDiverged",
+    "AutoscaleDown",
+    "AutoscaleUp",
     "BindFailed",
     "CheckpointWritten",
     "ChipRecovered",
@@ -55,6 +59,9 @@ REASONS: tuple[str, ...] = (
     "CircuitClosed",
     "CircuitOpen",
     "DegradedMode",
+    "DrainCancelled",
+    "DrainCompleted",
+    "DrainStarted",
     "GangCommitted",
     "GangDissolved",
     "GangReserved",

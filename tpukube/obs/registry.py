@@ -171,6 +171,20 @@ DECLARED_SERIES: frozenset[str] = frozenset({
     "tpukube_capacity_stranded_demands",
     "tpukube_capacity_recoverable_chips",
     "tpukube_unschedulable_pods",
+    # fleet elasticity (sched/drain.py + sched/autoscale.py, ISSUE 19;
+    # rendered only when drain_enabled / autoscale_enabled built them)
+    "tpukube_drain_started_total",
+    "tpukube_drain_completed_total",
+    "tpukube_drain_evictions_total",
+    "tpukube_drain_nodes_removed_total",
+    "tpukube_drain_chips_removed_total",
+    "tpukube_drain_slices_dropped_total",
+    "tpukube_drain_peak_tick_moves",
+    "tpukube_drain_active",
+    "tpukube_autoscaler_scale_ups_total",
+    "tpukube_autoscaler_scale_downs_total",
+    "tpukube_autoscaler_nodes_added_total",
+    "tpukube_autoscaler_ticks_total",
     # both daemons (unified retry/circuit layer, core/retry.py; series
     # render only where a Retrier/CircuitBreaker is actually wired)
     "tpukube_retry_attempts_total",

@@ -207,6 +207,14 @@ def extender_statusz(
             **capacity.stats(),
             "stranded": capacity.stranded_summary(),
         }
+    # fleet elasticity (ISSUE 19): drain choreography + autoscaler
+    # loop — both keys conditional like capacity's (off-is-off)
+    drain = getattr(extender, "drain", None)
+    if drain is not None:
+        out["drain"] = drain.statusz()
+    autoscaler = getattr(extender, "autoscaler", None)
+    if autoscaler is not None:
+        out["autoscaler"] = autoscaler.statusz()
     if lifecycle is not None:
         out["lifecycle_releases"] = lifecycle.released
     if reconcile is not None:

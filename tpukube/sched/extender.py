@@ -284,6 +284,24 @@ class Extender:
             from tpukube.obs.capacity import CapacityRecorder
 
             self.capacity = CapacityRecorder(self, config)
+        # Fleet elasticity (ISSUE 19): the graceful drain/decommission
+        # choreography (sched/drain.py) and the autoscaler loop
+        # (sched/autoscale.py). None (the config defaults) constructs
+        # nothing — no cordon state is consulted on any placement
+        # path, no tpukube_drain_* / tpukube_autoscaler_* series
+        # render, and /statusz carries no drain/autoscaler section.
+        # Built AFTER snapshots/cycle/tenants/capacity so a tick can
+        # read all of them (queue depth, SLO burn, utilization).
+        self.drain = None
+        if config.drain_enabled:
+            from tpukube.sched.drain import DrainCoordinator
+
+            self.drain = DrainCoordinator(self, config)
+        self.autoscaler = None
+        if config.autoscale_enabled:
+            from tpukube.sched.autoscale import Autoscaler
+
+            self.autoscaler = Autoscaler(self, config)
         self.preemptions = 0   # victims evicted for higher-priority gangs
         self.binds_total = 0   # successful binds (metrics counter)
         # The bind EFFECTOR: with bindVerb configured, kube-scheduler
@@ -943,6 +961,11 @@ class Extender:
         view = self.state.node(name)
         if view is None:
             return "no tpukube node-topology annotation"
+        if self.drain is not None and self.state.is_cordoned(name):
+            # draining (ISSUE 19): live allocs keep serving, new
+            # placements are refused — capacity forensics root-causes
+            # demand stranded this way as "draining", not "capacity"
+            return "node cordoned (draining)"
         vtpu_node = view.shares_per_chip > 1
         if resource == RESOURCE_VTPU:
             if not vtpu_node:
@@ -1153,7 +1176,7 @@ class Extender:
             # so the bound chip realizes the score the node won on (other
             # hosts' FREE chips are not blockers; treating them as such,
             # as the old mask form did, mis-ranked fragmentation)
-            blocked = ss.occupied | mask_set
+            blocked = ss.occupied | mask_set | ss.absent
             best = max(
                 node_free,
                 key=lambda c: (
@@ -1598,6 +1621,14 @@ class Extender:
                 # seam's pattern): a scheduling-clock read per
                 # decision, a real sample only on interval expiry
                 self.capacity.maybe_sample()
+            if self.drain is not None:
+                # amortized drain choreography: budgeted migrate-or-
+                # preempt progress rides the decision path under the
+                # same lock, exactly like checkpoints and capacity
+                # samples (a clock read when no drain is active)
+                self.drain.maybe_tick()
+            if self.autoscaler is not None:
+                self.autoscaler.maybe_tick()
             return response
 
     def checkpoint_doc(self) -> dict:
@@ -1628,8 +1659,9 @@ class Extender:
             # the seedable scheduling snapshot: a warm restart installs
             # it directly, so the first lookups HIT instead of forcing
             # the O(chips) rebuild that would drag every lazy node in
-            head["snap"] = {
-                sid: {
+            snap_doc: dict[str, dict] = {}
+            for sid, ss in snap.slices.items():
+                sd = {
                     "occ": [list(c) for c in ss.occupied],
                     "res": [list(c) for c in ss.reserved],
                     "unh": [list(c) for c in ss.unhealthy],
@@ -1638,8 +1670,13 @@ class Extender:
                     "used": ss.used_shares,
                     "total": ss.total_shares,
                 }
-                for sid, ss in snap.slices.items()
-            }
+                if ss.cordoned:
+                    # only-when-non-empty: with the drain flag off the
+                    # checkpoint bytes stay identical to the pre-drain
+                    # layout (the off-is-off golden)
+                    sd["crd"] = [list(c) for c in ss.cordoned]
+                snap_doc[sid] = sd
+            head["snap"] = snap_doc
         return {
             "head": head,
             "node_entries": node_entries,

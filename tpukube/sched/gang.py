@@ -772,7 +772,9 @@ class GangManager:
         ss = snap.slice(slice_id)
         # members-look-free is request-specific: an ad-hoc sweep (via the
         # snapshot module's sole constructor seam), not the cached one
-        occupied = (ss.occupied | ss.reserved) - assigned
+        # absent stays blocked even where a member was assigned: a chip
+        # whose host left cannot be restored onto
+        occupied = ((ss.occupied | ss.reserved) - assigned) | ss.absent
         sweep = sweep_for(mesh, occupied)
         best: Optional[tuple] = None
         for sb in slicefit.iter_free_boxes_in(

@@ -209,6 +209,25 @@ class StateJournal:
             self.appends += 1
             self._cond.notify()
 
+    def sync(self) -> None:
+        """Durability barrier: block until every record enqueued before
+        this call is flushed (and fsync'd under the ``always`` policy).
+        The drain/decommission choreography syncs its cordon and
+        un-ingest seams through this before acting on them — a crash
+        right after a drain began must not forget WHICH capacity was
+        leaving, and a decommission is only safe to report once the
+        un-ingest record cannot be lost. Rare-path only: one barrier
+        per drain transition, never per scheduling decision."""
+        done = threading.Event()
+        with self._cond:
+            if self._closed:
+                return
+            self._queue.append(("sync", done))
+            self._cond.notify()
+        if not done.wait(timeout=30.0):
+            log.error("journal sync barrier did not land within 30s "
+                      "(%s)", self.path)
+
     def seq(self) -> int:
         """Last assigned record seq (the checkpoint's WAL position)."""
         with self._cond:
@@ -268,6 +287,16 @@ class StateJournal:
         f = self._file
         wrote = False
         for item in items:
+            if item[0] == "sync":
+                # barrier: everything written so far must be durable
+                # before the waiter proceeds
+                if wrote:
+                    f.flush()
+                    if self.fsync == "always":
+                        os.fsync(f.fileno())
+                    wrote = False
+                item[1].set()
+                continue
             if item[0] == "ckpt":
                 if wrote:
                     # records queued before the checkpoint must be ON
@@ -480,6 +509,8 @@ class StateJournal:
             for item in self._queue:
                 if item[0] == "ckpt" and item[2] is not None:
                     item[2].set()  # never strand a sync waiter
+                elif item[0] == "sync":
+                    item[1].set()  # barrier waiters neither
             self._queue.clear()
             self._cond.notify()
         self._thread.join(timeout=10.0)
@@ -643,6 +674,16 @@ def replay_records(extender, records: list[dict]) -> int:
                 state.release(d["p"])
             elif kind == "node":
                 state.upsert_node(d["n"], dict(d["anno"]))
+            elif kind == "cordon":
+                # drain choreography (ISSUE 19): cordon/uncordon is a
+                # plain ledger mutation — idempotent, unknown names
+                # skipped by the mutator itself
+                state.set_cordon(list(d["n"]), bool(d["c"]))
+            elif kind == "unnodes":
+                # un-ingest batch: nodes with live allocations are
+                # skipped loudly inside remove_nodes (WAL order places
+                # releases first, so replay normally finds them free)
+                state.remove_nodes(list(d["n"]))
             elif kind == "nodes":
                 # one bulk-ingest batch (ISSUE 15): replay through the
                 # same fast path; per-item errors are logged by the
@@ -764,6 +805,11 @@ def recover_extender(extender, api) -> dict[str, Any]:
                             canonical_link(a, b) for a, b in sd["brk"]),
                         used_shares=int(sd["used"]),
                         total_shares=int(sd["total"]),
+                        # "crd" is written only when non-empty (drain
+                        # off ⇒ checkpoint bytes unchanged)
+                        cordoned=frozenset(
+                            TopologyCoord(*c)
+                            for c in sd.get("crd", ())),
                     )
                 extender.snapshots.seed(ClusterSnapshot(
                     key=extender.snapshots.epoch_key(), slices=slices,

@@ -1371,6 +1371,15 @@ class ShardRouter:
         self.health_checks_total = 0
         self.health_failures_total = 0
         self._health_checked_at: Optional[float] = None
+        # replica indices whose DrainCoordinator is actively draining
+        # (ISSUE 19): a replica mid-drain is legitimately slow — its
+        # decision lock is busy migrating residents — and the health
+        # checker must NOT dead-mark it (dead-marking aborts its
+        # rendezvous parts and rebuilds state the drain is about to
+        # retire anyway). The drain registers intent BEFORE its first
+        # eviction tick and clears it when no drain remains active.
+        self._drain_intent: set[int] = set()
+        self.health_skips_draining_total = 0
         # pod key -> last bound AllocResult, decoded from bind answers
         # (subprocess mode only): lets the federated allocation() serve
         # the lifecycle loop's per-release existence checks without an
@@ -1383,6 +1392,15 @@ class ShardRouter:
         # an N=1 SUBPROCESS router routes normally, over the wire.
         self._sole = (self.replicas[0].extender
                       if n == 1 and self.mode == "inprocess" else None)
+        # wire each in-process replica's drain choreography to the
+        # router's intent set (subprocess replicas drain behind their
+        # own listener; the daemon has no router to shield it, and
+        # the health checker there probes /healthz, not the decision
+        # lock). No drain (the flag default) wires nothing.
+        for rep in self.replicas:
+            _ext = rep.extender
+            if _ext is not None and getattr(_ext, "drain", None) is not None:
+                _ext.drain.attach_router(self, rep.index)
         # router maps only (replica state lives behind each replica's
         # own locks; this leaf lock never nests around them on the
         # mutation path — routing reads replica state lock-free
@@ -1977,6 +1995,18 @@ class ShardRouter:
             },
             "transport": self.transport_statusz(),
         }
+        with self._lock:
+            intent = sorted(self._drain_intent)
+            skips = self.health_skips_draining_total
+        if intent:
+            # present only while a drain shields replicas (off-is-off:
+            # no drain, no key — the statusz goldens hold byte-for-byte)
+            doc["drain_intent"] = [self.replicas[i].name for i in intent]
+        if intent or skips:
+            # the drain/health-check race fix's receipt: probes skipped
+            # because the replica was shielded by drain intent (can only
+            # be nonzero with the drain flag on, so off stays off)
+            doc["health_skips_draining_total"] = skips
         if self._sole is None:
             # the router's OWN observability plane (absent under the
             # N=1 in-process parity gate — off-is-off)
@@ -2631,6 +2661,17 @@ class ShardRouter:
             for rep in self.replicas:
                 if not rep.alive:
                     continue
+                with self._lock:
+                    draining = rep.index in self._drain_intent
+                if draining:
+                    # drain/health-check race (ISSUE 19): a replica
+                    # mid-drain holds its decision lock through
+                    # budgeted eviction ticks — slow, not dead.
+                    # Dead-marking it would abort its rendezvous
+                    # parts and rebuild the very state the drain is
+                    # retiring; skip until the drain clears intent.
+                    self.health_skips_draining_total += 1
+                    continue
                 self.health_checks_total += 1
                 try:
                     ok = rep.transport.healthz()
@@ -2655,6 +2696,18 @@ class ShardRouter:
         rep.alive = False
         rep.killed = True
         self._drop_dead_alloc_cache(idx)
+
+    # -- drain intent (ISSUE 19) ----------------------------------------------
+    def register_drain_intent(self, idx: int) -> None:
+        """A DrainCoordinator on replica ``idx`` is beginning its
+        choreography: shield the replica from dead-marking (see
+        ``health_check``) until the intent clears."""
+        with self._lock:
+            self._drain_intent.add(idx)
+
+    def clear_drain_intent(self, idx: int) -> None:
+        with self._lock:
+            self._drain_intent.discard(idx)
 
     def pull_evictions(self) -> int:
         """Drain each subprocess replica's local eviction queue onto

@@ -200,7 +200,8 @@ class SliceSnapshot:
 
     __slots__ = (
         "slice_id", "mesh", "occupied", "reserved", "unhealthy",
-        "terminating", "broken", "used_shares", "total_shares",
+        "terminating", "cordoned", "absent", "broken", "used_shares",
+        "total_shares",
         "_occ_sweep", "_blocked_sweep", "_frag", "_largest",
     )
 
@@ -215,6 +216,8 @@ class SliceSnapshot:
         broken: frozenset[Link],
         used_shares: int,
         total_shares: int,
+        cordoned: frozenset[TopologyCoord] = frozenset(),
+        absent: frozenset[TopologyCoord] = frozenset(),
     ):
         self.slice_id = slice_id
         self.mesh = mesh
@@ -226,6 +229,22 @@ class SliceSnapshot:
         #: evicted-but-still-terminating victims' chips (preemption
         #: planners treat these like unhealthy: nothing frees them sooner)
         self.terminating = terminating
+        #: drain mask (fleet elasticity, ISSUE 19): chips of cordoned
+        #: nodes — excluded from every NEW placement, while chips they
+        #: already serve stay accounted through ``occupied`` as usual.
+        #: Cordon transitions travel as full-rebuild markers (rare by
+        #: design), so the delta-advance path carries this set through
+        #: untouched.
+        self.cordoned = cordoned
+        #: geometry mask (fleet elasticity, ISSUE 19): chips whose host
+        #: left the cluster (un-ingest, spot churn) or never arrived (a
+        #: recovery rebuilt from a partially-advertised fleet). Unlike
+        #: ``cordoned`` there is nothing live behind these coords at
+        #: all — every sweep and capacity count must treat them as
+        #: non-existent, or a shrunken slice advertises phantom chips.
+        #: Topology changes travel as full-rebuild markers, so the
+        #: delta-advance path carries this set through untouched.
+        self.absent = absent
         self.broken = broken
         #: allocated / total shares over healthy capacity — carried as
         #: the two INTEGERS (not the derived float) so a ledger delta
@@ -247,37 +266,60 @@ class SliceSnapshot:
 
     # -- prepared sweeps ---------------------------------------------------
     def occupancy_sweep(self) -> "slicefit._Sweep":
-        """Sweep over the OCCUPIED grid (allocated + unhealthy chips) —
-        the scorer's fallback and the fragmentation metric's base."""
+        """Sweep over the OCCUPIED grid (allocated + unhealthy + absent
+        chips) — the scorer's fallback and the fragmentation metric's
+        base. Absent chips block here too: there is no hardware behind
+        them to ever free up."""
         sweep = self._occ_sweep
         if sweep is None:
-            sweep = self._occ_sweep = sweep_for(self.mesh, self.occupied)
+            sweep = self._occ_sweep = sweep_for(
+                self.mesh, self.occupied | self.absent)
         return sweep
 
     def blocked_sweep(self) -> "slicefit._Sweep":
-        """Sweep over occupied | reserved — what every placement search
-        (gang reservation, prioritize scoring) masks against."""
+        """Sweep over occupied | reserved | cordoned | absent — what
+        every placement search (gang reservation, prioritize scoring)
+        masks against. Cordoned chips are drain-blocked: live
+        allocations on them keep serving, but nothing NEW lands there.
+        Absent chips have no host at all."""
         sweep = self._blocked_sweep
         if sweep is None:
             sweep = self._blocked_sweep = sweep_for(
-                self.mesh, self.occupied | self.reserved
+                self.mesh,
+                self.occupied | self.reserved | self.cordoned
+                | self.absent
             )
         return sweep
+
+    def uncordoned_sweep(self) -> "slicefit._Sweep":
+        """Sweep over occupied | reserved | absent ONLY — the
+        drain-pressure counterfactual (obs/capacity.py: would this
+        demand fit if the cordoned chips were given back?). Absent
+        chips stay masked: cancelling a drain does not resurrect a
+        host that already left. Uncached: probed only while a drain is
+        in flight."""
+        if not self.cordoned:
+            return self.blocked_sweep()
+        return sweep_for(
+            self.mesh, self.occupied | self.reserved | self.absent)
 
     # -- derived numbers ---------------------------------------------------
     @property
     def free_chips(self) -> int:
-        """Chips neither occupied nor unhealthy (reservation-blind).
-        Pure set arithmetic — counting must not force a sweep build."""
-        return self.mesh.num_chips - len(self.occupied)
+        """Chips neither occupied nor unhealthy nor absent
+        (reservation-blind). Pure set arithmetic — counting must not
+        force a sweep build."""
+        return self.mesh.num_chips - len(self.occupied | self.absent)
 
     @property
     def blocked_free_chips(self) -> int:
-        """Chips free for a NEW placement (occupied and reserved both
-        masked) — the gang layer's capacity-ranking number. The union
-        handles the (normally disjoint) sets overlapping, exactly as
-        the OR'd grid the blocked sweep is built from would."""
-        return self.mesh.num_chips - len(self.occupied | self.reserved)
+        """Chips free for a NEW placement (occupied, reserved,
+        cordoned, and absent all masked) — the gang layer's
+        capacity-ranking number. The union handles the (normally
+        disjoint) sets overlapping, exactly as the OR'd grid the
+        blocked sweep is built from would."""
+        return self.mesh.num_chips - len(
+            self.occupied | self.reserved | self.cordoned | self.absent)
 
     def largest_free_box(self) -> int:
         if self._largest is None:
@@ -345,7 +387,7 @@ def _audit_divergence(cached: ClusterSnapshot,
     for sid in sorted(cached.slices):
         a, b = cached.slices[sid], rebuilt.slices[sid]
         for attr in ("occupied", "reserved", "unhealthy", "terminating",
-                     "broken"):
+                     "cordoned", "absent", "broken"):
             va, vb = getattr(a, attr), getattr(b, attr)
             if va != vb:
                 extra = sorted(tuple(x) if not isinstance(x, tuple) else x
@@ -598,6 +640,11 @@ class SnapshotCache:
                 broken=old.broken,
                 used_shares=old.used_shares + used.get(sid, 0),
                 total_shares=old.total_shares + total.get(sid, 0),
+                # cordon and topology transitions are full markers
+                # (set_cordon, ingest, un-ingest), so the carried sets
+                # are exact across any delta chain
+                cordoned=old.cordoned,
+                absent=old.absent,
             )
         return ClusterSnapshot(key=key, slices=slices)
 
@@ -767,6 +814,11 @@ class SnapshotCache:
                 broken=frozenset(broken),
                 used_shares=used,
                 total_shares=total,
+                # no incremental cache to bypass: cordoned_coords and
+                # absent_coords ARE the single derivations (audit and
+                # build share them)
+                cordoned=frozenset(self._state.cordoned_coords(sid)),
+                absent=frozenset(self._state.absent_coords(sid)),
             )
         return ClusterSnapshot(key=key, slices=slices)
 
