@@ -243,6 +243,35 @@ def sched_micro() -> dict:
         app_thread.stop()
     out["http_overhead_ms"] = round(
         out["filter_http_p50_ms"] - out["filter_inproc_p50_ms"], 3)
+    # ISSUE 20: the wire-codec point — TKW1 encode/decode p50 and the
+    # frame-vs-compact-JSON size ratio on a fleet-shaped upsert wave
+    # (the hot body shape: a dict list with identical keys, repeated
+    # node/slice strings, a few badLinks rows). check.sh's perf smoke
+    # ceilings the µs and floors the ratio via perf_floor.json "wire".
+    from tpukube.sched import wirecodec
+
+    wave = {"items": [
+        {"name": name, "slice": cfg.slice_id,
+         "topology": "16x16x16", "chips": mesh.chips_per_host,
+         "device_ids": [f"{name}-chip-{i}"
+                        for i in range(mesh.chips_per_host)],
+         "badLinks": ([] if i % 7 else
+                      [{"from": f"{name}-chip-0",
+                        "to": f"{name}-chip-1"}]),
+         "free": mesh.chips_per_host, "epoch": 3, "healthy": True}
+        for i, name in enumerate(names)
+    ]}
+    json_len = len(wirecodec.dumps_json(wave))
+    frame, _raw = wirecodec.encode_frame(wave, 1024)
+    wirecodec.decode_frame(frame)  # warm
+
+    out["wire_encode_us"] = round(1000 * p50_ms(
+        lambda: wirecodec.encode_frame(wave, 1024)), 1)
+    out["wire_decode_us"] = round(1000 * p50_ms(
+        lambda: wirecodec.decode_frame(frame)), 1)
+    out["wire_json_bytes"] = json_len
+    out["wire_frame_bytes"] = len(frame)
+    out["wire_ratio"] = round(json_len / len(frame), 2)
     return out
 
 
@@ -451,7 +480,8 @@ def kilonode_scaling() -> dict:
     return out
 
 
-def _shard_sweep_point(n: int, pods: int, transport: str) -> dict:
+def _shard_sweep_point(n: int, pods: int, transport: str,
+                       wire_codec: str = "json") -> dict:
     """One replica-count point of the shard sweep: the scenario-12
     fleet (4 ICI slices of 16x16x40: 40,960 chips / 10,240 nodes) and
     churn trace, planned by N replicas over the given transport."""
@@ -467,6 +497,7 @@ def _shard_sweep_point(n: int, pods: int, transport: str) -> dict:
         "TPUKUBE_FILTER_FROM_PLAN": "1",
         "TPUKUBE_PLANNER_REPLICAS": str(n),
         "TPUKUBE_SHARD_TRANSPORT": transport,
+        "TPUKUBE_WIRE_CODEC": wire_codec,
     })
     mesh = cfg.sim_mesh()
     slices = {
@@ -475,8 +506,10 @@ def _shard_sweep_point(n: int, pods: int, transport: str) -> dict:
                               torus=mesh.torus)
         for i in range(4)
     }
+    codec_tag = "" if wire_codec == "json" else f"_{wire_codec}"
     r = scenarios._kilonode_drive(
-        cfg, metric=f"shard_{transport}_n{n}", total_target=pods,
+        cfg, metric=f"shard_{transport}_n{n}{codec_tag}",
+        total_target=pods,
         gang_size=512, max_alive=8192, check_leaks=True,
         slices=slices, include_setup=False,
     )
@@ -560,6 +593,29 @@ def shard_scaling() -> dict:
         # N workers + the router need N+1 schedulable cores before the
         # efficiency number means parallelism rather than time-slicing
         point["cpu_limited"] = cpus < int(n) + 1
+    # ISSUE 20: the wire before/after in ONE run — the same N=2
+    # process point re-driven with the TKW1 binary codec, so the
+    # recorded bytes/wave ratio is json-vs-binary on an identical
+    # fixed trace (the acceptance asks >= 3x; check.sh's codec smoke
+    # floors it via perf_floor.json "wire")
+    try:
+        binary_pt = _shard_sweep_point(2, pods, "subprocess",
+                                       wire_codec="binary")
+    except Exception as e:
+        logging.getLogger("bench").warning(
+            "codec-on shard point skipped: %s", e)
+        out["wire_codec"] = {"skipped": str(e)}
+        return out
+    wj = out["process"]["2"].get("wire") or {}
+    wb = binary_pt.get("wire") or {}
+    jpw, bpw = wj.get("bytes_per_wave"), wb.get("bytes_per_wave")
+    out["wire_codec"] = {
+        "json": wj,
+        "binary": wb,
+        "bytes_per_wave_ratio": (round(jpw / bpw, 2)
+                                 if jpw and bpw else None),
+        "pods_per_sec_binary": binary_pt["pods_per_sec"],
+    }
     return out
 
 

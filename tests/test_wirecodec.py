@@ -1,0 +1,643 @@
+"""ISSUE 20: compact binary wire codec for the sharded driver surface.
+
+The acceptance gates covered here:
+  * every /worker op schema round-trips ``decode(encode(x)) == x`` on
+    seeded fleet-shaped bodies (node payloads with badLinks, pod
+    lists, alloc deltas) — JSON stays the parity oracle;
+  * placements are bit-identical ``wire_codec: binary`` vs ``json``
+    over real worker daemons, and the default (json) plane's wire
+    accounting/exposition shape is untouched;
+  * a truncated/corrupt TKW1 frame answers HTTP 400 and leaves the
+    worker serving — never a crash, never a spuriously dead replica;
+  * per-request Content-Type/Accept negotiation: a binary router over
+    a JSON-only worker degrades cleanly to JSON (rolling upgrades),
+    and a respawned worker re-handshakes from JSON;
+plus the satellites: compact JSON separators on the codec-off path,
+failed requests billed into the wire counters, codec-tagged
+``wire_by_op`` cells, and chaos (worker SIGKILL/restart) green over
+the binary transport.
+
+Worker daemons are real subprocesses; tests that need them skip
+gracefully where spawning is unavailable.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import math
+import random
+import threading
+from collections import deque
+
+import pytest
+
+from tpukube.chaos import ledger_divergence
+from tpukube.core.clock import FakeClock
+from tpukube.core.config import load_config
+from tpukube.core.mesh import MeshSpec
+from tpukube.core.types import PodGroup
+from tpukube.sched import wirecodec
+from tpukube.sched.wirecodec import (
+    WireCodecError,
+    decode_frame,
+    dumps_json,
+    encode_frame,
+)
+from tpukube.sim.harness import SimCluster
+
+
+def can_spawn_workers() -> bool:
+    from tpukube.sched.shard import ShardError, SubprocessTransport
+
+    try:
+        probe = SubprocessTransport(0, load_config(env={}),
+                                    fake_clock=False)
+        probe.close()
+        return True
+    except (ShardError, OSError):
+        return False
+
+
+needs_workers = pytest.mark.skipif(
+    not can_spawn_workers(),
+    reason="cannot spawn shard-worker subprocesses here",
+)
+
+
+def proc_config(n: int, **extra: str):
+    return load_config(env={
+        "TPUKUBE_PLANNER_REPLICAS": str(n),
+        "TPUKUBE_SHARD_TRANSPORT": "subprocess",
+        "TPUKUBE_BATCH_ENABLED": "1",
+        **extra,
+    })
+
+
+def two_slices(dims=(2, 2, 2)) -> dict[str, MeshSpec]:
+    return {
+        sid: MeshSpec(dims=dims, host_block=(2, 2, 1),
+                      torus=(False, False, False))
+        for sid in ("s0", "s1")
+    }
+
+
+# -- fleet-shaped op bodies ---------------------------------------------------
+
+def _pod_obj(rng: random.Random, i: int) -> dict:
+    from tpukube.core import codec as core_codec
+
+    grp = (core_codec.pod_group_annotations(
+        PodGroup(f"g{i % 5}", min_member=rng.randint(2, 8),
+                 allow_dcn=bool(i % 2)))
+        if i % 3 == 0 else {})
+    return {
+        "metadata": {"name": f"pod-{i}", "namespace": "default",
+                     "uid": f"uid-{i:06d}",
+                     "annotations": grp,
+                     "labels": {"team": f"t{i % 4}"}},
+        "spec": {"priority": rng.randint(0, 100), "containers": [{
+            "name": "main",
+            "resources": {"requests": {
+                "qiniu.com/tpu": str(rng.choice([1, 2, 4]))}},
+        }]},
+    }
+
+
+def _node_item(rng: random.Random, i: int) -> dict:
+    """An upsert_nodes item shaped like the fleet ingest payloads:
+    annotation JSON with device ids, coords, and occasional badLinks
+    — the KubeGPU-lineage body the codec exists to compress."""
+    name = f"tpu-v4-{i // 64:02d}-{i % 64:03d}"
+    return {
+        "name": name,
+        "slice_id": f"s{i // 64:02d}",
+        "topology": "16x16x40",
+        "chips": 4,
+        "device_ids": [f"{name}-chip-{d}" for d in range(4)],
+        "coords": [[i % 16, (i // 16) % 16, i % 40 + d]
+                   for d in range(4)],
+        "badLinks": ([] if i % 11 else
+                     [{"from": f"{name}-chip-0",
+                       "to": f"{name}-chip-1",
+                       "kind": "ici"}]),
+        "hbm_bytes": 34359738368,
+        "free": rng.choice([0, 2, 4]),
+        "epoch": rng.randint(0, 40),
+        "healthy": i % 13 != 0,
+    }
+
+
+def _alloc_obj(rng: random.Random, i: int) -> dict:
+    node = f"tpu-v4-00-{i % 64:03d}"
+    n = rng.choice([1, 2, 4])
+    return {
+        "pod_key": f"default/job-{i}",
+        "node_name": node,
+        "device_ids": [f"{node}-chip-{d}" for d in range(n)],
+        "coords": [[i % 16, i % 16, (i + d) % 40] for d in range(n)],
+        "slice_id": f"s{i % 4:02d}",
+    }
+
+
+def _op_bodies(seed: int) -> dict[str, object]:
+    """One representative body per /worker op (requests AND the
+    response shapes the worker sends back)."""
+    rng = random.Random(seed)
+    return {
+        "upsert": {"items": [_node_item(rng, i) for i in range(96)]},
+        "admit": {"pods": [_pod_obj(rng, i) for i in range(48)]},
+        "planned": {"keys": [f"default/pod-{i}" for i in range(128)]},
+        "planned_resp": {"nodes": {
+            f"default/pod-{i}": (f"tpu-v4-00-{i % 64:03d}"
+                                 if i % 5 else None)
+            for i in range(128)}},
+        "bind": {"bodies": [{
+            "Pod": _pod_obj(rng, i),
+            "Node": f"tpu-v4-00-{i % 64:03d}",
+        } for i in range(32)]},
+        "release": {"keys": [f"default/pod-{i}" for i in range(64)]},
+        "handle": {"kind": "filter", "body": {
+            "Pod": _pod_obj(rng, 0),
+            "NodeNames": [f"tpu-v4-00-{i:03d}" for i in range(64)],
+        }},
+        "gang_prepare": {"op": "prepare", "pod": _pod_obj(rng, 3),
+                         "cpp": 4,
+                         "volumes": {f"s{i:02d}": rng.randint(0, 64)
+                                     for i in range(4)}},
+        "gauges_resp": {"slices": {f"s{i:02d}": {
+            "free": rng.randint(0, 4096),
+            "largest_free_box": [rng.randint(1, 16) for _ in range(3)],
+            "nodes": 256, "unhealthy": rng.randint(0, 3),
+        } for i in range(4)}},
+        "allocs_since_resp": {
+            "cursor": [3, rng.randint(100, 10_000)],
+            "bytes": rng.randint(0, 1 << 20),
+            "adds": [_alloc_obj(rng, i) for i in range(80)],
+            "removes": [f"default/job-{i}" for i in range(40)],
+        },
+        "allocs_resp": {"allocs": [_alloc_obj(rng, i)
+                                   for i in range(120)]},
+        "recover": {"nodes": [_node_item(rng, i) for i in range(32)],
+                    "pods": [_pod_obj(rng, i) for i in range(32)]},
+        "rebuild": {"pods": [{
+            "pod_key": f"default/job-{i}",
+            "node": f"tpu-v4-00-{i % 64:03d}",
+            "devices": f"{i % 4}",
+        } for i in range(48)]},
+        "emit": {"reason": "Scheduled", "obj": "default/pod-1",
+                 "message": "bound 4 chips", "type": "Normal"},
+        "advance": {"seconds": 2.5},
+        "summary_resp": {"nodes": 1024, "allocs": 512,
+                         "binds_total": 9999,
+                         "utilization": 0.8125,
+                         "queue_depth": 0,
+                         "slices": ["s00", "s01"],
+                         "latencies": {"filter_ms": [0.5, 1.25]},
+                         "events": {"emitted": 42}},
+    }
+
+
+# -- round-trip property ------------------------------------------------------
+
+@pytest.mark.parametrize("seed", [0, 1337, 90210])
+def test_every_op_schema_roundtrips(seed):
+    for op, body in _op_bodies(seed).items():
+        for compress_min in (0, 1024, 1 << 30):
+            frame, raw_len = encode_frame(body, compress_min)
+            assert decode_frame(frame) == body, (op, compress_min)
+            assert raw_len > 0
+
+
+def test_hot_bodies_beat_compact_json():
+    """The per-op key tables + interning + compression must collapse
+    the hot dict-list bodies well below compact JSON — the bytes/wave
+    acceptance depends on it."""
+    bodies = _op_bodies(7)
+    for op in ("upsert", "admit", "allocs_since_resp", "allocs_resp",
+               "planned_resp", "bind", "recover"):
+        body = bodies[op]
+        frame, _ = encode_frame(body, 1024)
+        jlen = len(dumps_json(body))
+        assert len(frame) < jlen / 2, \
+            f"{op}: frame {len(frame)} vs json {jlen}"
+
+
+def test_scalar_edge_values_roundtrip():
+    cases = [
+        None, True, False, 0, 1, -1, 2**62, -(2**62), 10**18,
+        0.0, 1.5, -2.75, 1e308, -1e308, 5e-324,
+        "", "x", "ü" * 21, "x" * 64, "y" * 65, "z" * 100_000,
+        [], {}, [[]], [{}], {"": ""}, {"k": []},
+        [1, "1", 1.0, True, None],
+        {"nested": {"deep": [{"a": [1, [2, [3, {"b": None}]]]}]}},
+    ]
+    for v in cases:
+        frame, _ = encode_frame(v, 1 << 30)
+        out = decode_frame(frame)
+        assert out == v
+        # 1 vs True / 1.0 vs 1: json's type fidelity is the oracle
+        assert type(out) is type(v)
+    # float specials: -0.0 keeps its sign, inf survives, nan is nan
+    assert math.copysign(1, decode_frame(
+        encode_frame(-0.0, 1 << 30)[0])) == -1
+    assert decode_frame(
+        encode_frame(math.inf, 1 << 30)[0]) == math.inf
+    assert math.isnan(decode_frame(
+        encode_frame(math.nan, 1 << 30)[0]))
+
+
+def test_heterogeneous_dict_lists_roundtrip():
+    """Lists of dicts with MISMATCHED keys must skip the table path
+    and still round-trip exactly."""
+    v = {"rows": [{"a": 1}, {"a": 1, "b": 2}, {"b": 2, "a": 1},
+                  {"c": 3}, {}, "not-a-dict", [1], None]}
+    frame, _ = encode_frame(v, 1 << 30)
+    assert decode_frame(frame) == v
+
+
+def test_intern_rule_symmetric_over_64_bytes():
+    # a >64-byte string repeats: encoded twice (never interned), and
+    # the decoder must not grow its table for it
+    big = "n" * 65
+    v = [big, big, "small", "small"]
+    frame, _ = encode_frame(v, 1 << 30)
+    assert decode_frame(frame) == v
+    # repeated small strings DO pay only once
+    many_small = ["node-abc"] * 100
+    f_small, _ = encode_frame(many_small, 1 << 30)
+    assert len(f_small) < 100 * 8
+
+
+def test_compression_threshold_and_keep_raw():
+    body = {"items": [_node_item(random.Random(1), i)
+                      for i in range(64)]}
+    raw_frame, raw_len = encode_frame(body, 1 << 30)
+    comp_frame, comp_raw = encode_frame(body, 0)
+    assert raw_len == comp_raw
+    assert decode_frame(comp_frame) == decode_frame(raw_frame) == body
+    assert len(comp_frame) < len(raw_frame)
+    # incompressible payloads stay raw even above the threshold
+    noise = "".join(chr(0x100 + random.Random(2).randrange(0x4000))
+                    for _ in range(4096))
+    f_noise, _ = encode_frame(noise, 0)
+    assert f_noise[4] in (0, 1, 2)  # valid flag either way
+    assert decode_frame(f_noise) == noise
+
+
+# -- garbage-frame fuzz -------------------------------------------------------
+
+def test_garbage_frames_fuzz():
+    """Truncations, bit flips, bad magic, bad flags, trailing bytes:
+    decode must raise WireCodecError — never IndexError/KeyError/
+    MemoryError/hang — or succeed (a lucky mutation)."""
+    rng = random.Random(4242)
+    body = _op_bodies(1)["upsert"]
+    frames = [encode_frame(body, 1 << 30)[0],
+              encode_frame(body, 0)[0],
+              encode_frame({"k": list(range(100))}, 1 << 30)[0]]
+    cases = [b"", b"T", b"TKW1", b"TKW2" + frames[0][4:],
+             frames[0] + b"\x00", bytes([255]) * 64]
+    for f in frames:
+        for cut in (5, 6, len(f) // 2, len(f) - 1):
+            cases.append(f[:cut])
+        for _ in range(200):
+            mutated = bytearray(f)
+            for _ in range(rng.randint(1, 4)):
+                mutated[rng.randrange(len(mutated))] = \
+                    rng.randrange(256)
+            cases.append(bytes(mutated))
+    decoded = failed = 0
+    for case in cases:
+        try:
+            decode_frame(case)
+            decoded += 1
+        except WireCodecError:
+            failed += 1
+    # every outcome accounted for: nothing escaped as another type
+    assert decoded + failed == len(cases)
+    assert failed > len(cases) // 2
+
+
+def test_adversarial_counts_bounded():
+    """A frame claiming a huge list/table row count must fail fast on
+    the length-vs-remaining-bytes check, not allocate gigabytes."""
+    import io
+    import struct as _struct
+
+    out = io.BytesIO()
+    out.write(b"TKW1\x00\x08")  # list tag
+    # varint 2**40 elements, no payload
+    n = 1 << 40
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.write(bytes((b | 0x80,)) if n else bytes((b,)))
+        if not n:
+            break
+    with pytest.raises(WireCodecError):
+        decode_frame(out.getvalue())
+
+
+# -- the JSON path (codec off) ------------------------------------------------
+
+def test_dumps_json_compact_separators():
+    body = {"a": [1, 2], "b": {"c": True}}
+    assert dumps_json(body) == b'{"a":[1,2],"b":{"c":true}}'
+    assert json.loads(dumps_json(_op_bodies(0)["upsert"])) == \
+        _op_bodies(0)["upsert"]
+
+
+def test_config_validation():
+    assert load_config(env={}).wire_codec == "json"
+    assert load_config(env={}).wire_compress_min_bytes == 1024
+    cfg = load_config(env={"TPUKUBE_WIRE_CODEC": "binary"})
+    assert cfg.wire_codec == "binary"
+    # binary + inprocess is NOT an error: worker YAMLs carry it (the
+    # router pins every worker's own transport to inprocess)
+    assert cfg.shard_transport == "inprocess"
+    with pytest.raises(ValueError, match="wire_codec"):
+        load_config(env={"TPUKUBE_WIRE_CODEC": "msgpack"})
+    with pytest.raises(ValueError, match="wire_compress_min_bytes"):
+        load_config(env={"TPUKUBE_WIRE_COMPRESS_MIN_BYTES": "-1"})
+
+
+# -- negotiation against a JSON-only peer (rolling upgrade) -------------------
+
+class _JsonOnlyHandler:
+    """A pre-codec worker: answers compact JSON to everything and
+    ignores Accept — what a mixed-version fleet's old daemons do."""
+
+
+def _stub_transport(port: int, codec: str = "binary"):
+    """A SubprocessTransport pointed at a stub server: __new__ skips
+    the daemon spawn, fields mirror __init__."""
+    from tpukube.sched.shard import SubprocessTransport
+
+    t = object.__new__(SubprocessTransport)
+    t.index = 0
+    t.on_down = None
+    t.down = False
+    t.health_checks = 0
+    t.health_failures = 0
+    t.rtt_window = deque(maxlen=SubprocessTransport.RTT_WINDOW)
+    t.rtt_sum = 0.0
+    t.rtt_count = 0
+    t.wire_tx = 0
+    t.wire_rx = 0
+    t.wire_by_op = {}
+    t.wire_codec = codec
+    t.wire_compress_min_bytes = 64
+    t.wire_raw_tx = 0
+    t.wire_raw_rx = 0
+    t._peer_binary = None
+    t.on_wire = None
+    t._lock = threading.Lock()
+    t._conn = None
+    t._port = port
+    return t
+
+
+@pytest.fixture()
+def json_only_server():
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+            # a JSON-only worker would 400 on a binary body; the
+            # negotiating router never sends one unprompted
+            if body.startswith(b"TKW1"):
+                self.send_response(400)
+                self.end_headers()
+                return
+            doc = json.loads(body) if body else {}
+            out = json.dumps({"echo": doc}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield srv.server_address[1]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_binary_router_degrades_to_json_only_worker(json_only_server):
+    """A binary-codec router against a JSON-only peer: every request
+    stays JSON (the Accept probe is simply ignored), nothing errors,
+    and the wire accounting never tags the op binary."""
+    t = _stub_transport(json_only_server, codec="binary")
+    for _ in range(3):
+        out = t._request("POST", "/worker/planned",
+                         {"keys": ["default/a", "default/b"]})
+        assert out == {"echo": {"keys": ["default/a", "default/b"]}}
+    assert t._peer_binary is None  # peer never answered TKW1
+    snap = t.wire_snapshot()
+    assert snap["codec"] == "binary"  # configured...
+    assert "codec" not in snap["by_op"]["planned"]  # ...never used
+    assert snap["by_op"]["planned"]["calls"] == 3
+
+
+def test_failed_requests_billed(json_only_server):
+    """The satellite: a request that raises after conn.request still
+    bills its tx bytes and bumps a failures counter — retry storms
+    must show in the wire bill."""
+    t = _stub_transport(json_only_server, codec="json")
+    t._port = 1  # nothing listens there
+    from tpukube.sched.shard import ReplicaUnavailable
+
+    body = {"keys": ["default/x" * 10]}
+    with pytest.raises(ReplicaUnavailable):
+        t._request("POST", "/worker/planned", body, mark_down=False,
+                   timeout=2.0)
+    snap = t.wire_snapshot()
+    cell = snap["by_op"]["planned"]
+    assert cell["failures"] == 1
+    assert cell["calls"] == 1
+    assert cell["tx"] == len(dumps_json(body))
+    assert cell["rx"] == 0
+    assert not t.down  # mark_down=False: billed but not condemned
+
+
+# -- real worker daemons ------------------------------------------------------
+
+@needs_workers
+def test_corrupt_frame_answers_400_worker_keeps_serving():
+    """A truncated/corrupt TKW1 body reaches a REAL worker daemon: the
+    worker answers 400 and keeps serving; the transport raises
+    ShardError (a request defect), never marks the replica dead."""
+    from tpukube.sched.shard import ShardError, SubprocessTransport
+
+    t = SubprocessTransport(0, load_config(env={}), fake_clock=True)
+    try:
+        frame, _ = encode_frame({"keys": ["default/x"]}, 1 << 30)
+        for evil in (frame[:-3], b"TKW1\x07garbage", b"TKW9" + frame[4:]):
+            conn = http.client.HTTPConnection("127.0.0.1", t._port,
+                                              timeout=10)
+            conn.request(
+                "POST", "/worker/planned", body=evil,
+                headers={"Content-Type": wirecodec.WIRE_CONTENT_TYPE})
+            resp = conn.getresponse()
+            body = resp.read()
+            conn.close()
+            assert resp.status == 400, (evil[:12], resp.status)
+            assert b"bad wire frame" in body
+        # the worker still serves; the replica is not dead
+        assert t.healthz()
+        assert not t.down
+        out = t._request("POST", "/worker/planned",
+                         {"keys": ["default/x"]})
+        assert out == {"nodes": {"default/x": None}}
+        # a defective request is a ShardError (HTTP 4xx), never a
+        # ReplicaUnavailable: the transport stays up
+        with pytest.raises(ShardError):
+            t._request("POST", "/worker/no-such-op", {})
+        assert not t.down
+    finally:
+        t.kill()
+
+
+@needs_workers
+def test_negotiation_upgrades_and_accounts():
+    """First contact is a JSON probe; a TKW1 answer upgrades request
+    bodies to binary; the codec-tagged cells carry raw vs wire
+    bytes."""
+    import dataclasses
+
+    from tpukube.sched.shard import SubprocessTransport
+
+    cfg = dataclasses.replace(load_config(env={}),
+                              wire_codec="binary",
+                              wire_compress_min_bytes=128)
+    t = SubprocessTransport(0, cfg, fake_clock=True)
+    try:
+        # the spawn-time probe (one cheap /worker/gauges GET) already
+        # completed the handshake, so even the FIRST heavy body — the
+        # cold-start ingest in real deployments — rides TKW1
+        assert t._peer_binary is True
+        # a torn connection renegotiates from the JSON probe
+        t._peer_binary = None
+        keys = [f"default/pod-{i}" for i in range(200)]
+        t._request("POST", "/worker/planned", {"keys": keys})
+        assert t._peer_binary is True
+        t._request("POST", "/worker/planned", {"keys": keys})
+        snap = t.wire_snapshot()
+        cell = snap["by_op"]["planned"]
+        assert cell["codec"] == "binary"
+        # the binary call's compressed frame beat its raw size, so
+        # cumulative raw bytes exceed cumulative wire bytes both ways
+        assert cell["raw_tx"] > cell["tx"]
+        assert cell["raw_rx"] > cell["rx"]
+        assert snap["raw_rx"] > snap["rx"]
+        assert snap["raw_tx"] > snap["tx"]
+    finally:
+        t.kill()
+
+
+@needs_workers
+def test_json_default_leaves_wire_untagged():
+    """wire_codec: json (the default): no Accept probe, no TKW1
+    anywhere, snapshot/cells keep the pre-codec shape."""
+    t = None
+    from tpukube.sched.shard import SubprocessTransport
+
+    t = SubprocessTransport(0, load_config(env={}), fake_clock=True)
+    try:
+        t._request("POST", "/worker/planned", {"keys": ["default/x"]})
+        assert t._peer_binary is None
+        snap = t.wire_snapshot()
+        assert set(snap) == {"tx", "rx", "by_op"}
+        assert set(snap["by_op"]["planned"]) == {"tx", "rx", "calls"}
+    finally:
+        t.kill()
+
+
+def _mixed_workload(c: SimCluster) -> dict[str, tuple[str, tuple]]:
+    placements: dict[str, tuple[str, tuple]] = {}
+
+    def put(pod):
+        node, alloc = c.schedule(pod)
+        placements[alloc.pod_key] = (node,
+                                     tuple(sorted(alloc.device_ids)))
+
+    put(c.make_pod("solo-0", tpu=1))
+    put(c.make_pod("multi-0", tpu=2))
+    grp = PodGroup("pg", min_member=2)
+    for i in range(2):
+        put(c.make_pod(f"pg-{i}", tpu=1, group=grp, priority=10))
+    c.complete_pod("solo-0")
+    put(c.make_pod("solo-1", tpu=1))
+    return placements
+
+
+@needs_workers
+def test_codec_on_placement_parity_and_bytes_shrink():
+    """The tentpole acceptance at test scale: identical placements
+    codec-on vs codec-off over 2 real worker daemons, with the binary
+    run's wire bill strictly smaller and codec-tagged."""
+    results = {}
+    wire = {}
+    for codec in ("json", "binary"):
+        cfg = proc_config(2, TPUKUBE_WIRE_CODEC=codec,
+                          TPUKUBE_WIRE_COMPRESS_MIN_BYTES="256")
+        with SimCluster(cfg, clock=FakeClock(), in_process=True,
+                        slices=two_slices()) as c:
+            results[codec] = _mixed_workload(c)
+            assert ledger_divergence(c) == []
+            wire[codec] = c.extender.wire_totals()
+    assert results["binary"] == results["json"]
+    assert wire["binary"]["codec"] == "binary"
+    assert "codec" not in wire["json"]
+    assert wire["binary"]["total"] < wire["json"]["total"]
+    assert wire["binary"]["saved"] > 0
+
+
+@needs_workers
+def test_worker_kill_restart_over_binary_transport():
+    """Chaos with the codec ON: SIGKILL a worker daemon mid-plane,
+    health check marks it dead, warm restart respawns it — and the
+    fresh transport re-handshakes from JSON before upgrading (the
+    respawned worker might have been older/JSON-only)."""
+    clock = FakeClock()
+    cfg = proc_config(2, TPUKUBE_WIRE_CODEC="binary",
+                      TPUKUBE_SNAPSHOT_AUDIT_RATE="1.0")
+    with SimCluster(cfg, clock=clock, in_process=True,
+                    slices=two_slices()) as c:
+        placed = c.schedule_pending(
+            [c.make_pod(f"p{i}", tpu=1) for i in range(8)]
+        )
+        assert len(placed) == 8
+        router = c.extender
+        # the live plane really negotiated binary
+        assert any("codec" in (rep.transport.wire_snapshot() or {})
+                   for rep in router.replicas)
+        victim = next(
+            idx for idx in (0, 1)
+            if router.replicas[idx].transport.summary()["allocs"])
+        held = router.replicas[victim].transport.summary()["allocs"]
+        router.replicas[victim].transport._proc.kill()
+        router.replicas[victim].transport._proc.wait(timeout=10)
+        clock.advance(1.0)
+        assert router.health_check() == 1
+        restored = c.restart_replica(victim)
+        assert restored == held
+        fresh = router.replicas[victim].transport
+        # respawn re-handshakes: fresh transport, no assumed peer
+        assert fresh._peer_binary in (None, True)
+        # plane still places over the binary transport
+        node, _alloc = c.schedule(c.make_pod("after", tpu=1))
+        assert node
+        assert ledger_divergence(c) == []
+        audit = router.audit_stats()
+        assert audit["divergences"] == 0

@@ -137,6 +137,20 @@ for key, need in floor.get("min_speedup", {}).items():
     if m[key] < need:
         bad.append(f"{key}={m[key]:.2f} below the required {need}x "
                    f"(snapshot cache not engaging?)")
+wire = floor.get("wire")
+if wire:
+    # ISSUE 20: the TKW1 codec point — encode/decode ceilings (a
+    # complexity blow-up guard) and the frame-vs-JSON size floor on
+    # the fleet-shaped upsert wave
+    for key, cap in (("wire_encode_us", wire["encode_us_max"]),
+                     ("wire_decode_us", wire["decode_us_max"])):
+        if m[key] > cap * floor["allowed_regression"]:
+            bad.append(f"{key}={m[key]:.0f}us exceeds ceiling {cap}us "
+                       f"x {floor['allowed_regression']}")
+    if m["wire_ratio"] < wire["micro_ratio_min"]:
+        bad.append(f"wire_ratio={m['wire_ratio']:.2f} below the "
+                   f"required {wire['micro_ratio_min']}x (table "
+                   f"encoding / interning / compression not engaging?)")
 if "lint_wall_s_floor" in floor:
     # the CFG dataflow passes must not blow up lint wall time — the
     # static analysis runs on every tier-1 invocation
@@ -517,6 +531,128 @@ else:
 if bad:
     sys.exit("process-mode shard smoke FAILED: " + "; ".join(bad))
 print("process-mode shard smoke OK")
+PY
+
+echo
+echo "== wire-codec smoke (ISSUE 20: 2 SUBPROCESS planner daemons —"
+echo "   a fixed mixed workload must place bit-identically with"
+echo "   wire_codec json vs binary, and a fixed-trace scenario-12"
+echo "   slice drive at snapshot_audit_rate=1.0 must move at least"
+echo "   bytes_per_wave_ratio_min x fewer bytes/wave over TKW1 than"
+echo "   JSON (floors from tools/perf_floor.json); skips where"
+echo "   subprocesses are unavailable) =="
+JAX_PLATFORMS=cpu python - <<'PY'
+import json
+import sys
+
+floor = json.load(open("tools/perf_floor.json"))["wire"]
+
+from tpukube.core.config import load_config
+from tpukube.sched.shard import ShardError, SubprocessTransport
+
+try:
+    probe = SubprocessTransport(0, load_config(env={}),
+                                fake_clock=False)
+    probe.close()
+except (ShardError, OSError) as e:
+    print(f"wire-codec smoke SKIPPED: cannot spawn worker "
+          f"daemons here ({e})")
+    sys.exit(0)
+
+from tpukube.core.clock import FakeClock
+from tpukube.core.mesh import MeshSpec
+from tpukube.core.types import PodGroup
+from tpukube.sim.harness import SimCluster
+
+def cfg_for(codec: str):
+    return load_config(env={
+        "TPUKUBE_PLANNER_REPLICAS": "2",
+        "TPUKUBE_SHARD_TRANSPORT": "subprocess",
+        "TPUKUBE_BATCH_ENABLED": "1",
+        "TPUKUBE_WIRE_CODEC": codec,
+        "TPUKUBE_WIRE_COMPRESS_MIN_BYTES": "256",
+    })
+
+def mixed(codec: str):
+    """A fixed mixed workload (solo/multi-chip/gang/churn) through the
+    per-pod webhook protocol: pod -> (node, sorted device ids)."""
+    slices = {sid: MeshSpec(dims=(2, 2, 2), host_block=(2, 2, 1),
+                            torus=(False, False, False))
+              for sid in ("s0", "s1")}
+    out = {}
+    with SimCluster(cfg_for(codec), clock=FakeClock(),
+                    in_process=True, slices=slices) as c:
+        def put(pod):
+            node, alloc = c.schedule(pod)
+            out[alloc.pod_key] = (node,
+                                  tuple(sorted(alloc.device_ids)))
+        put(c.make_pod("solo-0", tpu=1))
+        put(c.make_pod("multi-0", tpu=2))
+        grp = PodGroup("pg", min_member=2)
+        for i in range(2):
+            put(c.make_pod(f"pg-{i}", tpu=1, group=grp, priority=10))
+        c.complete_pod("solo-0")
+        put(c.make_pod("solo-1", tpu=1))
+        snap = c.extender.wire_totals()
+    return out, snap
+
+placed_json, wire_json_small = mixed("json")
+placed_bin, wire_bin_small = mixed("binary")
+bad = []
+if placed_json != placed_bin:
+    diff = {k for k in placed_json.keys() | placed_bin.keys()
+            if placed_json.get(k) != placed_bin.get(k)}
+    bad.append(f"codec-on placements diverge from codec-off: {sorted(diff)}")
+if wire_bin_small.get("codec") != "binary":
+    bad.append("binary run moved no TKW1 frames (negotiation broken?)")
+
+# the byte bill at drive scale: the same fixed trace once per codec
+from tpukube.sim import scenarios
+
+def drive(codec: str):
+    cfg = load_config(env={
+        "TPUKUBE_SIM_MESH_DIMS": "8,8,16",
+        "TPUKUBE_SIM_HOST_BLOCK": "2,2,1",
+        "TPUKUBE_BATCH_ENABLED": "1",
+        "TPUKUBE_BATCH_MAX_PODS": "2048",
+        "TPUKUBE_FILTER_FROM_PLAN": "1",
+        "TPUKUBE_PLANNER_REPLICAS": "2",
+        "TPUKUBE_SHARD_TRANSPORT": "subprocess",
+        "TPUKUBE_SNAPSHOT_AUDIT_RATE": "1.0",
+        "TPUKUBE_WIRE_CODEC": codec,
+    })
+    mesh = cfg.sim_mesh()
+    slices = {
+        f"s{i:02d}": MeshSpec(dims=mesh.dims,
+                              host_block=mesh.host_block,
+                              torus=mesh.torus)
+        for i in range(4)
+    }
+    return scenarios._kilonode_drive(
+        cfg, metric=f"wire_{codec}", total_target=floor["pods"],
+        gang_size=128, max_alive=2048, check_leaks=True,
+        slices=slices, include_setup=False,
+    )
+
+wj = drive("json")["wire"]
+wb = drive("binary")["wire"]
+ratio = (wj["bytes_per_wave"] / wb["bytes_per_wave"]
+         if wb["bytes_per_wave"] else 0.0)
+print(json.dumps({
+    "json_bytes_per_wave": wj["bytes_per_wave"],
+    "binary_bytes_per_wave": wb["bytes_per_wave"],
+    "bytes_per_wave_ratio": round(ratio, 2),
+    "binary_compress_ratio": wb.get("compress_ratio"),
+    "binary_saved_bytes": wb.get("saved_bytes"),
+}))
+if wb.get("codec") != "binary":
+    bad.append("binary drive recorded no codec (negotiation broken?)")
+if ratio < floor["bytes_per_wave_ratio_min"]:
+    bad.append(f"bytes/wave ratio {ratio:.2f} below the "
+               f"{floor['bytes_per_wave_ratio_min']}x floor")
+if bad:
+    sys.exit("wire-codec smoke FAILED: " + "; ".join(bad))
+print("wire-codec smoke OK")
 PY
 
 echo

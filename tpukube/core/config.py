@@ -212,6 +212,28 @@ class TpuKubeConfig:
     #                 this; production runs the same worker daemon
     #                 shape under its own supervisor.
     shard_transport: str = "inprocess"
+    # Wire codec for the router<->worker `/worker/*` surface (ISSUE 20;
+    # sched/wirecodec.py):
+    #   json    — the default AND the parity oracle: compact-separator
+    #             JSON bodies, byte-for-byte what the plane shipped
+    #             before the codec existed.
+    #   binary  — versioned TKW1 frames (per-op key tables, interned
+    #             strings, zlib/zstd above wire_compress_min_bytes),
+    #             negotiated per request via Content-Type/Accept so a
+    #             binary router over a JSON-only worker degrades
+    #             cleanly to JSON per replica (rolling upgrades,
+    #             deploy/README.md). Placements are byte-identical
+    #             codec-on vs codec-off — the codec moves bytes, never
+    #             decisions. Meaningful only on the subprocess
+    #             transport, but binary+inprocess is NOT a config
+    #             error: SubprocessTransport pins every worker's own
+    #             YAML to inprocess, and the worker must still boot
+    #             (the worker side is Accept-driven, not config-driven).
+    wire_codec: str = "json"
+    # Binary payloads at or above this many encoded bytes are
+    # compressed (kept raw if compression doesn't shrink them). Small
+    # control ops stay raw — compression overhead would dominate.
+    wire_compress_min_bytes: int = 1024
 
     # Decision provenance (tpukube/obs/decisions.py, ISSUE 12). With
     # decisions_enabled the extender keeps a bounded, sampled,
@@ -558,6 +580,12 @@ def load_config(
             f"unknown shard_transport {cfg.shard_transport!r} "
             f"(inprocess | subprocess)"
         )
+    if cfg.wire_codec not in ("json", "binary"):
+        raise ValueError(
+            f"unknown wire_codec {cfg.wire_codec!r} (json | binary)"
+        )
+    if cfg.wire_compress_min_bytes < 0:
+        raise ValueError("wire_compress_min_bytes must be >= 0")
     if cfg.drain_max_concurrent_moves < 1:
         raise ValueError("drain_max_concurrent_moves must be >= 1")
     if cfg.drain_tenant_budget < 0:

@@ -346,6 +346,7 @@ def build_router_registry(router) -> Registry:
                       "request payload, dir=rx the response body) — "
                       "the wire-cost baseline the ROADMAP codec item "
                       "is judged against.")
+        snaps = []
         for rep in router.replicas:
             # snapshot at registry build: the registry is rebuilt per
             # scrape, so the values are scrape-current without taking
@@ -354,10 +355,31 @@ def build_router_registry(router) -> Registry:
                 if hasattr(rep.transport, "wire_snapshot") else None
             if not snap:
                 continue
+            snaps.append((rep.name, snap))
             for op, cell in sorted(snap["by_op"].items()):
                 for d in ("tx", "rx"):
                     wire.labels(op=op, dir=d, replica=rep.name) \
                         .set_function(lambda v=cell[d]: v)
+        if any("codec" in snap for _, snap in snaps):
+            # wire codec savings (ISSUE 20): rendered ONLY when a
+            # binary-codec transport exists, so the default (json)
+            # plane's exposition stays byte-identical
+            saved = reg.counter(
+                "tpukube_router_wire_saved_bytes_total",
+                help_text="Bytes the binary wire codec kept off the "
+                          "router->replica transport, per op and "
+                          "replica (pre-compression frame bytes minus "
+                          "bytes actually sent).")
+            for name, snap in snaps:
+                for op, cell in sorted(snap["by_op"].items()):
+                    if "codec" not in cell:
+                        continue
+                    delta = max(
+                        0, (cell.get("raw_tx", 0)
+                            + cell.get("raw_rx", 0))
+                        - (cell["tx"] + cell["rx"]))
+                    saved.labels(op=op, replica=name) \
+                        .set_function(lambda v=delta: v)
     return reg
 
 

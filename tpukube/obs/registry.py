@@ -160,6 +160,11 @@ DECLARED_SERIES: frozenset[str] = frozenset({
     # per {op, dir, replica} over the subprocess transport — the
     # measured baseline the ROADMAP codec item is judged against
     "tpukube_router_wire_bytes_total",
+    # compact binary wire codec (sched/wirecodec.py, ISSUE 20): bytes
+    # the TKW1 codec kept off the transport, per {op, replica} —
+    # rendered ONLY when a binary-codec transport exists, so the
+    # default (wire_codec: json) exposition stays byte-identical
+    "tpukube_router_wire_saved_bytes_total",
     # capacity analytics & demand forensics (tpukube/obs/capacity.py,
     # ISSUE 17; series render only when capacity_enabled built a
     # CapacityRecorder — legacy exposition stays byte-identical with

@@ -92,7 +92,7 @@ from tpukube import trace as trace_mod
 from tpukube.core import codec
 from tpukube.core.config import TpuKubeConfig
 from tpukube.core.types import AllocResult, PodGroup, PodInfo, TopologyCoord
-from tpukube.sched import kube, slicefit
+from tpukube.sched import kube, slicefit, wirecodec
 from tpukube.sched.extender import Extender, ExtenderError
 from tpukube.sched.gang import GangError
 from tpukube.sched.state import StateError
@@ -526,7 +526,23 @@ class SubprocessTransport:
         # RTT stats; read via wire_snapshot().
         self.wire_tx = 0
         self.wire_rx = 0
-        self.wire_by_op: dict[str, dict[str, int]] = {}
+        self.wire_by_op: dict[str, dict[str, Any]] = {}
+        # wire codec (ISSUE 20, sched/wirecodec.py): json (default,
+        # the parity oracle) or binary (TKW1 frames). raw counters
+        # track pre-compression frame bytes so /statusz can cite a
+        # per-op compression ratio without re-serializing to JSON.
+        self.wire_codec = config.wire_codec
+        self.wire_compress_min_bytes = config.wire_compress_min_bytes
+        self.wire_raw_tx = 0
+        self.wire_raw_rx = 0
+        # Per-connection negotiated peer capability: None = unknown
+        # (requests go out as JSON with an Accept probe), True = the
+        # peer answered in TKW1, so request BODIES switch to binary
+        # too. Reset to None whenever the kept-alive connection is
+        # torn down — a respawned worker re-handshakes from JSON, so a
+        # binary router over a restarted (possibly older, JSON-only)
+        # worker degrades cleanly per replica.
+        self._peer_binary: Optional[bool] = None
         #: optional (index, op, tx_bytes, rx_bytes, rtt_s) hook the
         #: router uses to feed its fan-out flight recorder; called
         #: outside the transport lock, after each completed request
@@ -583,6 +599,18 @@ class SubprocessTransport:
                     # the daemon serves: they are not health signal
                     self.health_checks = 0
                     self.health_failures = 0
+                    if self.wire_codec == "binary":
+                        # complete the codec handshake NOW with one
+                        # cheap op, or the first heavy call — usually
+                        # the fleet-sized cold-start ingest, the very
+                        # body the codec exists for — would ride the
+                        # JSON probe
+                        try:
+                            self._request("GET", "/worker/gauges",
+                                          timeout=5.0,
+                                          mark_down=False)
+                        except (ReplicaUnavailable, ShardError):
+                            pass  # probe only; requests renegotiate
                     return
             except ReplicaUnavailable:
                 pass
@@ -597,10 +625,37 @@ class SubprocessTransport:
     def _request(self, method: str, path: str,
                  body: Optional[dict] = None, timeout: float = 60.0,
                  mark_down: bool = True, as_text: bool = False) -> Any:
-        payload = (json.dumps(body).encode("utf-8")
-                   if body is not None else None)
-        headers = {"Content-Type": "application/json"} \
-            if payload is not None else {}
+        # Only the /worker/* op surface negotiates the binary codec;
+        # exposition passthrough (/metrics, /statusz, /healthz, ...)
+        # stays JSON/text regardless.
+        wire_op = path.startswith("/worker/")
+        negotiate = (wire_op and not as_text
+                     and self.wire_codec == "binary")
+        # _peer_binary is read without the lock: requests on one
+        # replica serialize behind _lock anyway, and the worst a stale
+        # read costs is one more JSON-bodied probe request.
+        req_codec = "json"
+        raw_tx = 0
+        if body is not None:
+            if negotiate and self._peer_binary:
+                payload, raw_tx = wirecodec.encode_frame(
+                    body, self.wire_compress_min_bytes)
+                headers = {
+                    "Content-Type": wirecodec.WIRE_CONTENT_TYPE}
+                req_codec = "binary"
+            else:
+                payload = wirecodec.dumps_json(body)
+                headers = {
+                    "Content-Type": wirecodec.JSON_CONTENT_TYPE}
+                raw_tx = len(payload)
+        else:
+            payload = None
+            headers = {}
+        if negotiate:
+            # capability probe: a TKW1-speaking worker answers in
+            # kind; a JSON-only worker ignores it — the per-replica
+            # rolling-upgrade degrade
+            headers["Accept"] = wirecodec.WIRE_CONTENT_TYPE
         ctx = trace_mod.TRACE_CONTEXT.get()
         if ctx is not None:
             # propagate the router's trace context so the worker tags
@@ -637,6 +692,26 @@ class SubprocessTransport:
                 if self._conn is not None:
                     self._conn.close()
                     self._conn = None
+                # fresh connection means a possibly fresh peer (a
+                # respawned worker): renegotiate the codec from JSON
+                self._peer_binary = None
+                # bill the failed request too — an unaccounted retry
+                # storm is exactly the traffic this counter exists to
+                # expose (rx stays 0: nothing usable came back)
+                tx = len(payload or b"")
+                self.wire_tx += tx
+                self.wire_raw_tx += raw_tx
+                cell = self.wire_by_op.get(op)
+                if cell is None:
+                    cell = self.wire_by_op[op] = \
+                        {"tx": 0, "rx": 0, "calls": 0}
+                cell["tx"] += tx
+                cell["calls"] += 1
+                cell["failures"] = cell.get("failures", 0) + 1
+                if req_codec == "binary":
+                    cell["codec"] = "binary"
+                    cell["raw_tx"] = cell.get("raw_tx", 0) + raw_tx
+                    cell["raw_rx"] = cell.get("raw_rx", 0)
                 if mark_down:
                     self._mark_down_locked(e)
                 raise ReplicaUnavailable(
@@ -646,9 +721,19 @@ class SubprocessTransport:
             self.rtt_window.append(dt)
             self.rtt_sum += dt
             self.rtt_count += 1
+            resp_ct = (resp.getheader("Content-Type") or "").split(
+                ";", 1)[0].strip()
+            resp_binary = resp_ct == wirecodec.WIRE_CONTENT_TYPE
+            if negotiate and resp_binary and resp.status < 400:
+                # the worker answered TKW1: switch request bodies to
+                # binary for the rest of this connection
+                self._peer_binary = True
             tx, rx = len(payload or b""), len(raw)
             self.wire_tx += tx
             self.wire_rx += rx
+            self.wire_raw_tx += raw_tx
+            if not resp_binary:
+                self.wire_raw_rx += rx
             cell = self.wire_by_op.get(op)
             if cell is None:
                 cell = self.wire_by_op[op] = \
@@ -656,8 +741,18 @@ class SubprocessTransport:
             cell["tx"] += tx
             cell["rx"] += rx
             cell["calls"] += 1
+            if req_codec == "binary" or resp_binary:
+                # tag the cell with the codec that actually crossed
+                # the wire (absence of the tag = pure JSON, so the
+                # default-codec cell shape is unchanged) and track
+                # pre-compression frame bytes for the ratio exposition
+                cell["codec"] = "binary"
+                cell["raw_tx"] = cell.get("raw_tx", 0) + raw_tx
+                cell.setdefault("raw_rx", 0)
         if self.on_wire is not None:
-            self.on_wire(self.index, op, tx, rx, dt)
+            self.on_wire(self.index, op, tx, rx, dt,
+                         "binary" if (req_codec == "binary"
+                                      or resp_binary) else "json")
         if resp.status >= 400:
             raise ShardError(
                 f"replica r{self.index} {path}: HTTP {resp.status}: "
@@ -665,7 +760,25 @@ class SubprocessTransport:
             )
         if as_text:
             return raw.decode("utf-8", errors="replace")
-        return json.loads(raw) if raw else None
+        if not raw:
+            return None
+        if resp_binary:
+            # decode outside the transport lock (a fleet-sized audit
+            # read must not stall the next request behind its decode)
+            try:
+                out, raw_rx = wirecodec.decode_frame_ex(raw)
+            except wirecodec.WireCodecError as e:
+                raise ShardError(
+                    f"replica r{self.index} {path}: undecodable "
+                    f"wire frame: {e}"
+                ) from e
+            with self._lock:
+                self.wire_raw_rx += raw_rx
+                cell = self.wire_by_op.get(op)
+                if cell is not None:
+                    cell["raw_rx"] = cell.get("raw_rx", 0) + raw_rx
+            return out
+        return json.loads(raw)
 
     def _mark_down_locked(self, err: Exception) -> None:
         if not self.down:
@@ -897,12 +1010,21 @@ class SubprocessTransport:
         """Cumulative request/response byte counters, total and per op
         — the baseline the ROADMAP codec item will be judged against."""
         with self._lock:
-            return {
+            snap = {
                 "tx": self.wire_tx,
                 "rx": self.wire_rx,
                 "by_op": {op: dict(c)
                           for op, c in self.wire_by_op.items()},
             }
+            if self.wire_codec != "json":
+                # pre-compression frame bytes next to the wire bytes:
+                # saved = raw - wire, without re-serializing to JSON.
+                # Keys appear only with the codec on so the default
+                # plane's snapshot/statusz stays byte-identical.
+                snap["codec"] = self.wire_codec
+                snap["raw_tx"] = self.wire_raw_tx
+                snap["raw_rx"] = self.wire_raw_rx
+            return snap
 
     # lifecycle -------------------------------------------------------------
     def rebuild_from_pods(self, pods: list[dict[str, str]]) -> int:
@@ -1624,20 +1746,25 @@ class ShardRouter:
                         **fields)
 
     def _record_flight(self, idx: int, op: str, tx: int, rx: int,
-                       dt: float) -> None:
+                       dt: float, codec_used: str = "json") -> None:
         """The subprocess transports' on_wire hook: one bounded ring
         entry per completed request (sizes + RTT) — the /statusz
         flight recorder. Lock-free (one atomic deque append)."""
         flights = self._flights
         if flights is not None:
-            flights.append({
+            entry = {
                 "ts": round(time.time(), 3),
                 "replica": f"r{idx}",
                 "op": op,
                 "tx_bytes": tx,
                 "rx_bytes": rx,
                 "rtt_ms": round(dt * 1000.0, 3),
-            })
+            }
+            if codec_used != "json":
+                # tagged only off the JSON default, so the recorder's
+                # entry shape is unchanged on the oracle path
+                entry["codec"] = codec_used
+            flights.append(entry)
 
     def flights_snapshot(self, limit: int = 64) -> list[dict[str, Any]]:
         """Most recent fan-out requests, oldest first."""
@@ -1659,6 +1786,8 @@ class ShardRouter:
         churn-wave numerator on the driver surface, and the baseline
         the ROADMAP codec item is judged against."""
         tx = rx = 0
+        raw_tx = raw_rx = 0
+        codec_name = None
         by_op: dict[str, dict[str, int]] = {}
         per_replica: dict[str, dict[str, int]] = {}
         for rep in self.replicas:
@@ -1669,13 +1798,39 @@ class ShardRouter:
             tx += snap["tx"]
             rx += snap["rx"]
             per_replica[rep.name] = {"tx": snap["tx"], "rx": snap["rx"]}
+            if "codec" in snap:
+                codec_name = snap["codec"]
+                raw_tx += snap["raw_tx"]
+                raw_rx += snap["raw_rx"]
             for op, cell in snap["by_op"].items():
                 agg = by_op.setdefault(
                     op, {"tx": 0, "rx": 0, "calls": 0})
                 for k in ("tx", "rx", "calls"):
                     agg[k] += cell[k]
-        return {"tx": tx, "rx": rx, "total": tx + rx,
-                "per_replica": per_replica, "by_op": by_op}
+                # codec-tagged cells carry failures/raw counters; fold
+                # them in without changing the default cell shape
+                if "failures" in cell:
+                    agg["failures"] = \
+                        agg.get("failures", 0) + cell["failures"]
+                if "codec" in cell:
+                    agg["codec"] = cell["codec"]
+                    agg["raw_tx"] = \
+                        agg.get("raw_tx", 0) + cell.get("raw_tx", 0)
+                    agg["raw_rx"] = \
+                        agg.get("raw_rx", 0) + cell.get("raw_rx", 0)
+        doc = {"tx": tx, "rx": rx, "total": tx + rx,
+               "per_replica": per_replica, "by_op": by_op}
+        if codec_name is not None:
+            # bytes the codec kept off the wire and the resulting
+            # compression ratio (pre-compression frames / wire bytes)
+            doc["codec"] = codec_name
+            doc["raw_tx"] = raw_tx
+            doc["raw_rx"] = raw_rx
+            doc["saved"] = max(0, (raw_tx + raw_rx) - (tx + rx))
+            wire_total = tx + rx
+            doc["ratio"] = (round((raw_tx + raw_rx) / wire_total, 3)
+                            if wire_total else None)
+        return doc
 
     def explain(self, pod_key: str) -> Optional[dict[str, Any]]:
         """Stitched federated /explain: the router's own route /

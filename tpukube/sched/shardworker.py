@@ -38,7 +38,7 @@ from aiohttp import web
 
 from tpukube import trace as trace_mod
 from tpukube.core import codec
-from tpukube.sched import kube, shard
+from tpukube.sched import kube, shard, wirecodec
 from tpukube.sched.extender import Extender, make_app
 from tpukube.sched.gang import GangError
 from tpukube.sched.state import StateError
@@ -79,32 +79,66 @@ def make_worker_app(extender: Extender, clock=None) -> web.Application:
 
     app.middlewares.append(trace_context_mw)
 
-    async def _json(request: web.Request) -> Any:
+    # Wire codec (ISSUE 20, sched/wirecodec.py). The worker side is
+    # CAPABILITY-driven, not config-driven: it decodes whatever
+    # Content-Type the router sent and answers TKW1 only when the
+    # request's Accept asked for it — its own YAML (which the router
+    # pins to wire_codec-agnostic inprocess anyway) never gates the
+    # wire format, so a binary router and a JSON router can share a
+    # worker mid rolling upgrade. wire_compress_min_bytes DOES come
+    # from config: both ends compress by the same threshold.
+    compress_min = extender._config.wire_compress_min_bytes
+
+    def _dumps(obj: Any) -> str:
+        # compact separators on the JSON path too (journal.py already
+        # does this) — a few percent off every wire body, codec off
+        return json.dumps(obj, separators=wirecodec.JSON_SEPARATORS)
+
+    async def _body(request: web.Request) -> Any:
+        ct = request.headers.get("Content-Type", "")
+        if ct.split(";", 1)[0].strip() == wirecodec.WIRE_CONTENT_TYPE:
+            raw = await request.read()
+            try:
+                return wirecodec.decode_frame(raw)
+            except wirecodec.WireCodecError as e:
+                # a truncated/corrupt frame is the CALLER's defect:
+                # answer 400 and keep serving — never crash the
+                # replica, never let the router read it as death
+                raise web.HTTPBadRequest(text=f"bad wire frame: {e}")
         try:
             return await request.json()
         except json.JSONDecodeError as e:
             raise web.HTTPBadRequest(text=f"bad JSON: {e}")
 
+    def _respond(request: web.Request, obj: Any) -> web.Response:
+        if wirecodec.WIRE_CONTENT_TYPE in \
+                request.headers.get("Accept", ""):
+            frame, _ = wirecodec.encode_frame(obj, compress_min)
+            return web.Response(
+                body=frame,
+                content_type=wirecodec.WIRE_CONTENT_TYPE)
+        return web.json_response(obj, dumps=_dumps)
+
     async def handle(request: web.Request) -> web.Response:
-        doc = await _json(request)
+        doc = await _body(request)
         try:
             out = extender.handle(doc["kind"], doc["body"])
         except kube.KubeSchemaError as e:
             # in-band so the router re-raises the SAME exception type
             # the in-process transport would have propagated
-            return web.json_response({"schema_error": str(e)})
-        return web.json_response(out)
+            return _respond(request, {"schema_error": str(e)})
+        return _respond(request, out)
 
     async def upsert(request: web.Request) -> web.Response:
-        doc = await _json(request)
+        doc = await _body(request)
         # ONE bulk-ingest decision for the whole batch (ISSUE 15): the
         # worker ingests its shard through the cold-start fast path
-        return web.json_response({
+        return _respond(request, {
             "results": extender.upsert_nodes_many(doc["items"])
         })
 
     async def admit(request: web.Request) -> web.Response:
-        doc = await _json(request)
+        doc = await _body(request)
         admitted = []
         for obj in doc["pods"]:
             try:
@@ -114,19 +148,19 @@ def make_worker_app(extender: Extender, clock=None) -> web.Application:
             except kube.KubeSchemaError as e:
                 log.error("admit: undecodable pod object (%s)", e)
                 admitted.append(False)
-        return web.json_response({"admitted": admitted})
+        return _respond(request, {"admitted": admitted})
 
     async def plan(request: web.Request) -> web.Response:
-        return web.json_response({"planned": extender.plan_pending()})
+        return _respond(request, {"planned": extender.plan_pending()})
 
     async def planned(request: web.Request) -> web.Response:
-        doc = await _json(request)
-        return web.json_response({"nodes": {
+        doc = await _body(request)
+        return _respond(request, {"nodes": {
             key: extender.planned_node(key) for key in doc["keys"]
         }})
 
     async def bind_many(request: web.Request) -> web.Response:
-        doc = await _json(request)
+        doc = await _body(request)
         results = []
         for body in doc["bodies"]:
             try:
@@ -135,26 +169,26 @@ def make_worker_app(extender: Extender, clock=None) -> web.Application:
                 results.append(kube.binding_result(
                     f"bad bind body: {e}"
                 ))
-        return web.json_response({"results": results})
+        return _respond(request, {"results": results})
 
     async def release_many(request: web.Request) -> web.Response:
-        doc = await _json(request)
+        doc = await _body(request)
         for key in doc["keys"]:
             extender.handle("release", {"pod_key": key})
-        return web.json_response({})
+        return _respond(request, {})
 
     async def gauges(request: web.Request) -> web.Response:
-        return web.json_response(
+        return _respond(request, 
             {"slices": shard.replica_gauges(extender)}
         )
 
     async def gang(request: web.Request) -> web.Response:
-        doc = await _json(request)
+        doc = await _body(request)
         op = doc.get("op")
         try:
             if op == "fit":
                 pod = kube.pod_from_k8s(doc["pod"])
-                return web.json_response({"fits": shard.gang_fit_probe(
+                return _respond(request, {"fits": shard.gang_fit_probe(
                     extender, pod, int(doc["total"])
                 )})
             if op == "prepare":
@@ -164,18 +198,18 @@ def make_worker_app(extender: Extender, clock=None) -> web.Application:
                     {sid: int(v)
                      for sid, v in doc["volumes"].items()},
                 )
-                return web.json_response({"parts": parts})
+                return _respond(request, {"parts": parts})
             key = (doc["namespace"], doc["name"]) \
                 if "namespace" in doc else None
             if op == "drop":
                 extender.gang.drop_reservation(key)
-                return web.json_response({})
+                return _respond(request, {})
             if op == "dissolve":
                 extender.gang.dissolve(key)
-                return web.json_response({})
+                return _respond(request, {})
             if op == "reservation":
                 res = extender.gang.reservation(*key)
-                return web.json_response({"reservation": (
+                return _respond(request, {"reservation": (
                     None if res is None else {
                         "committed": res.committed,
                         "slices": {
@@ -187,15 +221,15 @@ def make_worker_app(extender: Extender, clock=None) -> web.Application:
                 )})
             if op == "sweep":
                 extender.gang.sweep()
-                return web.json_response({})
+                return _respond(request, {})
         except GangError as e:
-            return web.json_response({"error": str(e), "kind": "gang"})
+            return _respond(request, {"error": str(e), "kind": "gang"})
         except StateError as e:
-            return web.json_response({"error": str(e), "kind": "state"})
+            return _respond(request, {"error": str(e), "kind": "state"})
         raise web.HTTPBadRequest(text=f"unknown gang op {op!r}")
 
     async def allocs(request: web.Request) -> web.Response:
-        return web.json_response({"allocs": [
+        return _respond(request, {"allocs": [
             codec.alloc_obj(a) for a in extender.state.allocations()
         ]})
 
@@ -203,10 +237,10 @@ def make_worker_app(extender: Extender, clock=None) -> web.Application:
         # generation-based incremental resync (ISSUE 15): a churn
         # wave's federated read moves O(changed-allocs) bytes per
         # replica instead of the whole ledger
-        doc = await _json(request)
+        doc = await _body(request)
         out = extender.state.allocs_since(doc.get("cursor"))
         if out is None:
-            return web.json_response({"disabled": True})
+            return _respond(request, {"disabled": True})
         wire: dict = {"cursor": list(out["cursor"]),
                       "bytes": out["bytes"]}
         if "full" in out:
@@ -214,7 +248,7 @@ def make_worker_app(extender: Extender, clock=None) -> web.Application:
         else:
             wire["adds"] = [codec.alloc_obj(a) for a in out["adds"]]
             wire["removes"] = out["removes"]
-        return web.json_response(wire)
+        return _respond(request, wire)
 
     async def recover(request: web.Request) -> web.Response:
         # warm restart from this worker's own journal segment,
@@ -223,9 +257,9 @@ def make_worker_app(extender: Extender, clock=None) -> web.Application:
         # router to fall back to the cold re-ingest on a fresh daemon
         from tpukube.sched import journal as journal_mod
 
-        doc = await _json(request)
+        doc = await _body(request)
         if extender.journal is None:
-            return web.json_response(
+            return _respond(request, 
                 {"recover_error": "journal disabled"})
         try:
             stats = journal_mod.recover_extender(
@@ -234,8 +268,8 @@ def make_worker_app(extender: Extender, clock=None) -> web.Application:
                                doc.get("pods") or []),
             )
         except journal_mod.JournalError as e:
-            return web.json_response({"recover_error": str(e)})
-        return web.json_response({
+            return _respond(request, {"recover_error": str(e)})
+        return _respond(request, {
             "stats": stats,
             "restored": len(extender.state.allocations()),
         })
@@ -243,30 +277,30 @@ def make_worker_app(extender: Extender, clock=None) -> web.Application:
     async def alloc_one(request: web.Request) -> web.Response:
         pod = request.query.get("pod", "")
         a = extender.state.allocation(pod)
-        return web.json_response(
+        return _respond(request, 
             {"alloc": codec.alloc_obj(a) if a is not None else None}
         )
 
     async def nodes(request: web.Request) -> web.Response:
-        return web.json_response(
+        return _respond(request, 
             {"names": list(extender.state.node_names())}
         )
 
     async def summary(request: web.Request) -> web.Response:
-        return web.json_response(shard.replica_summary(extender))
+        return _respond(request, shard.replica_summary(extender))
 
     async def emit(request: web.Request) -> web.Response:
-        doc = await _json(request)
+        doc = await _body(request)
         extender.events.emit(
             doc.get("reason", ""), obj=doc.get("obj", ""),
             message=doc.get("message", ""),
             **({"type": doc["type"]} if doc.get("type") else {}),
         )
-        return web.json_response({})
+        return _respond(request, {})
 
     async def rebuild(request: web.Request) -> web.Response:
-        doc = await _json(request)
-        return web.json_response(
+        doc = await _body(request)
+        return _respond(request, 
             {"restored": extender.rebuild_from_pods(doc["pods"])}
         )
 
@@ -278,7 +312,7 @@ def make_worker_app(extender: Extender, clock=None) -> web.Application:
                 out.append(q.popleft())
             except IndexError:
                 break
-        return web.json_response({"pods": out})
+        return _respond(request, {"pods": out})
 
     async def stall(request: web.Request) -> web.Response:
         # test-only: hold this request open for N seconds without
@@ -286,12 +320,12 @@ def make_worker_app(extender: Extender, clock=None) -> web.Application:
         # proof (tests/test_shard_proc.py) measures overlap with it
         import asyncio
 
-        doc = await _json(request)
+        doc = await _body(request)
         await asyncio.sleep(min(float(doc.get("seconds", 0)), 5.0))
-        return web.json_response({})
+        return _respond(request, {})
 
     async def advance(request: web.Request) -> web.Response:
-        doc = await _json(request)
+        doc = await _body(request)
         adv = getattr(clock, "advance", None)
         if adv is None:
             raise web.HTTPBadRequest(
@@ -299,7 +333,7 @@ def make_worker_app(extender: Extender, clock=None) -> web.Application:
                      "--fake-clock to advance simulated time)"
             )
         adv(float(doc["seconds"]))
-        return web.json_response({"now": clock.monotonic()})
+        return _respond(request, {"now": clock.monotonic()})
 
     app.router.add_post("/worker/handle", handle)
     app.router.add_post("/worker/upsert", upsert)

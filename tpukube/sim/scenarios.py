@@ -86,6 +86,12 @@ _PASSTHROUGH_KEYS = (
     "TPUKUBE_AUTOSCALE_ENABLED",
     "TPUKUBE_AUTOSCALE_MIN_SLICES",
     "TPUKUBE_AUTOSCALE_MAX_SLICES",
+    # compact binary wire codec (ISSUE 20): check.sh's codec smoke and
+    # the bench wire comparison re-run sharded drives with the TKW1
+    # codec on asserting bit-identical placements and flooring the
+    # bytes/wave ratio against the JSON oracle
+    "TPUKUBE_WIRE_CODEC",
+    "TPUKUBE_WIRE_COMPRESS_MIN_BYTES",
 )
 
 
@@ -998,6 +1004,16 @@ def _kilonode_drive(cfg: TpuKubeConfig, metric: str, total_target: int,
                 "per_replica": wt["per_replica"],
                 "top_ops": dict(top[:8]),
             }
+            if "codec" in wt:
+                # binary wire codec (ISSUE 20): pre-compression frame
+                # bytes and the resulting on-wire compression ratio —
+                # keys appear only with the codec on, so the default
+                # (json) drive result stays byte-identical
+                result["wire"]["codec"] = wt["codec"]
+                result["wire"]["raw_bytes"] = \
+                    wt["raw_tx"] + wt["raw_rx"]
+                result["wire"]["saved_bytes"] = wt["saved"]
+                result["wire"]["compress_ratio"] = wt["ratio"]
         if ext.decisions is not None:
             # the measured-overhead guard (ISSUE 12): provenance's
             # cumulative record wall as a fraction of the drive wall —
